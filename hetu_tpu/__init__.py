@@ -1,0 +1,38 @@
+"""hetu_tpu: a TPU-native distributed deep-learning framework.
+
+A from-scratch rebuild of the capabilities of Hetu (Hsword/Hetu) designed for
+TPUs: ops lower to XLA HLO / Pallas, collectives run over ICI/DCN device meshes
+via jax.sharding / shard_map, and the parameter-server / embedding tier lives on
+TPU-VM hosts. See SURVEY.md at the repo root for the structural map of the
+reference this build follows (reference: /root/reference, python/hetu/__init__.py:1-15
+for the API surface being matched).
+
+Public surface (mirrors the reference's `import hetu as ht` ergonomics):
+
+    import hetu_tpu as ht
+    ht.ops.*          # functional op library (jnp/lax/Pallas)
+    ht.layers.*       # module system: Linear, Conv2d, MultiHeadAttention, MoE...
+    ht.optim.*        # SGD/Momentum/AdaGrad/Adam/AdamW/AMSGrad/LAMB (+sparse)
+    ht.init.*         # initializers
+    ht.lr.*           # LR schedulers
+    ht.data.*         # dataloaders with dp-rank slicing
+    ht.parallel.*     # mesh, sharding specs, strategies, pipeline, MoE comm
+    ht.rng            # checkpointable (seed, seqnum) RNG
+    ht.Executor       # compiled train/eval executor (graph-level API)
+    ht.gradients      # autodiff entry point
+"""
+
+from hetu_tpu.version import __version__
+from hetu_tpu import rng
+from hetu_tpu import ops
+from hetu_tpu import init
+from hetu_tpu import optim
+from hetu_tpu import lr
+from hetu_tpu import layers
+from hetu_tpu import data
+from hetu_tpu import parallel
+from hetu_tpu.train.executor import Executor, TrainState, gradients
+from hetu_tpu.train import checkpoint
+
+# Convenience re-exports matching the reference's top-level names
+from hetu_tpu.parallel.mesh import make_mesh, local_mesh, MeshConfig

@@ -1,0 +1,2 @@
+from hetu_tpu.data.dataloader import Dataloader
+from hetu_tpu.data import datasets

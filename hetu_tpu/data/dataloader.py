@@ -1,0 +1,95 @@
+"""Batching dataloader with DP-rank and MP-part slicing.
+
+Reference: python/hetu/dataloader.py (376 LoC): `Dataloader` (:125) slices the
+dataset per data-parallel rank (`set_dp_rank`, :202) and per model-parallel
+part (`set_mp_parts`, :210), shuffles with the framework's seeded RNG, and
+feeds numpy/memmap arrays in minibatches.
+
+TPU notes: in single-controller JAX the loader usually yields *global* batches
+that jit shards over the 'dp' mesh axis; `set_dp_rank` exists for the
+multi-host (one-process-per-host) regime where each host loads only its slice
+of the global batch — same contract as the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from hetu_tpu import rng as hrng
+
+
+class Dataloader:
+    def __init__(self, data, batch_size: int, *, shuffle: bool = False,
+                 drop_last: bool = True, dtype=np.float32):
+        """data: one array or a tuple/list of arrays with equal leading dim."""
+        self.arrays = [np.asarray(a) for a in
+                       (data if isinstance(data, (tuple, list)) else [data])]
+        n = self.arrays[0].shape[0]
+        assert all(a.shape[0] == n for a in self.arrays)
+        self.n_total = n
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.dp_rank: Optional[int] = None
+        self.dp_nrank: Optional[int] = None
+        self.parts = None
+        self._single = not isinstance(data, (tuple, list))
+
+    # ---- distributed slicing (reference dataloader.py:202-260) ----
+    def set_dp_rank(self, dp_rank: int, dp_nrank: int):
+        """Keep only this data-parallel rank's shard (contiguous block)."""
+        self.dp_rank, self.dp_nrank = dp_rank, dp_nrank
+
+    def set_mp_parts(self, part_idx, parts):
+        """Model-parallel input splitting (reference :210): `parts` maps
+        dims to split counts, part_idx the index per dim."""
+        self.parts = (part_idx, parts)
+
+    def _local_arrays(self):
+        arrs = self.arrays
+        if self.dp_rank is not None:
+            per = self.n_total // self.dp_nrank
+            lo = self.dp_rank * per
+            hi = lo + per
+            arrs = [a[lo:hi] for a in arrs]
+        if self.parts is not None:
+            part_idx, parts = self.parts
+            out = []
+            for a in arrs:
+                for dim, cnt in parts.items():
+                    size = a.shape[dim] // cnt
+                    idx = [slice(None)] * a.ndim
+                    idx[dim] = slice(part_idx[dim] * size,
+                                     (part_idx[dim] + 1) * size)
+                    a = a[tuple(idx)]
+                out.append(a)
+            arrs = out
+        return arrs
+
+    @property
+    def num_batches(self) -> int:
+        n = self._local_arrays()[0].shape[0]
+        if self.drop_last:
+            return n // self.batch_size
+        return math.ceil(n / self.batch_size)
+
+    # alias matching the reference's get_num_step naming
+    get_batch_num = num_batches
+
+    def __iter__(self):
+        arrs = self._local_arrays()
+        n = arrs[0].shape[0]
+        order = np.arange(n)
+        if self.shuffle:
+            hrng.np_rng().shuffle(order)
+        nb = self.num_batches
+        for b in range(nb):
+            sel = order[b * self.batch_size:(b + 1) * self.batch_size]
+            batch = [a[sel] for a in arrs]
+            yield batch[0] if self._single else tuple(batch)
+
+    def __len__(self):
+        return self.num_batches

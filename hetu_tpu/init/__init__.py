@@ -1,0 +1,97 @@
+"""Initializers.
+
+Reference: python/hetu/initializers.py (Constant/Zeros/Ones/Uniform/Normal/
+TruncatedNormal/Xavier(Glorot)/He variants, 433 LoC).  Functional: each
+initializer is `fn(key, shape, dtype) -> array`, composable with the module
+system; `init_on_ps` semantics (server-side seeded init) are reproduced by the
+PS plane reusing the same functions with the same (seed, seqnum) stream.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def constant(value=0.0):
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+    return init
+
+
+def zeros():
+    return constant(0.0)
+
+
+def ones():
+    return constant(1.0)
+
+
+def uniform(minval=-0.05, maxval=0.05):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, minval, maxval)
+    return init
+
+
+def normal(mean=0.0, stddev=0.05):
+    def init(key, shape, dtype=jnp.float32):
+        return mean + stddev * jax.random.normal(key, shape, dtype)
+    return init
+
+
+def truncated_normal(mean=0.0, stddev=0.05):
+    def init(key, shape, dtype=jnp.float32):
+        return mean + stddev * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape, dtype)
+    return init
+
+
+def _fans(shape):
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    if len(shape) == 4:  # OIHW conv
+        rf = shape[2] * shape[3]
+        return shape[1] * rf, shape[0] * rf
+    fan = int(math.sqrt(math.prod(shape)))
+    return fan, fan
+
+
+def xavier_uniform(gain: float = 1.0):
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        limit = gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+    return init
+
+
+def xavier_normal(gain: float = 1.0):
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        std = gain * math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    return init
+
+
+def he_uniform(gain: float = 1.0):
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        limit = gain * math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+    return init
+
+
+def he_normal(gain: float = 1.0):
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        std = gain * math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    return init
+
+
+# aliases matching the reference's naming
+glorot_uniform = xavier_uniform
+glorot_normal = xavier_normal
+kaiming_uniform = he_uniform
+kaiming_normal = he_normal

@@ -1,0 +1,7 @@
+from hetu_tpu.layers.base import Module, Sequential, Lambda, child_rng
+from hetu_tpu.layers.linear import Linear, Conv2d, Embedding
+from hetu_tpu.layers.norm import BatchNorm, LayerNorm, InstanceNorm2d
+from hetu_tpu.layers.misc import (
+    MaxPool2d, AvgPool2d, Relu, Gelu, Tanh, Sigmoid, DropOut, Flatten,
+)
+from hetu_tpu.layers.attention import MultiHeadAttention

@@ -1,0 +1,59 @@
+"""Multi-head attention layer.
+
+Reference: python/hetu/layers/attention.py (MultiHeadAttention composing
+batch_matmul/softmax ops).  TPU-native: one fused QKV projection (a single
+MXU matmul), `ops.attention` core (or Pallas flash attention for long
+sequences), and Megatron-shardable weight layout — the QKV and output
+projections are the col-/row-split points the MegatronLM strategy uses
+(reference distributed_strategies/simple.py:174-283).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import init as initializers
+from hetu_tpu import ops
+from hetu_tpu.layers.base import Module
+
+
+class MultiHeadAttention(Module):
+    def __init__(self, hidden_size: int, num_heads: int, *,
+                 dropout_rate: float = 0.0, causal: bool = False,
+                 weight_init=None, dtype=jnp.float32):
+        assert hidden_size % num_heads == 0
+        self.hidden_size = hidden_size
+        self.num_heads = num_heads
+        self.head_dim = hidden_size // num_heads
+        self.dropout_rate = dropout_rate
+        self.causal = causal
+        self.weight_init = weight_init or initializers.xavier_uniform()
+        self.dtype = dtype
+
+    def init(self, key):
+        kq, ko = jax.random.split(key)
+        h = self.hidden_size
+        return {"params": {
+            "qkv_weight": self.weight_init(kq, (h, 3 * h), self.dtype),
+            "qkv_bias": jnp.zeros((3 * h,), self.dtype),
+            "out_weight": self.weight_init(ko, (h, h), self.dtype),
+            "out_bias": jnp.zeros((h,), self.dtype),
+        }, "state": {}}
+
+    def apply(self, variables, x, *, mask=None, train: bool = False, rng=None):
+        """x: [batch, seq, hidden]; mask broadcastable to [B,H,S,S] (1=keep)."""
+        p = variables["params"]
+        b, s, h = x.shape
+        qkv = ops.linear(x, p["qkv_weight"], p["qkv_bias"])  # [B,S,3H]
+        qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))  # [B,Hd,S,D]
+        if self.causal:
+            out = ops.causal_attention(q, k, v)
+        else:
+            out = ops.attention(q, k, v, mask=mask)
+        out = jnp.moveaxis(out, 1, 2).reshape(b, s, h)
+        if train and self.dropout_rate > 0.0:
+            out = ops.dropout(out, self.dropout_rate, rng, train=True)
+        y = ops.linear(out, p["out_weight"], p["out_bias"])
+        return y, {}

@@ -1,0 +1,99 @@
+"""Linear and convolution layers.
+
+Reference: python/hetu/layers/{linear.py,conv.py}.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import init as initializers
+from hetu_tpu import ops
+from hetu_tpu.layers.base import Module
+
+
+class Linear(Module):
+    def __init__(self, in_features: int, out_features: int, *,
+                 bias: bool = True, weight_init=None, bias_init=None,
+                 activation=None, dtype=jnp.float32):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+        self.weight_init = weight_init or initializers.xavier_uniform()
+        self.bias_init = bias_init or initializers.zeros()
+        self.activation = activation
+        self.dtype = dtype
+
+    def init(self, key):
+        kw, kb = jax.random.split(key)
+        params = {"weight": self.weight_init(
+            kw, (self.in_features, self.out_features), self.dtype)}
+        if self.use_bias:
+            params["bias"] = self.bias_init(kb, (self.out_features,), self.dtype)
+        return {"params": params, "state": {}}
+
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        p = variables["params"]
+        y = ops.linear(x, p["weight"], p.get("bias"))
+        if self.activation is not None:
+            y = self.activation(y)
+        return y, {}
+
+
+class Conv2d(Module):
+    """NCHW conv layer (reference: layers/conv.py Conv2d)."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, *, bias: bool = True, weight_init=None,
+                 bias_init=None, activation=None, dtype=jnp.float32):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = (kernel_size, kernel_size) if isinstance(
+            kernel_size, int) else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.use_bias = bias
+        self.weight_init = weight_init or initializers.he_normal()
+        self.bias_init = bias_init or initializers.zeros()
+        self.activation = activation
+        self.dtype = dtype
+
+    def init(self, key):
+        kw, kb = jax.random.split(key)
+        w_shape = (self.out_channels, self.in_channels) + self.kernel_size
+        params = {"weight": self.weight_init(kw, w_shape, self.dtype)}
+        if self.use_bias:
+            params["bias"] = self.bias_init(kb, (self.out_channels,), self.dtype)
+        return {"params": params, "state": {}}
+
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        p = variables["params"]
+        if self.use_bias:
+            y = ops.conv2d_add_bias(x, p["weight"], p["bias"],
+                                    stride=self.stride, padding=self.padding)
+        else:
+            y = ops.conv2d(x, p["weight"], stride=self.stride,
+                           padding=self.padding)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y, {}
+
+
+class Embedding(Module):
+    """Dense embedding table (reference: layers/embedding.py)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, *,
+                 weight_init=None, dtype=jnp.float32):
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight_init = weight_init or initializers.normal(stddev=0.01)
+        self.dtype = dtype
+
+    def init(self, key):
+        return {"params": {"weight": self.weight_init(
+            key, (self.num_embeddings, self.embedding_dim), self.dtype)},
+            "state": {}}
+
+    def apply(self, variables, indices, *, train: bool = False, rng=None):
+        return ops.embedding_lookup(variables["params"]["weight"], indices), {}

@@ -1,0 +1,63 @@
+"""Pooling, activation, dropout, reshape layers.
+
+Reference: python/hetu/layers/{pooling.py,activation.py,dropout.py,reshape.py}.
+"""
+
+from __future__ import annotations
+
+from hetu_tpu import ops
+from hetu_tpu.layers.base import Module
+
+
+class MaxPool2d(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        return ops.max_pool2d(x, self.kernel_size, self.stride, self.padding), {}
+
+
+class AvgPool2d(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        return ops.avg_pool2d(x, self.kernel_size, self.stride, self.padding), {}
+
+
+class Relu(Module):
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        return ops.relu(x), {}
+
+
+class Gelu(Module):
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        return ops.gelu(x), {}
+
+
+class Tanh(Module):
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        return ops.tanh(x), {}
+
+
+class Sigmoid(Module):
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        return ops.sigmoid(x), {}
+
+
+class DropOut(Module):
+    """Reference: layers/dropout.py (named DropOut there too)."""
+
+    def __init__(self, rate: float = 0.5):
+        self.rate = rate
+
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        if train and rng is None:
+            raise ValueError("DropOut needs rng in train mode")
+        y = ops.dropout(x, self.rate, rng, train=train)
+        return y, {}
+
+
+class Flatten(Module):
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        return x.reshape(x.shape[0], -1), {}

@@ -1,0 +1,63 @@
+"""Normalization layers with running-stat state.
+
+Reference: python/hetu/layers/normalization.py (BatchNorm/LayerNorm/
+InstanceNorm2d layer wrappers).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import ops
+from hetu_tpu.layers.base import Module
+
+
+class BatchNorm(Module):
+    def __init__(self, num_features: int, momentum: float = 0.1,
+                 eps: float = 1e-5, dtype=jnp.float32):
+        self.num_features = num_features
+        self.momentum = momentum
+        self.eps = eps
+        self.dtype = dtype
+
+    def init(self, key):
+        f = self.num_features
+        return {
+            "params": {"scale": jnp.ones((f,), self.dtype),
+                       "bias": jnp.zeros((f,), self.dtype)},
+            "state": {"mean": jnp.zeros((f,), jnp.float32),
+                      "var": jnp.ones((f,), jnp.float32)},
+        }
+
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        p, s = variables["params"], variables["state"]
+        y, rm, rv = ops.batch_norm(
+            x, p["scale"], p["bias"], s["mean"], s["var"],
+            momentum=self.momentum, eps=self.eps, train=train)
+        return y, {"mean": rm, "var": rv}
+
+
+class LayerNorm(Module):
+    def __init__(self, num_features: int, eps: float = 1e-5, dtype=jnp.float32):
+        self.num_features = num_features
+        self.eps = eps
+        self.dtype = dtype
+
+    def init(self, key):
+        f = self.num_features
+        return {"params": {"scale": jnp.ones((f,), self.dtype),
+                           "bias": jnp.zeros((f,), self.dtype)},
+                "state": {}}
+
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        p = variables["params"]
+        return ops.layer_norm(x, p["scale"], p["bias"], eps=self.eps), {}
+
+
+class InstanceNorm2d(Module):
+    def __init__(self, eps: float = 1e-7):
+        self.eps = eps
+
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        return ops.instance_norm2d(x, eps=self.eps), {}

@@ -1,0 +1,82 @@
+"""LR schedulers.
+
+Reference: python/hetu/lr_scheduler.py (Step/MultiStep/Exponential/Cosine/
+Lambda schedules consumed by optimizer update ops).  Each scheduler is a
+callable step->lr built from jnp ops so it traces into the jitted train step.
+"""
+
+from __future__ import annotations
+
+import math
+from bisect import bisect_right
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def __call__(self, step):
+        raise NotImplementedError
+
+
+class ConstantScheduler(LRScheduler):
+    def __init__(self, lr):
+        self.lr = lr
+
+    def __call__(self, step):
+        return jnp.asarray(self.lr, jnp.float32)
+
+
+class StepScheduler(LRScheduler):
+    """lr * gamma^(step // step_size)."""
+
+    def __init__(self, lr, step_size: int, gamma: float = 0.1):
+        self.lr, self.step_size, self.gamma = lr, step_size, gamma
+
+    def __call__(self, step):
+        e = (step // self.step_size).astype(jnp.float32)
+        return self.lr * self.gamma ** e
+
+
+class MultiStepScheduler(LRScheduler):
+    """lr decayed by gamma at each milestone."""
+
+    def __init__(self, lr, milestones, gamma: float = 0.1):
+        self.lr, self.gamma = lr, gamma
+        self.milestones = jnp.asarray(sorted(milestones))
+
+    def __call__(self, step):
+        n = jnp.sum(step >= self.milestones).astype(jnp.float32)
+        return self.lr * self.gamma ** n
+
+
+class ExponentialScheduler(LRScheduler):
+    def __init__(self, lr, gamma: float = 0.99):
+        self.lr, self.gamma = lr, gamma
+
+    def __call__(self, step):
+        return self.lr * self.gamma ** step.astype(jnp.float32)
+
+
+class CosineScheduler(LRScheduler):
+    """Cosine anneal between lr and min_lr over t_max steps, with optional
+    linear warmup (the BERT recipe in the reference examples)."""
+
+    def __init__(self, lr, t_max: int, min_lr: float = 0.0, warmup: int = 0):
+        self.lr, self.t_max, self.min_lr, self.warmup = lr, t_max, min_lr, warmup
+
+    def __call__(self, step):
+        s = step.astype(jnp.float32)
+        warm = self.lr * s / max(self.warmup, 1)
+        prog = jnp.clip((s - self.warmup) / max(self.t_max - self.warmup, 1),
+                        0.0, 1.0)
+        cos = self.min_lr + 0.5 * (self.lr - self.min_lr) * (
+            1 + jnp.cos(math.pi * prog))
+        return jnp.where(s < self.warmup, warm, cos)
+
+
+class LambdaScheduler(LRScheduler):
+    def __init__(self, lr, fn):
+        self.lr, self.fn = lr, fn
+
+    def __call__(self, step):
+        return self.lr * self.fn(step)

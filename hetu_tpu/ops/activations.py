@@ -1,0 +1,44 @@
+"""Activations.
+
+Reference: python/hetu/gpu_ops/{Relu,LeakyRelu,Gelu,Sigmoid,Tanh,Softmax,
+LogSoftmax}.py (+ src/ops/*.cu).  All fuse into neighbouring HLOs on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def leaky_relu(x, alpha: float = 0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def gelu(x, approximate: bool = True):
+    """tanh-approx GELU by default, matching the reference kernel
+    (src/ops/Gelu.cu uses the tanh approximation)."""
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)

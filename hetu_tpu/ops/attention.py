@@ -1,0 +1,35 @@
+"""Scaled-dot-product attention cores.
+
+The reference has no fused attention op — its MultiHeadAttention layer
+(python/hetu/layers/attention.py) composes batch_matmul/softmax ops.  On TPU
+we provide (a) an XLA composition that the compiler fuses well at moderate
+sequence lengths, and (b) a Pallas flash-attention kernel for long sequences
+(hetu_tpu/ops/pallas_kernels/flash_attention.py), plus ring attention for the
+sequence-parallel axis (hetu_tpu/parallel/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def attention(q, k, v, *, mask=None, scale=None):
+    """q,k,v: [..., heads, seq, head_dim] (or [B,H,S,D]).
+
+    mask: broadcastable to [..., heads, q_len, kv_len]; True/1 = keep.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("...qd,...kd->...qk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask.astype(bool), scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("...qk,...kd->...qd", probs, v)
+
+
+def causal_attention(q, k, v, *, scale=None):
+    s_q, s_k = q.shape[-2], k.shape[-2]
+    mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+    return attention(q, k, v, mask=mask, scale=scale)

@@ -1,0 +1,67 @@
+"""Convolution and pooling.
+
+Reference: python/hetu/gpu_ops/{Conv2d,Conv2dAddBias,MaxPool,AvgPool}.py backed
+by cuDNN (src/ops/Conv2d.cu, CuDNNConv2d*.cu, MaxPool.cu, AvgPool.cu).
+
+TPU notes: convs lower to XLA convolution HLO which maps onto the MXU.  We keep
+the reference's NCHW layout at the API level (its examples are NCHW) but XLA
+picks the best internal layout.  Accumulation is forced to f32 for bf16 inputs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def conv2d(x, w, stride=1, padding=0):
+    """NCHW conv; w is OIHW (gpu_ops/Conv2d.py conv2d_op)."""
+    stride = _pair(stride)
+    padding = _pair(padding)
+    acc = jnp.float32 if x.dtype == jnp.bfloat16 else None
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=stride,
+        padding=[(padding[0], padding[0]), (padding[1], padding[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=acc,
+    )
+
+
+def conv2d_add_bias(x, w, bias, stride=1, padding=0):
+    """Fused conv+bias (gpu_ops/Conv2dAddBias.py); XLA fuses the add."""
+    y = conv2d(x, w, stride=stride, padding=padding)
+    return y + bias.reshape(1, -1, 1, 1)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0):
+    """NCHW max pool (gpu_ops/MaxPool.py)."""
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    p = _pair(padding)
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1, 1, k[0], k[1]),
+        window_strides=(1, 1, s[0], s[1]),
+        padding=((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+    )
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0):
+    """NCHW average pool (gpu_ops/AvgPool.py); count includes padding to match
+    the reference kernel's `/ (kernel_H*kernel_W)` (src/ops/AvgPool.cu)."""
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    p = _pair(padding)
+    summed = lax.reduce_window(
+        x, jnp.asarray(0, x.dtype), lax.add,
+        window_dimensions=(1, 1, k[0], k[1]),
+        window_strides=(1, 1, s[0], s[1]),
+        padding=((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+    )
+    return summed / (k[0] * k[1])

@@ -1,0 +1,19 @@
+"""Dropout.
+
+Reference: python/hetu/gpu_ops/Dropout.py (+ cuDNN dropout in src/ops).
+Functional: the PRNG key is explicit, which is what makes it reproducible
+under jit — the TPU-native version of the reference's (seed, seqnum) scheme.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dropout(x, rate: float, key, *, train: bool = True):
+    if not train or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)

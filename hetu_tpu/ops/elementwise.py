@@ -1,0 +1,131 @@
+"""Elementwise ops.
+
+Reference: python/hetu/gpu_ops/{Abs,AddElewise,AddConst,MinusElewise,
+MinusByConst,MultiplyElewise,MultiplyConst,Division,Opposite,Exp,Log,Pow,Sqrt,
+Sine,Floor,Clamp,Sign,Bool,Where,MaskedFill,Mask}.py and the matching CUDA
+kernels in src/ops/.  On TPU each is a single XLA elementwise HLO that fuses
+into neighbouring ops, so these wrappers exist for API parity and for the
+broadcasting semantics the reference guarantees (BroadcastShape insertion,
+gpu_ops/AddElewise.py gradient broadcast handling).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def abs_(x):
+    return jnp.abs(x)
+
+
+def add(a, b):
+    return jnp.add(a, b)
+
+
+def add_const(x, c):
+    return x + c
+
+
+def minus(a, b):
+    return jnp.subtract(a, b)
+
+
+def minus_const(x, c):
+    return x - c
+
+
+def const_minus(c, x):
+    return c - x
+
+
+def multiply(a, b):
+    return jnp.multiply(a, b)
+
+
+def mul_const(x, c):
+    return x * c
+
+
+def divide(a, b):
+    return jnp.divide(a, b)
+
+
+def div_const(x, c):
+    return x / c
+
+
+def const_div(c, x):
+    return c / x
+
+
+def opposite(x):
+    return jnp.negative(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def pow_(a, b):
+    return jnp.power(a, b)
+
+
+def const_pow(c, x):
+    return jnp.power(c, x)
+
+
+def power(x, p):
+    return jnp.power(x, p)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def clamp(x, min=None, max=None):  # noqa: A002 - mirror reference arg names
+    return jnp.clip(x, min, max)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def bool_(x):
+    return (x != 0).astype(jnp.float32)
+
+
+def where(cond, a, b):
+    return jnp.where(cond, a, b)
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask.astype(bool), jnp.asarray(value, x.dtype), x)
+
+
+def mask(x, mask):  # noqa: A002
+    return x * mask.astype(x.dtype)

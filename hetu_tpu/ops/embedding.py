@@ -1,0 +1,127 @@
+"""Embedding lookup and sparse-gradient machinery.
+
+Reference: python/hetu/gpu_ops/{EmbeddingLookUp,SparseEmbeddingLookUp,
+AssignWithIndexedSlices,SumSparseGradient}.py, ndarray.py:680 (IndexedSlices),
+src/ops/EmbeddingLookUp.cu (gather + IndexedSlices grad reduction).
+
+TPU design: dense lookup is a gather XLA handles well.  For the parameter-
+server / embedding-cache plane (HET, SURVEY.md §2.2) gradients must stay in
+(indices, values) form instead of densifying to the full table — that is what
+`IndexedSlices` + `take_grad_indexed` provide; the PS client ships them to the
+host-side store without materializing a table-sized buffer in HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class IndexedSlices:
+    """Sparse gradient: values[i] is the grad row for table row indices[i].
+
+    Reference analog: python/hetu/ndarray.py:680.  `deduplicate` merges
+    repeated indices by summation (ndarray.py IndexedSlices.deduplicate).
+    """
+
+    indices: jax.Array  # [n]
+    values: jax.Array   # [n, dim]
+    dense_shape: tuple  # (num_rows, dim)
+
+    def tree_flatten(self):
+        return (self.indices, self.values), self.dense_shape
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    def deduplicate(self):
+        """Merge duplicate indices by summing their value rows.
+
+        Static-shape friendly: output keeps the same length; duplicate slots
+        beyond the first occurrence get index=-1 (ignored by appliers).
+        """
+        idx = self.indices.astype(jnp.int32)
+        n = idx.shape[0]
+        order = jnp.argsort(idx)
+        sidx = idx[order]
+        svals = self.values[order]
+        # first occurrence mask in sorted order
+        first = jnp.concatenate([jnp.array([True]), sidx[1:] != sidx[:-1]])
+        # segment ids: which output slot each sorted row sums into
+        seg = jnp.cumsum(first) - 1
+        summed = jax.ops.segment_sum(svals, seg, num_segments=n)
+        uniq = jnp.where(first, sidx, -1)
+        # compact unique indices to the front in sorted order
+        out_idx = jax.ops.segment_max(jnp.where(first, sidx, -1), seg,
+                                      num_segments=n)
+        return IndexedSlices(out_idx, summed, self.dense_shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        valid = self.indices >= 0
+        safe = jnp.where(valid, self.indices, 0).astype(jnp.int32)
+        vals = jnp.where(valid[:, None], self.values, 0)
+        return out.at[safe].add(vals)
+
+
+def embedding_lookup(table, indices):
+    """Dense embedding gather (gpu_ops/EmbeddingLookUp.py embedding_lookup_op).
+
+    Out-of-range indices return zero rows, matching the reference kernel's
+    bounds check (src/ops/EmbeddingLookUp.cu).
+    """
+    idx = indices.astype(jnp.int32)
+    in_range = (idx >= 0) & (idx < table.shape[0])
+    safe = jnp.where(in_range, idx, 0)
+    rows = jnp.take(table, safe, axis=0)
+    return jnp.where(in_range[..., None], rows, 0)
+
+
+def sparse_embedding_lookup(table, indices):
+    """Lookup used on the PS/Hybrid path (gpu_ops/ParameterServerCommunicate.py).
+
+    Identical forward to `embedding_lookup`; the sparse gradient is produced
+    explicitly with `take_grad_indexed` on the *output* cotangent (the table
+    is a non-differentiated argument on the PS path — in the reference the
+    embedding rows live on the servers, and workers push IndexedSlices).
+    A JAX `custom_vjp` cannot return an IndexedSlices cotangent for an array
+    primal (pytree-structure mismatch), hence the explicit routing.
+    """
+    return embedding_lookup(table, indices)
+
+
+def take_grad_indexed(indices, grad_out, num_rows: int):
+    """Build an IndexedSlices grad from lookup output grads.
+
+    Mirrors the reference's EmbeddingLookUp gradient which emits IndexedSlices
+    consumed by sparse-optimizer kernels / PS push.
+    """
+    flat_idx = indices.reshape(-1).astype(jnp.int32)
+    flat_g = grad_out.reshape(-1, grad_out.shape[-1])
+    return IndexedSlices(flat_idx, flat_g, (num_rows, grad_out.shape[-1]))
+
+
+def sum_sparse_gradient(*slices_list):
+    """Sum several IndexedSlices into one (gpu_ops/SumSparseGradient.py)."""
+    idx = jnp.concatenate([s.indices for s in slices_list])
+    vals = jnp.concatenate([s.values for s in slices_list])
+    return IndexedSlices(idx, vals, slices_list[0].dense_shape)
+
+
+def assign_with_indexed_slices(table, slices: IndexedSlices, *,
+                               add: bool = False):
+    """Write sparse rows into a table (gpu_ops/AssignWithIndexedSlices.py)."""
+    valid = slices.indices >= 0
+    safe = jnp.where(valid, slices.indices, 0).astype(jnp.int32)
+    vals = jnp.where(valid[:, None], slices.values, 0).astype(table.dtype)
+    if add:
+        return table.at[safe].add(vals)
+    # for set, invalid rows must write back the existing value
+    cur = table[safe]
+    vals = jnp.where(valid[:, None], slices.values.astype(table.dtype), cur)
+    return table.at[safe].set(vals)

@@ -1,0 +1,60 @@
+"""Loss ops.
+
+Reference: python/hetu/gpu_ops/{BinaryCrossEntropy,BinaryCrossEntropyWithLogits,
+CrossEntropy,CrossEntropySparse,SoftmaxCrossEntropy,SoftmaxCrossEntropySparse,
+NllLoss}.py.  Shapes follow the reference: losses are per-sample (no implicit
+mean) unless reduced by the caller, matching the reference ops which return
+per-example losses consumed by reduce_mean in examples.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def binary_cross_entropy(pred, label, eps: float = 1e-12):
+    """-(y log p + (1-y) log(1-p)) per element (gpu_ops/BinaryCrossEntropy.py)."""
+    pred = jnp.clip(pred, eps, 1 - eps)
+    return -(label * jnp.log(pred) + (1 - label) * jnp.log(1 - pred))
+
+
+def binary_cross_entropy_with_logits(logits, label):
+    """Numerically-stable BCE on logits (gpu_ops/BinaryCrossEntropyWithLogits.py)."""
+    # max(x,0) - x*y + log(1 + exp(-|x|))
+    return jnp.maximum(logits, 0) - logits * label + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))
+
+
+def cross_entropy(pred, label, eps: float = 1e-12):
+    """-sum(y * log p) over last axis; pred is a probability distribution
+    (gpu_ops/CrossEntropy.py)."""
+    return -jnp.sum(label * jnp.log(jnp.clip(pred, eps, None)), axis=-1)
+
+
+def cross_entropy_sparse(pred, label, ignored_index: int = -1,
+                         eps: float = 1e-12):
+    """Sparse-label variant (gpu_ops/CrossEntropySparse.py) with ignored index."""
+    p = jnp.take_along_axis(pred, label[..., None].astype(jnp.int32), axis=-1)
+    loss = -jnp.log(jnp.clip(p[..., 0], eps, None))
+    return jnp.where(label == ignored_index, 0.0, loss)
+
+
+def softmax_cross_entropy(logits, label):
+    """Fused softmax+CE on one-hot/soft labels (gpu_ops/SoftmaxCrossEntropy.py)."""
+    return -jnp.sum(label * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+
+
+def softmax_cross_entropy_sparse(logits, label, ignored_index: int = -1):
+    """Fused softmax+CE on integer labels (gpu_ops/SoftmaxCrossEntropySparse.py)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, jnp.maximum(label, 0)[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return jnp.where(label == ignored_index, 0.0, -picked)
+
+
+def nll_loss(logp, label):
+    """Negative log-likelihood on log-probabilities (gpu_ops/NllLoss.py)."""
+    picked = jnp.take_along_axis(
+        logp, label[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return -picked

@@ -1,0 +1,66 @@
+"""Matmul-family ops — the MXU workhorses.
+
+Reference: python/hetu/gpu_ops/{MatrixMult,Linear,BatchMatrixMult,Addmm,
+Baddbmm,MatrixDot}.py dispatching to cuBLAS (src/ops/MatrixMult.cu).
+
+TPU notes: all of these lower to dot_general, which XLA tiles onto the
+128x128 MXU.  We default accumulation to float32 (preferred_element_type)
+so bfloat16 inputs keep full-precision accumulation — the TPU-native analog
+of cuBLAS's default compute type.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _acc_dtype(a, b):
+    # bf16 x bf16 accumulates in f32 on the MXU; keep f32 outputs for parity
+    # with the reference's fp32 kernels unless both inputs are low precision.
+    if a.dtype == jnp.bfloat16 or b.dtype == jnp.bfloat16:
+        return jnp.float32
+    return None
+
+
+def matmul(a, b, trans_a: bool = False, trans_b: bool = False):
+    """2-D matmul with transpose flags (gpu_ops/MatrixMult.py matmul_op)."""
+    if trans_a:
+        a = a.T
+    if trans_b:
+        b = b.T
+    return jnp.matmul(a, b, preferred_element_type=_acc_dtype(a, b))
+
+
+def linear(x, w, bias=None, trans_w: bool = False):
+    """x @ w (+ bias) — gpu_ops/Linear.py."""
+    if trans_w:
+        w = w.T
+    y = jnp.matmul(x, w, preferred_element_type=_acc_dtype(x, w))
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def batch_matmul(a, b, trans_a: bool = False, trans_b: bool = False):
+    """Batched matmul (gpu_ops/BatchMatrixMult.py)."""
+    if trans_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if trans_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b, preferred_element_type=_acc_dtype(a, b))
+
+
+def addmm(input_, a, b, alpha: float = 1.0, beta: float = 1.0):
+    """beta*input + alpha*(a @ b) — gpu_ops/Addmm.py."""
+    return beta * input_ + alpha * jnp.matmul(a, b)
+
+
+def baddbmm(input_, a, b, alpha: float = 1.0, beta: float = 1.0):
+    """Batched addmm — gpu_ops/Baddbmm.py."""
+    return beta * input_ + alpha * jnp.matmul(a, b)
+
+
+def matrix_dot(a, b):
+    """Elementwise product then row-sum (gpu_ops/MatrixDot.py)."""
+    return jnp.sum(a * b, axis=-1)

@@ -1,0 +1,86 @@
+"""MoE dispatch/combine primitives.
+
+Reference: python/hetu/gpu_ops/{Dispatch,LayoutTransform,ReverseLayoutTransform,
+TopKIdx,GroupTopKIdx,BalanceAssignment,MinDist,Sample}.py and the CUDA layout
+kernels; assembled by layers/moe_layer.py in the reference.
+
+TPU design (GShard-style): instead of the reference's scatter/gather layout
+kernels we build one-hot *dispatch* and *combine* tensors so the whole
+token->expert permutation is two einsums — dense MXU work that XLA overlaps
+with the expert all_to_all.  Capacity is static (required by XLA); overflow
+tokens are dropped exactly like the reference's capacity_factor path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top_k_idx_gate(logits, k: int):
+    """Top-k expert selection (gpu_ops/TopKIdx.py).
+
+    Returns (gate_weights [tokens,k] softmaxed over the chosen k, idx [tokens,k]).
+    """
+    vals, idx = lax.top_k(logits, k)
+    gates = jax.nn.softmax(vals, axis=-1)
+    return gates, idx
+
+
+def make_dispatch_combine(gates, expert_idx, num_experts: int, capacity: int):
+    """Build dispatch/combine tensors from top-k gate decisions.
+
+    gates: [T, k] combine weights; expert_idx: [T, k] chosen experts.
+    Returns:
+      dispatch [T, E, C] bool — token t goes to slot c of expert e
+      combine  [T, E, C] float — dispatch weighted by gate prob
+    Equivalent of the reference's layout_transform_op index computation
+    (src/ops/LayoutTransform.cu) but as dense masks for the MXU.
+    """
+    T, k = gates.shape
+    # position of each (token, choice) within its expert's queue
+    oh = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)  # [T,k,E]
+    # priority: earlier tokens and lower choice index first (matches the
+    # reference's in-order capacity assignment)
+    flat = oh.reshape(T * k, num_experts)
+    pos_in_expert = jnp.cumsum(flat, axis=0) - flat          # [T*k, E]
+    pos = jnp.sum(pos_in_expert.reshape(T, k, num_experts) * oh, axis=-1)  # [T,k]
+    within_cap = pos < capacity
+    slot_oh = jax.nn.one_hot(jnp.where(within_cap, pos, capacity),
+                             capacity + 1, dtype=gates.dtype)[..., :capacity]
+    disp = jnp.einsum("tke,tkc->tec", oh.astype(gates.dtype), slot_oh)
+    comb = jnp.einsum("tk,tke,tkc->tec", gates, oh.astype(gates.dtype), slot_oh)
+    return disp, comb
+
+
+def layout_transform(tokens, dispatch):
+    """Pack tokens into [E, C, D] expert-major layout (gpu_ops/LayoutTransform.py)."""
+    return jnp.einsum("td,tec->ecd", tokens, dispatch)
+
+
+def reverse_layout_transform(expert_out, combine):
+    """Un-pack expert outputs back to token order, gate-weighted
+    (gpu_ops/ReverseLayoutTransform.py)."""
+    return jnp.einsum("ecd,tec->td", expert_out, combine)
+
+
+def balance_assignment(scores, *, iters: int = 20):
+    """Balanced token->expert assignment via Sinkhorn iteration.
+
+    Reference: gpu_ops/BalanceAssignment.py implements the BASE layer's
+    auction algorithm (Lewis et al.).  Auctions are sequential and hostile to
+    XLA; Sinkhorn normalization achieves the same balanced doubly-stochastic
+    assignment with fixed iteration count (the standard TPU reformulation).
+    scores: [T, E] affinities. Returns expert index per token [T].
+    """
+    T, E = scores.shape
+    logp = scores - jnp.max(scores, axis=-1, keepdims=True)
+
+    def body(_, lp):
+        lp = lp - jax.nn.logsumexp(lp, axis=0, keepdims=True)
+        lp = lp - jax.nn.logsumexp(lp, axis=1, keepdims=True)
+        return lp
+
+    lp = lax.fori_loop(0, iters, body, logp)
+    return jnp.argmax(lp, axis=-1)

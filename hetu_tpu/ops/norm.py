@@ -1,0 +1,51 @@
+"""Normalization ops.
+
+Reference: python/hetu/gpu_ops/{BatchNorm,LayerNorm,InstanceNorm2d}.py backed by
+cuDNN BN and hand-written LN kernels (src/ops/{BatchNorm,LayerNorm,InstanceNorm2d}.cu).
+
+These are the functional cores; the stateful running-stat handling lives in
+hetu_tpu/layers/norm.py.  XLA fuses the whole normalize-scale-shift chain, so
+no custom kernels are needed (the reductions are fast on VPU).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def batch_norm(x, scale, bias, running_mean, running_var, *,
+               momentum: float = 0.1, eps: float = 1e-5, train: bool = True):
+    """NCHW batch norm (gpu_ops/BatchNorm.py batch_normalization_op).
+
+    Returns (y, new_running_mean, new_running_var).  `momentum` follows the
+    reference/cuDNN convention: new = (1-momentum)*running + momentum*batch.
+    """
+    if train:
+        axes = (0, 2, 3) if x.ndim == 4 else (0,)
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_rm = (1 - momentum) * running_mean + momentum * mean
+        new_rv = (1 - momentum) * running_var + momentum * var
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+    shape = (1, -1, 1, 1) if x.ndim == 4 else (1, -1)
+    y = (x - mean.reshape(shape)) * jnp.reciprocal(
+        jnp.sqrt(var.reshape(shape) + eps))
+    y = y * scale.reshape(shape) + bias.reshape(shape)
+    return y, new_rm, new_rv
+
+
+def layer_norm(x, scale, bias, *, eps: float = 1e-5, axis: int = -1):
+    """Layer norm over the trailing axis (gpu_ops/LayerNorm.py)."""
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    y = (x - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    return y * scale + bias
+
+
+def instance_norm2d(x, *, eps: float = 1e-7):
+    """Per-sample per-channel norm over H,W (gpu_ops/InstanceNorm2d.py)."""
+    mean = jnp.mean(x, axis=(2, 3), keepdims=True)
+    var = jnp.var(x, axis=(2, 3), keepdims=True)
+    return (x - mean) * jnp.reciprocal(jnp.sqrt(var + eps))

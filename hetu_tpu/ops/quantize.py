@@ -1,0 +1,60 @@
+"""Quantization / pruning ops used by the embedding-compression stack.
+
+Reference: python/hetu/gpu_ops/{Quantize,QuantizeEmbedding,QuantizeALPTEmb,
+Prune,ParamClip}.py and src/ops/Quantize.cu; consumed by the
+EmbeddingMemoryCompression tool (SURVEY.md §2.4).
+
+TPU notes: int8 storage with scale/zero-point; dequantize fuses into the
+consuming matmul/gather.  Stochastic rounding uses an explicit PRNG key.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize(x, bits: int = 8, *, scale=None, zero_point=0.0, key=None):
+    """Uniform quantization to `bits` (signed). Returns (q, scale).
+
+    With `key` given, uses stochastic rounding (the reference's ALPT path).
+    """
+    qmax = 2 ** (bits - 1) - 1
+    if scale is None:
+        scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / qmax
+    scaled = (x - zero_point) / scale
+    if key is not None:
+        noise = jax.random.uniform(key, x.shape) - 0.5
+        scaled = scaled + noise
+    q = jnp.clip(jnp.round(scaled), -qmax - 1, qmax).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(q, scale, zero_point=0.0, dtype=jnp.float32):
+    return q.astype(dtype) * scale + zero_point
+
+
+def quantize_embedding_lookup(q_table, scale, indices, zero_point=0.0,
+                              dtype=jnp.float32):
+    """Gather from an int8 table then dequantize (gpu_ops/QuantizeEmbedding.py);
+    XLA fuses the dequant into the gather consumer."""
+    rows = jnp.take(q_table, indices.astype(jnp.int32), axis=0)
+    if jnp.ndim(scale) > 0:  # per-row scale
+        s = jnp.take(scale, indices.astype(jnp.int32), axis=0)[..., None]
+    else:
+        s = scale
+    return rows.astype(dtype) * s + zero_point
+
+
+def prune_low_magnitude(x, rate: float):
+    """Zero the smallest-|x| fraction `rate` (gpu_ops/Prune.py, DeepLight)."""
+    k = int(x.size * (1.0 - rate))
+    if k <= 0:
+        return jnp.zeros_like(x)
+    thresh = jax.lax.top_k(jnp.abs(x).reshape(-1), k)[0][-1]
+    return jnp.where(jnp.abs(x) >= thresh, x, 0)
+
+
+def param_clip(x, min_val, max_val):
+    """gpu_ops/ParamClip.py."""
+    return jnp.clip(x, min_val, max_val)

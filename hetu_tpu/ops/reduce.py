@@ -1,0 +1,126 @@
+"""Reductions, sorting, top-k, unique.
+
+Reference: python/hetu/gpu_ops/{ReduceSum,ReduceMean,ReduceMin,ReduceMul,
+ReduceNorm1,ReduceNorm2,ReduceSumAxisZero,Norm,Max,Min,Argmax,Argsort,
+TopKIdx,TopKVal,Unique,SamGroupSum,SamMax}.py.
+
+TPU notes: top-k uses lax.top_k (XLA sort-based, efficient on VPU);
+`unique` is reformulated to a fixed-output-size form (size param) because XLA
+needs static shapes — callers pass the worst-case size, matching how the
+reference's MoE/embedding paths bound their outputs anyway.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def reduce_sum(x, axes=None, keepdims: bool = False):
+    return jnp.sum(x, axis=_ax(axes), keepdims=keepdims)
+
+
+def reduce_mean(x, axes=None, keepdims: bool = False):
+    return jnp.mean(x, axis=_ax(axes), keepdims=keepdims)
+
+
+def reduce_min(x, axes=None, keepdims: bool = False):
+    return jnp.min(x, axis=_ax(axes), keepdims=keepdims)
+
+
+def reduce_max(x, axes=None, keepdims: bool = False):
+    return jnp.max(x, axis=_ax(axes), keepdims=keepdims)
+
+
+def reduce_mul(x, axes=None, keepdims: bool = False):
+    return jnp.prod(x, axis=_ax(axes), keepdims=keepdims)
+
+
+def reduce_norm1(x, axes=None, keepdims: bool = False):
+    return jnp.sum(jnp.abs(x), axis=_ax(axes), keepdims=keepdims)
+
+
+def reduce_norm2(x, axes=None, keepdims: bool = False):
+    return jnp.sqrt(jnp.sum(x * x, axis=_ax(axes), keepdims=keepdims))
+
+
+def reduce_sum_axis_zero(x):
+    """Reference's dedicated axis-0 sum used for grad accumulation
+    (gpu_ops/ReduceSumAxisZero.py)."""
+    return jnp.sum(x, axis=0)
+
+
+def _ax(axes):
+    if axes is None:
+        return None
+    if isinstance(axes, int):
+        return axes
+    return tuple(axes)
+
+
+def norm(x, ord: int = 2):  # noqa: A002
+    """Whole-tensor p-norm (gpu_ops/Norm.py)."""
+    if ord == 1:
+        return jnp.sum(jnp.abs(x))
+    if ord == 2:
+        return jnp.sqrt(jnp.sum(x * x))
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), ord)), 1.0 / ord)
+
+
+def max_(x, axis=None, keepdims: bool = False):
+    return jnp.max(x, axis=axis, keepdims=keepdims)
+
+
+def min_(x, axis=None, keepdims: bool = False):
+    return jnp.min(x, axis=axis, keepdims=keepdims)
+
+
+def argmax(x, axis: int = -1):
+    return jnp.argmax(x, axis=axis)
+
+
+def argsort(x, axis: int = -1, descending: bool = False):
+    s = jnp.argsort(x, axis=axis)
+    return jnp.flip(s, axis=axis) if descending else s
+
+
+def topk(x, k: int, axis: int = -1):
+    """Return (values, indices) of the top-k along axis (largest first)."""
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+        v, i = lax.top_k(x, k)
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+    return lax.top_k(x, k)
+
+
+def topk_idx(x, k: int, axis: int = -1):
+    """gpu_ops/TopKIdx.py."""
+    return topk(x, k, axis)[1]
+
+
+def topk_val(x, k: int, axis: int = -1):
+    """gpu_ops/TopKVal.py."""
+    return topk(x, k, axis)[0]
+
+
+def unique(x, size: int, fill_value=0):
+    """Static-size unique (gpu_ops/Unique.py / src/ops/Unique.cu).
+
+    XLA needs static shapes, so callers give the max number of uniques
+    (`size`); surplus slots hold `fill_value`.  Returns (uniques, inverse).
+    """
+    return jnp.unique(x, size=size, fill_value=fill_value,
+                      return_inverse=True)[:2]
+
+
+def sam_group_sum(x, group_idx, num_groups: int):
+    """Segment-sum used by the SAM MoE gate (gpu_ops/SamGroupSum.py)."""
+    return jax.ops.segment_sum(x, group_idx.astype(jnp.int32),
+                               num_segments=num_groups)
+
+
+def sam_max(x, group_idx, num_groups: int):
+    """Segment-max used by the SAM MoE gate (gpu_ops/SamMax.py)."""
+    return jax.ops.segment_max(x, group_idx.astype(jnp.int32),
+                               num_segments=num_groups)

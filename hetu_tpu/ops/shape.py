@@ -1,0 +1,174 @@
+"""Shape / indexing / layout ops.
+
+Reference: python/hetu/gpu_ops/{Reshape,Transpose,Concat,Concatenate,Split,
+Slice,SliceAssign,SliceByMatrix,Pad,Tile,Repeat,Roll,BroadcastShape,Broadcast,
+Gather,Scatter,Scatter1D,Indexing,OneHot,Where,Arange,Full,OnesLike,ZerosLike,
+CumSum,Interpolate,TrilLookup}.py.  All are data-movement HLOs XLA handles
+natively; static shapes keep everything jit-compatible.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def transpose(x, perm=None):
+    return jnp.transpose(x, perm)
+
+
+def concat(a, b, axis: int = 0):
+    """Two-input concat (gpu_ops/Concat.py)."""
+    return jnp.concatenate([a, b], axis=axis)
+
+
+def concatenate(arrays, axis: int = 0):
+    """N-input concat (gpu_ops/Concatenate.py)."""
+    return jnp.concatenate(arrays, axis=axis)
+
+
+def split(x, n_or_indices, axis: int = 0):
+    return jnp.split(x, n_or_indices, axis=axis)
+
+
+def slice_(x, begin, size):
+    """Static slice by (begin, size) (gpu_ops/Slice.py slice_op)."""
+    return lax.slice(x, begin, [b + s for b, s in zip(begin, size)])
+
+
+def slice_assign(x, y, begin):
+    """Write y into x at offset `begin` (gpu_ops/SliceAssign.py)."""
+    return lax.dynamic_update_slice(x, y.astype(x.dtype), tuple(begin))
+
+
+def slice_by_matrix(x, idx_a, idx_b):
+    """x[idx_a, idx_b] pairwise gather (gpu_ops/SliceByMatrix.py)."""
+    return x[idx_a.astype(jnp.int32), idx_b.astype(jnp.int32)]
+
+
+def pad(x, paddings, mode: str = "constant", constant_values=0):
+    return jnp.pad(x, paddings, mode=mode,
+                   **({"constant_values": constant_values}
+                      if mode == "constant" else {}))
+
+
+def tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+def repeat(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def roll(x, shift, axis=None):
+    return jnp.roll(x, shift, axis=axis)
+
+
+def broadcast_shape(x, shape):
+    """Broadcast to target shape (gpu_ops/BroadcastShape.py)."""
+    return jnp.broadcast_to(x, shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def gather(x, indices, axis: int = 0):
+    """Index-select along axis (gpu_ops/Gather.py)."""
+    return jnp.take(x, indices.astype(jnp.int32), axis=axis)
+
+
+def gather_elements(x, indices, axis: int = -1):
+    """torch.gather-style elementwise gather."""
+    return jnp.take_along_axis(x, indices.astype(jnp.int32), axis=axis)
+
+
+def scatter(x, indices, updates, axis: int = -1):
+    """take_along_axis inverse: write updates at indices along axis
+    (gpu_ops/Scatter.py)."""
+    return _put_along_axis(x, indices.astype(jnp.int32), updates, axis)
+
+
+def _put_along_axis(x, indices, updates, axis):
+    # jnp.put_along_axis exists in newer jax; implement via scatter for safety.
+    x = jnp.asarray(x)
+    idx = jnp.meshgrid(*[jnp.arange(s) for s in indices.shape], indexing="ij")
+    idx[axis if axis >= 0 else x.ndim + axis] = indices
+    return x.at[tuple(idx)].set(updates.astype(x.dtype))
+
+
+def scatter1d(x, indices, updates):
+    """1-D scatter set (gpu_ops/Scatter1D.py)."""
+    x = jnp.asarray(x)
+    return x.at[indices.astype(jnp.int32)].set(updates.astype(x.dtype))
+
+
+def indexing(x, indices):
+    """Row indexing (gpu_ops/Indexing.py)."""
+    return x[indices.astype(jnp.int32)]
+
+
+def one_hot(x, num_classes: int, dtype=jnp.float32):
+    return jax.nn.one_hot(x, num_classes, dtype=dtype)
+
+
+def arange(start, stop=None, step=1, dtype=None):
+    return jnp.arange(start, stop, step, dtype=dtype)
+
+
+def full(shape, fill_value, dtype=None):
+    return jnp.full(shape, fill_value, dtype=dtype)
+
+
+def full_like(x, fill_value):
+    return jnp.full_like(x, fill_value)
+
+
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+def cumsum(x, axis: int = -1):
+    return jnp.cumsum(x, axis=axis)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis)
+
+
+def interpolate(x, size=None, scale_factor=None, mode: str = "bilinear",
+                align_corners: bool = False):
+    """NCHW spatial resize (gpu_ops/Interpolate.py, bilinear like the
+    reference's Interpolate.cu)."""
+    n, c, h, w = x.shape
+    if size is None:
+        size = (int(h * scale_factor), int(w * scale_factor))
+    method = {"bilinear": "linear", "nearest": "nearest"}[mode]
+    # jax.image.resize expects full output shape
+    out = jax.image.resize(x, (n, c, size[0], size[1]), method=method)
+    return out
+
+
+def tril_lookup(x, offset: int = 0):
+    """Pack the lower triangle of the last two dims into a vector
+    (gpu_ops/TrilLookup.py)."""
+    h, w = x.shape[-2], x.shape[-1]
+    rows, cols = jnp.tril_indices(h, k=offset, m=w)
+    return x[..., rows, cols]
+
+
+def tril(x, k: int = 0):
+    return jnp.tril(x, k)
+
+
+def triu(x, k: int = 0):
+    return jnp.triu(x, k)

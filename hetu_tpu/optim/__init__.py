@@ -1,0 +1,5 @@
+from hetu_tpu.optim.optimizer import (
+    Optimizer, SGDOptimizer, MomentumOptimizer, NesterovOptimizer,
+    AdaGradOptimizer, AdamOptimizer, AMSGradOptimizer, AdamWOptimizer,
+    LambOptimizer,
+)

@@ -1,0 +1,251 @@
+"""Optimizers.
+
+Reference: python/hetu/optimizer.py (742 LoC): SGD(:255), Momentum/Nesterov
+(:324), AdaGrad(:418), Adam(:610), AMSGrad(:624), AdamW(:671), LAMB(:730),
+each with dense + sparse (IndexedSlices) update kernels in src/ops/Optimizer*.cu,
+plus l2-regularization folded into the update.
+
+TPU design: purely functional `init_state / update` over parameter pytrees —
+the whole update is one fused XLA kernel per parameter, and under DP sharding
+XLA applies the update shard-wise (automatic ZeRO-style sharded weight update
+when params are sharded).  Sparse updates (`update_indexed`) take
+IndexedSlices so embedding tables update only touched rows — the building
+block the PS plane's server-side optimizers reuse.
+
+The reference's `minimize(loss)` (optimizer.py:66) builds grads + an
+OptimizerOp; here `Executor`/`TrainState` own that composition (jax.grad +
+optimizer.update) — see hetu_tpu/train/executor.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.ops.embedding import IndexedSlices
+
+Schedule = Union[float, Callable[[jax.Array], jax.Array]]
+
+
+def _lr_at(lr: Schedule, step):
+    return lr(step) if callable(lr) else lr
+
+
+class Optimizer:
+    """Base optimizer: stateless object + pytree state.
+
+    state = {"step": int32, "slots": {slot_name: pytree like params}}
+    """
+
+    slot_names: tuple = ()
+
+    def __init__(self, learning_rate: Schedule = 0.01, l2reg: float = 0.0):
+        self.learning_rate = learning_rate
+        self.l2reg = l2reg
+
+    # ---- dense path ----
+    def init_state(self, params) -> dict:
+        slots = {name: jax.tree_util.tree_map(jnp.zeros_like, params)
+                 for name in self.slot_names}
+        return {"step": jnp.zeros((), jnp.int32), "slots": slots}
+
+    def update(self, grads, state, params):
+        """Return (new_params, new_state)."""
+        step = state["step"] + 1
+        lr = _lr_at(self.learning_rate, step)
+
+        slot_lists = [state["slots"][n] for n in self.slot_names]
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        glist = treedef.flatten_up_to(grads)
+        slots_flat = [treedef.flatten_up_to(s) for s in slot_lists]
+
+        new_params, new_slots = [], [[] for _ in self.slot_names]
+        for i, (p, g) in enumerate(zip(leaves, glist)):
+            s_in = tuple(sf[i] for sf in slots_flat)
+            if isinstance(g, IndexedSlices):
+                p_new, s_out = self.apply_indexed(p, g, s_in, lr, step)
+            else:
+                if self.l2reg > 0.0:
+                    g = g + self.l2reg * p
+                p_new, s_out = self.apply_dense(p, g, s_in, lr, step)
+            new_params.append(p_new)
+            for j, s in enumerate(s_out):
+                new_slots[j].append(s)
+
+        params_out = jax.tree_util.tree_unflatten(treedef, new_params)
+        slots_out = {n: jax.tree_util.tree_unflatten(treedef, new_slots[j])
+                     for j, n in enumerate(self.slot_names)}
+        return params_out, {"step": step, "slots": slots_out}
+
+    # ---- per-leaf kernels (override in subclasses) ----
+    def apply_dense(self, p, g, slots, lr, step):
+        raise NotImplementedError
+
+    def apply_indexed(self, p, slices: IndexedSlices, slots, lr, step):
+        """Sparse row-wise update; default: gather rows, run the dense rule on
+        rows, scatter back (matches the reference's *_sparse kernels)."""
+        sl = slices.deduplicate()
+        valid = sl.indices >= 0
+        safe = jnp.where(valid, sl.indices, 0).astype(jnp.int32)
+        g_rows = jnp.where(valid[:, None], sl.values, 0)
+        p_rows = p[safe]
+        s_rows = tuple(s[safe] for s in slots)
+        if self.l2reg > 0.0:
+            g_rows = g_rows + self.l2reg * p_rows
+        p_new_rows, s_new_rows = self.apply_dense(p_rows, g_rows, s_rows, lr,
+                                                  step)
+        delta = jnp.where(valid[:, None], p_new_rows - p_rows, 0)
+        p_out = p.at[safe].add(delta.astype(p.dtype))
+        s_out = tuple(
+            s.at[safe].add(jnp.where(valid[:, None], ns - os, 0))
+            for s, ns, os in zip(slots, s_new_rows, s_rows))
+        return p_out, s_out
+
+    def minimize(self, loss_fn):
+        """Convenience mirroring reference optimizer.minimize (optimizer.py:66):
+        returns step_fn(params, state, *args) -> (loss, params, state)."""
+        def step(params, opt_state, *args):
+            loss, grads = jax.value_and_grad(loss_fn)(params, *args)
+            params, opt_state = self.update(grads, opt_state, params)
+            return loss, params, opt_state
+        return step
+
+
+class SGDOptimizer(Optimizer):
+    """optimizer.py:255."""
+
+    def apply_dense(self, p, g, slots, lr, step):
+        return p - lr * g.astype(p.dtype), ()
+
+
+class MomentumOptimizer(Optimizer):
+    """optimizer.py:324 (heavy-ball)."""
+
+    slot_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.01, momentum: float = 0.9,
+                 l2reg: float = 0.0):
+        super().__init__(learning_rate, l2reg)
+        self.momentum = momentum
+
+    def apply_dense(self, p, g, slots, lr, step):
+        (v,) = slots
+        v = self.momentum * v - lr * g
+        return p + v, (v,)
+
+
+class NesterovOptimizer(MomentumOptimizer):
+    """optimizer.py:324 nesterov=True."""
+
+    def apply_dense(self, p, g, slots, lr, step):
+        (v,) = slots
+        v_new = self.momentum * v - lr * g
+        return p + self.momentum * v_new - lr * g, (v_new,)
+
+
+class AdaGradOptimizer(Optimizer):
+    """optimizer.py:418."""
+
+    slot_names = ("accum",)
+
+    def __init__(self, learning_rate=0.01, initial_accumulator_value=0.0,
+                 eps: float = 1e-7, l2reg: float = 0.0):
+        super().__init__(learning_rate, l2reg)
+        self.initial_accumulator_value = initial_accumulator_value
+        self.eps = eps
+
+    def init_state(self, params):
+        st = super().init_state(params)
+        if self.initial_accumulator_value:
+            st["slots"]["accum"] = jax.tree_util.tree_map(
+                lambda a: a + self.initial_accumulator_value,
+                st["slots"]["accum"])
+        return st
+
+    def apply_dense(self, p, g, slots, lr, step):
+        (acc,) = slots
+        acc = acc + g * g
+        return p - lr * g / (jnp.sqrt(acc) + self.eps), (acc,)
+
+
+class AdamOptimizer(Optimizer):
+    """optimizer.py:610."""
+
+    slot_names = ("m", "v")
+
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-7, l2reg: float = 0.0):
+        super().__init__(learning_rate, l2reg)
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+
+    def apply_dense(self, p, g, slots, lr, step):
+        m, v = slots
+        g = g.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        return (p - lr * mhat / (jnp.sqrt(vhat) + self.eps)).astype(p.dtype), (m, v)
+
+
+class AMSGradOptimizer(AdamOptimizer):
+    """optimizer.py:624."""
+
+    slot_names = ("m", "v", "vmax")
+
+    def apply_dense(self, p, g, slots, lr, step):
+        m, v, vmax = slots
+        g = g.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        vmax = jnp.maximum(vmax, v)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = vmax / (1 - self.beta2 ** t)
+        return (p - lr * mhat / (jnp.sqrt(vhat) + self.eps)).astype(p.dtype), (m, v, vmax)
+
+
+class AdamWOptimizer(AdamOptimizer):
+    """optimizer.py:671 — decoupled weight decay."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 eps=1e-7, weight_decay: float = 0.01):
+        super().__init__(learning_rate, beta1, beta2, eps, l2reg=0.0)
+        self.weight_decay = weight_decay
+
+    def apply_dense(self, p, g, slots, lr, step):
+        m, v = slots
+        g = g.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        upd = mhat / (jnp.sqrt(vhat) + self.eps) + self.weight_decay * p
+        return (p - lr * upd).astype(p.dtype), (m, v)
+
+
+class LambOptimizer(AdamOptimizer):
+    """optimizer.py:730 — layerwise trust-ratio scaling."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 eps=1e-6, weight_decay: float = 0.01):
+        super().__init__(learning_rate, beta1, beta2, eps, l2reg=0.0)
+        self.weight_decay = weight_decay
+
+    def apply_dense(self, p, g, slots, lr, step):
+        m, v = slots
+        g = g.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        upd = mhat / (jnp.sqrt(vhat) + self.eps) + self.weight_decay * p
+        w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+        u_norm = jnp.linalg.norm(upd)
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return (p - lr * trust * upd).astype(p.dtype), (m, v)

@@ -1,0 +1,5 @@
+from hetu_tpu.parallel.mesh import (
+    MeshConfig, make_mesh, local_mesh, AXIS_DP, AXIS_TP, AXIS_PP, AXIS_EP,
+    AXIS_SP,
+)
+from hetu_tpu.parallel.spec import ShardSpec, NodeStatus

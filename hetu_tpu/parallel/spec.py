@@ -1,0 +1,110 @@
+"""Sharding specs — the NodeStatus algebra, TPU-native.
+
+Reference: python/hetu/context.py `NodeStatus` (:248): a per-op sharding is
+{dim→splits} + duplicate + partial + order; GraphStatus (:902) runs fixed-point
+deduction over the graph and inserts collectives where producer/consumer specs
+mismatch (cross_send/cross_receive :1640-1826).
+
+TPU mapping:
+  * {dim→splits}  → per-dim mesh-axis assignment (PartitionSpec)
+  * duplicate     → axes not named (replication is the default in GSPMD)
+  * partial       → value holds per-device partial sums pending a psum over
+                    the listed axes (XLA: "unreduced"; we track it explicitly
+                    and emit lax.psum / with_sharding_constraint)
+  * order         → mesh axis ordering (mesh.py DEFAULT_AXIS_ORDER)
+
+The deduction fixed-point largely dissolves into XLA's SPMD propagation; what
+remains ours is the *planner* choosing annotation points and explicit
+collectives (reduce vs allreduce vs reduce-scatter) — see
+hetu_tpu/parallel/planner.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AxisName = str
+DimSpec = Union[None, AxisName, Tuple[AxisName, ...]]
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """Sharding of one array: per-dim mesh axes + partial-sum axes."""
+
+    dims: Tuple[DimSpec, ...]
+    partial: Tuple[AxisName, ...] = ()
+
+    # ---- constructors ----
+    @staticmethod
+    def replicated(ndim: int) -> "ShardSpec":
+        return ShardSpec(dims=(None,) * ndim)
+
+    @staticmethod
+    def split(ndim: int, dim: int, axis: AxisName) -> "ShardSpec":
+        dims = [None] * ndim
+        dims[dim] = axis
+        return ShardSpec(dims=tuple(dims))
+
+    # ---- conversions ----
+    def pspec(self) -> P:
+        return P(*self.dims)
+
+    def named(self, mesh: Mesh) -> NamedSharding:
+        if self.partial:
+            raise ValueError(
+                "partial spec has no NamedSharding; reduce it first "
+                "(reference analog: partial→allreduce in cross_receive)")
+        return NamedSharding(mesh, self.pspec())
+
+    # ---- the NodeStatus-style pattern checks (context.py:769-783) ----
+    def check_allreduce(self, tgt: "ShardSpec") -> Optional[Tuple[AxisName, ...]]:
+        """partial here, replicated there → psum over partial axes."""
+        if self.partial and tgt.partial == () and tgt.dims == self.dims:
+            return self.partial
+        return None
+
+    def check_reducescatter(self, tgt: "ShardSpec") -> Optional[Tuple[AxisName, int]]:
+        """partial here, extra split there on some dim → reduce_scatter."""
+        if not self.partial or tgt.partial:
+            return None
+        diff = [(i, a) for i, (a, b) in enumerate(zip(self.dims, tgt.dims))
+                if a != b]
+        if len(diff) == 1:
+            i, _ = diff[0]
+            if self.dims[i] is None and tgt.dims[i] in self.partial:
+                return (tgt.dims[i], i)
+        return None
+
+    def check_allgather(self, tgt: "ShardSpec") -> Optional[Tuple[AxisName, int]]:
+        """split here, replicated there on some dim → all_gather."""
+        if self.partial or tgt.partial:
+            return None
+        diff = [(i, a, b) for i, (a, b) in enumerate(zip(self.dims, tgt.dims))
+                if a != b]
+        if len(diff) == 1:
+            i, a, b = diff[0]
+            if a is not None and b is None:
+                return (a, i)
+        return None
+
+    def reduce_partial(self, x, mesh_axes=None):
+        """Apply the pending psum (inside shard_map / collective contexts)."""
+        y = x
+        for ax in self.partial:
+            y = lax.psum(y, ax)
+        return y
+
+
+# Name-parity alias: the reference calls this NodeStatus.
+NodeStatus = ShardSpec
+
+
+def constrain(x, mesh: Mesh, spec: ShardSpec):
+    """with_sharding_constraint under a spec — the annotation primitive the
+    planner uses where the reference inserted comm ops."""
+    return jax.lax.with_sharding_constraint(x, spec.named(mesh))

@@ -1,0 +1,75 @@
+"""Checkpointable global RNG with (seed, seqnum) semantics.
+
+Mirrors the reference's reproducible RNG (python/hetu/random.py:14-43 and
+src/common/random.cc): a global seed plus a monotonically increasing sequence
+number; every consumer derives an independent stream from (seed, seqnum) so a
+checkpoint that records the pair can resume bit-identically.
+
+TPU-native translation: instead of a C-runtime seed consumed by curand, we fold
+the sequence number into a jax PRNG key.  `next_key()` is the imperative entry
+point used by initializers and dataloaders outside jit; inside jit, keys are
+threaded functionally (TrainState.rng).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+
+@dataclass
+class _RngState:
+    seed: int = 0
+    seqnum: int = 0
+
+
+_state = _RngState()
+_lock = threading.Lock()
+
+
+def set_random_seed(seed: int) -> None:
+    """Set the global seed and reset the sequence number (reference: random.py:14)."""
+    with _lock:
+        _state.seed = int(seed)
+        _state.seqnum = 0
+
+
+def get_seed_status() -> tuple[int, int]:
+    """Return (seed, seqnum) for checkpointing (reference: executor.py:597-598)."""
+    return _state.seed, _state.seqnum
+
+
+def set_seed_status(seed: int, seqnum: int) -> None:
+    """Restore (seed, seqnum) from a checkpoint."""
+    with _lock:
+        _state.seed = int(seed)
+        _state.seqnum = int(seqnum)
+
+
+def step_seqnum(n: int = 1) -> int:
+    """Advance the sequence number (reference: random.py StepSeqNum)."""
+    with _lock:
+        _state.seqnum += n
+        return _state.seqnum
+
+
+def next_key() -> jax.Array:
+    """Derive the next PRNG key from (seed, seqnum) and advance seqnum."""
+    with _lock:
+        key = jax.random.fold_in(jax.random.PRNGKey(_state.seed), _state.seqnum)
+        _state.seqnum += 1
+    return key
+
+
+def np_rng() -> np.random.Generator:
+    """Reproducible numpy Generator derived from (seed, seqnum); advances seqnum.
+
+    Reference analog: python/hetu/random.py:40-43 (get_np_rand).
+    """
+    with _lock:
+        g = np.random.default_rng((_state.seed, _state.seqnum))
+        _state.seqnum += 1
+    return g

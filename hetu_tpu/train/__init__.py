@@ -1,0 +1,2 @@
+from hetu_tpu.train.executor import Executor, TrainState, gradients
+from hetu_tpu.train import checkpoint

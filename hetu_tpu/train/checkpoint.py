@@ -1,0 +1,72 @@
+"""Checkpoint save/load with reproducible-RNG capture.
+
+Reference: python/hetu/gpu_ops/executor.py:558-670 — `Executor.save/load`
+pickles name→numpy dense params on rank 0, asks the PS to SaveParam/LoadParam
+for server-held embeddings, and records the RNG (seed, seqnum)
+(executor.py:597-617); `load_dict(consider_splits=True)` (:630) re-splits
+tensors when the model-parallel layout changed.
+
+TPU version: the state is one pytree; we save numpy leaves + treedef + RNG.
+Resharding on load is free — jax.device_put with the current sharding lays
+out each leaf for whatever mesh the restore runs under, which subsumes
+`consider_splits`.  (orbax is available for async multi-host checkpointing;
+this built-in format keeps zero deps and byte-stable tests.)
+"""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from hetu_tpu import rng as hrng
+
+_FORMAT_VERSION = 1
+
+
+def state_dict(state) -> dict:
+    """Flatten a pytree state into {path_string: numpy array}."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    return {jax.tree_util.keystr(path): np.asarray(leaf)
+            for path, leaf in flat}
+
+
+def save(path, state, *, extra: Optional[dict] = None) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    payload = {
+        "version": _FORMAT_VERSION,
+        "leaves": [np.asarray(l) for l in leaves],
+        "rng": hrng.get_seed_status(),
+        "extra": extra or {},
+    }
+    with open(path, "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load(path, state_template, *, restore_rng: bool = True):
+    """Restore into the structure (and shardings) of `state_template`."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    leaves_t, treedef = jax.tree_util.tree_flatten(state_template)
+    leaves = payload["leaves"]
+    if len(leaves) != len(leaves_t):
+        raise ValueError(
+            f"checkpoint has {len(leaves)} leaves, template {len(leaves_t)}")
+    out = []
+    for i, (saved, tmpl) in enumerate(zip(leaves, leaves_t)):
+        arr = np.asarray(saved)
+        if hasattr(tmpl, "shape") and tuple(arr.shape) != tuple(tmpl.shape):
+            raise ValueError(
+                f"checkpoint leaf {i} shape {arr.shape} != template "
+                f"{tuple(tmpl.shape)} — wrong architecture?")
+        if hasattr(tmpl, "sharding"):
+            arr = jax.device_put(arr, tmpl.sharding)  # re-split for new layout
+        out.append(arr)
+    if restore_rng:
+        hrng.set_seed_status(*payload["rng"])
+    return jax.tree_util.tree_unflatten(treedef, out)

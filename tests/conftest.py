@@ -1,0 +1,33 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+The reference's distributed tests need mpirun + real GPUs (SURVEY.md §4);
+ours run anywhere by forcing XLA:CPU with 8 virtual devices — multi-chip
+sharding semantics are identical, so sharding/collective tests are real
+tests, not mocks.  Must run before the first jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the harness presets axon/tpu
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# jax may already be imported by the interpreter's sitecustomize, in which
+# case the env var above came too late — the config route still works as long
+# as no backend has initialized yet.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    from hetu_tpu import rng
+    rng.set_random_seed(123)
+    np.random.seed(123)
+    yield

@@ -1,0 +1,79 @@
+"""Layer/module-system tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu import layers
+
+
+def test_linear_shapes_and_grad():
+    m = layers.Linear(8, 4)
+    v = m.init(jax.random.PRNGKey(0))
+    x = jnp.ones((2, 8))
+    y, _ = m.apply(v, x)
+    assert y.shape == (2, 4)
+
+    def loss(p):
+        out, _ = m.apply({"params": p, "state": {}}, x)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(v["params"])
+    assert g["weight"].shape == (8, 4)
+
+
+def test_conv_layer():
+    m = layers.Conv2d(3, 6, 3, stride=1, padding=1)
+    v = m.init(jax.random.PRNGKey(0))
+    y, _ = m.apply(v, jnp.ones((2, 3, 8, 8)))
+    assert y.shape == (2, 6, 8, 8)
+
+
+def test_batchnorm_state_updates():
+    m = layers.BatchNorm(3)
+    v = m.init(jax.random.PRNGKey(0))
+    x = 2.0 + jax.random.normal(jax.random.PRNGKey(1), (16, 3, 4, 4))
+    y, new_state = m.apply(v, x, train=True)
+    assert not np.allclose(np.asarray(new_state["mean"]), 0.0)
+    # eval mode: state unchanged
+    v2 = {"params": v["params"], "state": new_state}
+    y2, state2 = m.apply(v2, x, train=False)
+    np.testing.assert_allclose(np.asarray(state2["mean"]),
+                               np.asarray(new_state["mean"]))
+
+
+def test_sequential_composition():
+    model = layers.Sequential(
+        layers.Linear(8, 16), layers.Relu(),
+        layers.DropOut(0.5), layers.Linear(16, 2),
+    )
+    v = model.init(jax.random.PRNGKey(0))
+    x = jnp.ones((4, 8))
+    y, _ = model.apply(v, x, train=True, rng=jax.random.PRNGKey(1))
+    assert y.shape == (4, 2)
+    y_eval, _ = model.apply(v, x, train=False)
+    assert y_eval.shape == (4, 2)
+    # eval is deterministic
+    y_eval2, _ = model.apply(v, x, train=False)
+    np.testing.assert_allclose(np.asarray(y_eval), np.asarray(y_eval2))
+
+
+def test_mha_shapes_and_causal():
+    m = layers.MultiHeadAttention(16, 4, causal=True)
+    v = m.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 16))
+    y, _ = m.apply(v, x)
+    assert y.shape == (2, 6, 16)
+    # causality: changing future tokens must not change earlier outputs
+    x2 = x.at[:, -1].set(0.0)
+    y2, _ = m.apply(v, x2)
+    np.testing.assert_allclose(np.asarray(y[:, :5]), np.asarray(y2[:, :5]),
+                               atol=1e-5)
+
+
+def test_embedding_layer():
+    m = layers.Embedding(10, 4)
+    v = m.init(jax.random.PRNGKey(0))
+    y, _ = m.apply(v, jnp.asarray([[1, 2], [3, 4]]))
+    assert y.shape == (2, 2, 4)

@@ -1,0 +1,151 @@
+"""Optimizer oracle tests (reference pattern: tests/test_optimizer.py with
+HetuOptimizerTester; oracle here is a straightforward numpy implementation)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import hetu_tpu as ht
+from hetu_tpu import optim
+from hetu_tpu.ops.embedding import IndexedSlices
+
+
+def params():
+    g = np.random.default_rng(0)
+    return {"w": g.standard_normal((4, 3)).astype(np.float32),
+            "b": g.standard_normal((3,)).astype(np.float32)}
+
+
+def grads_like(p, seed=1):
+    g = np.random.default_rng(seed)
+    return {k: g.standard_normal(v.shape).astype(np.float32)
+            for k, v in p.items()}
+
+
+def run_steps(opt, p, gs, n=3):
+    state = opt.init_state(p)
+    cur = p
+    for i in range(n):
+        cur, state = opt.update(gs, state, cur)
+    return {k: np.asarray(v) for k, v in cur.items()}
+
+
+def test_sgd():
+    p, g = params(), grads_like(params())
+    out = run_steps(optim.SGDOptimizer(0.1), p, g, n=2)
+    np.testing.assert_allclose(out["w"], p["w"] - 0.2 * g["w"], rtol=1e-5)
+
+
+def test_sgd_l2reg():
+    p, g = params(), grads_like(params())
+    out = run_steps(optim.SGDOptimizer(0.1, l2reg=0.01), p, g, n=1)
+    np.testing.assert_allclose(out["w"], p["w"] - 0.1 * (g["w"] + 0.01 * p["w"]),
+                               rtol=1e-5)
+
+
+def test_momentum_and_nesterov():
+    p, g = params(), grads_like(params())
+    out = run_steps(optim.MomentumOptimizer(0.1, 0.9), p, g, n=2)
+    v = -0.1 * g["w"]
+    w = p["w"] + v
+    v = 0.9 * v - 0.1 * g["w"]
+    np.testing.assert_allclose(out["w"], w + v, rtol=1e-5)
+    out_n = run_steps(optim.NesterovOptimizer(0.1, 0.9), p, g, n=1)
+    v1 = -0.1 * g["w"]
+    np.testing.assert_allclose(out_n["w"], p["w"] + 0.9 * v1 - 0.1 * g["w"],
+                               rtol=1e-5)
+
+
+def test_adagrad():
+    p, g = params(), grads_like(params())
+    out = run_steps(optim.AdaGradOptimizer(0.1, eps=1e-7), p, g, n=2)
+    acc = g["w"] ** 2
+    w = p["w"] - 0.1 * g["w"] / (np.sqrt(acc) + 1e-7)
+    acc += g["w"] ** 2
+    w = w - 0.1 * g["w"] / (np.sqrt(acc) + 1e-7)
+    np.testing.assert_allclose(out["w"], w, rtol=1e-5)
+
+
+def np_adam(p, g, n, lr=0.01, b1=0.9, b2=0.999, eps=1e-7):
+    m = np.zeros_like(p); v = np.zeros_like(p); w = p.copy()
+    for t in range(1, n + 1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mh = m / (1 - b1 ** t)
+        vh = v / (1 - b2 ** t)
+        w = w - lr * mh / (np.sqrt(vh) + eps)
+    return w
+
+
+def test_adam():
+    p, g = params(), grads_like(params())
+    out = run_steps(optim.AdamOptimizer(0.01), p, g, n=3)
+    np.testing.assert_allclose(out["w"], np_adam(p["w"], g["w"], 3), rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_adamw():
+    p, g = params(), grads_like(params())
+    out = run_steps(optim.AdamWOptimizer(0.01, weight_decay=0.1), p, g, n=1)
+    m = 0.1 * g["w"]; v = 0.001 * g["w"] ** 2
+    mh = m / 0.1; vh = v / 0.001
+    ref = p["w"] - 0.01 * (mh / (np.sqrt(vh) + 1e-7) + 0.1 * p["w"])
+    np.testing.assert_allclose(out["w"], ref, rtol=1e-5)
+
+
+def test_amsgrad_lamb_run():
+    p, g = params(), grads_like(params())
+    for opt in (optim.AMSGradOptimizer(0.01), optim.LambOptimizer(0.01)):
+        out = run_steps(opt, p, g, n=2)
+        assert np.isfinite(out["w"]).all()
+        assert not np.allclose(out["w"], p["w"])
+
+
+def test_sparse_update_matches_dense():
+    """IndexedSlices grad must equal the dense update on touched rows and
+    leave untouched rows alone (reference sparse-kernel contract)."""
+    g = np.random.default_rng(3)
+    table = g.standard_normal((8, 4)).astype(np.float32)
+    idx = np.array([1, 3, 1])  # duplicate index on purpose
+    vals = g.standard_normal((3, 4)).astype(np.float32)
+    dense = np.zeros_like(table)
+    np.add.at(dense, idx, vals)
+
+    for opt in (optim.SGDOptimizer(0.1), optim.AdamOptimizer(0.01),
+                optim.AdaGradOptimizer(0.1)):
+        p = {"t": jnp.asarray(table)}
+        sparse_g = {"t": IndexedSlices(jnp.asarray(idx), jnp.asarray(vals),
+                                       (8, 4))}
+        st = opt.init_state(p)
+        p_sp, _ = opt.update(sparse_g, st, p)
+        p2 = {"t": jnp.asarray(table)}
+        st2 = opt.init_state(p2)
+        p_de, _ = opt.update({"t": jnp.asarray(dense)}, st2, p2)
+        touched = np.unique(idx)
+        np.testing.assert_allclose(np.asarray(p_sp["t"])[touched],
+                                   np.asarray(p_de["t"])[touched], rtol=1e-4,
+                                   atol=1e-5)
+        untouched = [i for i in range(8) if i not in touched]
+        np.testing.assert_allclose(np.asarray(p_sp["t"])[untouched],
+                                   table[untouched], rtol=1e-6)
+
+
+def test_lr_schedulers():
+    from hetu_tpu import lr as lrs
+    s = lrs.StepScheduler(1.0, step_size=10, gamma=0.5)
+    assert float(s(jnp.asarray(0))) == 1.0
+    assert float(s(jnp.asarray(10))) == 0.5
+    ms = lrs.MultiStepScheduler(1.0, [5, 15], 0.1)
+    assert abs(float(ms(jnp.asarray(6))) - 0.1) < 1e-6
+    assert abs(float(ms(jnp.asarray(20))) - 0.01) < 1e-7
+    ex = lrs.ExponentialScheduler(1.0, 0.9)
+    assert abs(float(ex(jnp.asarray(2))) - 0.81) < 1e-6
+    cos = lrs.CosineScheduler(1.0, t_max=100, warmup=10)
+    assert float(cos(jnp.asarray(5))) == 0.5
+    assert abs(float(cos(jnp.asarray(100)))) < 1e-6
+    # scheduler inside an optimizer
+    opt = ht.optim.SGDOptimizer(lrs.StepScheduler(0.1, 1, 0.5))
+    p = {"w": jnp.ones((2,))}
+    st = opt.init_state(p)
+    p1, st = opt.update({"w": jnp.ones((2,))}, st, p)
+    # step becomes 1 → lr = 0.1*0.5
+    np.testing.assert_allclose(np.asarray(p1["w"]), 1 - 0.05, rtol=1e-6)
