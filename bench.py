@@ -1,0 +1,60 @@
+"""Benchmark: ResNet-18 / CIFAR10 training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: BASELINE.json publishes no reference numbers yet ("published": {});
+the stand-in denominator is 2000 samples/s/chip — the order of magnitude of
+ResNet-18/CIFAR10 training on one A100 (the reference's 8xA100 allreduce-DP
+headline divided per chip).  vs_baseline > 1.0 means faster than that
+stand-in.  Replace when real reference numbers land.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu import models, optim
+
+BASELINE_SAMPLES_PER_SEC = 2000.0
+BATCH = 128
+WARMUP = 10
+STEPS = 30
+
+
+def main():
+    model = models.ResNet18(num_classes=10)
+    ex = ht.Executor(model.loss_fn(), optim.MomentumOptimizer(0.1, 0.9),
+                     seed=0)
+    state = ex.init_state(model.init(jax.random.PRNGKey(0)))
+
+    g = np.random.default_rng(0)
+    x = g.standard_normal((BATCH, 3, 32, 32), dtype=np.float32)
+    y = g.integers(0, 10, BATCH).astype(np.int32)
+    batch = (x, y)
+
+    for _ in range(WARMUP):
+        state, m = ex.run("train", state, batch)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, m = ex.run("train", state, batch)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    sps = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "resnet18_cifar10_train_samples_per_sec_per_chip",
+        "value": round(sps, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

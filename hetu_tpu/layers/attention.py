@@ -32,20 +32,23 @@ class MultiHeadAttention(Module):
         self.dtype = dtype
 
     def init(self, key):
+        # f32 master weights; self.dtype is the compute dtype (see Linear)
         kq, ko = jax.random.split(key)
         h = self.hidden_size
         return {"params": {
-            "qkv_weight": self.weight_init(kq, (h, 3 * h), self.dtype),
-            "qkv_bias": jnp.zeros((3 * h,), self.dtype),
-            "out_weight": self.weight_init(ko, (h, h), self.dtype),
-            "out_bias": jnp.zeros((h,), self.dtype),
+            "qkv_weight": self.weight_init(kq, (h, 3 * h), jnp.float32),
+            "qkv_bias": jnp.zeros((3 * h,), jnp.float32),
+            "out_weight": self.weight_init(ko, (h, h), jnp.float32),
+            "out_bias": jnp.zeros((h,), jnp.float32),
         }, "state": {}}
 
     def apply(self, variables, x, *, mask=None, train: bool = False, rng=None):
         """x: [batch, seq, hidden]; mask broadcastable to [B,H,S,S] (1=keep)."""
         p = variables["params"]
         b, s, h = x.shape
-        qkv = ops.linear(x, p["qkv_weight"], p["qkv_bias"])  # [B,S,3H]
+        x = x.astype(self.dtype)
+        qkv = ops.linear(x, p["qkv_weight"].astype(self.dtype),
+                         p["qkv_bias"])  # [B,S,3H]
         qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
         q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))  # [B,Hd,S,D]
         if self.causal:
@@ -55,5 +58,6 @@ class MultiHeadAttention(Module):
         out = jnp.moveaxis(out, 1, 2).reshape(b, s, h)
         if train and self.dropout_rate > 0.0:
             out = ops.dropout(out, self.dropout_rate, rng, train=True)
-        y = ops.linear(out, p["out_weight"], p["out_bias"])
+        y = ops.linear(out.astype(self.dtype),
+                       p["out_weight"].astype(self.dtype), p["out_bias"])
         return y, {}

@@ -26,16 +26,23 @@ class Linear(Module):
         self.dtype = dtype
 
     def init(self, key):
+        # params are stored f32 (master weights); self.dtype is the COMPUTE
+        # dtype applied at use time, so bf16 training keeps full-precision
+        # optimizer updates
         kw, kb = jax.random.split(key)
         params = {"weight": self.weight_init(
-            kw, (self.in_features, self.out_features), self.dtype)}
+            kw, (self.in_features, self.out_features), jnp.float32)}
         if self.use_bias:
-            params["bias"] = self.bias_init(kb, (self.out_features,), self.dtype)
+            params["bias"] = self.bias_init(kb, (self.out_features,),
+                                            jnp.float32)
         return {"params": params, "state": {}}
 
     def apply(self, variables, x, *, train: bool = False, rng=None):
         p = variables["params"]
-        y = ops.linear(x, p["weight"], p.get("bias"))
+        # compute in self.dtype (bf16 on TPU keeps f32 master weights and
+        # f32 MXU accumulation via preferred_element_type in ops.linear)
+        w = p["weight"].astype(self.dtype)
+        y = ops.linear(x.astype(self.dtype), w, p.get("bias"))
         if self.activation is not None:
             y = self.activation(y)
         return y, {}
@@ -60,21 +67,26 @@ class Conv2d(Module):
         self.dtype = dtype
 
     def init(self, key):
+        # f32 master weights; self.dtype is the compute dtype (see Linear)
         kw, kb = jax.random.split(key)
         w_shape = (self.out_channels, self.in_channels) + self.kernel_size
-        params = {"weight": self.weight_init(kw, w_shape, self.dtype)}
+        params = {"weight": self.weight_init(kw, w_shape, jnp.float32)}
         if self.use_bias:
-            params["bias"] = self.bias_init(kb, (self.out_channels,), self.dtype)
+            params["bias"] = self.bias_init(kb, (self.out_channels,),
+                                            jnp.float32)
         return {"params": params, "state": {}}
 
     def apply(self, variables, x, *, train: bool = False, rng=None):
         p = variables["params"]
+        w = p["weight"].astype(self.dtype)
+        x = x.astype(self.dtype)
         if self.use_bias:
-            y = ops.conv2d_add_bias(x, p["weight"], p["bias"],
+            # bias stays uncast: the conv accumulates in f32 for bf16 inputs
+            # (ops/conv.py preferred_element_type), so the add promotes
+            y = ops.conv2d_add_bias(x, w, p["bias"],
                                     stride=self.stride, padding=self.padding)
         else:
-            y = ops.conv2d(x, p["weight"], stride=self.stride,
-                           padding=self.padding)
+            y = ops.conv2d(x, w, stride=self.stride, padding=self.padding)
         if self.activation is not None:
             y = self.activation(y)
         return y, {}

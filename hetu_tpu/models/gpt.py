@@ -1,0 +1,96 @@
+"""GPT (decoder-only LM).
+
+Reference: examples/nlp GPT-2 examples + tools/Galvatron gpt models
+(hybrid-parallel flagship workload).  Pre-LN causal transformer with tied
+LM head; scan-over-layers; Megatron-shardable weights.  This is the flagship
+model for the multi-chip dry-run (tp/dp/pp/sp shardings).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import init as initializers
+from hetu_tpu import ops
+from hetu_tpu.layers.base import Module
+from hetu_tpu.layers.transformer import TransformerBlock
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_size: int = 3072
+    max_position: int = 1024
+    dropout_rate: float = 0.1
+    dtype: object = jnp.float32
+
+
+class GPTModel(Module):
+    def __init__(self, config: GPTConfig):
+        self.c = config
+        self.block = TransformerBlock(
+            config.hidden_size, config.num_heads, config.ffn_size,
+            dropout_rate=config.dropout_rate, causal=True, pre_norm=True,
+            dtype=config.dtype)
+        self.w_init = initializers.normal(stddev=0.02)
+
+    def init(self, key):
+        c = self.c
+        ks = jax.random.split(key, 4)
+        block_keys = jax.random.split(ks[0], c.num_layers)
+        blocks = jax.vmap(lambda k: self.block.init(k)["params"])(block_keys)
+        params = {
+            "tok_emb": self.w_init(ks[1], (c.vocab_size, c.hidden_size)),
+            "pos_emb": self.w_init(ks[2], (c.max_position, c.hidden_size)),
+            "blocks": blocks,
+            "ln_f_scale": jnp.ones((c.hidden_size,)),
+            "ln_f_bias": jnp.zeros((c.hidden_size,)),
+        }
+        return {"params": params, "state": {}}
+
+    def apply(self, variables, input_ids, *, train: bool = False, rng=None):
+        """Returns (logits [B,S,V], {})."""
+        p = variables["params"]
+        c = self.c
+        b, s = input_ids.shape
+        h = ops.embedding_lookup(p["tok_emb"], input_ids)
+        h = h + p["pos_emb"][None, :s]
+        if train and c.dropout_rate > 0:
+            h = ops.dropout(h, c.dropout_rate, jax.random.fold_in(rng, 999),
+                            train=True)
+        h = h.astype(c.dtype)
+
+        def layer(carry, xs):
+            p_l, k_l = xs
+            out, _ = self.block.apply({"params": p_l, "state": {}}, carry,
+                                      train=train, rng=k_l)
+            return out, None
+
+        keys = (jax.random.split(rng, c.num_layers) if rng is not None
+                else jnp.zeros((c.num_layers, 2), jnp.uint32))
+        h, _ = jax.lax.scan(layer, h, (p["blocks"], keys))
+        h = h.astype(jnp.float32)
+        h = ops.layer_norm(h, p["ln_f_scale"], p["ln_f_bias"])
+        logits = ops.linear(h, p["tok_emb"].T)  # tied LM head
+        return logits, {}
+
+    def lm_loss_fn(self):
+        """Next-token LM loss; batch = (input_ids,) or (input_ids, labels)."""
+        def fn(params, model_state, batch, rng, train):
+            ids = batch[0] if isinstance(batch, (tuple, list)) else batch
+            logits, _ = self.apply({"params": params, "state": {}}, ids,
+                                   train=train, rng=rng)
+            loss = jnp.mean(ops.softmax_cross_entropy_sparse(
+                logits[:, :-1], ids[:, 1:]))
+            return loss, ({}, model_state)
+        return fn
+
+
+def gpt2_small(**kw) -> GPTModel:
+    return GPTModel(GPTConfig(**kw))

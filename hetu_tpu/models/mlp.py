@@ -1,0 +1,20 @@
+"""MLP (reference: examples/cnn/models/mlp.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hetu_tpu import layers, ops
+
+
+def MLP(in_features: int = 784, hidden: tuple = (256, 256),
+        num_classes: int = 10, dropout: float = 0.0):
+    mods = []
+    prev = in_features
+    for h in hidden:
+        mods += [layers.Linear(prev, h), layers.Relu()]
+        if dropout:
+            mods.append(layers.DropOut(dropout))
+        prev = h
+    mods.append(layers.Linear(prev, num_classes))
+    return layers.Sequential(*mods)

@@ -1,0 +1,147 @@
+"""ResNet for CIFAR-scale inputs.
+
+Reference: examples/cnn/models/resnet.py (resnet18/34 with BasicBlock on
+CIFAR10) — the BASELINE.json config #1/#2 workload.
+
+TPU notes: NCHW at the API (matching the reference); convs are bias-free with
+BN (as in the reference), which XLA fuses into conv epilogues.  bf16-friendly:
+pass dtype=jnp.bfloat16 to run the conv/matmul path in bf16 with f32 BN stats.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import init as initializers
+from hetu_tpu import ops
+from hetu_tpu.layers.base import Module, child_rng
+from hetu_tpu.layers.linear import Conv2d, Linear
+from hetu_tpu.layers.norm import BatchNorm
+
+
+class BasicBlock(Module):
+    expansion = 1
+
+    def __init__(self, in_planes: int, planes: int, stride: int = 1,
+                 dtype=jnp.float32):
+        self.conv1 = Conv2d(in_planes, planes, 3, stride=stride, padding=1,
+                            bias=False, dtype=dtype)
+        self.bn1 = BatchNorm(planes)
+        self.conv2 = Conv2d(planes, planes, 3, stride=1, padding=1,
+                            bias=False, dtype=dtype)
+        self.bn2 = BatchNorm(planes)
+        self.downsample = None
+        if stride != 1 or in_planes != planes * self.expansion:
+            self.downsample = (
+                Conv2d(in_planes, planes * self.expansion, 1, stride=stride,
+                       bias=False, dtype=dtype),
+                BatchNorm(planes * self.expansion))
+
+    def init(self, key):
+        ks = jax.random.split(key, 6)
+        v = {"conv1": self.conv1.init(ks[0]), "bn1": self.bn1.init(ks[1]),
+             "conv2": self.conv2.init(ks[2]), "bn2": self.bn2.init(ks[3])}
+        if self.downsample is not None:
+            v["ds_conv"] = self.downsample[0].init(ks[4])
+            v["ds_bn"] = self.downsample[1].init(ks[5])
+        return {"params": {k: x["params"] for k, x in v.items()},
+                "state": {k: x["state"] for k, x in v.items()}}
+
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        p, s = variables["params"], variables["state"]
+        ns = {}
+        def sub(mod, name, h):
+            out, st = mod.apply({"params": p[name], "state": s[name]}, h,
+                                train=train)
+            ns[name] = st
+            return out
+        out = sub(self.conv1, "conv1", x)
+        out = ops.relu(sub(self.bn1, "bn1", out))
+        out = sub(self.conv2, "conv2", out)
+        out = sub(self.bn2, "bn2", out)
+        if self.downsample is not None:
+            sc = sub(self.downsample[0], "ds_conv", x)
+            sc = sub(self.downsample[1], "ds_bn", sc)
+        else:
+            sc = x
+        return ops.relu(out + sc), ns
+
+
+class ResNet(Module):
+    def __init__(self, block, num_blocks, num_classes: int = 10,
+                 dtype=jnp.float32):
+        self.dtype = dtype
+        self.conv1 = Conv2d(3, 64, 3, stride=1, padding=1, bias=False,
+                            dtype=dtype)
+        self.bn1 = BatchNorm(64)
+        self.in_planes = 64
+        self.stages = []
+        for planes, n, stride in ((64, num_blocks[0], 1),
+                                  (128, num_blocks[1], 2),
+                                  (256, num_blocks[2], 2),
+                                  (512, num_blocks[3], 2)):
+            blocks = []
+            for i in range(n):
+                blocks.append(block(self.in_planes, planes,
+                                    stride if i == 0 else 1, dtype=dtype))
+                self.in_planes = planes * block.expansion
+            self.stages.append(blocks)
+        self.fc = Linear(512 * block.expansion, num_classes, dtype=dtype)
+
+    def init(self, key):
+        params, state = {}, {}
+        k0, k1, kf, kb = jax.random.split(key, 4)
+        for name, mod, kk in (("conv1", self.conv1, k0), ("bn1", self.bn1, k1),
+                              ("fc", self.fc, kf)):
+            v = mod.init(kk)
+            params[name], state[name] = v["params"], v["state"]
+        for si, blocks in enumerate(self.stages):
+            for bi, b in enumerate(blocks):
+                v = b.init(jax.random.fold_in(kb, si * 100 + bi))
+                params[f"layer{si}_{bi}"] = v["params"]
+                state[f"layer{si}_{bi}"] = v["state"]
+        return {"params": params, "state": state}
+
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        p, s = variables["params"], variables["state"]
+        ns = {}
+        x = x.astype(self.dtype)
+        h, st = self.conv1.apply(
+            {"params": p["conv1"], "state": s["conv1"]}, x, train=train)
+        ns["conv1"] = st
+        h, st = self.bn1.apply(
+            {"params": p["bn1"], "state": s["bn1"]}, h, train=train)
+        ns["bn1"] = st
+        h = ops.relu(h)
+        for si, blocks in enumerate(self.stages):
+            for bi, b in enumerate(blocks):
+                name = f"layer{si}_{bi}"
+                h, st = b.apply({"params": p[name], "state": s[name]}, h,
+                                train=train)
+                ns[name] = st
+        h = jnp.mean(h, axis=(2, 3))  # global average pool
+        logits, _ = self.fc.apply(
+            {"params": p["fc"], "state": s["fc"]}, h.astype(jnp.float32))
+        ns["fc"] = {}
+        return logits, ns
+
+    def loss_fn(self):
+        """Standard classification loss_fn for the Executor."""
+        def fn(params, model_state, batch, rng, train):
+            x, y = batch
+            logits, new_state = self.apply(
+                {"params": params, "state": model_state}, x, train=train,
+                rng=rng)
+            loss = jnp.mean(ops.softmax_cross_entropy_sparse(logits, y))
+            acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+            return loss, ({"acc": acc}, new_state)
+        return fn
+
+
+def ResNet18(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, dtype)
+
+
+def ResNet34(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes, dtype)

@@ -1,0 +1,4 @@
+from hetu_tpu.parallel.strategies.base import Strategy
+from hetu_tpu.parallel.strategies.simple import (
+    DataParallel, MegatronLM,
+)

@@ -1,0 +1,61 @@
+"""Manual strategies: DataParallel and MegatronLM.
+
+Reference: python/hetu/distributed_strategies/simple.py — `DataParallel` (:6),
+`ModelParallel4CNN` (:46), `ModelParallel4LM` (:113), `OneWeirdTrick4CNN`
+(:119), `MegatronLM` (:174): column-split QKV/FFN-in, row-split
+out-proj/FFN-out with partial-sum→allreduce, vocab-parallel embedding.
+
+TPU translation: the same split decisions expressed as PartitionSpecs; XLA's
+SPMD partitioner inserts the psum exactly where the reference's partial-sum
+NodeStatus triggered an AllReduceCommunicateOp.  Works for our transformer
+models' parameter naming (models/bert.py, models/gpt.py, layers/transformer.py);
+stacked scan-over-layers params have a leading layer dim, handled by prefixing
+None.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from hetu_tpu.parallel.mesh import AXIS_DP, AXIS_TP
+from hetu_tpu.parallel.strategies.base import Strategy
+
+
+class DataParallel(Strategy):
+    """All params replicated, batch over dp (simple.py:6)."""
+
+    def param_spec(self, path, leaf):
+        return P()
+
+
+class MegatronLM(Strategy):
+    """Megatron-style tensor parallel for the transformer models.
+
+    Column-parallel (output-dim split over tp): qkv_weight, ffn_in weight —
+    and their biases.  Row-parallel (input-dim split, partial-sum output):
+    out_weight, ffn_out weight — biases replicated.  Vocab-parallel:
+    tok_emb (dim 0); the tied LM head / vocab-CE then computes with vocab
+    partials (simple.py:174-283).
+    """
+
+    COL = ("qkv_weight", "qkv_bias", "ffn_in")  # split output dim
+    ROW = ("out_weight", "ffn_out")             # split input dim
+    VOCAB = ("tok_emb", "mlm_bias")
+
+    def param_spec(self, path, leaf):
+        ndim = leaf.ndim if hasattr(leaf, "ndim") else len(leaf.shape)
+        def spec_with_layer_prefix(*tail):
+            # stacked scan params carry a leading layer dim
+            pad = ndim - len(tail)
+            return P(*((None,) * pad + tail))
+
+        if any(k in path for k in self.VOCAB):
+            return P(AXIS_TP, *(None,) * (ndim - 1))
+        if any(k in path for k in self.COL):
+            return spec_with_layer_prefix(AXIS_TP)
+        if any(k in path for k in self.ROW):
+            if "bias" in path:  # row-parallel biases are replicated
+                return P()
+            if ndim >= 2:
+                return spec_with_layer_prefix(AXIS_TP, None)
+        return P()

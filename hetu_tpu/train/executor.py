@@ -101,6 +101,10 @@ class Executor:
         opt_state = (self.optimizer.init_state(params)
                      if self.optimizer is not None else {})
         rng_key = rng_key if rng_key is not None else hrng.next_key()
+        # copy leaves: the train step donates its input state, which would
+        # otherwise invalidate the caller's `variables`/rng buffers
+        params, model_state, rng_key = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a).copy(), (params, model_state, rng_key))
         state = TrainState(params=params, opt_state=opt_state,
                            model_state=model_state, rng=rng_key,
                            step=jnp.zeros((), jnp.int32))
