@@ -1,0 +1,243 @@
+"""Mixture-of-Experts layer and gates.
+
+Reference: python/hetu/layers/moe_layer.py (`Expert` :6, `MoELayer` :45 —
+gate → layout_transform → AllToAll → local experts → reverse AllToAll →
+reverse layout) and the gate zoo: `TopKGate` (TopGate.py), `HashGate`,
+`KTop1Gate` (ktop1_layer.py), `BalanceAssignmentGate` (BASE layer, auction),
+`SAMGate` (sam_layer.py).
+
+TPU design: GShard-style dense dispatch/combine einsums (ops/moe_ops.py)
+instead of scatter kernels; expert weights are stacked [E, ...] and sharded
+over the 'ep' mesh axis, dispatched tokens constrained to P('ep', ...), and
+XLA's SPMD partitioner materializes the all_to_all exactly where the
+reference called alltoall_op (gpu_ops/AllToAll.py).  Gates produce
+(combine_weights [T,k], expert_idx [T,k], aux_loss).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import init as initializers
+from hetu_tpu import ops
+from hetu_tpu.layers.base import Module
+from hetu_tpu.ops.moe_ops import (
+    balance_assignment, layout_transform, make_dispatch_combine,
+    reverse_layout_transform, top_k_idx_gate,
+)
+
+
+class TopKGate(Module):
+    """Top-k softmax gate with GShard load-balancing aux loss
+    (reference layers/TopGate.py)."""
+
+    def __init__(self, hidden_size: int, num_experts: int, k: int = 2,
+                 aux_weight: float = 1e-2):
+        self.hidden_size, self.num_experts, self.k = hidden_size, num_experts, k
+        self.aux_weight = aux_weight
+        self.w_init = initializers.xavier_uniform()
+
+    def init(self, key):
+        return {"params": {"gate_w": self.w_init(
+            key, (self.hidden_size, self.num_experts), jnp.float32)},
+            "state": {}}
+
+    def apply(self, variables, tokens, *, train: bool = False, rng=None):
+        logits = ops.linear(tokens.astype(jnp.float32),
+                            variables["params"]["gate_w"])
+        probs = jax.nn.softmax(logits, axis=-1)
+        gates, idx = top_k_idx_gate(logits, self.k)
+        # GShard aux: E * sum_e (mean gate prob_e * mean dispatch frac_e)
+        me = jnp.mean(probs, axis=0)
+        oh = jax.nn.one_hot(idx[:, 0], self.num_experts)
+        ce = jnp.mean(oh, axis=0)
+        aux = self.aux_weight * self.num_experts * jnp.sum(me * ce)
+        return (gates, idx, aux), {}
+
+
+class HashGate(Module):
+    """Deterministic hash routing (reference layers/hash_layer.py): expert =
+    token_id %% num_experts; requires integer ids alongside embeddings."""
+
+    def __init__(self, num_experts: int):
+        self.num_experts = num_experts
+
+    def apply(self, variables, token_ids, *, train: bool = False, rng=None):
+        idx = (token_ids.reshape(-1) % self.num_experts).astype(jnp.int32)
+        gates = jnp.ones((idx.shape[0], 1), jnp.float32)
+        return (gates, idx[:, None], jnp.asarray(0.0)), {}
+
+
+class KTop1Gate(Module):
+    """k independent groups, each top-1 (reference layers/ktop1_layer.py):
+    experts are partitioned into k groups; a token picks its best expert in
+    every group, gates softmaxed over the k winners."""
+
+    def __init__(self, hidden_size: int, num_experts: int, k: int = 2):
+        assert num_experts % k == 0
+        self.hidden_size, self.num_experts, self.k = hidden_size, num_experts, k
+        self.w_init = initializers.xavier_uniform()
+
+    def init(self, key):
+        return {"params": {"gate_w": self.w_init(
+            key, (self.hidden_size, self.num_experts), jnp.float32)},
+            "state": {}}
+
+    def apply(self, variables, tokens, *, train: bool = False, rng=None):
+        logits = ops.linear(tokens.astype(jnp.float32),
+                            variables["params"]["gate_w"])
+        T = logits.shape[0]
+        per = self.num_experts // self.k
+        grouped = logits.reshape(T, self.k, per)
+        best = jnp.argmax(grouped, axis=-1)                      # [T,k]
+        offset = jnp.arange(self.k, dtype=jnp.int32) * per
+        idx = best.astype(jnp.int32) + offset[None, :]
+        best_val = jnp.max(grouped, axis=-1)
+        gates = jax.nn.softmax(best_val, axis=-1)
+        return (gates, idx, jnp.asarray(0.0)), {}
+
+
+class BalanceAssignmentGate(Module):
+    """BASE-layer balanced assignment (reference layers/base via
+    gpu_ops/BalanceAssignment.py auction; Sinkhorn reformulation on TPU —
+    see ops.balance_assignment)."""
+
+    def __init__(self, hidden_size: int, num_experts: int, iters: int = 20):
+        self.hidden_size, self.num_experts, self.iters = (
+            hidden_size, num_experts, iters)
+        self.w_init = initializers.xavier_uniform()
+
+    def init(self, key):
+        return {"params": {"gate_w": self.w_init(
+            key, (self.hidden_size, self.num_experts), jnp.float32)},
+            "state": {}}
+
+    def apply(self, variables, tokens, *, train: bool = False, rng=None):
+        scores = ops.linear(tokens.astype(jnp.float32),
+                            variables["params"]["gate_w"])
+        idx = balance_assignment(scores, iters=self.iters)
+        gates = jnp.take_along_axis(
+            jax.nn.sigmoid(scores), idx[:, None], axis=-1)
+        return (gates, idx[:, None].astype(jnp.int32), jnp.asarray(0.0)), {}
+
+
+class SAMGate(Module):
+    """Switch-and-mix style grouped gate (reference layers/sam_layer.py using
+    SamGroupSum/SamMax kernels): tokens are bucketed by nearest centroid,
+    buckets summarized by group-sum, each group routed top-1."""
+
+    def __init__(self, hidden_size: int, num_experts: int):
+        self.hidden_size, self.num_experts = hidden_size, num_experts
+        self.w_init = initializers.xavier_uniform()
+
+    def init(self, key):
+        return {"params": {
+            "centroids": self.w_init(key, (self.num_experts,
+                                           self.hidden_size), jnp.float32)},
+            "state": {}}
+
+    def apply(self, variables, tokens, *, train: bool = False, rng=None):
+        c = variables["params"]["centroids"]
+        t = tokens.astype(jnp.float32)
+        # nearest centroid by dot-product affinity
+        aff = t @ c.T                                            # [T,E]
+        idx = jnp.argmax(aff, axis=-1).astype(jnp.int32)
+        # group-sum summarization (ops.sam_group_sum) re-scores the groups
+        gsum = ops.sam_group_sum(t, idx, self.num_experts)       # [E,D]
+        gscore = jnp.sum(gsum * c, axis=-1)                      # [E]
+        gates = jax.nn.sigmoid(jnp.take(gscore, idx))[:, None]
+        return (gates, idx[:, None], jnp.asarray(0.0)), {}
+
+
+class Expert(Module):
+    """Stacked FFN experts: w1 [E,D,F], w2 [E,F,D] (reference layers/
+    moe_layer.py:6 Expert as per-device FFN; stacked here for SPMD)."""
+
+    def __init__(self, num_experts: int, hidden_size: int, ffn_size: int,
+                 activation=ops.gelu, dtype=jnp.float32):
+        self.num_experts, self.hidden_size, self.ffn_size = (
+            num_experts, hidden_size, ffn_size)
+        self.activation = activation
+        self.dtype = dtype
+        self.w_init = initializers.he_normal()
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        E, D, F = self.num_experts, self.hidden_size, self.ffn_size
+        return {"params": {
+            "w1": self.w_init(k1, (E, D, F), jnp.float32),
+            "b1": jnp.zeros((E, F), jnp.float32),
+            "w2": self.w_init(k2, (E, F, D), jnp.float32),
+            "b2": jnp.zeros((E, D), jnp.float32)}, "state": {}}
+
+    def apply(self, variables, xe, *, train: bool = False, rng=None):
+        """xe: [E, C, D] → [E, C, D]."""
+        p = variables["params"]
+        dt = self.dtype
+        h = jnp.einsum("ecd,edf->ecf", xe.astype(dt), p["w1"].astype(dt),
+                       preferred_element_type=jnp.float32) + p["b1"][:, None]
+        h = self.activation(h)
+        y = jnp.einsum("ecf,efd->ecd", h.astype(dt), p["w2"].astype(dt),
+                       preferred_element_type=jnp.float32) + p["b2"][:, None]
+        return y, {}
+
+
+class MoELayer(Module):
+    """gate → dispatch → (A2A) → experts → (reverse A2A) → combine.
+
+    capacity_factor bounds tokens per expert: C = cf * T * k / E (static for
+    XLA; overflow dropped like the reference's capacity path).  With `mesh`
+    given, expert-major tensors are sharding-constrained to the 'ep' axis so
+    XLA inserts the all_to_all pair.
+    """
+
+    def __init__(self, gate: Module, experts: Expert, *,
+                 capacity_factor: float = 1.25, mesh=None, ep_axis: str = "ep"):
+        self.gate = gate
+        self.experts = experts
+        self.capacity_factor = capacity_factor
+        self.mesh = mesh
+        self.ep_axis = ep_axis
+
+    def init(self, key):
+        kg, ke = jax.random.split(key)
+        g = self.gate.init(kg)
+        e = self.experts.init(ke)
+        return {"params": {"gate": g["params"], "experts": e["params"]},
+                "state": {}}
+
+    def _constrain(self, x, *spec):
+        if self.mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P(*spec)))
+
+    def apply(self, variables, x, *, gate_input=None, train: bool = False,
+              rng=None):
+        """x: [B, S, D] or [T, D]. gate_input: alternative gate features
+        (e.g. token ids for HashGate)."""
+        p = variables["params"]
+        orig_shape = x.shape
+        D = x.shape[-1]
+        tokens = x.reshape(-1, D)
+        T = tokens.shape[0]
+        E = self.experts.num_experts
+        k_choices = getattr(self.gate, "k", 1)
+        capacity = max(1, int(self.capacity_factor * T * k_choices / E))
+
+        gi = gate_input.reshape(-1) if gate_input is not None else tokens
+        (gates, idx, aux), _ = self.gate.apply(
+            {"params": p["gate"], "state": {}}, gi, train=train, rng=rng)
+
+        disp, comb = make_dispatch_combine(gates, idx, E, capacity)
+        xe = layout_transform(tokens, disp)          # [E, C, D]
+        xe = self._constrain(xe, self.ep_axis)       # A2A insertion point
+        ye, _ = self.experts.apply({"params": p["experts"], "state": {}}, xe,
+                                   train=train)
+        ye = self._constrain(ye, self.ep_axis)       # reverse A2A
+        out = reverse_layout_transform(ye, comb)     # [T, D]
+        return (out.reshape(orig_shape), aux), {}
