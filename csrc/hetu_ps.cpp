@@ -1,0 +1,596 @@
+// hetu_tpu parameter-server core (C ABI, loaded via ctypes).
+//
+// TPU-native rebuild of the reference's ps-lite + hetu_cache planes
+// (reference: ps-lite/include/ps/psf/* typed PS functions,
+// ps-lite/include/ps/server/{PSFHandle,optimizer,param,ssp_handler,
+// preduce_handler}.h, src/hetu_cache/* HET versioned cache).
+//
+// On TPU-VMs the parameter/embedding plane lives on the host CPUs next to
+// the chips: tables in host RAM, server-side optimizers on host threads,
+// sparse pull/push crossing into HBM only for the touched rows.  This file
+// is the single-process core; the multi-host van (gRPC/DCN) wraps these same
+// handlers (see hetu_tpu/ps/README in python docs).
+//
+// Capabilities (mirrors PsfType enum, PSFunc.h:33-57):
+//   DensePush/DensePull/DDPushPull      -> ps_dense_{push,pull,push_pull}
+//   SparsePush/SparsePull/SDPushPull    -> ps_sparse_{push,pull,push_pull}
+//   ParamInit/Clear/Save/Load           -> ps_table_{create,clear,save,load}
+//   server optimizers (optimizer.h)     -> SGD/Momentum/AdaGrad/Adam rows
+//   kSSPInit/kSSPSync (ssp_handler.h)   -> ps_ssp_{init,clock,wait}
+//   kPReduceGetPartner (preduce_*.h)    -> ps_preduce_get_partner
+//   HET cache (hetu_cache)              -> ps_cache_{create,lookup,update,
+//                                          flush} with LRU/LFU/LFUOpt and
+//                                          version-bounded staleness.
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <list>
+#include <map>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- tables
+
+enum OptKind { OPT_SGD = 0, OPT_MOMENTUM = 1, OPT_ADAGRAD = 2, OPT_ADAM = 3 };
+
+struct Table {
+  int64_t rows = 0, dim = 0;
+  std::vector<float> data;
+  std::vector<uint64_t> version;   // per-row update counter (HET versions)
+  // server-side optimizer state
+  int opt = OPT_SGD;
+  float lr = 0.01f, mom = 0.9f, eps = 1e-7f, b1 = 0.9f, b2 = 0.999f;
+  std::vector<float> s1, s2;       // slots (velocity/accum or m/v)
+  std::vector<uint64_t> step;      // per-row adam step
+  std::mutex mu;
+};
+
+static std::mutex g_tables_mu;
+static std::map<int, Table*> g_tables;
+
+int ps_table_create(int id, int64_t rows, int64_t dim, int init_kind,
+                    double a, double b, uint64_t seed) {
+  // init_kind: 0 zeros, 1 constant(a), 2 uniform(a,b), 3 normal(mean=a,std=b)
+  auto* t = new Table();
+  t->rows = rows; t->dim = dim;
+  t->data.resize(rows * dim);
+  t->version.assign(rows, 0);
+  std::mt19937_64 rng(seed);
+  if (init_kind == 1) {
+    std::fill(t->data.begin(), t->data.end(), (float)a);
+  } else if (init_kind == 2) {
+    std::uniform_real_distribution<float> d((float)a, (float)b);
+    for (auto& x : t->data) x = d(rng);
+  } else if (init_kind == 3) {
+    std::normal_distribution<float> d((float)a, (float)b);
+    for (auto& x : t->data) x = d(rng);
+  }
+  std::lock_guard<std::mutex> lk(g_tables_mu);
+  auto it = g_tables.find(id);
+  if (it != g_tables.end()) { delete it->second; }
+  g_tables[id] = t;
+  return 0;
+}
+
+static Table* get_table(int id) {
+  std::lock_guard<std::mutex> lk(g_tables_mu);
+  auto it = g_tables.find(id);
+  return it == g_tables.end() ? nullptr : it->second;
+}
+
+int ps_table_set_optimizer(int id, int kind, float lr, float mom, float eps,
+                           float b1, float b2) {
+  Table* t = get_table(id);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  t->opt = kind; t->lr = lr; t->mom = mom; t->eps = eps; t->b1 = b1;
+  t->b2 = b2;
+  size_t n = t->data.size();
+  if (kind == OPT_MOMENTUM || kind == OPT_ADAGRAD) t->s1.assign(n, 0.f);
+  if (kind == OPT_ADAM) {
+    t->s1.assign(n, 0.f); t->s2.assign(n, 0.f);
+    t->step.assign(t->rows, 0);
+  }
+  return 0;
+}
+
+int ps_table_clear(int id) {
+  Table* t = get_table(id);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  std::fill(t->data.begin(), t->data.end(), 0.f);
+  std::fill(t->version.begin(), t->version.end(), 0);
+  return 0;
+}
+
+int64_t ps_table_rows(int id) { Table* t = get_table(id); return t ? t->rows : -1; }
+int64_t ps_table_dim(int id) { Table* t = get_table(id); return t ? t->dim : -1; }
+
+// ---------------------------------------------------------------- dense
+
+int ps_dense_pull(int id, float* out) {
+  Table* t = get_table(id);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  std::memcpy(out, t->data.data(), t->data.size() * sizeof(float));
+  return 0;
+}
+
+int ps_dense_push(int id, const float* grad) {
+  // push = apply server-side optimizer on the whole table
+  Table* t = get_table(id);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  size_t n = t->data.size();
+  switch (t->opt) {
+    case OPT_SGD:
+      for (size_t i = 0; i < n; i++) t->data[i] -= t->lr * grad[i];
+      break;
+    case OPT_MOMENTUM:
+      for (size_t i = 0; i < n; i++) {
+        t->s1[i] = t->mom * t->s1[i] - t->lr * grad[i];
+        t->data[i] += t->s1[i];
+      }
+      break;
+    case OPT_ADAGRAD:
+      for (size_t i = 0; i < n; i++) {
+        t->s1[i] += grad[i] * grad[i];
+        t->data[i] -= t->lr * grad[i] / (std::sqrt(t->s1[i]) + t->eps);
+      }
+      break;
+    case OPT_ADAM:
+      for (int64_t r = 0; r < t->rows; r++) {
+        uint64_t st = ++t->step[r];
+        float bc1 = 1.f - std::pow(t->b1, (float)st);
+        float bc2 = 1.f - std::pow(t->b2, (float)st);
+        for (int64_t d = 0; d < t->dim; d++) {
+          size_t i = r * t->dim + d;
+          t->s1[i] = t->b1 * t->s1[i] + (1 - t->b1) * grad[i];
+          t->s2[i] = t->b2 * t->s2[i] + (1 - t->b2) * grad[i] * grad[i];
+          t->data[i] -= t->lr * (t->s1[i] / bc1) /
+                        (std::sqrt(t->s2[i] / bc2) + t->eps);
+        }
+      }
+      break;
+  }
+  for (auto& v : t->version) v++;
+  return 0;
+}
+
+int ps_dense_push_pull(int id, const float* grad, float* out) {
+  int rc = ps_dense_push(id, grad);
+  if (rc) return rc;
+  return ps_dense_pull(id, out);
+}
+
+// ---------------------------------------------------------------- sparse
+
+int ps_sparse_pull(int id, const int64_t* idx, int64_t n, float* out,
+                   uint64_t* versions_out) {
+  Table* t = get_table(id);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  for (int64_t i = 0; i < n; i++) {
+    int64_t r = idx[i];
+    if (r < 0 || r >= t->rows) {
+      std::memset(out + i * t->dim, 0, t->dim * sizeof(float));
+      if (versions_out) versions_out[i] = 0;
+      continue;
+    }
+    std::memcpy(out + i * t->dim, t->data.data() + r * t->dim,
+                t->dim * sizeof(float));
+    if (versions_out) versions_out[i] = t->version[r];
+  }
+  return 0;
+}
+
+static void apply_row(Table* t, int64_t r, const float* g) {
+  float* w = t->data.data() + r * t->dim;
+  switch (t->opt) {
+    case OPT_SGD:
+      for (int64_t d = 0; d < t->dim; d++) w[d] -= t->lr * g[d];
+      break;
+    case OPT_MOMENTUM: {
+      float* v = t->s1.data() + r * t->dim;
+      for (int64_t d = 0; d < t->dim; d++) {
+        v[d] = t->mom * v[d] - t->lr * g[d];
+        w[d] += v[d];
+      }
+      break;
+    }
+    case OPT_ADAGRAD: {
+      float* a = t->s1.data() + r * t->dim;
+      for (int64_t d = 0; d < t->dim; d++) {
+        a[d] += g[d] * g[d];
+        w[d] -= t->lr * g[d] / (std::sqrt(a[d]) + t->eps);
+      }
+      break;
+    }
+    case OPT_ADAM: {
+      float* m = t->s1.data() + r * t->dim;
+      float* v = t->s2.data() + r * t->dim;
+      uint64_t st = ++t->step[r];
+      float bc1 = 1.f - std::pow(t->b1, (float)st);
+      float bc2 = 1.f - std::pow(t->b2, (float)st);
+      for (int64_t d = 0; d < t->dim; d++) {
+        m[d] = t->b1 * m[d] + (1 - t->b1) * g[d];
+        v[d] = t->b2 * v[d] + (1 - t->b2) * g[d] * g[d];
+        w[d] -= t->lr * (m[d] / bc1) / (std::sqrt(v[d] / bc2) + t->eps);
+      }
+      break;
+    }
+  }
+  t->version[r]++;
+}
+
+int ps_sparse_push(int id, const int64_t* idx, const float* grads,
+                   int64_t n) {
+  Table* t = get_table(id);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  // aggregate duplicate indices BEFORE applying: adaptive optimizers must
+  // see one step per row per push, not one per occurrence (matches the
+  // reference server handlers' aggregate-then-apply semantics)
+  std::unordered_map<int64_t, std::vector<float>> agg;
+  agg.reserve(n);
+  for (int64_t i = 0; i < n; i++) {
+    int64_t r = idx[i];
+    if (r < 0 || r >= t->rows) continue;
+    auto [it, fresh] = agg.try_emplace(r);
+    if (fresh) it->second.assign(t->dim, 0.f);
+    const float* g = grads + i * t->dim;
+    for (int64_t d = 0; d < t->dim; d++) it->second[d] += g[d];
+  }
+  for (auto& kv : agg) apply_row(t, kv.first, kv.second.data());
+  return 0;
+}
+
+int ps_sparse_push_pull(int id, const int64_t* idx, const float* grads,
+                        int64_t n, float* out) {
+  int rc = ps_sparse_push(id, idx, grads, n);
+  if (rc) return rc;
+  return ps_sparse_pull(id, idx, n, out, nullptr);
+}
+
+// raw row write (checkpoint load path)
+int ps_sparse_set(int id, const int64_t* idx, const float* vals, int64_t n) {
+  Table* t = get_table(id);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  for (int64_t i = 0; i < n; i++) {
+    int64_t r = idx[i];
+    if (r < 0 || r >= t->rows) continue;
+    std::memcpy(t->data.data() + r * t->dim, vals + i * t->dim,
+                t->dim * sizeof(float));
+    t->version[r]++;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------- save/load
+
+int ps_table_save(int id, const char* path) {
+  Table* t = get_table(id);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -2;
+  std::fwrite(&t->rows, sizeof(int64_t), 1, f);
+  std::fwrite(&t->dim, sizeof(int64_t), 1, f);
+  std::fwrite(t->data.data(), sizeof(float), t->data.size(), f);
+  std::fclose(f);
+  return 0;
+}
+
+int ps_table_load(int id, const char* path) {
+  Table* t = get_table(id);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -2;
+  int64_t rows, dim;
+  if (std::fread(&rows, sizeof(int64_t), 1, f) != 1 ||
+      std::fread(&dim, sizeof(int64_t), 1, f) != 1 ||
+      rows != t->rows || dim != t->dim) { std::fclose(f); return -3; }
+  size_t n = std::fread(t->data.data(), sizeof(float), t->data.size(), f);
+  std::fclose(f);
+  return n == t->data.size() ? 0 : -4;
+}
+
+// ---------------------------------------------------------------- SSP
+
+struct SSP {
+  int nworkers = 0, staleness = 0;
+  std::vector<int64_t> clock;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+static SSP g_ssp;
+
+int ps_ssp_init(int nworkers, int staleness) {
+  std::lock_guard<std::mutex> lk(g_ssp.mu);
+  g_ssp.nworkers = nworkers;
+  g_ssp.staleness = staleness;
+  g_ssp.clock.assign(nworkers, 0);
+  return 0;
+}
+
+// Advance worker's clock; block while it is more than `staleness` ahead of
+// the slowest worker (ssp_handler.h:12 bounded-staleness contract).
+int ps_ssp_clock_and_wait(int worker, int timeout_ms) {
+  std::unique_lock<std::mutex> lk(g_ssp.mu);
+  if (worker < 0 || worker >= g_ssp.nworkers) return -1;
+  g_ssp.clock[worker]++;
+  g_ssp.cv.notify_all();
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    int64_t min_clock = *std::min_element(g_ssp.clock.begin(),
+                                          g_ssp.clock.end());
+    if (g_ssp.clock[worker] - min_clock <= g_ssp.staleness) return 0;
+    if (g_ssp.cv.wait_until(lk, deadline) == std::cv_status::timeout)
+      return 1;  // timed out still ahead
+  }
+}
+
+int64_t ps_ssp_get_clock(int worker) {
+  std::lock_guard<std::mutex> lk(g_ssp.mu);
+  if (worker < 0 || worker >= g_ssp.nworkers) return -1;
+  return g_ssp.clock[worker];
+}
+
+// ---------------------------------------------------------------- preduce
+
+// Partial-reduce matchmaking (preduce_handler.h): a worker announces
+// readiness; the scheduler forms a group once `max_group` workers are ready
+// or `wait_ms` elapsed (>=1 member). Returns the group as a bitmask.
+struct PReduce {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<int> ready;
+  uint64_t round = 0;
+  // per-round masks: a waiter must read ITS round's group, not the latest —
+  // a single global mask races when a later round forms before the waiter
+  // reacquires the lock
+  std::map<uint64_t, uint64_t> round_masks;
+};
+static PReduce g_pr;
+
+static uint64_t preduce_form_group_locked() {
+  uint64_t mask = 0;
+  for (int w : g_pr.ready) mask |= (1ull << w);
+  g_pr.round_masks[g_pr.round] = mask;
+  g_pr.ready.clear();
+  g_pr.round++;
+  if (g_pr.round_masks.size() > 128)
+    g_pr.round_masks.erase(g_pr.round_masks.begin());
+  g_pr.cv.notify_all();
+  return mask;
+}
+
+uint64_t ps_preduce_get_partner(int worker, int max_group, int wait_ms) {
+  std::unique_lock<std::mutex> lk(g_pr.mu);
+  uint64_t my_round = g_pr.round;
+  g_pr.ready.push_back(worker);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(wait_ms);
+  if ((int)g_pr.ready.size() >= max_group) return preduce_form_group_locked();
+  while (g_pr.round == my_round) {
+    if (g_pr.cv.wait_until(lk, deadline) == std::cv_status::timeout) {
+      if (g_pr.round != my_round) break;  // formed while timing out
+      return preduce_form_group_locked();
+    }
+  }
+  auto it = g_pr.round_masks.find(my_round);
+  return it == g_pr.round_masks.end() ? 0 : it->second;
+}
+
+// ---------------------------------------------------------------- cache
+
+// Worker-side versioned embedding cache (HET, src/hetu_cache).  Policies:
+// 0 = LRU, 1 = LFU, 2 = LFUOpt (LFU with lazy aging).  The cache holds hot
+// rows with the version they were pulled at; a lookup is a hit only if the
+// cached version is within `staleness` of the server version when bounded
+// sync is requested.  Updates accumulate locally and flush by push.
+struct CacheEntry {
+  std::vector<float> row;
+  std::vector<float> pending;   // accumulated local gradient
+  uint64_t version = 0;
+  uint64_t freq = 0;            // LFU
+  uint64_t last = 0;            // LRU tick
+  bool dirty = false;
+};
+
+struct Cache {
+  int table_id = 0;
+  int64_t capacity = 0, dim = 0;
+  int policy = 0;
+  uint64_t tick = 0;
+  std::unordered_map<int64_t, CacheEntry> entries;
+  std::mutex mu;
+
+  uint64_t score(const CacheEntry& e) const {
+    if (policy == 0) return e.last;                 // LRU
+    if (policy == 1) return e.freq;                 // LFU
+    // LFUOpt: LFU with lazy aging — halve the stale frequency once per
+    // `capacity` ticks since last access, so once-hot rows can be displaced
+    // by currently-hot ones (reference LFUOpt, src/hetu_cache policies)
+    uint64_t age = (tick - e.last) / (uint64_t)std::max<int64_t>(capacity, 1);
+    return e.freq >> std::min<uint64_t>(age, 63);
+  }
+};
+
+static std::mutex g_caches_mu;
+static std::map<int, Cache*> g_caches;
+
+int ps_cache_create(int cache_id, int table_id, int64_t capacity,
+                    int policy) {
+  Table* t = get_table(table_id);
+  if (!t) return -1;
+  auto* c = new Cache();
+  c->table_id = table_id;
+  c->capacity = capacity;
+  c->dim = t->dim;
+  c->policy = policy;
+  std::lock_guard<std::mutex> lk(g_caches_mu);
+  auto it = g_caches.find(cache_id);
+  if (it != g_caches.end()) delete it->second;
+  g_caches[cache_id] = c;
+  return 0;
+}
+
+static Cache* get_cache(int id) {
+  std::lock_guard<std::mutex> lk(g_caches_mu);
+  auto it = g_caches.find(id);
+  return it == g_caches.end() ? nullptr : it->second;
+}
+
+// Embedding lookup through the cache with bounded staleness:
+// rows whose cached version is older than (server version - staleness) are
+// re-pulled (syncEmbedding, hetu_client.h:19-31).  Returns #misses.
+int64_t ps_cache_lookup(int cache_id, const int64_t* idx, int64_t n,
+                        uint64_t staleness, float* out) {
+  Cache* c = get_cache(cache_id);
+  if (!c) return -1;
+  Table* t = get_table(c->table_id);
+  if (!t) return -2;
+  std::lock_guard<std::mutex> lk(c->mu);
+  int64_t misses = 0;
+  c->tick++;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t key = idx[i];
+    // out-of-range keys are NEVER cached: zero rows out, like the server's
+    // sparse_pull bounds behavior (caching them would later reach apply_row
+    // with an OOB row index)
+    if (key < 0 || key >= t->rows) {
+      std::memset(out + i * c->dim, 0, c->dim * sizeof(float));
+      continue;
+    }
+    auto it = c->entries.find(key);
+    bool hit = false;
+    if (it != c->entries.end()) {
+      uint64_t server_v;
+      {
+        std::lock_guard<std::mutex> tl(t->mu);
+        server_v = t->version[key];
+      }
+      if (server_v <= it->second.version + staleness) hit = true;
+    }
+    if (!hit) {
+      misses++;
+      // flush pending update for the row before refreshing (pushSyncEmbedding)
+      if (it != c->entries.end() && it->second.dirty) {
+        std::lock_guard<std::mutex> tl(t->mu);
+        apply_row(t, key, it->second.pending.data());
+        it->second.dirty = false;
+        std::fill(it->second.pending.begin(), it->second.pending.end(), 0.f);
+      }
+      // pull fresh row
+      CacheEntry& e = c->entries[key];
+      e.row.resize(c->dim);
+      e.pending.assign(c->dim, 0.f);
+      {
+        std::lock_guard<std::mutex> tl(t->mu);
+        std::memcpy(e.row.data(), t->data.data() + key * c->dim,
+                    c->dim * sizeof(float));
+        e.version = t->version[key];
+      }
+      it = c->entries.find(key);
+    }
+    CacheEntry& e = it->second;
+    e.freq++;
+    e.last = c->tick;
+    std::memcpy(out + i * c->dim, e.row.data(), c->dim * sizeof(float));
+  }
+  // batch-evict down to capacity in one scored pass (not one full scan per
+  // victim): O(C log C) per lookup instead of O(misses * C)
+  int64_t excess = (int64_t)c->entries.size() - c->capacity;
+  if (excess > 0) {
+    std::vector<std::pair<uint64_t, int64_t>> scored;
+    scored.reserve(c->entries.size());
+    for (auto& kv : c->entries)
+      scored.emplace_back(c->score(kv.second), kv.first);
+    std::nth_element(scored.begin(), scored.begin() + excess, scored.end());
+    for (int64_t i = 0; i < excess; i++) {
+      int64_t victim = scored[i].second;
+      CacheEntry& e = c->entries[victim];
+      if (e.dirty) {
+        std::lock_guard<std::mutex> tl(t->mu);
+        apply_row(t, victim, e.pending.data());
+      }
+      c->entries.erase(victim);
+    }
+  }
+  return misses;
+}
+
+// Accumulate local gradient rows into the cache (pushEmbedding with lazy
+// flush); rows not cached are pushed straight to the server.
+int ps_cache_update(int cache_id, const int64_t* idx, const float* grads,
+                    int64_t n) {
+  Cache* c = get_cache(cache_id);
+  if (!c) return -1;
+  Table* t = get_table(c->table_id);
+  if (!t) return -2;
+  std::lock_guard<std::mutex> lk(c->mu);
+  for (int64_t i = 0; i < n; i++) {
+    int64_t key = idx[i];
+    if (key < 0) continue;
+    auto it = c->entries.find(key);
+    if (it == c->entries.end()) {
+      std::lock_guard<std::mutex> tl(t->mu);
+      if (key < t->rows) apply_row(t, key, grads + i * c->dim);
+      continue;
+    }
+    CacheEntry& e = it->second;
+    const float* g = grads + i * c->dim;
+    for (int64_t d = 0; d < c->dim; d++) e.pending[d] += g[d];
+    e.dirty = true;
+    // optimistic LOCAL application so subsequent cached lookups see fresh
+    // values (the HET trick: bounded divergence instead of synchronous
+    // push).  First-order (SGD with the table lr) on the local copy; the
+    // server applies its full optimizer to the accumulated gradient on
+    // flush/eviction, after which the row is re-pulled.
+    for (int64_t d = 0; d < c->dim; d++) e.row[d] -= t->lr * g[d];
+  }
+  return 0;
+}
+
+// Flush all dirty rows to the server and refresh their cached copies.
+int ps_cache_flush(int cache_id) {
+  Cache* c = get_cache(cache_id);
+  if (!c) return -1;
+  Table* t = get_table(c->table_id);
+  if (!t) return -2;
+  std::lock_guard<std::mutex> lk(c->mu);
+  std::lock_guard<std::mutex> tl(t->mu);
+  for (auto& kv : c->entries) {
+    if (!kv.second.dirty) continue;
+    apply_row(t, kv.first, kv.second.pending.data());
+    std::memcpy(kv.second.row.data(), t->data.data() + kv.first * c->dim,
+                c->dim * sizeof(float));
+    kv.second.version = t->version[kv.first];
+    kv.second.dirty = false;
+    std::fill(kv.second.pending.begin(), kv.second.pending.end(), 0.f);
+  }
+  return 0;
+}
+
+int64_t ps_cache_size(int cache_id) {
+  Cache* c = get_cache(cache_id);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> lk(c->mu);
+  return (int64_t)c->entries.size();
+}
+
+}  // extern "C"

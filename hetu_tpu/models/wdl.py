@@ -1,0 +1,73 @@
+"""Wide & Deep for CTR.
+
+Reference: examples/ctr/models/wdl.py (+ the PS/Hybrid launch scripts in
+examples/ctr/tests/*.sh) — BASELINE.json config #4 workload.
+
+Hybrid-parallel structure preserved from the reference: the (huge) sparse
+embedding tables live on the parameter server (hetu_tpu/ps/PSEmbedding);
+this module holds only the DENSE parameters, and its apply takes the pulled
+embedding rows as an input so the jitted step returns d(loss)/d(rows) for
+the host to push back (hetu_tpu/ps/embedding.py docstring).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import layers, ops
+from hetu_tpu.layers.base import Module
+
+
+class WideDeep(Module):
+    def __init__(self, num_sparse_fields: int, emb_dim: int, dense_dim: int,
+                 hidden=(256, 256)):
+        self.num_sparse_fields = num_sparse_fields
+        self.emb_dim = emb_dim
+        self.dense_dim = dense_dim
+        mods = []
+        prev = num_sparse_fields * emb_dim + dense_dim
+        for h in hidden:
+            mods += [layers.Linear(prev, h), layers.Relu()]
+            prev = h
+        mods.append(layers.Linear(prev, 1))
+        self.deep = layers.Sequential(*mods)
+        self.wide = layers.Linear(dense_dim, 1)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        d = self.deep.init(k1)
+        w = self.wide.init(k2)
+        return {"params": {"deep": d["params"], "wide": w["params"]},
+                "state": {"deep": d["state"], "wide": w["state"]}}
+
+    def apply(self, variables, dense_x, emb_rows, *, train: bool = False,
+              rng=None):
+        """dense_x: [B, dense_dim]; emb_rows: [B, fields, emb_dim]."""
+        p, s = variables["params"], variables["state"]
+        flat = emb_rows.reshape(emb_rows.shape[0], -1)
+        deep_in = jnp.concatenate([flat, dense_x], axis=-1)
+        deep_out, ds = self.deep.apply({"params": p["deep"],
+                                        "state": s["deep"]}, deep_in,
+                                       train=train, rng=rng)
+        wide_out, _ = self.wide.apply({"params": p["wide"],
+                                       "state": s["wide"]}, dense_x)
+        logit = (deep_out + wide_out)[:, 0]
+        return logit, {"deep": ds, "wide": {}}
+
+    def hybrid_step_fn(self, optimizer):
+        """Jitted hybrid train step: updates dense params, returns embedding
+        row grads for the PS push (the ParameterServerCommunicate analog)."""
+        def step(params, opt_state, model_state, dense_x, emb_rows, labels):
+            def loss_fn(params, emb_rows):
+                logit, new_state = self.apply(
+                    {"params": params, "state": model_state},
+                    dense_x, emb_rows, train=True)
+                loss = jnp.mean(
+                    ops.binary_cross_entropy_with_logits(logit, labels))
+                return loss, (logit, new_state)
+            (loss, (logit, new_state)), (gp, ge) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(params, emb_rows)
+            params, opt_state = optimizer.update(gp, opt_state, params)
+            return params, opt_state, new_state, loss, logit, ge
+        return jax.jit(step, donate_argnums=(0, 1))

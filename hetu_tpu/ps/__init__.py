@@ -1,0 +1,5 @@
+from hetu_tpu.ps.binding import lib, available
+from hetu_tpu.ps.client import (
+    PSTable, CacheSparseTable, SSPController, PartialReduce,
+)
+from hetu_tpu.ps.embedding import PSEmbedding

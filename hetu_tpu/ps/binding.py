@@ -1,0 +1,103 @@
+"""ctypes binding + on-demand build of the C++ PS core.
+
+Reference analog: python/hetu/_base.py loading _LIB/libps.so via ctypes and
+ps-lite/src/python_binding.cc (151 LoC C API).  We compile csrc/hetu_ps.cpp
+with g++ on first use (no cmake needed for one TU) into
+hetu_tpu/ps/_build/libhetu_ps.so.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE.parent.parent / "csrc" / "hetu_ps.cpp"
+_BUILD = _HERE / "_build"
+_SO = _BUILD / "libhetu_ps.so"
+
+_lock = threading.Lock()
+_lib = None
+_err = None
+
+
+def _build() -> None:
+    _BUILD.mkdir(parents=True, exist_ok=True)
+    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+        return
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           str(_SRC), "-o", str(_SO)]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _load():
+    global _lib, _err
+    with _lock:
+        if _lib is not None or _err is not None:
+            return _lib
+        try:
+            _build()
+            lib = ctypes.CDLL(str(_SO))
+        except Exception as e:  # pragma: no cover
+            _err = e
+            return None
+        c = ctypes
+        i64p = c.POINTER(c.c_int64)
+        f32p = c.POINTER(c.c_float)
+        u64p = c.POINTER(c.c_uint64)
+        sigs = {
+            "ps_table_create": ([c.c_int, c.c_int64, c.c_int64, c.c_int,
+                                 c.c_double, c.c_double, c.c_uint64], c.c_int),
+            "ps_table_set_optimizer": ([c.c_int, c.c_int, c.c_float, c.c_float,
+                                        c.c_float, c.c_float, c.c_float],
+                                       c.c_int),
+            "ps_table_clear": ([c.c_int], c.c_int),
+            "ps_table_rows": ([c.c_int], c.c_int64),
+            "ps_table_dim": ([c.c_int], c.c_int64),
+            "ps_dense_pull": ([c.c_int, f32p], c.c_int),
+            "ps_dense_push": ([c.c_int, f32p], c.c_int),
+            "ps_dense_push_pull": ([c.c_int, f32p, f32p], c.c_int),
+            "ps_sparse_pull": ([c.c_int, i64p, c.c_int64, f32p, u64p],
+                               c.c_int),
+            "ps_sparse_push": ([c.c_int, i64p, f32p, c.c_int64], c.c_int),
+            "ps_sparse_push_pull": ([c.c_int, i64p, f32p, c.c_int64, f32p],
+                                    c.c_int),
+            "ps_sparse_set": ([c.c_int, i64p, f32p, c.c_int64], c.c_int),
+            "ps_table_save": ([c.c_int, c.c_char_p], c.c_int),
+            "ps_table_load": ([c.c_int, c.c_char_p], c.c_int),
+            "ps_ssp_init": ([c.c_int, c.c_int], c.c_int),
+            "ps_ssp_clock_and_wait": ([c.c_int, c.c_int], c.c_int),
+            "ps_ssp_get_clock": ([c.c_int], c.c_int64),
+            "ps_preduce_get_partner": ([c.c_int, c.c_int, c.c_int],
+                                       c.c_uint64),
+            "ps_cache_create": ([c.c_int, c.c_int, c.c_int64, c.c_int],
+                                c.c_int),
+            "ps_cache_lookup": ([c.c_int, i64p, c.c_int64, c.c_uint64, f32p],
+                                c.c_int64),
+            "ps_cache_update": ([c.c_int, i64p, f32p, c.c_int64], c.c_int),
+            "ps_cache_flush": ([c.c_int], c.c_int),
+            "ps_cache_size": ([c.c_int], c.c_int64),
+        }
+        for name, (argtypes, restype) in sigs.items():
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = restype
+        _lib = lib
+        return _lib
+
+
+class _Lazy:
+    def __getattr__(self, name):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"hetu_ps native lib unavailable: {_err}")
+        return getattr(lib, name)
+
+
+lib = _Lazy()
+
+
+def available() -> bool:
+    return _load() is not None

@@ -1,0 +1,182 @@
+"""Python client objects over the native PS core.
+
+Reference analogs: python/hetu/cstable.py (CacheSparseTable :19),
+communicator PS worker calls in gpu_ops/ParameterServerCommunicate.py, SSP
+(ssp_handler.h), PartialReduce (python/hetu/preduce.py:8).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import itertools
+import threading
+
+import numpy as np
+
+from hetu_tpu.ps.binding import lib
+
+_table_ids = itertools.count(1)
+_cache_ids = itertools.count(1)
+
+
+def _i64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _check(rc, what: str):
+    """Raise on native-call failure (NOT assert: asserts vanish under -O)."""
+    if rc != 0:
+        raise RuntimeError(f"hetu_ps {what} failed with rc={rc}")
+    return rc
+
+
+_INIT_KINDS = {"zeros": 0, "constant": 1, "uniform": 2, "normal": 3}
+_OPT_KINDS = {"sgd": 0, "momentum": 1, "adagrad": 2, "adam": 3}
+
+
+class PSTable:
+    """A server-held parameter table with a server-side optimizer."""
+
+    def __init__(self, rows: int, dim: int, *, init: str = "normal",
+                 init_a: float = 0.0, init_b: float = 0.01, seed: int = 0,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 momentum: float = 0.9, eps: float = 1e-7,
+                 beta1: float = 0.9, beta2: float = 0.999):
+        self.id = next(_table_ids)
+        self.rows, self.dim = rows, dim
+        _check(lib.ps_table_create(self.id, rows, dim, _INIT_KINDS[init],
+                                   init_a, init_b, seed), "table_create")
+        _check(lib.ps_table_set_optimizer(self.id, _OPT_KINDS[optimizer], lr,
+                                          momentum, eps, beta1, beta2),
+               "set_optimizer")
+
+    # ---- dense plane ----
+    def dense_pull(self) -> np.ndarray:
+        out = np.empty((self.rows, self.dim), np.float32)
+        _check(lib.ps_dense_pull(self.id, _f32p(out)), "dense_pull")
+        return out
+
+    def dense_push(self, grad: np.ndarray) -> None:
+        grad = np.ascontiguousarray(grad, np.float32)
+        _check(lib.ps_dense_push(self.id, _f32p(grad)), "dense_push")
+
+    # ---- sparse plane ----
+    def sparse_pull(self, indices, *, with_versions: bool = False):
+        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
+        out = np.empty((idx.shape[0], self.dim), np.float32)
+        ver = np.empty(idx.shape[0], np.uint64) if with_versions else None
+        vp = ver.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)) if \
+            with_versions else None
+        _check(lib.ps_sparse_pull(self.id, _i64p(idx), idx.shape[0],
+                                  _f32p(out), vp), "sparse_pull")
+        return (out, ver) if with_versions else out
+
+    def sparse_push(self, indices, grads) -> None:
+        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
+        g = np.ascontiguousarray(grads, np.float32).reshape(idx.shape[0],
+                                                            self.dim)
+        _check(lib.ps_sparse_push(self.id, _i64p(idx), _f32p(g),
+                                  idx.shape[0]), "sparse_push")
+
+    def sparse_set(self, indices, values) -> None:
+        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
+        v = np.ascontiguousarray(values, np.float32).reshape(idx.shape[0],
+                                                             self.dim)
+        _check(lib.ps_sparse_set(self.id, _i64p(idx), _f32p(v),
+                                 idx.shape[0]), "sparse_set")
+
+    # ---- checkpoint (reference SaveParam/LoadParam) ----
+    def save(self, path) -> None:
+        _check(lib.ps_table_save(self.id, str(path).encode()), "table_save")
+
+    def load(self, path) -> None:
+        _check(lib.ps_table_load(self.id, str(path).encode()), "table_load")
+
+
+_POLICIES = {"lru": 0, "lfu": 1, "lfuopt": 2}
+
+
+class CacheSparseTable:
+    """Worker-side versioned embedding cache over a PSTable (HET tier;
+    reference python/hetu/cstable.py:19 + src/hetu_cache)."""
+
+    def __init__(self, table: PSTable, capacity: int,
+                 policy: str = "lfuopt", *, pull_bound: int = 0):
+        self.table = table
+        self.dim = table.dim
+        self.pull_bound = pull_bound  # staleness bound (versions)
+        self.id = next(_cache_ids)
+        _check(lib.ps_cache_create(self.id, table.id, capacity,
+                                   _POLICIES[policy]), "cache_create")
+        self.misses = 0
+        self.lookups = 0
+
+    def embedding_lookup(self, indices) -> np.ndarray:
+        idx = np.ascontiguousarray(indices, np.int64)
+        flat = idx.reshape(-1)
+        out = np.empty((flat.shape[0], self.dim), np.float32)
+        m = lib.ps_cache_lookup(self.id, _i64p(flat), flat.shape[0],
+                                self.pull_bound, _f32p(out))
+        if m < 0:
+            raise RuntimeError(f"hetu_ps cache_lookup failed with rc={m}")
+        self.misses += int(m)
+        self.lookups += flat.shape[0]
+        return out.reshape(*idx.shape, self.dim)
+
+    def embedding_update(self, indices, grads) -> None:
+        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
+        g = np.ascontiguousarray(grads, np.float32).reshape(idx.shape[0],
+                                                            self.dim)
+        _check(lib.ps_cache_update(self.id, _i64p(idx), _f32p(g),
+                                   idx.shape[0]), "cache_update")
+
+    def flush(self) -> None:
+        _check(lib.ps_cache_flush(self.id), "cache_flush")
+
+    @property
+    def size(self) -> int:
+        return int(lib.ps_cache_size(self.id))
+
+    @property
+    def hit_rate(self) -> float:
+        return 1.0 - self.misses / max(self.lookups, 1)
+
+
+class SSPController:
+    """Bounded-staleness clocks (reference ssp_handler.h)."""
+
+    def __init__(self, n_workers: int, staleness: int):
+        _check(lib.ps_ssp_init(n_workers, staleness), "ssp_init")
+        self.n_workers = n_workers
+
+    def clock_and_wait(self, worker: int, timeout_ms: int = 10_000) -> bool:
+        """Advance `worker`'s clock; True if within bound, False on timeout."""
+        rc = lib.ps_ssp_clock_and_wait(worker, timeout_ms)
+        if rc < 0:
+            raise RuntimeError(f"hetu_ps ssp_clock_and_wait rc={rc}")
+        return rc == 0
+
+    def clock(self, worker: int) -> int:
+        return int(lib.ps_ssp_get_clock(worker))
+
+
+class PartialReduce:
+    """Straggler-tolerant dynamic reduce groups (reference preduce.py:8).
+
+    get_partner returns the worker-id bitmask of this round's group; the
+    caller then runs the group allreduce (on TPU: a masked psum or a
+    gathered mean over the members).
+    """
+
+    def __init__(self, max_group: int = 8, wait_ms: int = 100):
+        self.max_group = max_group
+        self.wait_ms = wait_ms
+
+    def get_partner(self, worker: int) -> list[int]:
+        mask = int(lib.ps_preduce_get_partner(worker, self.max_group,
+                                              self.wait_ms))
+        return [i for i in range(64) if mask & (1 << i)]

@@ -1,0 +1,64 @@
+"""PS-backed embedding for training loops — the Hybrid comm-mode path.
+
+Reference: gpu_ops/ParameterServerCommunicate.py + EmbeddingLookUp with PS
+(executor prefetch pipeline, executor.py:384): dense params ride the
+allreduce plane while embeddings live on the parameter server; workers pull
+the touched rows before the step and push IndexedSlices after.
+
+TPU shape of the same idea: the jitted step takes the pulled rows as a
+regular input (so XLA sees a small dense tensor, not the trillion-row
+table), returns the rows' gradient as an output, and the host pushes it to
+the PS between steps.  `pull` can overlap the previous step (prefetch) since
+it's pure host work.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from hetu_tpu.ps.client import CacheSparseTable, PSTable
+
+
+class PSEmbedding:
+    """num_embeddings x dim table on the PS, with optional HET cache tier."""
+
+    def __init__(self, num_embeddings: int, dim: int, *,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 cache_capacity: Optional[int] = None,
+                 cache_policy: str = "lfuopt", pull_bound: int = 0,
+                 init: str = "normal", init_b: float = 0.01, seed: int = 0):
+        self.table = PSTable(num_embeddings, dim, init=init, init_b=init_b,
+                             seed=seed, optimizer=optimizer, lr=lr)
+        self.cache = (CacheSparseTable(self.table, cache_capacity,
+                                       cache_policy, pull_bound=pull_bound)
+                      if cache_capacity else None)
+        self.dim = dim
+
+    def pull(self, indices) -> np.ndarray:
+        """rows for this batch: [*indices.shape, dim] float32."""
+        if self.cache is not None:
+            return self.cache.embedding_lookup(indices)
+        return self.table.sparse_pull(
+            np.asarray(indices).reshape(-1)).reshape(
+                *np.asarray(indices).shape, self.dim)
+
+    def push(self, indices, row_grads) -> None:
+        """apply d(loss)/d(rows) on the server (or into the cache tier)."""
+        if self.cache is not None:
+            self.cache.embedding_update(indices, row_grads)
+        else:
+            self.table.sparse_push(indices, row_grads)
+
+    def flush(self) -> None:
+        if self.cache is not None:
+            self.cache.flush()
+
+    # checkpoint plumbing (reference PS SaveParam/LoadParam)
+    def save(self, path) -> None:
+        self.flush()
+        self.table.save(path)
+
+    def load(self, path) -> None:
+        self.table.load(path)

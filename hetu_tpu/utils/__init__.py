@@ -1,0 +1,2 @@
+from hetu_tpu.utils import metrics
+from hetu_tpu.utils.logger import MetricLogger

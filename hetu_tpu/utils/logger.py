@@ -1,0 +1,61 @@
+"""Training metric logger.
+
+Reference: python/hetu/logger.py (HetuLogger with NCCL-allreduced scalars,
+WandbLogger wired in executor.py:402-415).  Here scalar aggregation across
+shards already happened inside the jitted step (psum/pmean), so the logger
+is host-side bookkeeping: running means per key, step timing, optional
+wandb passthrough when the package + env are present.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import defaultdict
+from pathlib import Path
+from typing import Optional
+
+
+class MetricLogger:
+    def __init__(self, log_path: Optional[str] = None, *,
+                 use_wandb: bool = False, wandb_kwargs: Optional[dict] = None):
+        self.totals = defaultdict(float)
+        self.counts = defaultdict(int)
+        self.step = 0
+        self.t0 = time.perf_counter()
+        self.log_file = open(log_path, "a") if log_path else None
+        self.wandb = None
+        if use_wandb:  # pragma: no cover - optional dependency
+            try:
+                import wandb
+                wandb.init(**(wandb_kwargs or {}))
+                self.wandb = wandb
+            except Exception:
+                self.wandb = None
+
+    def log(self, metrics: dict, step: Optional[int] = None) -> None:
+        self.step = step if step is not None else self.step + 1
+        for k, v in metrics.items():
+            self.totals[k] += float(v)
+            self.counts[k] += 1
+        if self.wandb is not None:  # pragma: no cover
+            self.wandb.log({k: float(v) for k, v in metrics.items()},
+                           step=self.step)
+        if self.log_file:
+            rec = {"step": self.step,
+                   "t": round(time.perf_counter() - self.t0, 3),
+                   **{k: float(v) for k, v in metrics.items()}}
+            self.log_file.write(json.dumps(rec) + "\n")
+            self.log_file.flush()
+
+    def means(self) -> dict:
+        return {k: self.totals[k] / max(self.counts[k], 1)
+                for k in self.totals}
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
+
+    def close(self) -> None:
+        if self.log_file:
+            self.log_file.close()

@@ -1,0 +1,61 @@
+"""Wide&Deep hybrid (PS embeddings + dense optimizer) integration test +
+metrics unit tests."""
+
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import available
+from hetu_tpu.utils import metrics
+
+
+def test_auc_known_values():
+    assert metrics.auc([0.9, 0.8, 0.2, 0.1], [1, 1, 0, 0]) == 1.0
+    assert metrics.auc([0.1, 0.2, 0.8, 0.9], [1, 1, 0, 0]) == 0.0
+    assert abs(metrics.auc([0.5, 0.5, 0.5, 0.5], [1, 0, 1, 0]) - 0.5) < 1e-9
+    # ties averaged
+    assert abs(metrics.auc([0.9, 0.5, 0.5, 0.1], [1, 1, 0, 0]) - 0.875) < 1e-9
+
+
+def test_accuracy_and_f1():
+    assert metrics.accuracy(np.eye(3), [0, 1, 2]) == 1.0
+    p, r, f1 = metrics.precision_recall_f1([0.9, 0.9, 0.1, 0.9],
+                                           [1, 1, 0, 0])
+    assert p == 2 / 3 and r == 1.0
+    cm = metrics.confusion_matrix(np.asarray([0, 1, 1]), np.asarray([0, 1, 0]),
+                                  2)
+    assert cm[0, 0] == 1 and cm[0, 1] == 1 and cm[1, 1] == 1
+
+
+@pytest.mark.skipif(not available(), reason="native PS lib unavailable")
+def test_wdl_hybrid_learns():
+    import jax
+    from hetu_tpu import optim
+    from hetu_tpu.models.wdl import WideDeep
+    from hetu_tpu.ps import PSEmbedding
+
+    g = np.random.default_rng(0)
+    fields, dense_dim, vocab, B = 4, 3, 50, 64
+    sparse = g.integers(0, vocab, (B * 8, fields)).astype(np.int64)
+    dense_x = g.standard_normal((B * 8, dense_dim)).astype(np.float32)
+    y = ((sparse.sum(-1) % 2) ^ (dense_x[:, 0] > 0)).astype(np.float32)
+
+    emb = PSEmbedding(vocab, 8, optimizer="adagrad", lr=0.1,
+                      cache_capacity=64, seed=0)
+    model = WideDeep(fields, 8, dense_dim, hidden=(32,))
+    opt = optim.AdamOptimizer(5e-3)
+    v = model.init(jax.random.PRNGKey(0))
+    params, model_state = v["params"], v["state"]
+    opt_state = opt.init_state(params)
+    step = model.hybrid_step_fn(opt)
+
+    losses = []
+    for it in range(40):
+        lo = (it * B) % (sparse.shape[0] - B)
+        ids, dx, yy = (sparse[lo:lo + B], dense_x[lo:lo + B], y[lo:lo + B])
+        rows = emb.pull(ids)
+        params, opt_state, model_state, loss, logit, ge = step(
+            params, opt_state, model_state, dx, rows, yy)
+        emb.push(ids, np.asarray(ge))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    assert emb.cache.hit_rate > 0  # cache tier active

@@ -1,0 +1,216 @@
+"""Parameter-server plane tests (native C++ core via ctypes).
+
+Reference analogs: tests/pstests/test_apis.py, tests/hetu_cache/
+hetu_cache_test.py (cache vs numpy mirror), tests/test_ps_preduce.py.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import available
+
+if not available():  # pragma: no cover
+    pytest.skip("native PS lib unavailable", allow_module_level=True)
+
+from hetu_tpu.ps import CacheSparseTable, PSEmbedding, PSTable, \
+    PartialReduce, SSPController
+
+
+def test_dense_pull_push_sgd():
+    t = PSTable(4, 3, init="constant", init_a=1.0, optimizer="sgd", lr=0.1)
+    w0 = t.dense_pull()
+    np.testing.assert_allclose(w0, 1.0)
+    g = np.full((4, 3), 2.0, np.float32)
+    t.dense_push(g)
+    np.testing.assert_allclose(t.dense_pull(), 1.0 - 0.2, rtol=1e-6)
+
+
+def test_sparse_pull_push_and_versions():
+    t = PSTable(10, 4, init="normal", init_b=0.1, seed=3, optimizer="sgd",
+                lr=0.5)
+    w = t.dense_pull()
+    rows, ver = t.sparse_pull([1, 5], with_versions=True)
+    np.testing.assert_allclose(rows, w[[1, 5]])
+    assert list(ver) == [0, 0]
+    g = np.ones((2, 4), np.float32)
+    t.sparse_push([1, 5], g)
+    rows2, ver2 = t.sparse_pull([1, 5], with_versions=True)
+    np.testing.assert_allclose(rows2, w[[1, 5]] - 0.5, rtol=1e-6)
+    assert list(ver2) == [1, 1]
+    # untouched rows unchanged, version 0
+    np.testing.assert_allclose(t.sparse_pull([2]), w[[2]])
+
+
+def test_server_adam_matches_numpy():
+    t = PSTable(3, 2, init="zeros", optimizer="adam", lr=0.1)
+    g = np.asarray([[1, 2], [3, 4], [5, 6]], np.float32)
+    for _ in range(3):
+        t.dense_push(g)
+    # numpy adam
+    w = np.zeros((3, 2), np.float32); m = np.zeros_like(w); v = np.zeros_like(w)
+    for s in range(1, 4):
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        w -= 0.1 * (m / (1 - 0.9 ** s)) / (np.sqrt(v / (1 - 0.999 ** s)) + 1e-7)
+    np.testing.assert_allclose(t.dense_pull(), w, rtol=1e-5)
+
+
+def test_save_load_roundtrip(tmp_path):
+    t = PSTable(5, 3, init="normal", init_b=1.0, seed=7)
+    w = t.dense_pull()
+    t.save(tmp_path / "t.bin")
+    t.dense_push(np.ones((5, 3), np.float32))
+    assert not np.allclose(t.dense_pull(), w)
+    t.load(tmp_path / "t.bin")
+    np.testing.assert_allclose(t.dense_pull(), w)
+
+
+def test_cache_hits_and_eviction():
+    t = PSTable(100, 4, init="normal", init_b=0.1, seed=1)
+    c = CacheSparseTable(t, capacity=8, policy="lru")
+    w = t.dense_pull()
+    out = c.embedding_lookup([1, 2, 3])
+    np.testing.assert_allclose(out, w[[1, 2, 3]])
+    assert c.misses == 3
+    c.embedding_lookup([1, 2, 3])
+    assert c.misses == 3  # all hits
+    # overflow capacity → eviction keeps size bounded
+    c.embedding_lookup(np.arange(20))
+    assert c.size <= 8
+
+
+def test_cache_staleness_bound():
+    t = PSTable(10, 2, init="zeros", optimizer="sgd", lr=1.0)
+    c = CacheSparseTable(t, capacity=10, policy="lfu", pull_bound=0)
+    c.embedding_lookup([0])           # cached at version 0
+    t.sparse_push([0], np.ones((1, 2), np.float32))  # server moves to v1
+    out = c.embedding_lookup([0])     # bound 0 → must re-pull
+    np.testing.assert_allclose(out[0], [-1.0, -1.0])
+
+    c2 = CacheSparseTable(t, capacity=10, policy="lfu", pull_bound=5)
+    c2.embedding_lookup([0])
+    t.sparse_push([0], np.ones((1, 2), np.float32))  # v2, within bound 5
+    out2 = c2.embedding_lookup([0])
+    np.testing.assert_allclose(out2[0], [-1.0, -1.0])  # stale copy OK
+    assert c2.misses == 1  # second lookup was a bounded-staleness hit
+
+
+def test_cache_update_flush():
+    t = PSTable(10, 2, init="zeros", optimizer="sgd", lr=0.5)
+    c = CacheSparseTable(t, capacity=10)
+    c.embedding_lookup([3])
+    c.embedding_update([3], np.full((1, 2), 2.0, np.float32))
+    # server not yet updated (lazy push)
+    np.testing.assert_allclose(t.sparse_pull([3]), 0.0)
+    c.flush()
+    np.testing.assert_allclose(t.sparse_pull([3]), -1.0, rtol=1e-6)
+
+
+def test_cache_oob_keys_safe():
+    """OOB ids through the cache tier: zero rows, never cached, flush safe
+    (regression: was heap corruption)."""
+    t = PSTable(4, 2, init="constant", init_a=1.0, optimizer="sgd", lr=0.5)
+    c = CacheSparseTable(t, capacity=4)
+    out = c.embedding_lookup([100000, 1, -5])
+    np.testing.assert_allclose(out[0], 0.0)
+    np.testing.assert_allclose(out[1], 1.0)
+    np.testing.assert_allclose(out[2], 0.0)
+    c.embedding_update([100000, -5], np.ones((2, 2), np.float32))
+    c.flush()  # must not crash / corrupt
+    np.testing.assert_allclose(t.dense_pull(), 1.0)  # untouched
+
+
+def test_cache_local_updates_visible():
+    """Cached lookups must see locally-accumulated updates before flush
+    (regression: rows were frozen at pull value)."""
+    t = PSTable(10, 2, init="zeros", optimizer="sgd", lr=0.5)
+    c = CacheSparseTable(t, capacity=10)
+    c.embedding_lookup([3])
+    for _ in range(2):
+        c.embedding_update([3], np.full((1, 2), 2.0, np.float32))
+    out = c.embedding_lookup([3])  # hit; local copy advanced
+    np.testing.assert_allclose(out[0], [-2.0, -2.0])  # 2 local sgd steps
+    c.flush()
+    # server applied ONE aggregated optimizer step on pending sum (4.0)
+    np.testing.assert_allclose(t.sparse_pull([3])[0], [-2.0, -2.0], rtol=1e-6)
+
+
+def test_sparse_push_aggregates_duplicates():
+    """Duplicate ids in one push = one adaptive-optimizer step on the summed
+    gradient (regression: was one step per occurrence)."""
+    t = PSTable(4, 1, init="zeros", optimizer="adagrad", lr=1.0)
+    t.sparse_push([2, 2], np.asarray([[1.0], [1.0]], np.float32))
+    # aggregated: g=2 → acc=4 → w = -1*2/2 = -1
+    np.testing.assert_allclose(t.sparse_pull([2])[0], [-1.0], rtol=1e-5)
+    _, ver = t.sparse_pull([2], with_versions=True)
+    assert int(ver[0]) == 1  # one update, not two
+
+
+def test_ssp_bounded_staleness():
+    ssp = SSPController(2, staleness=1)
+    results = {}
+
+    def fast():
+        ok0 = ssp.clock_and_wait(0, timeout_ms=200)   # clock 1, min 0 → ok
+        ok1 = ssp.clock_and_wait(0, timeout_ms=300)   # clock 2 → must wait
+        results["fast"] = (ok0, ok1, time.time())
+
+    def slow():
+        time.sleep(0.15)
+        ssp.clock_and_wait(1, timeout_ms=200)
+        results["slow"] = time.time()
+
+    t1, t2 = threading.Thread(target=fast), threading.Thread(target=slow)
+    t1.start(); t2.start(); t1.join(); t2.join()
+    ok0, ok1, t_fast = results["fast"]
+    assert ok0 and ok1
+    # the fast worker could only proceed after the slow worker clocked
+    assert t_fast >= results["slow"] - 0.05
+
+
+def test_preduce_matchmaking():
+    pr = PartialReduce(max_group=2, wait_ms=2000)
+    groups = {}
+
+    def worker(w):
+        groups[w] = pr.get_partner(w)
+
+    ts = [threading.Thread(target=worker, args=(w,)) for w in (0, 1)]
+    [t.start() for t in ts]; [t.join() for t in ts]
+    assert groups[0] == groups[1] == [0, 1]
+
+    # single straggler times out into a singleton group
+    solo = PartialReduce(max_group=4, wait_ms=50).get_partner(3)
+    assert solo == [3]
+
+
+def test_ps_embedding_learns():
+    """Tiny CTR-style hybrid step: PS embedding + host loop learns XOR-ish
+    labels (reference analog: examples/ctr PS mode)."""
+    import jax
+    import jax.numpy as jnp
+
+    emb = PSEmbedding(4, 2, optimizer="sgd", lr=0.5, init="normal",
+                      init_b=0.1, seed=0)
+    ids = np.array([0, 1, 2, 3], np.int64)
+    y = np.array([0, 1, 1, 0], np.float32)
+
+    @jax.jit
+    def step(rows):
+        def loss_fn(rows):
+            logit = rows.sum(axis=-1)
+            l = jnp.maximum(logit, 0) - logit * y + jnp.log1p(
+                jnp.exp(-jnp.abs(logit)))
+            return jnp.mean(l)
+        return jax.value_and_grad(loss_fn)(rows)
+
+    losses = []
+    for _ in range(30):
+        rows = emb.pull(ids)
+        loss, grows = step(jnp.asarray(rows))
+        emb.push(ids, np.asarray(grows))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
