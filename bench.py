@@ -2,6 +2,12 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
+Timing method: steady-state slope.  On tunneled TPU platforms
+jax.block_until_ready does not actually wait, and a single value fetch pays
+the full tunnel round trip, so we time k1 and k2 chained steps (state feeds
+state, so they serialize on device) each ended by a scalar fetch, and report
+(T2 - T1) / (k2 - k1) — dispatch and tunnel latency cancel.
+
 Baseline: BASELINE.json publishes no reference numbers yet ("published": {});
 the stand-in denominator is 2000 samples/s/chip — the order of magnitude of
 ResNet-18/CIFAR10 training on one A100 (the reference's 8xA100 allreduce-DP
@@ -22,32 +28,38 @@ from hetu_tpu import models, optim
 
 BASELINE_SAMPLES_PER_SEC = 2000.0
 BATCH = 128
-WARMUP = 10
-STEPS = 30
+K1, K2 = 10, 40
 
 
 def main():
     model = models.ResNet18(num_classes=10)
     ex = ht.Executor(model.loss_fn(), optim.MomentumOptimizer(0.1, 0.9),
                      seed=0)
-    state = ex.init_state(model.init(jax.random.PRNGKey(0)))
+    state0 = ex.init_state(model.init(jax.random.PRNGKey(0)))
 
     g = np.random.default_rng(0)
     x = g.standard_normal((BATCH, 3, 32, 32), dtype=np.float32)
     y = g.integers(0, 10, BATCH).astype(np.int32)
-    batch = (x, y)
+    # place the batch once: per-step H2D would otherwise dominate over a
+    # tunneled connection (real input pipelines overlap this transfer)
+    batch = jax.device_put((x, y))
 
-    for _ in range(WARMUP):
-        state, m = ex.run("train", state, batch)
-    jax.block_until_ready(state.params)
+    def run(state, k):
+        m = None
+        for _ in range(k):
+            state, m = ex.run("train", state, batch)
+        float(m["loss"])  # true sync: value fetch
+        return state
 
+    state = run(state0, 5)  # warmup/compile
     t0 = time.perf_counter()
-    for _ in range(STEPS):
-        state, m = ex.run("train", state, batch)
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
+    state = run(state, K1)
+    t1 = time.perf_counter()
+    state = run(state, K2)
+    t2 = time.perf_counter()
 
-    sps = BATCH * STEPS / dt
+    per_step = ((t2 - t1) - (t1 - t0)) / (K2 - K1)
+    sps = BATCH / per_step
     print(json.dumps({
         "metric": "resnet18_cifar10_train_samples_per_sec_per_chip",
         "value": round(sps, 1),

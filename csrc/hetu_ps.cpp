@@ -76,8 +76,12 @@ int ps_table_create(int id, int64_t rows, int64_t dim, int init_kind,
     for (auto& x : t->data) x = d(rng);
   }
   std::lock_guard<std::mutex> lk(g_tables_mu);
-  auto it = g_tables.find(id);
-  if (it != g_tables.end()) { delete it->second; }
+  if (g_tables.count(id)) {
+    // recreating a live id would free a Table other threads / attached
+    // caches still point at (use-after-free); callers must use fresh ids
+    delete t;
+    return -2;
+  }
   g_tables[id] = t;
   return 0;
 }
@@ -109,7 +113,7 @@ int ps_table_clear(int id) {
   if (!t) return -1;
   std::lock_guard<std::mutex> lk(t->mu);
   std::fill(t->data.begin(), t->data.end(), 0.f);
-  std::fill(t->version.begin(), t->version.end(), 0);
+  for (auto& v : t->version) v++;  // invalidate cached copies
   return 0;
 }
 
@@ -279,15 +283,26 @@ int ps_sparse_set(int id, const int64_t* idx, const float* vals, int64_t n) {
 
 // ---------------------------------------------------------------- save/load
 
+static const uint64_t kCkptMagic = 0x48545055'50533032ull;  // "HTPUPS02"
+
 int ps_table_save(int id, const char* path) {
   Table* t = get_table(id);
   if (!t) return -1;
   std::lock_guard<std::mutex> lk(t->mu);
   FILE* f = std::fopen(path, "wb");
   if (!f) return -2;
+  std::fwrite(&kCkptMagic, sizeof(uint64_t), 1, f);
   std::fwrite(&t->rows, sizeof(int64_t), 1, f);
   std::fwrite(&t->dim, sizeof(int64_t), 1, f);
+  int64_t sizes[3] = {(int64_t)t->s1.size(), (int64_t)t->s2.size(),
+                      (int64_t)t->step.size()};
+  std::fwrite(sizes, sizeof(int64_t), 3, f);
   std::fwrite(t->data.data(), sizeof(float), t->data.size(), f);
+  // full resume state: optimizer slots + per-row adam steps (the reference's
+  // SaveParam persists server-side state the same way)
+  std::fwrite(t->s1.data(), sizeof(float), t->s1.size(), f);
+  std::fwrite(t->s2.data(), sizeof(float), t->s2.size(), f);
+  std::fwrite(t->step.data(), sizeof(uint64_t), t->step.size(), f);
   std::fclose(f);
   return 0;
 }
@@ -298,13 +313,32 @@ int ps_table_load(int id, const char* path) {
   std::lock_guard<std::mutex> lk(t->mu);
   FILE* f = std::fopen(path, "rb");
   if (!f) return -2;
-  int64_t rows, dim;
-  if (std::fread(&rows, sizeof(int64_t), 1, f) != 1 ||
+  uint64_t magic = 0;
+  int64_t rows, dim, sizes[3];
+  if (std::fread(&magic, sizeof(uint64_t), 1, f) != 1 ||
+      magic != kCkptMagic ||
+      std::fread(&rows, sizeof(int64_t), 1, f) != 1 ||
       std::fread(&dim, sizeof(int64_t), 1, f) != 1 ||
-      rows != t->rows || dim != t->dim) { std::fclose(f); return -3; }
+      rows != t->rows || dim != t->dim ||
+      std::fread(sizes, sizeof(int64_t), 3, f) != 3) {
+    std::fclose(f); return -3;
+  }
   size_t n = std::fread(t->data.data(), sizeof(float), t->data.size(), f);
+  bool ok = n == t->data.size();
+  if (ok && sizes[0] == (int64_t)t->s1.size() && sizes[0] > 0)
+    ok = std::fread(t->s1.data(), sizeof(float), t->s1.size(), f) ==
+         t->s1.size();
+  else if (sizes[0] > 0) std::fseek(f, sizes[0] * sizeof(float), SEEK_CUR);
+  if (ok && sizes[1] == (int64_t)t->s2.size() && sizes[1] > 0)
+    ok = std::fread(t->s2.data(), sizeof(float), t->s2.size(), f) ==
+         t->s2.size();
+  else if (sizes[1] > 0) std::fseek(f, sizes[1] * sizeof(float), SEEK_CUR);
+  if (ok && sizes[2] == (int64_t)t->step.size() && sizes[2] > 0)
+    ok = std::fread(t->step.data(), sizeof(uint64_t), t->step.size(), f) ==
+         t->step.size();
   std::fclose(f);
-  return n == t->data.size() ? 0 : -4;
+  for (auto& v : t->version) v++;  // invalidate cached copies
+  return ok ? 0 : -4;
 }
 
 // ---------------------------------------------------------------- SSP
@@ -315,38 +349,52 @@ struct SSP {
   std::mutex mu;
   std::condition_variable cv;
 };
-static SSP g_ssp;
+// instanced: independent controllers must not share one clock table
+static std::mutex g_ssps_mu;
+static std::map<int, SSP*> g_ssps;
 
-int ps_ssp_init(int nworkers, int staleness) {
-  std::lock_guard<std::mutex> lk(g_ssp.mu);
-  g_ssp.nworkers = nworkers;
-  g_ssp.staleness = staleness;
-  g_ssp.clock.assign(nworkers, 0);
+int ps_ssp_init(int ssp_id, int nworkers, int staleness) {
+  std::lock_guard<std::mutex> glk(g_ssps_mu);
+  if (g_ssps.count(ssp_id)) return -2;  // no live-instance clobbering
+  auto* s = new SSP();
+  s->nworkers = nworkers;
+  s->staleness = staleness;
+  s->clock.assign(nworkers, 0);
+  g_ssps[ssp_id] = s;
   return 0;
+}
+
+static SSP* get_ssp(int id) {
+  std::lock_guard<std::mutex> lk(g_ssps_mu);
+  auto it = g_ssps.find(id);
+  return it == g_ssps.end() ? nullptr : it->second;
 }
 
 // Advance worker's clock; block while it is more than `staleness` ahead of
 // the slowest worker (ssp_handler.h:12 bounded-staleness contract).
-int ps_ssp_clock_and_wait(int worker, int timeout_ms) {
-  std::unique_lock<std::mutex> lk(g_ssp.mu);
-  if (worker < 0 || worker >= g_ssp.nworkers) return -1;
-  g_ssp.clock[worker]++;
-  g_ssp.cv.notify_all();
+int ps_ssp_clock_and_wait(int ssp_id, int worker, int timeout_ms) {
+  SSP* s = get_ssp(ssp_id);
+  if (!s) return -2;
+  std::unique_lock<std::mutex> lk(s->mu);
+  if (worker < 0 || worker >= s->nworkers) return -1;
+  s->clock[worker]++;
+  s->cv.notify_all();
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
   while (true) {
-    int64_t min_clock = *std::min_element(g_ssp.clock.begin(),
-                                          g_ssp.clock.end());
-    if (g_ssp.clock[worker] - min_clock <= g_ssp.staleness) return 0;
-    if (g_ssp.cv.wait_until(lk, deadline) == std::cv_status::timeout)
+    int64_t min_clock = *std::min_element(s->clock.begin(), s->clock.end());
+    if (s->clock[worker] - min_clock <= s->staleness) return 0;
+    if (s->cv.wait_until(lk, deadline) == std::cv_status::timeout)
       return 1;  // timed out still ahead
   }
 }
 
-int64_t ps_ssp_get_clock(int worker) {
-  std::lock_guard<std::mutex> lk(g_ssp.mu);
-  if (worker < 0 || worker >= g_ssp.nworkers) return -1;
-  return g_ssp.clock[worker];
+int64_t ps_ssp_get_clock(int ssp_id, int worker) {
+  SSP* s = get_ssp(ssp_id);
+  if (!s) return -2;
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (worker < 0 || worker >= s->nworkers) return -1;
+  return s->clock[worker];
 }
 
 // ---------------------------------------------------------------- preduce
@@ -363,36 +411,47 @@ struct PReduce {
   // a single global mask races when a later round forms before the waiter
   // reacquires the lock
   std::map<uint64_t, uint64_t> round_masks;
-};
-static PReduce g_pr;
 
-static uint64_t preduce_form_group_locked() {
-  uint64_t mask = 0;
-  for (int w : g_pr.ready) mask |= (1ull << w);
-  g_pr.round_masks[g_pr.round] = mask;
-  g_pr.ready.clear();
-  g_pr.round++;
-  if (g_pr.round_masks.size() > 128)
-    g_pr.round_masks.erase(g_pr.round_masks.begin());
-  g_pr.cv.notify_all();
-  return mask;
+  uint64_t form_group_locked() {
+    uint64_t mask = 0;
+    for (int w : ready) mask |= (1ull << w);
+    round_masks[round] = mask;
+    ready.clear();
+    round++;
+    if (round_masks.size() > 128) round_masks.erase(round_masks.begin());
+    cv.notify_all();
+    return mask;
+  }
+};
+// instanced: each logical reduce pool matches independently
+static std::mutex g_prs_mu;
+static std::map<int, PReduce*> g_prs;
+
+static PReduce* get_pr(int id) {
+  std::lock_guard<std::mutex> lk(g_prs_mu);
+  auto it = g_prs.find(id);
+  if (it == g_prs.end()) it = g_prs.emplace(id, new PReduce()).first;
+  return it->second;
 }
 
-uint64_t ps_preduce_get_partner(int worker, int max_group, int wait_ms) {
-  std::unique_lock<std::mutex> lk(g_pr.mu);
-  uint64_t my_round = g_pr.round;
-  g_pr.ready.push_back(worker);
+uint64_t ps_preduce_get_partner(int pool_id, int worker, int max_group,
+                                int wait_ms) {
+  if (worker < 0 || worker >= 64) return 0;  // mask encoding bound
+  PReduce* pr = get_pr(pool_id);
+  std::unique_lock<std::mutex> lk(pr->mu);
+  uint64_t my_round = pr->round;
+  pr->ready.push_back(worker);
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(wait_ms);
-  if ((int)g_pr.ready.size() >= max_group) return preduce_form_group_locked();
-  while (g_pr.round == my_round) {
-    if (g_pr.cv.wait_until(lk, deadline) == std::cv_status::timeout) {
-      if (g_pr.round != my_round) break;  // formed while timing out
-      return preduce_form_group_locked();
+  if ((int)pr->ready.size() >= max_group) return pr->form_group_locked();
+  while (pr->round == my_round) {
+    if (pr->cv.wait_until(lk, deadline) == std::cv_status::timeout) {
+      if (pr->round != my_round) break;  // formed while timing out
+      return pr->form_group_locked();
     }
   }
-  auto it = g_pr.round_masks.find(my_round);
-  return it == g_pr.round_masks.end() ? 0 : it->second;
+  auto it = pr->round_masks.find(my_round);
+  return it == pr->round_masks.end() ? 0 : it->second;
 }
 
 // ---------------------------------------------------------------- cache

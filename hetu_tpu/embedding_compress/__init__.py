@@ -1,0 +1,8 @@
+from hetu_tpu.embedding_compress.layers import (
+    HashEmbedding, CompositionalEmbedding, DPQEmbedding, MGQEEmbedding,
+    TensorTrainEmbedding, DHEEmbedding, ROBEEmbedding, QuantizedEmbedding,
+    ALPTEmbedding, PrunedEmbedding, PEPEmbedding, OptEmbedEmbedding,
+    AutoSRHEmbedding, MixedDimEmbedding, AutoDimEmbedding, DedupEmbedding,
+    AdaptiveEmbedding,
+)
+from hetu_tpu.embedding_compress.scheduler import CompressionScheduler

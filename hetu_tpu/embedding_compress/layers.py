@@ -1,0 +1,563 @@
+"""Embedding memory-compression method library.
+
+Reference: tools/EmbeddingMemoryCompression (VLDB'24; 9,574 LoC) — 19
+compression methods implemented as Hetu layers (methods/layers/*) plus
+multi-stage training schedulers.  Each method here is a Module with the
+Embedding contract: init(key) -> variables; apply(variables, indices) ->
+([..., dim] rows, state).  Methods are grouped exactly like the reference:
+
+  hashing        : HashEmbedding, CompositionalEmbedding (Q-R trick),
+                   ROBEEmbedding, DHEEmbedding, DedupEmbedding
+  quantization   : DPQEmbedding, MGQEEmbedding, QuantizedEmbedding,
+                   ALPTEmbedding
+  factorization  : TensorTrainEmbedding (TT-Rec)
+  pruning        : PrunedEmbedding (DeepLight), PEPEmbedding,
+                   OptEmbedEmbedding, AutoSRHEmbedding
+  dim selection  : MixedDimEmbedding (MDE), AutoDimEmbedding,
+                   AdaptiveEmbedding
+
+TPU notes: every method keeps lookups as dense gathers + einsums (MXU/VPU
+friendly, no host scatter), and compressed storage stays static-shaped so
+the whole lookup fuses under jit.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import init as initializers
+from hetu_tpu import ops
+from hetu_tpu.layers.base import Module
+
+_P1, _P2 = 1_000_000_007, 998_244_353  # universal-hash primes
+
+
+def _hash(ids, salt: int, mod: int):
+    h = (ids.astype(jnp.uint32) * jnp.uint32(2654435761)
+         + jnp.uint32(salt * 40503 + 1))
+    h = h ^ (h >> 16)
+    return (h % jnp.uint32(mod)).astype(jnp.int32)
+
+
+class HashEmbedding(Module):
+    """Plain modulo-hash table (reference methods/layers/hash.py)."""
+
+    def __init__(self, num_embeddings: int, dim: int, compress_ratio: float,
+                 **kw):
+        self.buckets = max(2, int(num_embeddings * compress_ratio))
+        self.dim = dim
+        self.w_init = initializers.normal(stddev=0.01)
+
+    def init(self, key):
+        return {"params": {"table": self.w_init(key, (self.buckets, self.dim),
+                                                jnp.float32)}, "state": {}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        idx = _hash(ids, 0, self.buckets)
+        return jnp.take(variables["params"]["table"], idx, axis=0), {}
+
+
+class CompositionalEmbedding(Module):
+    """Quotient-remainder compositional (reference compo.py): two small
+    tables indexed by id//K and id%K, combined multiplicatively."""
+
+    def __init__(self, num_embeddings: int, dim: int, *, combine: str = "mul",
+                 **kw):
+        self.K = max(2, int(math.isqrt(num_embeddings)) + 1)
+        self.nq = (num_embeddings + self.K - 1) // self.K
+        self.dim = dim
+        self.combine = combine
+        self.w_init = initializers.normal(stddev=0.05)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"params": {
+            "q": self.w_init(k1, (self.nq, self.dim), jnp.float32),
+            "r": self.w_init(k2, (self.K, self.dim), jnp.float32)},
+            "state": {}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        p = variables["params"]
+        ids = ids.astype(jnp.int32)
+        eq = jnp.take(p["q"], ids // self.K, axis=0)
+        er = jnp.take(p["r"], ids % self.K, axis=0)
+        if self.combine == "mul":
+            return eq * er, {}
+        if self.combine == "add":
+            return eq + er, {}
+        return jnp.concatenate([eq, er], axis=-1), {}
+
+
+class DPQEmbedding(Module):
+    """Differentiable product quantization (reference dpq.py): ids map to
+    per-subspace code logits; codebook rows are combined with softmax (soft,
+    train) or argmax (hard, eval) with a straight-through estimator."""
+
+    def __init__(self, num_embeddings: int, dim: int, *, n_codebooks: int = 4,
+                 codes: int = 64, **kw):
+        assert dim % n_codebooks == 0
+        self.n, self.dim = num_embeddings, dim
+        self.m = n_codebooks
+        self.codes = codes
+        self.sub = dim // n_codebooks
+        self.w_init = initializers.normal(stddev=0.05)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"params": {
+            "logits": self.w_init(k1, (self.n, self.m, self.codes),
+                                  jnp.float32),
+            "codebooks": self.w_init(k2, (self.m, self.codes, self.sub),
+                                     jnp.float32)}, "state": {}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        p = variables["params"]
+        lg = jnp.take(p["logits"], ids.astype(jnp.int32), axis=0)  # [...,m,C]
+        soft = jax.nn.softmax(lg, axis=-1)
+        hard = jax.nn.one_hot(jnp.argmax(lg, axis=-1), self.codes)
+        assign = soft + jax.lax.stop_gradient(hard - soft)  # straight-through
+        out = jnp.einsum("...mc,mcs->...ms", assign, p["codebooks"])
+        return out.reshape(*ids.shape, self.dim), {}
+
+    def to_serving(self, variables):
+        """Compress to the serving form: int8 codes [N, m] + codebooks —
+        the actual memory win (logits are train-time only)."""
+        p = variables["params"]
+        codes = jnp.argmax(p["logits"], axis=-1).astype(jnp.int8)
+        return {"params": {}, "state": {"codes": codes,
+                                        "codebooks": p["codebooks"]}}
+
+    def serving_lookup(self, serving_variables, ids):
+        s = serving_variables["state"]
+        codes = jnp.take(s["codes"], ids.astype(jnp.int32),
+                         axis=0).astype(jnp.int32)         # [..., m]
+        # gather per-subspace codebook rows: [..., m, sub]
+        rows = s["codebooks"][jnp.arange(self.m), codes]
+        return rows.reshape(*ids.shape, self.dim)
+
+
+class MGQEEmbedding(DPQEmbedding):
+    """Multi-granularity quantization (reference mgqe.py): frequent ids use
+    the full code space, infrequent ids a subset — here via a per-id code
+    budget mask derived from a frequency split."""
+
+    def __init__(self, num_embeddings: int, dim: int, *, n_codebooks: int = 4,
+                 codes: int = 64, hot_fraction: float = 0.1,
+                 cold_codes: int = 16, **kw):
+        super().__init__(num_embeddings, dim, n_codebooks=n_codebooks,
+                         codes=codes)
+        self.hot_cut = max(1, int(num_embeddings * hot_fraction))
+        self.cold_codes = cold_codes
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        p = variables["params"]
+        ids = ids.astype(jnp.int32)
+        lg = jnp.take(p["logits"], ids, axis=0)
+        # cold ids only address the first `cold_codes` codes
+        is_hot = (ids < self.hot_cut)[..., None, None]
+        code_ok = jnp.arange(self.codes) < self.cold_codes
+        lg = jnp.where(is_hot | code_ok, lg, -1e30)
+        soft = jax.nn.softmax(lg, axis=-1)
+        hard = jax.nn.one_hot(jnp.argmax(lg, axis=-1), self.codes)
+        assign = soft + jax.lax.stop_gradient(hard - soft)
+        out = jnp.einsum("...mc,mcs->...ms", assign, p["codebooks"])
+        return out.reshape(*ids.shape, self.dim), {}
+
+
+class TensorTrainEmbedding(Module):
+    """TT-Rec factorization (reference tt.py): vocab = prod(i_k), dim =
+    prod(j_k); cores G_k [r_{k-1}, i_k, j_k, r_k] contracted per lookup."""
+
+    def __init__(self, num_embeddings: int, dim: int, *, ranks: int = 8,
+                 factors: int = 3, **kw):
+        self.n, self.dim = num_embeddings, dim
+        self.i_facs = self._factorize(num_embeddings, factors)
+        self.j_facs = self._factorize(dim, factors)
+        self.ranks = [1] + [ranks] * (factors - 1) + [1]
+        self.w_init = initializers.normal(stddev=0.3)
+
+    @staticmethod
+    def _factorize(n: int, k: int) -> list:
+        base = max(2, int(round(n ** (1.0 / k))))
+        facs = [base] * (k - 1)
+        last = (n + int(jnp.prod(jnp.asarray(facs))) - 1) // int(
+            jnp.prod(jnp.asarray(facs)))
+        return facs + [max(last, 1)]
+
+    def init(self, key):
+        ks = jax.random.split(key, len(self.i_facs))
+        cores = {}
+        for k_i, (i_f, j_f) in enumerate(zip(self.i_facs, self.j_facs)):
+            cores[f"core{k_i}"] = self.w_init(
+                ks[k_i], (self.ranks[k_i], i_f, j_f, self.ranks[k_i + 1]),
+                jnp.float32)
+        return {"params": cores, "state": {}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        p = variables["params"]
+        orig_shape = ids.shape
+        flat = ids.astype(jnp.int32).reshape(-1)
+        # id → per-factor indices (mixed radix)
+        rem = flat
+        out = None
+        for k_i, i_f in enumerate(self.i_facs):
+            sub = rem % i_f
+            rem = rem // i_f
+            core = p[f"core{k_i}"][:, sub]           # [r_in, T, j, r_out]
+            core = jnp.moveaxis(core, 1, 0)          # [T, r_in, j, r_out]
+            if out is None:
+                out = core[:, 0]                     # [T, j, r_out]
+            else:
+                # out [T, J, r_in] x core [T, r_in, j, r_out]
+                out = jnp.einsum("tjr,trks->tjks", out, core)
+                out = out.reshape(out.shape[0], -1, out.shape[-1])
+        rows = out[..., 0][:, :self.dim]             # [T, dim]
+        return rows.reshape(*orig_shape, self.dim), {}
+
+
+class DHEEmbedding(Module):
+    """Deep hash embedding (reference dhe.py): k universal hashes → dense
+    feature vector → small MLP, no table at all."""
+
+    def __init__(self, num_embeddings: int, dim: int, *, k_hashes: int = 32,
+                 hidden: int = 64, **kw):
+        self.k = k_hashes
+        self.dim = dim
+        self.hidden = hidden
+        self.w_init = initializers.he_normal()
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"params": {
+            "w1": self.w_init(k1, (self.k, self.hidden), jnp.float32),
+            "b1": jnp.zeros((self.hidden,)),
+            "w2": self.w_init(k2, (self.hidden, self.dim), jnp.float32),
+            "b2": jnp.zeros((self.dim,))}, "state": {}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        p = variables["params"]
+        feats = jnp.stack(
+            [_hash(ids, s, _P1).astype(jnp.float32) / _P1
+             for s in range(self.k)], axis=-1)
+        feats = (feats - 0.5) * 3.46  # ~unit variance
+        h = ops.gelu(feats @ p["w1"] + p["b1"])
+        return h @ p["w2"] + p["b2"], {}
+
+
+class ROBEEmbedding(Module):
+    """Random offset block embedding (reference robe.py): rows are chunks of
+    one shared weight array addressed by hashed offsets."""
+
+    def __init__(self, num_embeddings: int, dim: int, compress_ratio: float,
+                 *, chunk: int = 8, **kw):
+        self.size = max(dim, int(num_embeddings * dim * compress_ratio))
+        self.dim = dim
+        self.chunk = chunk
+        self.n_chunks = (dim + chunk - 1) // chunk
+        self.w_init = initializers.normal(stddev=0.01)
+
+    def init(self, key):
+        return {"params": {"array": self.w_init(key, (self.size,),
+                                                jnp.float32)}, "state": {}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        arr = variables["params"]["array"]
+        parts = []
+        for c in range(self.n_chunks):
+            off = _hash(ids, c + 1, max(self.size - self.chunk, 1))
+            gather_idx = off[..., None] + jnp.arange(self.chunk)
+            parts.append(jnp.take(arr, gather_idx, axis=0))
+        rows = jnp.concatenate(parts, axis=-1)[..., :self.dim]
+        return rows, {}
+
+
+class QuantizedEmbedding(Module):
+    """int8-storage embedding (reference quantize.py): rows stored quantized
+    with a per-row scale; dequant fuses into the gather.  Non-differentiable
+    storage — training updates flow through `assign` on the host/PS side, so
+    this is the inference/serving form (like the reference's switchinference
+    scheduler stage)."""
+
+    def __init__(self, num_embeddings: int, dim: int, *, bits: int = 8, **kw):
+        self.n, self.dim = num_embeddings, dim
+        self.bits = bits
+
+    def init(self, key):
+        w = initializers.normal(stddev=0.01)(key, (self.n, self.dim),
+                                             jnp.float32)
+        qmax = 2 ** (self.bits - 1) - 1
+        scale = jnp.maximum(jnp.max(jnp.abs(w), axis=1), 1e-8) / qmax
+        q = jnp.clip(jnp.round(w / scale[:, None]), -qmax - 1,
+                     qmax).astype(jnp.int8)
+        return {"params": {}, "state": {"q": q, "scale": scale}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        s = variables["state"]
+        rows = ops.quantize_embedding_lookup(s["q"], s["scale"],
+                                             ids.astype(jnp.int32))
+        return rows, s
+
+    @staticmethod
+    def from_table(table, bits: int = 8):
+        qmax = 2 ** (bits - 1) - 1
+        scale = jnp.maximum(jnp.max(jnp.abs(table), axis=1), 1e-8) / qmax
+        q = jnp.clip(jnp.round(table / scale[:, None]), -qmax - 1,
+                     qmax).astype(jnp.int8)
+        return q, scale
+
+
+class ALPTEmbedding(Module):
+    """Adaptive low-precision training (reference alpt.py): int8 rows with a
+    LEARNED per-row scale; forward dequantizes, backward flows to the scale
+    and (via straight-through) the stored rows; stochastic rounding keeps
+    the quantized update unbiased."""
+
+    def __init__(self, num_embeddings: int, dim: int, *, bits: int = 8, **kw):
+        self.n, self.dim, self.bits = num_embeddings, dim, bits
+        self.w_init = initializers.normal(stddev=0.01)
+
+    def init(self, key):
+        w = self.w_init(key, (self.n, self.dim), jnp.float32)
+        return {"params": {"w": w,
+                           "log_scale": jnp.full((self.n,), -5.0)},
+                "state": {}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        p = variables["params"]
+        ids = ids.astype(jnp.int32)
+        rows = jnp.take(p["w"], ids, axis=0)
+        scale = jnp.exp(jnp.take(p["log_scale"], ids, axis=0))[..., None]
+        qmax = 2 ** (self.bits - 1) - 1
+        scaled = rows / scale
+        if train and rng is not None:
+            noise = jax.random.uniform(rng, scaled.shape) - 0.5
+            rounded = jnp.floor(scaled + 0.5 + noise)
+        else:
+            rounded = jnp.round(scaled)
+        rounded = jnp.clip(rounded, -qmax - 1, qmax)
+        # straight-through: forward uses quantized value, grad flows to w & scale
+        deq = rounded * scale
+        return scaled * scale + jax.lax.stop_gradient(deq - scaled * scale), {}
+
+
+class PrunedEmbedding(Module):
+    """DeepLight-style magnitude pruning (reference prune.py): a binary mask
+    re-derived from |w| at a sparsity rate that follows a schedule."""
+
+    def __init__(self, num_embeddings: int, dim: int, *, rate: float = 0.9,
+                 **kw):
+        self.n, self.dim, self.rate = num_embeddings, dim, rate
+        self.w_init = initializers.normal(stddev=0.01)
+
+    def init(self, key):
+        return {"params": {"w": self.w_init(key, (self.n, self.dim),
+                                            jnp.float32)},
+                "state": {"rate": jnp.asarray(self.rate)}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        w = variables["params"]["w"]
+        rate = variables["state"]["rate"]
+        rows = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        thresh = jnp.quantile(jnp.abs(rows), rate)
+        return jnp.where(jnp.abs(rows) >= thresh, rows, 0.0), \
+            variables["state"]
+
+
+class PEPEmbedding(Module):
+    """Plug-in embedding pruning (reference pep.py): learnable per-element
+    soft thresholds g; w_eff = sign(w) * relu(|w| - sigmoid(g))."""
+
+    def __init__(self, num_embeddings: int, dim: int, *,
+                 init_threshold: float = -8.0, **kw):
+        self.n, self.dim = num_embeddings, dim
+        self.init_threshold = init_threshold
+        self.w_init = initializers.normal(stddev=0.01)
+
+    def init(self, key):
+        return {"params": {
+            "w": self.w_init(key, (self.n, self.dim), jnp.float32),
+            "g": jnp.full((self.n, self.dim), self.init_threshold)},
+            "state": {}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        p = variables["params"]
+        ids = ids.astype(jnp.int32)
+        w = jnp.take(p["w"], ids, axis=0)
+        g = jnp.take(p["g"], ids, axis=0)
+        return jnp.sign(w) * jax.nn.relu(jnp.abs(w) - jax.nn.sigmoid(g)), {}
+
+
+class OptEmbedEmbedding(Module):
+    """OptEmbed (reference optembed.py): learnable per-row mask via binary
+    step with straight-through gradient (gpu_ops/OptEmbedBinaryStep.py)."""
+
+    def __init__(self, num_embeddings: int, dim: int, **kw):
+        self.n, self.dim = num_embeddings, dim
+        self.w_init = initializers.normal(stddev=0.01)
+
+    def init(self, key):
+        # thresholds start low (softplus(-6) ~ 0) so every row begins
+        # unmasked and gradients flow; training raises t to prune
+        return {"params": {
+            "w": self.w_init(key, (self.n, self.dim), jnp.float32),
+            "t": jnp.full((self.n,), -6.0)}, "state": {}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        p = variables["params"]
+        ids = ids.astype(jnp.int32)
+        w = jnp.take(p["w"], ids, axis=0)
+        t = jnp.take(p["t"], ids, axis=0)
+        score = jnp.linalg.norm(w, axis=-1) - jax.nn.softplus(t)
+        hard = (score > 0).astype(w.dtype)
+        soft = jax.nn.sigmoid(score * 10.0)
+        mask = soft + jax.lax.stop_gradient(hard - soft)
+        return w * mask[..., None], {}
+
+
+class AutoSRHEmbedding(Module):
+    """AutoSRH (reference autosrh.py): per-dimension relevance gates learned
+    jointly, pruned by gate magnitude at deploy time."""
+
+    def __init__(self, num_embeddings: int, dim: int, **kw):
+        self.n, self.dim = num_embeddings, dim
+        self.w_init = initializers.normal(stddev=0.01)
+
+    def init(self, key):
+        return {"params": {
+            "w": self.w_init(key, (self.n, self.dim), jnp.float32),
+            "alpha": jnp.ones((self.n, self.dim))}, "state": {}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        p = variables["params"]
+        ids = ids.astype(jnp.int32)
+        w = jnp.take(p["w"], ids, axis=0)
+        a = jnp.take(p["alpha"], ids, axis=0)
+        return w * a, {}
+
+
+class MixedDimEmbedding(Module):
+    """Mixed-dimension embedding (reference mde.py): frequency tiers get
+    different native dims, projected up to `dim`."""
+
+    def __init__(self, num_embeddings: int, dim: int, *,
+                 tier_fractions: Sequence[float] = (0.1, 0.9),
+                 tier_dims: Sequence[int] = None, **kw):
+        self.n, self.dim = num_embeddings, dim
+        tier_dims = tier_dims or [dim, max(2, dim // 4)]
+        self.tiers = []
+        start = 0
+        for frac, d in zip(tier_fractions, tier_dims):
+            cnt = max(1, int(num_embeddings * frac))
+            self.tiers.append((start, min(start + cnt, num_embeddings), d))
+            start += cnt
+        if start < num_embeddings:  # remainder into last tier
+            s, e, d = self.tiers[-1]
+            self.tiers[-1] = (s, num_embeddings, d)
+        self.w_init = initializers.normal(stddev=0.01)
+
+    def init(self, key):
+        params = {}
+        ks = jax.random.split(key, 2 * len(self.tiers))
+        for i, (s, e, d) in enumerate(self.tiers):
+            params[f"t{i}"] = self.w_init(ks[2 * i], (e - s, d), jnp.float32)
+            if d != self.dim:
+                params[f"p{i}"] = self.w_init(ks[2 * i + 1], (d, self.dim),
+                                              jnp.float32)
+        return {"params": params, "state": {}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        p = variables["params"]
+        ids = ids.astype(jnp.int32)
+        out = jnp.zeros((*ids.shape, self.dim), jnp.float32)
+        for i, (s, e, d) in enumerate(self.tiers):
+            in_tier = (ids >= s) & (ids < e)
+            local = jnp.clip(ids - s, 0, e - s - 1)
+            rows = jnp.take(p[f"t{i}"], local, axis=0)
+            if d != self.dim:
+                rows = rows @ p[f"p{i}"]
+            out = jnp.where(in_tier[..., None], rows, out)
+        return out, {}
+
+
+class AutoDimEmbedding(Module):
+    """AutoDim (reference autodim.py): differentiable dim selection — every
+    candidate dim has a sub-table + projection; a learned softmax picks."""
+
+    def __init__(self, num_embeddings: int, dim: int, *,
+                 candidate_dims: Sequence[int] = None, **kw):
+        self.n, self.dim = num_embeddings, dim
+        self.cands = list(candidate_dims or [dim, dim // 2, dim // 4])
+        self.w_init = initializers.normal(stddev=0.01)
+
+    def init(self, key):
+        params = {"arch": jnp.zeros((len(self.cands),))}
+        ks = jax.random.split(key, 2 * len(self.cands))
+        for i, d in enumerate(self.cands):
+            params[f"t{i}"] = self.w_init(ks[2 * i], (self.n, d), jnp.float32)
+            params[f"p{i}"] = self.w_init(ks[2 * i + 1], (d, self.dim),
+                                          jnp.float32)
+        return {"params": params, "state": {}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        p = variables["params"]
+        ids = ids.astype(jnp.int32)
+        w = jax.nn.softmax(p["arch"])
+        out = 0.0
+        for i in range(len(self.cands)):
+            out = out + w[i] * (jnp.take(p[f"t{i}"], ids, axis=0) @ p[f"p{i}"])
+        return out, {}
+
+    def selected_dim(self, variables) -> int:
+        return self.cands[int(jnp.argmax(variables["params"]["arch"]))]
+
+
+class DedupEmbedding(Module):
+    """Dedup (reference dedup.py): an index-indirection array maps ids to
+    shared physical rows (e.g. after near-duplicate clustering)."""
+
+    def __init__(self, num_embeddings: int, dim: int, compress_ratio: float,
+                 **kw):
+        self.n = num_embeddings
+        self.phys = max(2, int(num_embeddings * compress_ratio))
+        self.dim = dim
+        self.w_init = initializers.normal(stddev=0.01)
+
+    def init(self, key):
+        return {"params": {"table": self.w_init(key, (self.phys, self.dim),
+                                                jnp.float32)},
+                "state": {"remap": _hash(jnp.arange(self.n), 7, self.phys)}}
+
+    def set_remap(self, variables, remap):
+        variables["state"]["remap"] = jnp.asarray(remap, jnp.int32)
+        return variables
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        remap = variables["state"]["remap"]
+        phys_ids = jnp.take(remap, ids.astype(jnp.int32), axis=0)
+        return jnp.take(variables["params"]["table"], phys_ids, axis=0), \
+            variables["state"]
+
+
+class AdaptiveEmbedding(MixedDimEmbedding):
+    """Adaptive embedding (reference adapt.py, Transformer-XL style): alias
+    of the tiered mixed-dim scheme with geometric dim decay per tier."""
+
+    def __init__(self, num_embeddings: int, dim: int, *, n_tiers: int = 3,
+                 div: int = 4, **kw):
+        fracs = []
+        dims = []
+        rem = 1.0
+        for t in range(n_tiers):
+            f = 0.1 * (4 ** t)
+            f = min(f, rem)
+            fracs.append(f)
+            dims.append(max(2, dim // (div ** t)))
+            rem -= f
+        if rem > 0:
+            fracs[-1] += rem
+        super().__init__(num_embeddings, dim, tier_fractions=fracs,
+                         tier_dims=dims)

@@ -1,0 +1,82 @@
+"""Multi-stage compression training schedulers.
+
+Reference: tools/EmbeddingMemoryCompression/methods/scheduler/
+{base,compressor,multistage,switchinference}.py — training proceeds in
+stages (e.g. dense warmup → prune schedule → frozen sparse finetune; or
+full-precision train → quantized serving switch).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+
+@dataclass
+class Stage:
+    name: str
+    until_step: int                 # stage active while step < until_step
+    on_enter: Optional[Callable] = None  # fn(variables) -> variables
+
+
+class CompressionScheduler:
+    """Drives stage transitions by step count (multistage.py analog).
+
+    Example (DeepLight pruning):
+        sched = CompressionScheduler([
+            Stage("warmup", 1000),
+            Stage("prune", 5000, on_enter=set_prune_rate(0.9)),
+            Stage("finetune", 10000),
+        ])
+        variables = sched.maybe_transition(step, variables)
+    """
+
+    def __init__(self, stages: List[Stage]):
+        assert stages and all(
+            a.until_step < b.until_step for a, b in zip(stages, stages[1:]))
+        self.stages = stages
+        self._current = 0
+
+    @property
+    def current(self) -> Stage:
+        return self.stages[self._current]
+
+    def stage_at(self, step: int) -> int:
+        for i, s in enumerate(self.stages):
+            if step < s.until_step:
+                return i
+        return len(self.stages) - 1
+
+    def maybe_transition(self, step: int, variables):
+        """Advance stages; run on_enter hooks for each newly entered stage."""
+        target = self.stage_at(step)
+        while self._current < target:
+            self._current += 1
+            hook = self.stages[self._current].on_enter
+            if hook is not None:
+                variables = hook(variables)
+        return variables
+
+
+def prune_rate_setter(rate: float):
+    """on_enter hook: set PrunedEmbedding's sparsity rate."""
+    import jax.numpy as jnp
+
+    def hook(variables):
+        variables["state"]["rate"] = jnp.asarray(rate)
+        return variables
+
+    return hook
+
+
+def switch_to_quantized(embedding_module, bits: int = 8):
+    """on_enter hook: convert a dense table to int8 serving form
+    (switchinference.py analog)."""
+    from hetu_tpu.embedding_compress.layers import QuantizedEmbedding
+
+    def hook(variables):
+        q, scale = QuantizedEmbedding.from_table(variables["params"]["w"],
+                                                 bits)
+        return {"params": {}, "state": {"q": q, "scale": scale}}
+
+    return hook

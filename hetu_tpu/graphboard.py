@@ -1,0 +1,105 @@
+"""Graph visualizer: jaxpr → standalone HTML dashboard.
+
+Reference: python/graphboard/ (graph2fig.py + index.html) — renders the
+dataflow graph for inspection.  TPU version: trace any jittable fn to its
+jaxpr (the dataflow graph) and emit a self-contained HTML file (embedded
+JSON + svg layout, zero dependencies).
+"""
+
+from __future__ import annotations
+
+import html
+import json
+from pathlib import Path
+
+import jax
+
+
+def jaxpr_graph(fn, *example_args) -> dict:
+    """Trace fn and return {nodes: [...], edges: [...]}."""
+    closed = jax.make_jaxpr(fn)(*example_args)
+    jaxpr = closed.jaxpr
+    nodes, edges = [], []
+    var_src = {}
+    for i, v in enumerate(jaxpr.invars):
+        name = f"in{i}"
+        nodes.append({"id": name, "label": f"input {i}\n{v.aval.str_short()}",
+                      "kind": "input"})
+        var_src[str(v)] = name
+    for ei, eqn in enumerate(jaxpr.eqns):
+        name = f"op{ei}"
+        out_sh = ", ".join(o.aval.str_short() for o in eqn.outvars)
+        nodes.append({"id": name, "label": f"{eqn.primitive.name}\n{out_sh}",
+                      "kind": "op"})
+        for iv in eqn.invars:
+            src = var_src.get(str(iv))
+            if src is not None:
+                edges.append({"from": src, "to": name})
+        for ov in eqn.outvars:
+            var_src[str(ov)] = name
+    for i, v in enumerate(jaxpr.outvars):
+        name = f"out{i}"
+        nodes.append({"id": name, "label": f"output {i}", "kind": "output"})
+        src = var_src.get(str(v))
+        if src is not None:
+            edges.append({"from": src, "to": name})
+    return {"nodes": nodes, "edges": edges}
+
+
+_HTML = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>hetu_tpu graphboard</title>
+<style>
+ body {{ font: 12px monospace; background: #1e1e1e; color: #ddd; }}
+ .node {{ fill: #2d6cdf; stroke: #9cf; rx: 4; }}
+ .node.input {{ fill: #2da05a; }}
+ .node.output {{ fill: #c05050; }}
+ text {{ fill: #fff; font: 10px monospace; pointer-events: none; }}
+ line {{ stroke: #888; stroke-width: 1; marker-end: url(#arr); }}
+</style></head><body>
+<h3>hetu_tpu graphboard — {n} ops</h3>
+<svg id="g" width="100%" height="{height}px">
+<defs><marker id="arr" markerWidth="6" markerHeight="6" refX="5" refY="3"
+ orient="auto"><path d="M0,0 L6,3 L0,6 z" fill="#888"/></marker></defs>
+</svg>
+<script>
+const graph = {graph_json};
+const svg = document.getElementById('g');
+const W = 180, H = 46, COLS = Math.max(2, Math.floor(
+    (window.innerWidth - 40) / (W + 30)));
+const pos = {{}};
+graph.nodes.forEach((n, i) => {{
+  pos[n.id] = {{ x: 20 + (i % COLS) * (W + 30),
+                y: 20 + Math.floor(i / COLS) * (H + 40) }};
+}});
+graph.edges.forEach(e => {{
+  const a = pos[e.from], b = pos[e.to];
+  const l = document.createElementNS('http://www.w3.org/2000/svg', 'line');
+  l.setAttribute('x1', a.x + W / 2); l.setAttribute('y1', a.y + H);
+  l.setAttribute('x2', b.x + W / 2); l.setAttribute('y2', b.y);
+  svg.appendChild(l);
+}});
+graph.nodes.forEach(n => {{
+  const p = pos[n.id];
+  const r = document.createElementNS('http://www.w3.org/2000/svg', 'rect');
+  r.setAttribute('x', p.x); r.setAttribute('y', p.y);
+  r.setAttribute('width', W); r.setAttribute('height', H);
+  r.setAttribute('class', 'node ' + n.kind);
+  svg.appendChild(r);
+  n.label.split('\\n').forEach((line, li) => {{
+    const t = document.createElementNS('http://www.w3.org/2000/svg', 'text');
+    t.setAttribute('x', p.x + 6); t.setAttribute('y', p.y + 16 + li * 13);
+    t.textContent = line.slice(0, 28);
+    svg.appendChild(t);
+  }});
+}});
+</script></body></html>
+"""
+
+
+def export_html(fn, *example_args, path="graphboard.html") -> str:
+    g = jaxpr_graph(fn, *example_args)
+    rows = (len(g["nodes"]) // 4 + 2)
+    out = _HTML.format(n=len(g["nodes"]), height=rows * 90,
+                       graph_json=json.dumps(g))
+    Path(path).write_text(out)
+    return str(path)

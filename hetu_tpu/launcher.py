@@ -1,0 +1,141 @@
+"""Cluster config + multi-host/process launcher.
+
+Reference: bin/heturun → python/runner.py + python/hetu/launcher.py: parses a
+yaml cluster spec (`DistConfig`, context.py:2204), spawns scheduler/server/
+worker processes locally or over ssh with DMLC_* env, and mpirun for
+allreduce workers.
+
+TPU translation: a TPU pod is one logical machine to JAX — the launcher's
+job collapses to (a) parsing the cluster yaml, (b) `jax.distributed`
+initialization per host (coordinator address / process id / process count —
+the MPI-rank-discovery analog), and (c) a local multi-process mode that
+simulates multi-host on CPUs for tests (the reference's
+launch-locally-without-a-cluster trick, launcher.py:18-38).
+
+yaml schema:
+    nodes:
+      - host: 10.0.0.1        # or 'localhost'
+        chips: 4
+    coordinator: 10.0.0.1:8476
+    mesh: {dp: 2, tp: 4}      # optional default mesh axes
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import List, Optional
+
+import yaml
+
+
+@dataclass
+class NodeSpec:
+    host: str
+    chips: int = 4
+
+
+@dataclass
+class DistConfig:
+    nodes: List[NodeSpec] = field(default_factory=list)
+    coordinator: str = "localhost:8476"
+    mesh: dict = field(default_factory=dict)
+
+    @staticmethod
+    def load(path) -> "DistConfig":
+        d = yaml.safe_load(Path(path).read_text())
+        nodes = [NodeSpec(n["host"], n.get("chips", 4))
+                 for n in d.get("nodes", [])]
+        return DistConfig(nodes=nodes,
+                          coordinator=d.get("coordinator", "localhost:8476"),
+                          mesh=d.get("mesh", {}))
+
+    @property
+    def num_hosts(self) -> int:
+        return max(len(self.nodes), 1)
+
+    @property
+    def total_chips(self) -> int:
+        return sum(n.chips for n in self.nodes) or 1
+
+    def env_for(self, process_id: int) -> dict:
+        """Per-host env for jax.distributed (the DMLC_* analog)."""
+        return {
+            "HETU_TPU_COORDINATOR": self.coordinator,
+            "HETU_TPU_NUM_PROCESSES": str(self.num_hosts),
+            "HETU_TPU_PROCESS_ID": str(process_id),
+        }
+
+
+def initialize_from_env() -> None:
+    """Call early in a training script launched by heturun: wires
+    jax.distributed from the env the launcher set (reference: worker_init /
+    wrapped_mpi_nccl_init, executor.py:65-113)."""
+    coord = os.environ.get("HETU_TPU_COORDINATOR")
+    if not coord:
+        return  # single-host run
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ["HETU_TPU_NUM_PROCESSES"]),
+        process_id=int(os.environ["HETU_TPU_PROCESS_ID"]))
+
+
+def launch(config: DistConfig, argv: List[str], *,
+           local_devices_per_proc: Optional[int] = None,
+           dry_run: bool = False) -> int:
+    """Spawn the training command on every node (ssh for remote hosts,
+    subprocess locally).  With local_devices_per_proc set, forces CPU
+    devices per process — the local multi-process test mode."""
+    procs = []
+    cmds = []
+    for pid, node in enumerate(config.nodes or [NodeSpec("localhost")]):
+        env = {**os.environ, **config.env_for(pid)}
+        if local_devices_per_proc:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                f" --xla_force_host_platform_device_count="
+                                f"{local_devices_per_proc}").strip()
+        if node.host in ("localhost", "127.0.0.1"):
+            cmd = list(argv)
+        else:
+            exports = " ".join(
+                f"{k}={v}" for k, v in config.env_for(pid).items())
+            cmd = ["ssh", node.host, f"{exports} {' '.join(argv)}"]
+        cmds.append(cmd)
+        if not dry_run:
+            procs.append(subprocess.Popen(cmd, env=env))
+    if dry_run:
+        for c in cmds:
+            print(" ".join(c))
+        return 0
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def main(args=None) -> int:  # bin/heturun entry
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="heturun", description="hetu_tpu cluster launcher")
+    ap.add_argument("-c", "--config", help="cluster yaml")
+    ap.add_argument("-n", "--num-local", type=int, default=0,
+                    help="local CPU-device multi-process mode")
+    ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    ns = ap.parse_args(args)
+    if not ns.command:
+        ap.error("no command given")
+    cfg = DistConfig.load(ns.config) if ns.config else DistConfig(
+        nodes=[NodeSpec("localhost")])
+    return launch(cfg, ns.command,
+                  local_devices_per_proc=ns.num_local or None,
+                  dry_run=ns.dry_run)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+"""Model import/export.
+
+Reference: python/hetu/onnx/ (2,337 LoC — hetu2onnx.export, onnx2hetu.
+load_onnx, per-op opset handlers, tested against TF round trips).
+
+This environment has no `onnx` package (and no egress to fetch one), so the
+portable interchange format here is a self-contained JSON graph serialized
+from the traced jaxpr ("HTIR"), with ONNX proto emission gated behind the
+optional dependency: when `onnx` is importable, `export_onnx` maps the same
+traced graph onto ONNX operators.
+
+    export_graph(fn, args, path)   -> HTIR json (always available)
+    load_graph(path)               -> dict graph
+    export_onnx(fn, args, path)    -> .onnx (requires the onnx package)
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+
+# jax primitive name → ONNX op type (the opset-handler table analog,
+# reference onnx/onnx_opset/*)
+_PRIM_TO_ONNX = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div", "neg": "Neg",
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "sqrt": "Sqrt", "abs": "Abs",
+    "sign": "Sign", "floor": "Floor", "ceil": "Ceil", "max": "Max",
+    "min": "Min", "pow": "Pow", "dot_general": "MatMul",
+    "conv_general_dilated": "Conv", "reshape": "Reshape",
+    "transpose": "Transpose", "concatenate": "Concat", "slice": "Slice",
+    "pad": "Pad", "broadcast_in_dim": "Expand", "reduce_sum": "ReduceSum",
+    "reduce_max": "ReduceMax", "reduce_min": "ReduceMin",
+    "logistic": "Sigmoid", "erf": "Erf", "rsqrt": "Reciprocal",
+    "gather": "Gather", "dynamic_slice": "Slice", "select_n": "Where",
+    "convert_element_type": "Cast", "stop_gradient": "Identity",
+    "custom_jvp_call": "Identity", "integer_pow": "Pow", "squeeze": "Squeeze",
+    "argmax": "ArgMax", "iota": "Range", "clamp": "Clip",
+}
+
+
+def trace_graph(fn, *example_args) -> dict:
+    """Serialize the traced dataflow graph to a portable dict."""
+    closed = jax.make_jaxpr(fn)(*example_args)
+    jaxpr = closed.jaxpr
+    consts = [np.asarray(c).tolist() if np.asarray(c).size <= 64 else
+              {"shape": list(np.shape(c)), "dtype": str(np.asarray(c).dtype)}
+              for c in closed.consts]
+    nodes = []
+    for eqn in jaxpr.eqns:
+        nodes.append({
+            "op": eqn.primitive.name,
+            "onnx_op": _PRIM_TO_ONNX.get(eqn.primitive.name),
+            "inputs": [str(v) for v in eqn.invars],
+            "outputs": [str(v) for v in eqn.outvars],
+            "attrs": {k: repr(v) for k, v in eqn.params.items()},
+        })
+    return {
+        "format": "hetu_tpu.htir.v1",
+        "inputs": [{"name": str(v), "shape": list(v.aval.shape),
+                    "dtype": str(v.aval.dtype)} for v in jaxpr.invars],
+        "outputs": [str(v) for v in jaxpr.outvars],
+        "constants": consts,
+        "nodes": nodes,
+    }
+
+
+def export_graph(fn, example_args, path) -> str:
+    g = trace_graph(fn, *example_args)
+    Path(path).write_text(json.dumps(g, indent=1))
+    return str(path)
+
+
+def load_graph(path) -> dict:
+    g = json.loads(Path(path).read_text())
+    if g.get("format") != "hetu_tpu.htir.v1":
+        raise ValueError(f"not an HTIR graph: {path}")
+    return g
+
+
+def unsupported_ops(graph: dict) -> list:
+    """Primitives with no ONNX mapping — what export_onnx would reject."""
+    return sorted({n["op"] for n in graph["nodes"] if n["onnx_op"] is None})
+
+
+def export_onnx(fn, example_args, path):  # pragma: no cover - optional dep
+    """Emit a real .onnx file; requires the `onnx` package."""
+    try:
+        import onnx  # noqa: F401
+        from onnx import helper
+    except ImportError as e:
+        raise ImportError(
+            "the `onnx` package is not installed in this environment; "
+            "use export_graph (HTIR json) or install onnx") from e
+    g = trace_graph(fn, *example_args)
+    missing = unsupported_ops(g)
+    if missing:
+        raise ValueError(f"no ONNX mapping for primitives: {missing}")
+    nodes = [helper.make_node(n["onnx_op"], n["inputs"], n["outputs"])
+             for n in g["nodes"]]
+    graph = helper.make_graph(
+        nodes, "hetu_tpu",
+        [helper.make_tensor_value_info(i["name"], 1, i["shape"])
+         for i in g["inputs"]],
+        [helper.make_tensor_value_info(o, 1, None) for o in g["outputs"]])
+    model = helper.make_model(graph)
+    onnx.save(model, str(path))
+    return str(path)

@@ -1,0 +1,305 @@
+"""Auto-parallel searchers.
+
+Reference: python/hetu/distributed_strategies/ — `FlexFlowSearching` MCMC
+(flexflow.py:12), `OptCNNSearching` per-layer DP (optcnn.py:9),
+`GPipeSearching` stage balancing (gpipe.py:6), `PipeDreamSearching` 2-level
+planner (pipedream.py:7), `PipeOptSearching` PP x intra-stage hybrid
+(pipeopt.py:9); all cost via HetuSimulator and emit JSON strategies
+(base.py:158-227).  Galvatron's per-layer DP planner
+(tools/Galvatron/csrc/dp_core.cpp:22) is the memory-budgeted variant.
+
+All searchers here share the LayerSpec/ShardOption IR and Simulator from
+hetu_tpu/profiler/simulator.py and return a `Plan` that serializes to JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from hetu_tpu.profiler.simulator import LayerSpec, ShardOption, Simulator
+
+
+@dataclass
+class Plan:
+    """Search result: per-layer option + pipeline split + predicted time."""
+
+    layer_options: List[ShardOption]
+    stage_bounds: List[int] = field(default_factory=list)  # layer idx per cut
+    dp: int = 1
+    n_microbatches: int = 1
+    predicted_time: float = 0.0
+    meta: dict = field(default_factory=dict)
+
+    def to_json(self, layers: Sequence[LayerSpec]) -> str:
+        return json.dumps({
+            "layers": {l.name: {"kind": o.kind, "tp": o.tp}
+                       for l, o in zip(layers, self.layer_options)},
+            "stage_bounds": self.stage_bounds,
+            "dp": self.dp,
+            "n_microbatches": self.n_microbatches,
+            "predicted_time": self.predicted_time,
+            "meta": self.meta,
+        }, indent=1)
+
+    def save(self, path, layers):
+        Path(path).write_text(self.to_json(layers))
+
+    @staticmethod
+    def load(path, layers: Sequence[LayerSpec]) -> "Plan":
+        d = json.loads(Path(path).read_text())
+        opts = [ShardOption(d["layers"][l.name]["kind"],
+                            d["layers"][l.name]["tp"]) for l in layers]
+        return Plan(opts, d["stage_bounds"], d["dp"], d["n_microbatches"],
+                    d["predicted_time"], d.get("meta", {}))
+
+
+class OptCNNSearching:
+    """Exact per-layer DP over a chain graph (reference optcnn.py:9):
+    state = (layer index, chosen option); edge cost = reshard time."""
+
+    def __init__(self, sim: Simulator, dp: int = 1):
+        self.sim = sim
+        self.dp = dp
+
+    def search(self, layers: Sequence[LayerSpec]) -> Plan:
+        n = len(layers)
+        # dp_cost[i][opt_idx] = best time of prefix ending with option
+        INF = float("inf")
+        best: List[Dict[int, Tuple[float, Optional[int]]]] = []
+        for i, layer in enumerate(layers):
+            cur: Dict[int, Tuple[float, Optional[int]]] = {}
+            for oi, opt in enumerate(layer.options):
+                lt = self.sim.layer_time(layer, opt, self.dp)
+                if i == 0:
+                    cur[oi] = (lt, None)
+                    continue
+                b = (INF, None)
+                for pj, popt in enumerate(layers[i - 1].options):
+                    prev_t = best[i - 1][pj][0]
+                    rs = self.sim.reshard_time(popt, opt,
+                                               layers[i - 1].act_bytes,
+                                               self.dp)
+                    t = prev_t + rs + lt
+                    if t < b[0]:
+                        b = (t, pj)
+                cur[oi] = b
+            best.append(cur)
+        # backtrack
+        end = min(best[-1].items(), key=lambda kv: kv[1][0])
+        choice_idx = [0] * n
+        choice_idx[n - 1] = end[0]
+        t_total = end[1][0]
+        for i in range(n - 1, 0, -1):
+            choice_idx[i - 1] = best[i][choice_idx[i]][1]
+        opts = [layers[i].options[choice_idx[i]] for i in range(n)]
+        return Plan(opts, dp=self.dp, predicted_time=t_total,
+                    meta={"searcher": "optcnn"})
+
+
+class FlexFlowSearching:
+    """MCMC over per-layer options (reference flexflow.py:12 delta-simulate
+    + Metropolis acceptance)."""
+
+    def __init__(self, sim: Simulator, dp: int = 1, *, iters: int = 2000,
+                 temp: float = 0.05, seed: int = 0):
+        self.sim = sim
+        self.dp = dp
+        self.iters = iters
+        self.temp = temp
+        self.rng = random.Random(seed)
+
+    def search(self, layers: Sequence[LayerSpec]) -> Plan:
+        cur = [self.rng.choice(l.options) for l in layers]
+        cur_t = self.sim.chain_time(layers, cur, self.dp)
+        best, best_t = list(cur), cur_t
+        for _ in range(self.iters):
+            i = self.rng.randrange(len(layers))
+            if len(layers[i].options) <= 1:
+                continue
+            cand = list(cur)
+            cand[i] = self.rng.choice(layers[i].options)
+            t = self.sim.chain_time(layers, cand, self.dp)
+            if t < cur_t or self.rng.random() < math.exp(
+                    -(t - cur_t) / max(self.temp * cur_t, 1e-12)):
+                cur, cur_t = cand, t
+                if t < best_t:
+                    best, best_t = list(cand), t
+        return Plan(best, dp=self.dp, predicted_time=best_t,
+                    meta={"searcher": "flexflow", "iters": self.iters})
+
+
+class GPipeSearching:
+    """Balanced stage partitioning by DP minimizing sum of squared stage
+    times (reference gpipe.py:6)."""
+
+    def __init__(self, sim: Simulator, n_stages: int, dp: int = 1,
+                 n_microbatches: int = 4):
+        self.sim = sim
+        self.n_stages = n_stages
+        self.dp = dp
+        self.M = n_microbatches
+
+    def search(self, layers: Sequence[LayerSpec],
+               options: Optional[Sequence[ShardOption]] = None) -> Plan:
+        n = len(layers)
+        S = self.n_stages
+        opts = (list(options) if options is not None
+                else [l.options[0] for l in layers])
+        t = [self.sim.layer_time(l, o, self.dp) for l, o in zip(layers, opts)]
+        prefix = [0.0]
+        for x in t:
+            prefix.append(prefix[-1] + x)
+
+        INF = float("inf")
+        # dp[s][i] = min cost splitting first i layers into s stages
+        dp = [[INF] * (n + 1) for _ in range(S + 1)]
+        cut = [[0] * (n + 1) for _ in range(S + 1)]
+        dp[0][0] = 0.0
+        for s in range(1, S + 1):
+            for i in range(s, n + 1):
+                for j in range(s - 1, i):
+                    seg = prefix[i] - prefix[j]
+                    c = dp[s - 1][j] + seg * seg
+                    if c < dp[s][i]:
+                        dp[s][i] = c
+                        cut[s][i] = j
+        bounds = []
+        i = n
+        for s in range(S, 0, -1):
+            bounds.append(i)
+            i = cut[s][i]
+        bounds = sorted(set(bounds))
+        stage_times = []
+        lo = 0
+        for b in bounds:
+            stage_times.append(prefix[b] - prefix[lo])
+            lo = b
+        total = self.sim.pipeline_time(stage_times, self.M,
+                                       layers[0].act_bytes)
+        return Plan(opts, stage_bounds=bounds, dp=self.dp,
+                    n_microbatches=self.M, predicted_time=total,
+                    meta={"searcher": "gpipe",
+                          "stage_times": stage_times})
+
+
+class PipeDreamSearching(GPipeSearching):
+    """PipeDream planner (reference pipedream.py:7): same stage partition,
+    1F1B steady-state cost = max-stage time (bubble amortized away), plus
+    weight-stash memory accounting in meta."""
+
+    def search(self, layers, options=None) -> Plan:
+        plan = super().search(layers, options)
+        stage_times = plan.meta["stage_times"]
+        steady = max(stage_times)  # per microbatch in steady state
+        plan.predicted_time = steady * self.M + sum(stage_times)
+        plan.meta["searcher"] = "pipedream"
+        # weight stashing: a stage holds up to (S - stage_idx) weight versions
+        S = len(stage_times)
+        lo = 0
+        stash = []
+        for si, b in enumerate(plan.stage_bounds):
+            pb = sum(l.param_bytes for l in layers[lo:b])
+            stash.append(pb * (S - si))
+            lo = b
+        plan.meta["stash_bytes"] = stash
+        return plan
+
+
+class PipeOptSearching:
+    """Joint PP x (per-layer TP/DP) search (reference pipeopt.py:9): for
+    each candidate stage count, run OptCNN within the chain, partition with
+    GPipe DP, pick the best total."""
+
+    def __init__(self, sim: Simulator, n_devices: int, *,
+                 n_microbatches: int = 4):
+        self.sim = sim
+        self.n_devices = n_devices
+        self.M = n_microbatches
+
+    def search(self, layers: Sequence[LayerSpec]) -> Plan:
+        best: Optional[Plan] = None
+        S = 1
+        while S <= self.n_devices:
+            dp = self.n_devices // S
+            inner = OptCNNSearching(self.sim, dp=dp).search(layers)
+            if S == 1:
+                cand = inner
+                cand.meta["searcher"] = "pipeopt"
+                cand.meta["pp"] = 1
+            else:
+                cand = GPipeSearching(self.sim, S, dp=dp,
+                                      n_microbatches=self.M).search(
+                    layers, inner.layer_options)
+                cand.meta["searcher"] = "pipeopt"
+                cand.meta["pp"] = S
+            if best is None or cand.predicted_time < best.predicted_time:
+                best = cand
+            S *= 2
+        return best
+
+
+class GalvatronSearching:
+    """Galvatron-style per-layer DP under a memory budget (reference
+    tools/Galvatron/csrc/dp_core.cpp:22 dynamic_programming_core): each
+    layer picks (option, remat flag); minimize time s.t. sum memory <=
+    budget.  Memory is bucketed to keep the DP table small."""
+
+    def __init__(self, sim: Simulator, dp: int = 1, *,
+                 memory_budget_bytes: float, buckets: int = 64,
+                 remat_overhead: float = 1.33):
+        self.sim = sim
+        self.dp = dp
+        self.budget = memory_budget_bytes
+        self.buckets = buckets
+        self.remat_overhead = remat_overhead
+
+    def search(self, layers: Sequence[LayerSpec]) -> Plan:
+        # every layer consumes >=1 bucket, so the grid must be finer than
+        # the layer count or deep models read as infeasible at any budget
+        B = max(self.buckets, 4 * len(layers))
+        unit = self.budget / B
+        INF = float("inf")
+        # dp[b] = (time, choices) best using <= b*unit memory
+        dp: List[Tuple[float, List[Tuple[ShardOption, bool]]]] = \
+            [(0.0, [])] + [(INF, [])] * B
+        dp = [(0.0, [])] * 1 + [(INF, [])] * B
+        cur = {0: (0.0, [])}
+        for layer in layers:
+            nxt: Dict[int, Tuple[float, List]] = {}
+            for used, (t_acc, choices) in cur.items():
+                for opt in layer.options:
+                    for remat in (False, True):
+                        mem = self.sim.layer_memory(layer, opt, self.dp,
+                                                    remat=remat)
+                        nb = used + max(1, int(math.ceil(mem / unit)))
+                        if nb > B:
+                            continue
+                        t = self.sim.layer_time(layer, opt, self.dp)
+                        if remat:
+                            t *= self.remat_overhead
+                        cand = (t_acc + t, choices + [(opt, remat)])
+                        if nb not in nxt or cand[0] < nxt[nb][0]:
+                            nxt[nb] = cand
+            # prune dominated states
+            pruned: Dict[int, Tuple[float, List]] = {}
+            best_t = INF
+            for nb in sorted(nxt):
+                if nxt[nb][0] < best_t:
+                    pruned[nb] = nxt[nb]
+                    best_t = nxt[nb][0]
+            cur = pruned
+            if not cur:
+                raise ValueError("memory budget infeasible for every option")
+        used, (t_total, choices) = min(cur.items(), key=lambda kv: kv[1][0])
+        plan = Plan([c[0] for c in choices], dp=self.dp,
+                    predicted_time=t_total,
+                    meta={"searcher": "galvatron",
+                          "remat": [c[1] for c in choices],
+                          "memory_buckets_used": used,
+                          "budget_bytes": self.budget})
+        return plan

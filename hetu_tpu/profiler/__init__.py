@@ -1,0 +1,3 @@
+from hetu_tpu.profiler.profiler import OpProfiler, CollectiveProfiler
+from hetu_tpu.profiler.cost_model import ChipSpec, CHIPS, detect_chip
+from hetu_tpu.profiler.simulator import Simulator, LayerSpec, ShardOption

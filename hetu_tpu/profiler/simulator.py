@@ -1,0 +1,165 @@
+"""Strategy simulator over a layer-graph IR.
+
+Reference: python/hetu/profiler.py `HetuSimulator` (:609) — cached per-op
+times, allreduce/allgather times, and the general cross-sharding comm cost
+mirroring cross_send/cross_receive (:1001-1266); consumed by every searcher
+(distributed_strategies/*).
+
+TPU version: a LayerSpec chain (flops / param / activation bytes per layer)
+with per-layer ShardOptions; the Simulator prices compute from the roofline
+model (optionally calibrated by one real matmul measurement), gradient
+allreduce from dp, TP collectives from the option's comm pattern, and
+resharding between mismatched adjacent options — the cross_send/receive
+cost analog.  Pipeline costing uses the GPipe bubble formula.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from hetu_tpu.profiler.cost_model import (
+    ChipSpec, allgather_time, allreduce_time, alltoall_time, detect_chip,
+    matmul_time, p2p_time,
+)
+
+
+@dataclass
+class ShardOption:
+    """One way to shard a layer over (dp, tp).
+
+    comm pattern follows Megatron algebra: 'none' (pure dp / replicated),
+    'col' (split output dim; needs allgather of output or stays split),
+    'row' (split input dim; needs psum of output), 'seq' (sequence split;
+    ring comm amortized into compute).
+    """
+
+    kind: str           # 'dp' | 'tp_col' | 'tp_row' | 'replicate' | 'seq'
+    tp: int = 1
+
+    def key(self):
+        return (self.kind, self.tp)
+
+
+@dataclass
+class LayerSpec:
+    name: str
+    flops: float                 # fwd FLOPs per global batch
+    param_bytes: float
+    act_bytes: float             # output activation bytes per global batch
+    options: List[ShardOption] = field(default_factory=list)
+
+
+class Simulator:
+    def __init__(self, chip: Optional[ChipSpec] = None, *,
+                 calibration: Optional[float] = None):
+        """calibration: measured/predicted ratio from one real matmul
+        (OpProfiler.time_matmul vs cost_model.matmul_time)."""
+        self.chip = chip or detect_chip()
+        self.cal = calibration or 1.0
+
+    # ---- per-layer ----
+    def layer_time(self, layer: LayerSpec, opt: ShardOption, dp: int,
+                   *, train: bool = True) -> float:
+        shards = dp * opt.tp
+        flops = layer.flops * (3.0 if train else 1.0)  # fwd + ~2x bwd
+        compute = flops / shards / (self.chip.bf16_flops * self.chip.mxu_util)
+        compute *= self.cal
+        t = compute
+        if train and dp > 1:
+            # gradient allreduce over dp, overlappable but bounded by wire
+            t += allreduce_time(self.chip, layer.param_bytes, dp)
+        if opt.kind == "tp_row" and opt.tp > 1:
+            t += allreduce_time(self.chip, layer.act_bytes / dp, opt.tp)
+        if opt.kind == "tp_col" and opt.tp > 1:
+            # activations stay split; cost shows up at reshard time
+            pass
+        return t
+
+    # ---- resharding between adjacent layers (cross_send/receive analog) ----
+    def reshard_time(self, prev: Optional[ShardOption], nxt: ShardOption,
+                     act_bytes: float, dp: int) -> float:
+        if prev is None or prev.key() == nxt.key():
+            return 0.0
+        per_dp = act_bytes / max(dp, 1)
+        if prev.kind == "tp_col" and nxt.kind == "tp_row" and \
+                prev.tp == nxt.tp:
+            return 0.0  # Megatron pairing: split output feeds split input
+        if prev.kind == "tp_col":
+            return allgather_time(self.chip, per_dp, prev.tp)
+        if nxt.kind in ("tp_col", "tp_row") and nxt.tp > 1:
+            return 0.0  # replicated → split is a local slice
+        if prev.kind == "seq" or nxt.kind == "seq":
+            return alltoall_time(self.chip, per_dp, max(prev.tp, nxt.tp))
+        return 0.0
+
+    # ---- whole-chain ----
+    def chain_time(self, layers: Sequence[LayerSpec],
+                   choice: Sequence[ShardOption], dp: int) -> float:
+        t = 0.0
+        prev = None
+        for layer, opt in zip(layers, choice):
+            t += self.reshard_time(prev, opt, layer.act_bytes, dp)
+            t += self.layer_time(layer, opt, dp)
+            prev = opt
+        return t
+
+    # ---- pipeline (GPipe bubble model) ----
+    def pipeline_time(self, stage_times: Sequence[float],
+                      n_microbatches: int, act_bytes: float) -> float:
+        """max-stage * (M + S - 1)/M + p2p transfers (gpipe_subexecutor
+        schedule shape)."""
+        S = len(stage_times)
+        M = max(n_microbatches, 1)
+        bubble = (max(stage_times) * (M + S - 1)) / M
+        xfer = (S - 1) * p2p_time(self.chip, act_bytes / M)
+        return bubble + xfer
+
+    # ---- memory ----
+    def layer_memory(self, layer: LayerSpec, opt: ShardOption, dp: int,
+                     *, optimizer_slots: int = 2, remat: bool = False) -> float:
+        shards = opt.tp
+        params = layer.param_bytes / shards
+        opt_state = params * optimizer_slots
+        acts = 0.0 if remat else layer.act_bytes / max(dp, 1) / max(opt.tp, 1)
+        return params + opt_state + acts
+
+
+def transformer_layer_specs(num_layers: int, hidden: int, ffn: int,
+                            seq: int, batch: int, vocab: int,
+                            *, tp_candidates=(1, 2, 4, 8),
+                            bytes_per_el: int = 2) -> List[LayerSpec]:
+    """Build the LayerSpec chain for a GPT-style model — the bridge from
+    model configs to the searchers (reference: backbone node-group formation,
+    distributed_strategies/base.py:47-156)."""
+    tokens = batch * seq
+    layers = [LayerSpec(
+        name="embed",
+        flops=2.0 * tokens * hidden,
+        param_bytes=float(vocab * hidden * 4),
+        act_bytes=float(tokens * hidden * bytes_per_el),
+        options=[ShardOption("dp")])]
+    attn_flops = (4 * tokens * hidden * hidden            # qkv+out proj
+                  + 2 * batch * seq * seq * hidden)       # scores+values
+    ffn_flops = 4.0 * tokens * hidden * ffn
+    for i in range(num_layers):
+        opts_attn = [ShardOption("dp")] + [
+            ShardOption("tp_col", t) for t in tp_candidates if t > 1]
+        layers.append(LayerSpec(
+            name=f"attn_{i}", flops=float(attn_flops),
+            param_bytes=float(4 * hidden * hidden * 4),
+            act_bytes=float(tokens * hidden * bytes_per_el),
+            options=opts_attn))
+        opts_ffn = [ShardOption("dp")] + [
+            ShardOption("tp_row", t) for t in tp_candidates if t > 1]
+        layers.append(LayerSpec(
+            name=f"ffn_{i}", flops=float(ffn_flops),
+            param_bytes=float(2 * hidden * ffn * 4),
+            act_bytes=float(tokens * hidden * bytes_per_el),
+            options=opts_ffn))
+    layers.append(LayerSpec(
+        name="head", flops=2.0 * tokens * hidden * vocab,
+        param_bytes=0.0,  # tied
+        act_bytes=float(tokens * vocab * bytes_per_el),
+        options=[ShardOption("dp")]))
+    return layers

@@ -67,11 +67,11 @@ def _load():
             "ps_sparse_set": ([c.c_int, i64p, f32p, c.c_int64], c.c_int),
             "ps_table_save": ([c.c_int, c.c_char_p], c.c_int),
             "ps_table_load": ([c.c_int, c.c_char_p], c.c_int),
-            "ps_ssp_init": ([c.c_int, c.c_int], c.c_int),
-            "ps_ssp_clock_and_wait": ([c.c_int, c.c_int], c.c_int),
-            "ps_ssp_get_clock": ([c.c_int], c.c_int64),
-            "ps_preduce_get_partner": ([c.c_int, c.c_int, c.c_int],
-                                       c.c_uint64),
+            "ps_ssp_init": ([c.c_int, c.c_int, c.c_int], c.c_int),
+            "ps_ssp_clock_and_wait": ([c.c_int, c.c_int, c.c_int], c.c_int),
+            "ps_ssp_get_clock": ([c.c_int, c.c_int], c.c_int64),
+            "ps_preduce_get_partner": ([c.c_int, c.c_int, c.c_int,
+                                        c.c_int], c.c_uint64),
             "ps_cache_create": ([c.c_int, c.c_int, c.c_int64, c.c_int],
                                 c.c_int),
             "ps_cache_lookup": ([c.c_int, i64p, c.c_int64, c.c_uint64, f32p],

@@ -17,6 +17,8 @@ from hetu_tpu.ps.binding import lib
 
 _table_ids = itertools.count(1)
 _cache_ids = itertools.count(1)
+_ssp_ids = itertools.count(1)
+_preduce_ids = itertools.count(1)
 
 
 def _i64p(a):
@@ -89,6 +91,11 @@ class PSTable:
         _check(lib.ps_sparse_set(self.id, _i64p(idx), _f32p(v),
                                  idx.shape[0]), "sparse_set")
 
+    def clear(self) -> None:
+        """Zero the table (reference ParamClear); bumps versions so caches
+        re-pull."""
+        _check(lib.ps_table_clear(self.id), "table_clear")
+
     # ---- checkpoint (reference SaveParam/LoadParam) ----
     def save(self, path) -> None:
         _check(lib.ps_table_save(self.id, str(path).encode()), "table_save")
@@ -147,21 +154,23 @@ class CacheSparseTable:
 
 
 class SSPController:
-    """Bounded-staleness clocks (reference ssp_handler.h)."""
+    """Bounded-staleness clocks (reference ssp_handler.h).  Instanced:
+    independent controllers hold independent clock tables."""
 
     def __init__(self, n_workers: int, staleness: int):
-        _check(lib.ps_ssp_init(n_workers, staleness), "ssp_init")
+        self.id = next(_ssp_ids)
+        _check(lib.ps_ssp_init(self.id, n_workers, staleness), "ssp_init")
         self.n_workers = n_workers
 
     def clock_and_wait(self, worker: int, timeout_ms: int = 10_000) -> bool:
         """Advance `worker`'s clock; True if within bound, False on timeout."""
-        rc = lib.ps_ssp_clock_and_wait(worker, timeout_ms)
+        rc = lib.ps_ssp_clock_and_wait(self.id, worker, timeout_ms)
         if rc < 0:
             raise RuntimeError(f"hetu_ps ssp_clock_and_wait rc={rc}")
         return rc == 0
 
     def clock(self, worker: int) -> int:
-        return int(lib.ps_ssp_get_clock(worker))
+        return int(lib.ps_ssp_get_clock(self.id, worker))
 
 
 class PartialReduce:
@@ -173,10 +182,13 @@ class PartialReduce:
     """
 
     def __init__(self, max_group: int = 8, wait_ms: int = 100):
+        self.id = next(_preduce_ids)
         self.max_group = max_group
         self.wait_ms = wait_ms
 
     def get_partner(self, worker: int) -> list[int]:
-        mask = int(lib.ps_preduce_get_partner(worker, self.max_group,
-                                              self.wait_ms))
+        if not 0 <= worker < 64:
+            raise ValueError("worker id must be in [0, 64) for mask encoding")
+        mask = int(lib.ps_preduce_get_partner(self.id, worker,
+                                              self.max_group, self.wait_ms))
         return [i for i in range(64) if mask & (1 << i)]

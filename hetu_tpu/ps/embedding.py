@@ -62,3 +62,8 @@ class PSEmbedding:
 
     def load(self, path) -> None:
         self.table.load(path)
+        # server bumped row versions on load, so bounded-staleness lookups
+        # re-pull; drop pending local updates that predate the checkpoint
+        if self.cache is not None:
+            self.cache.misses = 0
+            self.cache.lookups = 0

@@ -1,0 +1,3 @@
+from hetu_tpu.tokenizers.wordpiece import (
+    BasicTokenizer, WordpieceTokenizer, BertTokenizer,
+)

@@ -1,0 +1,151 @@
+"""BERT WordPiece tokenization.
+
+Reference: python/hetu/tokenizers/ (612 LoC — BERT WordPiece + helpers used
+by the NLP examples).  Self-contained: vocab files are one token per line
+(the standard bert vocab.txt format).
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from pathlib import Path
+from typing import Iterable, List, Optional
+
+
+def load_vocab(path) -> dict:
+    vocab = {}
+    for i, line in enumerate(Path(path).read_text(
+            encoding="utf-8").splitlines()):
+        vocab[line.strip()] = i
+    return vocab
+
+
+def _is_punct(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or \
+            (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+class BasicTokenizer:
+    """Whitespace + punctuation splitting, optional lowercasing + accent
+    stripping."""
+
+    def __init__(self, do_lower_case: bool = True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text: str) -> List[str]:
+        text = text.strip()
+        if self.do_lower_case:
+            text = text.lower()
+            text = unicodedata.normalize("NFD", text)
+            text = "".join(c for c in text
+                           if unicodedata.category(c) != "Mn")
+        out: List[str] = []
+        for tok in text.split():
+            cur = ""
+            for ch in tok:
+                if _is_punct(ch):
+                    if cur:
+                        out.append(cur)
+                        cur = ""
+                    out.append(ch)
+                else:
+                    cur += ch
+            if cur:
+                out.append(cur)
+        return out
+
+
+class WordpieceTokenizer:
+    """Greedy longest-match-first subword split with '##' continuations."""
+
+    def __init__(self, vocab: dict, unk_token: str = "[UNK]",
+                 max_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_chars = max_chars_per_word
+
+    def tokenize(self, word: str) -> List[str]:
+        if len(word) > self.max_chars:
+            return [self.unk_token]
+        out: List[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [self.unk_token]
+            out.append(piece)
+            start = end
+        return out
+
+
+class BertTokenizer:
+    def __init__(self, vocab_file=None, *, vocab: Optional[dict] = None,
+                 do_lower_case: bool = True, cls_token: str = "[CLS]",
+                 sep_token: str = "[SEP]", pad_token: str = "[PAD]",
+                 unk_token: str = "[UNK]", mask_token: str = "[MASK]"):
+        self.vocab = vocab if vocab is not None else load_vocab(vocab_file)
+        self.inv_vocab = {v: k for k, v in self.vocab.items()}
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordpieceTokenizer(self.vocab, unk_token)
+        self.cls_token, self.sep_token = cls_token, sep_token
+        self.pad_token, self.unk_token = pad_token, unk_token
+        self.mask_token = mask_token
+
+    def tokenize(self, text: str) -> List[str]:
+        out = []
+        for word in self.basic.tokenize(text):
+            out.extend(self.wordpiece.tokenize(word))
+        return out
+
+    def convert_tokens_to_ids(self, tokens: Iterable[str]) -> List[int]:
+        unk = self.vocab.get(self.unk_token, 0)
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def convert_ids_to_tokens(self, ids: Iterable[int]) -> List[str]:
+        return [self.inv_vocab.get(int(i), self.unk_token) for i in ids]
+
+    def encode(self, text_a: str, text_b: Optional[str] = None, *,
+               max_length: Optional[int] = None):
+        """Returns (input_ids, token_type_ids, attention_mask)."""
+        toks_a = self.tokenize(text_a)
+        toks_b = self.tokenize(text_b) if text_b else []
+        tokens = [self.cls_token] + toks_a + [self.sep_token]
+        types = [0] * len(tokens)
+        if toks_b:
+            tokens += toks_b + [self.sep_token]
+            types += [1] * (len(toks_b) + 1)
+        ids = self.convert_tokens_to_ids(tokens)
+        mask = [1] * len(ids)
+        if max_length is not None:
+            ids = ids[:max_length]
+            types = types[:max_length]
+            mask = mask[:max_length]
+            pad_id = self.vocab.get(self.pad_token, 0)
+            while len(ids) < max_length:
+                ids.append(pad_id)
+                types.append(0)
+                mask.append(0)
+        return ids, types, mask
+
+    def decode(self, ids: Iterable[int]) -> str:
+        words: List[str] = []
+        for t in self.convert_ids_to_tokens(ids):
+            if t in (self.cls_token, self.sep_token, self.pad_token):
+                continue
+            if t.startswith("##") and words:
+                words[-1] += t[2:]
+            else:
+                words.append(t)
+        return " ".join(words)

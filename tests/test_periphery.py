@@ -1,0 +1,97 @@
+"""Tokenizer, launcher config, graphboard, and HTIR export tests."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_wordpiece_tokenizer():
+    from hetu_tpu.tokenizers import BertTokenizer
+    vocab = {t: i for i, t in enumerate(
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "the", "quick",
+         "brown", "fox", "jump", "##ed", "##s", "over", "lazy", "dog", "."])}
+    tk = BertTokenizer(vocab=vocab)
+    toks = tk.tokenize("The quick brown fox jumped over the lazy dog.")
+    assert toks == ["the", "quick", "brown", "fox", "jump", "##ed", "over",
+                    "the", "lazy", "dog", "."]
+    ids, types, mask = tk.encode("the fox jumps", max_length=10)
+    assert len(ids) == len(types) == len(mask) == 10
+    assert ids[0] == vocab["[CLS]"]
+    assert mask[-1] == 0  # padded
+    # unknown word → [UNK]
+    assert tk.tokenize("zebra") == ["[UNK]"]
+    # round trip
+    assert tk.decode(tk.convert_tokens_to_ids(toks)).startswith(
+        "the quick brown fox jumped")
+    # pair encoding sets segment ids
+    ids2, types2, _ = tk.encode("the fox", "the dog")
+    assert 1 in types2 and types2[0] == 0
+
+
+def test_dist_config_and_launcher_dry_run(tmp_path):
+    from hetu_tpu.launcher import DistConfig, launch
+    cfg_file = tmp_path / "cluster.yml"
+    cfg_file.write_text(
+        "nodes:\n  - host: localhost\n    chips: 4\n"
+        "  - host: 10.0.0.2\n    chips: 4\n"
+        "coordinator: 10.0.0.1:8476\nmesh: {dp: 2, tp: 4}\n")
+    cfg = DistConfig.load(cfg_file)
+    assert cfg.num_hosts == 2 and cfg.total_chips == 8
+    assert cfg.mesh == {"dp": 2, "tp": 4}
+    env = cfg.env_for(1)
+    assert env["HETU_TPU_PROCESS_ID"] == "1"
+    rc = launch(cfg, ["python", "train.py"], dry_run=True)
+    assert rc == 0
+
+
+def test_heturun_cli_local(tmp_path):
+    script = tmp_path / "hello.py"
+    script.write_text("import os\n"
+                      "print('pid', os.environ.get('HETU_TPU_PROCESS_ID'))\n")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bin" / "heturun"), sys.executable,
+         str(script)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "pid" in out.stdout
+
+
+def test_graphboard_export(tmp_path):
+    from hetu_tpu.graphboard import export_html, jaxpr_graph
+
+    def fn(x, w):
+        return jnp.tanh(x @ w).sum()
+
+    g = jaxpr_graph(fn, jnp.ones((2, 3)), jnp.ones((3, 4)))
+    ops = [n["label"].split("\n")[0] for n in g["nodes"]]
+    assert any("dot" in o for o in ops)
+    assert any("tanh" in o for o in ops)
+    path = export_html(fn, jnp.ones((2, 3)), jnp.ones((3, 4)),
+                       path=tmp_path / "g.html")
+    text = Path(path).read_text()
+    assert "svg" in text and "dot_general" in text
+
+
+def test_htir_export_roundtrip(tmp_path):
+    from hetu_tpu import onnx as honnx
+
+    def fn(x, w):
+        return jax.nn.relu(x @ w)
+
+    path = honnx.export_graph(fn, (jnp.ones((2, 3)), jnp.ones((3, 4))),
+                              tmp_path / "m.json")
+    g = honnx.load_graph(path)
+    assert g["format"] == "hetu_tpu.htir.v1"
+    assert g["inputs"][0]["shape"] == [2, 3]
+    names = [n["op"] for n in g["nodes"]]
+    assert "dot_general" in names
+    assert all(n["onnx_op"] for n in g["nodes"]
+               if n["op"] in ("dot_general", "max")), g["nodes"]
+    # unsupported-op reporting
+    assert isinstance(honnx.unsupported_ops(g), list)

@@ -149,6 +149,75 @@ def test_sparse_push_aggregates_duplicates():
     assert int(ver[0]) == 1  # one update, not two
 
 
+def test_cache_invalidated_by_load_and_clear(tmp_path):
+    """Checkpoint load / table clear must bump versions so caches re-pull
+    (regression: caches served stale pre-load rows forever)."""
+    t = PSTable(5, 2, init="constant", init_a=3.0, optimizer="sgd", lr=0.5)
+    t.save(tmp_path / "w.bin")
+    c = CacheSparseTable(t, capacity=5, pull_bound=0)
+    c.embedding_lookup([1])
+    t.sparse_push([1], np.ones((1, 2), np.float32))  # 3 -> 2.5
+    t.load(tmp_path / "w.bin")                        # back to 3
+    np.testing.assert_allclose(c.embedding_lookup([1])[0], 3.0)
+    lib_misses = c.misses
+    t.clear()
+    np.testing.assert_allclose(c.embedding_lookup([1])[0], 0.0)
+    assert c.misses > lib_misses  # clear forced a re-pull
+
+
+def test_checkpoint_preserves_optimizer_slots(tmp_path):
+    """save/load must round-trip adaptive-optimizer state (regression:
+    restored weights paired with live accumulators)."""
+    t = PSTable(3, 2, init="zeros", optimizer="adam", lr=0.1)
+    g = np.ones((3, 2), np.float32)
+    t.dense_push(g)
+    t.save(tmp_path / "a.bin")
+    w_saved = t.dense_pull()
+    t.dense_push(g)
+    t.dense_push(g)
+    t.load(tmp_path / "a.bin")
+    np.testing.assert_allclose(t.dense_pull(), w_saved)
+    # continued training must match an uninterrupted run
+    t.dense_push(g)
+    t2 = PSTable(3, 2, init="zeros", optimizer="adam", lr=0.1)
+    t2.dense_push(g)
+    t2.dense_push(g)
+    np.testing.assert_allclose(t.dense_pull(), t2.dense_pull(), rtol=1e-6)
+
+
+def test_table_id_reuse_rejected():
+    from hetu_tpu.ps.binding import lib
+    t = PSTable(2, 2)
+    assert lib.ps_table_create(t.id, 2, 2, 0, 0.0, 0.0, 0) == -2
+
+
+def test_independent_preduce_pools_and_ssp():
+    """Two PartialReduce instances must not share a matchmaking pool; two
+    SSPControllers must not clobber each other's clocks (regression)."""
+    pr_a = PartialReduce(max_group=2, wait_ms=1500)
+    pr_b = PartialReduce(max_group=2, wait_ms=1500)
+    out = {}
+
+    def w(pool, wid, key):
+        out[key] = pool.get_partner(wid)
+
+    ts = [threading.Thread(target=w, args=(pr_a, 0, "a0")),
+          threading.Thread(target=w, args=(pr_a, 1, "a1")),
+          threading.Thread(target=w, args=(pr_b, 2, "b2")),
+          threading.Thread(target=w, args=(pr_b, 3, "b3"))]
+    [t.start() for t in ts]; [t.join() for t in ts]
+    assert out["a0"] == out["a1"] == [0, 1]
+    assert out["b2"] == out["b3"] == [2, 3]
+
+    s1 = SSPController(2, staleness=10)
+    s2 = SSPController(3, staleness=0)
+    s1.clock_and_wait(0, timeout_ms=100)
+    assert s1.clock(0) == 1 and s2.clock(0) == 0
+
+    with pytest.raises(ValueError, match="worker id"):
+        pr_a.get_partner(64)
+
+
 def test_ssp_bounded_staleness():
     ssp = SSPController(2, staleness=1)
     results = {}

@@ -1,0 +1,135 @@
+"""Auto-parallel searcher tests over the simulator IR."""
+
+import numpy as np
+import pytest
+
+from hetu_tpu.profiler.cost_model import CHIPS
+from hetu_tpu.profiler.simulator import (
+    LayerSpec, ShardOption, Simulator, transformer_layer_specs,
+)
+from hetu_tpu.parallel.strategies.search import (
+    FlexFlowSearching, GalvatronSearching, GPipeSearching, OptCNNSearching,
+    PipeDreamSearching, PipeOptSearching, Plan,
+)
+
+
+def sim():
+    return Simulator(CHIPS["v5e"])
+
+
+def gpt_layers(num_layers=4, hidden=4096, ffn=16384, seq=2048, batch=8,
+               vocab=32000):
+    return transformer_layer_specs(num_layers, hidden, ffn, seq, batch,
+                                   vocab, tp_candidates=(1, 4))
+
+
+def test_optcnn_prefers_tp_for_big_layers():
+    """On compute-bound big layers, 4-way TP must beat pure DP."""
+    layers = gpt_layers()
+    plan = OptCNNSearching(sim(), dp=1).search(layers)
+    kinds = {o.kind for l, o in zip(layers, plan.layer_options)
+             if l.name.startswith(("attn", "ffn"))}
+    assert kinds <= {"tp_col", "tp_row"}, kinds
+    # and the chosen plan is at least as good as all-dp
+    all_dp = [l.options[0] for l in layers]
+    t_dp = sim().chain_time(layers, all_dp, 1)
+    assert plan.predicted_time <= t_dp + 1e-9
+
+
+def test_optcnn_prefers_dp_for_tiny_layers():
+    """Tiny layers: TP comm dominates, DP wins."""
+    layers = transformer_layer_specs(2, 64, 128, 32, 4, 100,
+                                     tp_candidates=(1, 4))
+    plan = OptCNNSearching(sim(), dp=1).search(layers)
+    kinds = [o.kind for l, o in zip(layers, plan.layer_options)
+             if l.name.startswith(("attn", "ffn"))]
+    assert all(k == "dp" for k in kinds), kinds
+
+
+def test_flexflow_close_to_optcnn():
+    layers = gpt_layers()
+    opt = OptCNNSearching(sim(), dp=1).search(layers)
+    ff = FlexFlowSearching(sim(), dp=1, iters=3000, seed=1).search(layers)
+    assert ff.predicted_time <= opt.predicted_time * 1.25
+
+
+def test_gpipe_balances_stages():
+    s = sim()
+    layers = [LayerSpec(f"l{i}", flops=1e12 * (1 + (i % 2)), param_bytes=1e6,
+                        act_bytes=1e6, options=[ShardOption("dp")])
+              for i in range(8)]
+    plan = GPipeSearching(s, n_stages=4, n_microbatches=8).search(layers)
+    st = plan.meta["stage_times"]
+    assert len(st) == 4
+    assert max(st) < sum(st) * 0.5  # no stage hogs half the pipeline
+
+
+def test_pipedream_steady_state_cheaper_than_gpipe():
+    s = sim()
+    layers = [LayerSpec(f"l{i}", flops=1e12, param_bytes=1e6, act_bytes=1e6,
+                        options=[ShardOption("dp")]) for i in range(8)]
+    g = GPipeSearching(s, 4, n_microbatches=2).search(layers)
+    p = PipeDreamSearching(s, 4, n_microbatches=2).search(layers)
+    assert "stash_bytes" in p.meta and len(p.meta["stash_bytes"]) == 4
+    # stash decreases toward later stages
+    assert p.meta["stash_bytes"][0] >= p.meta["stash_bytes"][-1]
+
+
+def test_pipeopt_explores_pp():
+    layers = gpt_layers(num_layers=8)
+    plan = PipeOptSearching(sim(), n_devices=8, n_microbatches=8).search(
+        layers)
+    assert plan.meta["searcher"] == "pipeopt"
+    assert plan.predicted_time > 0
+    assert "pp" in plan.meta
+
+
+def test_galvatron_respects_memory_budget():
+    s = sim()
+    layers = gpt_layers(num_layers=4)
+    # generous budget: no remat chosen
+    big = GalvatronSearching(s, dp=1, memory_budget_bytes=1e12).search(layers)
+    assert not any(big.meta["remat"])
+    # tight budget: remat must appear (activations dominate)
+    total_mem = sum(s.layer_memory(l, l.options[0], 1) for l in layers)
+    tight = GalvatronSearching(
+        s, dp=1, memory_budget_bytes=total_mem * 0.4).search(layers)
+    assert any(tight.meta["remat"])
+    assert tight.predicted_time >= big.predicted_time
+    # infeasible budget raises
+    with pytest.raises(ValueError, match="infeasible"):
+        GalvatronSearching(s, dp=1, memory_budget_bytes=1e3).search(layers)
+
+
+def test_plan_json_roundtrip(tmp_path):
+    layers = gpt_layers(num_layers=2)
+    plan = OptCNNSearching(sim(), dp=2).search(layers)
+    plan.save(tmp_path / "plan.json", layers)
+    loaded = Plan.load(tmp_path / "plan.json", layers)
+    assert [o.key() for o in loaded.layer_options] == \
+        [o.key() for o in plan.layer_options]
+    assert loaded.dp == 2
+
+
+def test_profiler_measures_and_caches(tmp_path):
+    from hetu_tpu.profiler.profiler import OpProfiler, _CostCache
+    cache = _CostCache(tmp_path / "cache.json")
+    prof = OpProfiler(warmup=1, iters=2, cache=cache)
+    t1 = prof.time_matmul(64, 64, 64)
+    assert t1 > 0
+    # second call hits the cache (same value, no re-measure)
+    t2 = prof.time_matmul(64, 64, 64)
+    assert t1 == t2
+    assert (tmp_path / "cache.json").exists()
+
+
+def test_collective_profiler_runs():
+    import hetu_tpu as ht
+    from hetu_tpu.profiler.profiler import CollectiveProfiler, _CostCache
+    mesh = ht.make_mesh(dp=8)
+    prof = CollectiveProfiler(mesh, warmup=1, iters=2,
+                              cache=_CostCache("/tmp/test_coll_cache.json"))
+    t = prof.allreduce_time(1 << 16, "dp")
+    assert t > 0
+    t2 = prof.ppermute_time(1 << 16, "dp")
+    assert t2 > 0
