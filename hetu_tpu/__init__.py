@@ -31,8 +31,27 @@ from hetu_tpu import lr
 from hetu_tpu import layers
 from hetu_tpu import data
 from hetu_tpu import parallel
+from hetu_tpu import utils
+from hetu_tpu import models
+from hetu_tpu import tokenizers
+from hetu_tpu import embedding_compress
+from hetu_tpu import profiler
 from hetu_tpu.train.executor import Executor, TrainState, gradients
 from hetu_tpu.train import checkpoint
 
 # Convenience re-exports matching the reference's top-level names
 from hetu_tpu.parallel.mesh import make_mesh, local_mesh, MeshConfig
+
+# heavier/optional subsystems imported on attribute access:
+#   hetu_tpu.ps (native PS plane), hetu_tpu.onnx, hetu_tpu.graphboard,
+#   hetu_tpu.launcher
+_LAZY = {"ps", "onnx", "graphboard", "launcher"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f"hetu_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'hetu_tpu' has no attribute {name!r}")

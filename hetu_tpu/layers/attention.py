@@ -21,7 +21,11 @@ from hetu_tpu.layers.base import Module
 class MultiHeadAttention(Module):
     def __init__(self, hidden_size: int, num_heads: int, *,
                  dropout_rate: float = 0.0, causal: bool = False,
-                 weight_init=None, dtype=jnp.float32):
+                 weight_init=None, dtype=jnp.float32,
+                 attention_impl: str = "xla"):
+        """attention_impl: 'xla' (compiler-fused composition) or 'flash'
+        (Pallas kernel, hetu_tpu/ops/pallas_kernels) — flash requires seq
+        divisible by its block size and no explicit mask."""
         assert hidden_size % num_heads == 0
         self.hidden_size = hidden_size
         self.num_heads = num_heads
@@ -30,6 +34,7 @@ class MultiHeadAttention(Module):
         self.causal = causal
         self.weight_init = weight_init or initializers.xavier_uniform()
         self.dtype = dtype
+        self.attention_impl = attention_impl
 
     def init(self, key):
         # f32 master weights; self.dtype is the compute dtype (see Linear)
@@ -51,7 +56,10 @@ class MultiHeadAttention(Module):
                          p["qkv_bias"])  # [B,S,3H]
         qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
         q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))  # [B,Hd,S,D]
-        if self.causal:
+        if self.attention_impl == "flash" and mask is None:
+            from hetu_tpu.ops.pallas_kernels import flash_attention
+            out = flash_attention(q, k, v, causal=self.causal)
+        elif self.causal:
             out = ops.causal_attention(q, k, v)
         else:
             out = ops.attention(q, k, v, mask=mask)

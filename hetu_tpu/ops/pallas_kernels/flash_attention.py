@@ -1,0 +1,172 @@
+"""Flash attention forward kernel (Pallas/TPU).
+
+The reference has no fused attention (its MHA composes batch_matmul +
+softmax ops, layers/attention.py); on TPU the fusion matters because the
+[S, S] score matrix otherwise round-trips HBM.  This kernel streams K/V
+BLOCKS through VMEM — grid = (batch*heads, q_blocks, k_blocks) with the k
+dimension innermost, online-softmax state held in VMEM scratch across the
+k iterations — so VMEM usage is O(block_q * D + block_k * D) regardless of
+sequence length.
+
+Causal masking is BOTTOM-RIGHT aligned (query i attends to keys
+<= i + (S_k - S_q)), matching ops.causal_attention, so cross-length
+(prefix/KV-cache) calls agree with the oracle in both forward and the
+recompute backward.
+
+Scope: forward fusion + custom_vjp whose backward recomputes through the
+XLA composition in hetu_tpu/ops/attention.py (single source of truth for
+attention semantics; saves the forward's O(S^2) HBM traffic — the
+memory-optimal *training* path for very long sequences is ring attention,
+hetu_tpu/parallel/ring_attention.py).  Interpret mode runs the same kernel
+on CPU for correctness tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from jax.experimental import pallas as pl
+
+from hetu_tpu.ops.attention import attention as _xla_attention
+from hetu_tpu.ops.attention import causal_attention as _xla_causal_attention
+
+NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                      block_q: int, block_k: int, scale: float, causal: bool,
+                      causal_offset: int):
+    """Program (bh, qi, ki): one [block_q, block_k] tile of the attention.
+
+    q_ref [block_q, D]; k_ref/v_ref [block_k, D]; o_ref [block_q, D];
+    acc/m/l: VMEM scratch carrying online-softmax state across ki.
+    """
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_last = (qi + 1) * block_q - 1 + causal_offset  # last visible k pos
+    k_first = ki * block_k
+    live = (not causal) or (k_first <= q_last)
+
+    @pl.when(live)
+    def _():
+        q = q_ref[:].astype(jnp.float32) * scale
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        scores = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + causal_offset + \
+                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + \
+                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1))
+        p = jnp.exp(scores - m_new[:, None])
+        if causal:
+            p = jnp.where(scores <= NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1)
+        m_ref[:] = m_new
+        acc_ref[:] = acc_ref[:] * corr[:, None] + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _():
+        l = jnp.maximum(l_ref[:], 1e-20)
+        o_ref[:] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    bq = min(block_q, s_q)
+    bk = min(block_k, s_k)
+    assert s_q % bq == 0 and s_k % bk == 0, (s_q, bq, s_k, bk)
+
+    qf = q.reshape(b * h, s_q, d)
+    kf = k.reshape(b * h, s_k, d)
+    vf = v.reshape(b * h, s_k, d)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_q=bq, block_k=bk, scale=scale,
+        causal=causal, causal_offset=s_k - s_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s_q // bq, s_k // bk),
+        in_specs=[
+            pl.BlockSpec((None, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        scratch_shapes=_scratch(bq, d),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s_q, d)
+
+
+def _scratch(bq, d):
+    from jax.experimental.pallas import tpu as pltpu
+    return [pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq,), jnp.float32),
+            pltpu.VMEM((bq,), jnp.float32)]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                      block_k=block_k, interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out = _flash(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # recompute-backward through the shared XLA composition (ops/attention.py
+    # — also bottom-right causal); memory O(S^2) during bwd, see docstring
+    if causal:
+        ref = lambda q, k, v: _xla_causal_attention(q, k, v, scale=scale)
+    else:
+        ref = lambda q, k, v: _xla_attention(q, k, v, scale=scale)
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, scale=None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret=None):
+    """Fused attention: q,k,v [B, H, S, D] → [B, H, S_q, D].
+
+    interpret=None auto-selects: real kernel on TPU, interpret mode
+    elsewhere.  Sequence lengths must be multiples of the block sizes
+    (pad upstream; hetu_tpu keeps static shapes everywhere).  Causal
+    masking is bottom-right aligned for S_q != S_k.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _flash(q, k, v, float(scale), bool(causal), int(block_q),
+                  int(block_k), bool(interpret))

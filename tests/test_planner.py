@@ -1,0 +1,95 @@
+"""Plan audit: XLA-inserted collectives are detected and priced."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import hetu_tpu as ht
+from hetu_tpu.parallel.planner import audit, report
+from hetu_tpu.profiler.cost_model import CHIPS
+
+
+def test_dp_grad_step_has_allreduce():
+    """A DP train step must show the gradient all-reduce XLA inserted."""
+    mesh = ht.make_mesh(dp=8)
+    w = jax.device_put(jnp.ones((64, 64)), NamedSharding(mesh, P()))
+    x = jax.device_put(jnp.ones((32, 64)), NamedSharding(mesh, P("dp")))
+
+    def step(w, x):
+        def loss(w):
+            return jnp.sum(jnp.tanh(x @ w) ** 2)
+        g = jax.grad(loss)(w)
+        return w - 0.1 * g
+
+    a = audit(step, w, x)
+    kinds = {c.kind for c in a.collectives}
+    assert "all-reduce" in kinds, kinds
+    assert a.flops > 0
+    assert a.total_comm_bytes() > 0
+    txt = report(a, chip=CHIPS["v5e"], n_devices=8)
+    assert "all-reduce" in txt and "est step time" in txt
+
+
+def test_tp_matmul_has_expected_collective():
+    """Row-parallel matmul (contracting dim sharded) → psum → all-reduce."""
+    mesh = ht.make_mesh(tp=8)
+    w = jax.device_put(jnp.ones((64, 32)), NamedSharding(mesh, P("tp", None)))
+    x = jax.device_put(jnp.ones((16, 64)), NamedSharding(mesh, P(None, "tp")))
+
+    def f(x, w):
+        return x @ w  # contraction over the sharded dim forces a reduce
+
+    a = audit(f, x, w)
+    kinds = {c.kind for c in a.collectives}
+    assert kinds & {"all-reduce", "reduce-scatter"}, kinds
+
+
+def test_replicated_compute_has_no_collectives():
+    mesh = ht.make_mesh(dp=8)
+    w = jax.device_put(jnp.ones((16, 16)), NamedSharding(mesh, P()))
+    a = audit(lambda w: jnp.tanh(w @ w), w)
+    assert a.collectives == [], a.collectives
+
+
+def test_async_hlo_not_double_counted():
+    """all-reduce-start/-done pairs (TPU async default) must count once,
+    and tuple-result starts must still parse (regression)."""
+    from hetu_tpu.parallel.planner import _FIRST_SHAPE_RE, _KIND_RE
+    start = ("%ars = (f32[64,64], f32[64,64]) all-reduce-start(%p), "
+             "replica_groups={}")
+    done = "%ard = f32[64,64] all-reduce-done(%ars)"
+    plain = "%ar = f32[32,32] all-reduce(%p), to_apply=%sum"
+    m = _KIND_RE.search(start)
+    assert m and m.group(1) == "all-reduce" and m.group(2) == "-start"
+    assert _FIRST_SHAPE_RE.search(start).group(2) == "64,64"
+    md = _KIND_RE.search(done)
+    assert md and md.group(2) == "-done"  # audit() skips these
+    mp = _KIND_RE.search(plain)
+    assert mp and mp.group(2) is None
+
+
+def test_audit_scaled_multipliers():
+    from hetu_tpu.parallel.planner import CollectiveInfo, PlanAudit
+    a = PlanAudit(collectives=[
+        CollectiveInfo("collective-permute", "f32", (4, 4), 64)],
+        flops=10.0)
+    s = a.scaled({"collective-permute": 12})
+    assert s.total_comm_bytes() == 64 * 12
+    assert a.total_comm_bytes() == 64  # original untouched
+    assert s.flops == 10.0
+
+
+def test_estimate_time_positive_and_ordered():
+    mesh = ht.make_mesh(dp=8)
+    w = jax.device_put(jnp.ones((256, 256)), NamedSharding(mesh, P()))
+    x = jax.device_put(jnp.ones((64, 256)), NamedSharding(mesh, P("dp")))
+
+    def step(w, x):
+        g = jax.grad(lambda w: jnp.sum((x @ w) ** 2))(w)
+        return w - g
+
+    a = audit(step, w, x)
+    t8 = a.estimate_time(CHIPS["v5e"], n_devices=8)
+    t64 = a.estimate_time(CHIPS["v5e"], n_devices=64)
+    assert t8 > 0 and t64 >= t8  # bigger ring, more comm time
