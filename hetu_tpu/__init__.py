@@ -44,8 +44,8 @@ from hetu_tpu.parallel.mesh import make_mesh, local_mesh, MeshConfig
 
 # heavier/optional subsystems imported on attribute access:
 #   hetu_tpu.ps (native PS plane), hetu_tpu.onnx, hetu_tpu.graphboard,
-#   hetu_tpu.launcher
-_LAZY = {"ps", "onnx", "graphboard", "launcher"}
+#   hetu_tpu.launcher, hetu_tpu.graph (define-then-run facade)
+_LAZY = {"ps", "onnx", "graphboard", "launcher", "graph"}
 
 
 def __getattr__(name):

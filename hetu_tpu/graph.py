@@ -1,0 +1,301 @@
+"""Define-then-run graph facade — the reference's user-facing idiom.
+
+Reference: python/hetu/gpu_ops/Node.py (Op base: inputs, operator
+overloads) + executor.py (`Executor({'train': [loss, train_op]})`,
+`executor.run('train', feed_dict=...)`) and `gradients()` (executor.py:1265).
+
+A Hetu user writes:
+
+    x = ht.placeholder((B, 784), name="x")
+    w = ht.Variable(init.xavier_uniform(), (784, 10), name="w")
+    loss = ht.ops.softmax_cross_entropy_sparse(ht.ops.matmul(x, w), y).mean()
+    train = optimizer.minimize(loss)
+    executor = ht.Executor([loss, train])
+    executor.run(feed_dict={x: batch_x, y: batch_y})
+
+This module reproduces that workflow on the functional core: graph nodes
+record a dataflow DAG; GraphExecutor topologically evaluates it inside one
+jit (the whole graph traces to a single XLA program — the define-then-run
+graph IS the jaxpr), with Variables held as device state, `gradients()`
+via jax.grad over the traced function, and optimizer application through
+hetu_tpu.optim.
+
+Every op in hetu_tpu.ops is exposed as a graph builder via `op()` or the
+operator overloads on Node.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu import ops as _ops
+from hetu_tpu import rng as hrng
+from hetu_tpu.optim.optimizer import Optimizer
+
+_node_ids = itertools.count()
+
+
+class Node:
+    """A graph node (reference Op, Node.py:20)."""
+
+    # keep numpy from elementwise-broadcasting over Node on `ndarray <op> node`
+    __array_ufunc__ = None
+
+    def __init__(self, kind: str, fn: Optional[Callable], inputs: Sequence,
+                 name: Optional[str] = None, **attrs):
+        self.id = next(_node_ids)
+        self.kind = kind          # 'placeholder' | 'variable' | 'op'
+        self.fn = fn
+        self.inputs = list(inputs)
+        self.name = name or f"{kind}_{self.id}"
+        self.attrs = attrs
+
+    # ---- operator overloads (Node.py:60-120) ----
+    def __add__(self, o):
+        return op(_ops.add, self, _wrap(o))
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return op(_ops.minus, self, _wrap(o))
+
+    def __rsub__(self, o):
+        return op(_ops.minus, _wrap(o), self)
+
+    def __mul__(self, o):
+        return op(_ops.multiply, self, _wrap(o))
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return op(_ops.divide, self, _wrap(o))
+
+    def __neg__(self):
+        return op(_ops.opposite, self)
+
+    def __matmul__(self, o):
+        return op(_ops.matmul, self, _wrap(o))
+
+    def mean(self, axes=None):
+        return op(_ops.reduce_mean, self, axes=axes)
+
+    def sum(self, axes=None):
+        return op(_ops.reduce_sum, self, axes=axes)
+
+    def reshape(self, shape):
+        return op(_ops.reshape, self, shape=shape)
+
+    def __repr__(self):
+        return f"<Node {self.name}>"
+
+
+def _wrap(x):
+    if isinstance(x, Node):
+        return x
+    return constant(x)
+
+
+def placeholder(shape=None, dtype=jnp.float32, name=None) -> Node:
+    """Feed point (reference PlaceholderOp via ht.Variable(trainable=False))."""
+    return Node("placeholder", None, [], name=name, shape=shape, dtype=dtype)
+
+
+def Variable(initializer, shape=None, name=None, *, trainable=True,
+             value=None) -> Node:
+    """Trainable parameter (reference ht.Variable).
+
+    Either `value` (concrete array) or (`initializer`, `shape`).
+    """
+    if value is None:
+        if callable(initializer):
+            value = initializer(hrng.next_key(), shape)
+        else:
+            value = jnp.asarray(initializer)
+    return Node("variable", None, [], name=name, value=jnp.asarray(value),
+                trainable=trainable)
+
+
+def constant(value, name=None) -> Node:
+    return Node("constant", None, [], name=name, value=jnp.asarray(value))
+
+
+def op(fn: Callable, *inputs, **attrs) -> Node:
+    """Build an op node from any hetu_tpu.ops function."""
+    return Node("op", fn, [_wrap(i) if not isinstance(i, (int, float))
+                           or isinstance(i, Node) else i
+                           for i in inputs], **attrs)
+
+
+def topo_sort(outputs: Sequence[Node]) -> List[Node]:
+    seen, order = set(), []
+
+    def visit(n: Node):
+        if n.id in seen:
+            return
+        seen.add(n.id)
+        for i in n.inputs:
+            if isinstance(i, Node):
+                visit(i)
+        order.append(n)
+
+    for o in outputs:
+        visit(o)
+    return order
+
+
+def _evaluate(outputs: Sequence[Node], var_values: Dict[int, jax.Array],
+              feeds: Dict[int, jax.Array]):
+    order = topo_sort(outputs)
+
+    # gradient nodes grouped by loss so K grads of one loss trace ONE
+    # forward+backward (jax.grad over a dict), then composable like any value
+    grad_groups: Dict[int, List[Node]] = {}
+    for n in order:
+        if n.kind == "grad":
+            grad_groups.setdefault(n.inputs[0].id, []).append(n)
+    grad_vals: Dict[int, jax.Array] = {}
+    for loss_id, gnodes in grad_groups.items():
+        loss_node = gnodes[0].inputs[0]
+        wrts = [g.attrs["wrt"] for g in gnodes]
+
+        def lf(wdict, loss_node=loss_node):
+            merged = dict(var_values)
+            merged.update({int(k): v for k, v in wdict.items()})
+            return _evaluate([loss_node], merged, feeds)[0]
+
+        gd = jax.grad(lf)({str(w.id): var_values[w.id] for w in wrts})
+        for g, w in zip(gnodes, wrts):
+            grad_vals[g.id] = gd[str(w.id)]
+
+    vals: Dict[int, jax.Array] = {}
+    for n in order:
+        if n.kind == "placeholder":
+            if n.id not in feeds:
+                raise KeyError(f"no feed for placeholder {n.name}")
+            vals[n.id] = feeds[n.id]
+        elif n.kind == "variable":
+            vals[n.id] = var_values[n.id]
+        elif n.kind == "constant":
+            vals[n.id] = n.attrs["value"]
+        elif n.kind == "grad":
+            vals[n.id] = grad_vals[n.id]
+        else:
+            args = [vals[i.id] if isinstance(i, Node) else i
+                    for i in n.inputs]
+            vals[n.id] = n.fn(*args, **{k: v for k, v in n.attrs.items()
+                                        if k != "value"})
+    return [vals[o.id] for o in outputs]
+
+
+def gradients(loss: Node, variables: Sequence[Node]) -> List[Node]:
+    """Symbolic-gradient nodes (reference executor.py:1265): evaluated by
+    GraphExecutor via jax.grad of the traced graph."""
+    return [Node("grad", None, [loss, v], name=f"grad_{v.name}", wrt=v)
+            for v in variables]
+
+
+class GraphExecutor:
+    """Reference-style Executor over the node graph.
+
+    eval_node_dict: {'train': [loss, train_op], 'validate': [loss]} or a
+    plain list for a single subexecutor (executor.py:430 semantics).
+    """
+
+    def __init__(self, eval_node_dict, *, seed: Optional[int] = None):
+        if seed is not None:
+            hrng.set_random_seed(seed)
+        if not isinstance(eval_node_dict, dict):
+            eval_node_dict = {"default": list(eval_node_dict)}
+        self.groups = eval_node_dict
+
+        all_nodes = topo_sort([n for g in self.groups.values() for n in g
+                               if isinstance(n, Node)])
+        self.variables = [n for n in all_nodes if n.kind == "variable"]
+        self.var_values = {v.id: v.attrs["value"] for v in self.variables}
+        # one optimizer state per trainop node (groups may train different
+        # losses with different optimizers)
+        self.opt_states: Dict[int, object] = {}
+        self._compiled: Dict[str, Callable] = {}
+
+    # ---- execution ----
+    def _build(self, name: str):
+        nodes = self.groups[name]
+        train_ops = [n for n in nodes if n.kind == "trainop"]
+        outs = [n for n in nodes if n.kind != "trainop"]
+        trainables = [v for v in self.variables if v.attrs.get("trainable")]
+
+        if train_ops:
+            for top in train_ops:
+                if top.id not in self.opt_states:
+                    params = {str(v.id): self.var_values[v.id]
+                              for v in trainables}
+                    self.opt_states[top.id] = \
+                        top.attrs["optimizer"].init_state(params)
+
+            def step(var_values, opt_states, feeds):
+                # report outs at entry values (the batch the update used,
+                # matching the reference's same-pass loss)
+                outvals = _evaluate(outs, var_values, feeds) if outs else []
+                new_vals = dict(var_values)
+                new_opt = dict(opt_states)
+                # apply each trainop sequentially (listed order)
+                for top in train_ops:
+                    opt = top.attrs["optimizer"]
+                    loss_node = top.inputs[0]
+                    params = {str(v.id): new_vals[v.id] for v in trainables}
+
+                    def loss_fn(params, loss_node=loss_node):
+                        merged = dict(new_vals)
+                        for v in trainables:
+                            merged[v.id] = params[str(v.id)]
+                        return _evaluate([loss_node], merged, feeds)[0]
+
+                    grads = jax.grad(loss_fn)(params)
+                    params, new_opt[top.id] = opt.update(
+                        grads, new_opt[top.id], params)
+                    for v in trainables:
+                        new_vals[v.id] = params[str(v.id)]
+                return new_vals, new_opt, outvals
+
+            return jax.jit(step), True
+
+        def evaluate(var_values, feeds):
+            return _evaluate(outs, var_values, feeds)
+
+        return jax.jit(evaluate), False
+
+    def run(self, name: str = "default", feed_dict: Optional[Dict] = None):
+        """Returns the evaluated nodes' values (train_op yields None slot,
+        matching the reference's convention)."""
+        feed_dict = feed_dict or {}
+        feeds = {k.id: jnp.asarray(v) for k, v in feed_dict.items()}
+        if name not in self._compiled:
+            self._compiled[name] = self._build(name)
+        fn, is_train = self._compiled[name]
+        nodes = self.groups[name]
+        if is_train:
+            self.var_values, self.opt_states, outvals = fn(
+                self.var_values, self.opt_states, feeds)
+            outvals = list(outvals)
+            return [None if n.kind == "trainop" else outvals.pop(0)
+                    for n in nodes]
+        outvals = list(fn(self.var_values, feeds))
+        return [outvals.pop(0) for n in nodes]
+
+    # ---- state (reference save/load) ----
+    def get_variable_value(self, v: Node):
+        return self.var_values[v.id]
+
+    def set_variable_value(self, v: Node, value):
+        self.var_values[v.id] = jnp.asarray(value)
+
+
+def minimize(optimizer: Optimizer, loss: Node) -> Node:
+    """optimizer.minimize analog (optimizer.py:66): returns the train op
+    node to put in the executor's eval list."""
+    return Node("trainop", None, [loss], optimizer=optimizer)

@@ -1,0 +1,56 @@
+"""GCN for node classification.
+
+Reference: examples/gnn (GCN over GraphMix-sampled minibatches) +
+gpu_ops/DistGCN_15d.py.  Full-graph training here; the distributed form
+shards nodes over 'dp' with psum'd aggregations (see ops/graph_ops.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import init as initializers
+from hetu_tpu import ops
+from hetu_tpu.layers.base import Module
+from hetu_tpu.ops.graph_ops import gcn_conv, gcn_norm
+
+
+class GCN(Module):
+    def __init__(self, in_features: int, hidden: int, num_classes: int,
+                 *, dropout_rate: float = 0.5):
+        self.dims = (in_features, hidden, num_classes)
+        self.dropout_rate = dropout_rate
+        self.w_init = initializers.xavier_uniform()
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        f, h, c = self.dims
+        return {"params": {"w1": self.w_init(k1, (f, h), jnp.float32),
+                           "w2": self.w_init(k2, (h, c), jnp.float32)},
+                "state": {}}
+
+    def apply(self, variables, x, edge_src, edge_dst, edge_weight, *,
+              train: bool = False, rng=None):
+        """x: [N, F]; normalized edges from ops.graph_ops.gcn_norm."""
+        p = variables["params"]
+        n = x.shape[0]
+        h = gcn_conv(x, p["w1"], edge_src, edge_dst, edge_weight, n)
+        h = ops.relu(h)
+        if train and self.dropout_rate > 0:
+            h = ops.dropout(h, self.dropout_rate, rng, train=True)
+        return gcn_conv(h, p["w2"], edge_src, edge_dst, edge_weight, n), {}
+
+    def loss_fn(self, edge_src, edge_dst, edge_weight):
+        """Node-classification loss over a mask (semi-supervised setting)."""
+        def fn(params, model_state, batch, rng, train):
+            x, labels, mask = batch
+            logits, _ = self.apply({"params": params, "state": {}}, x,
+                                   edge_src, edge_dst, edge_weight,
+                                   train=train, rng=rng)
+            per = ops.softmax_cross_entropy_sparse(logits, labels)
+            loss = jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1)
+            acc = jnp.sum((jnp.argmax(logits, -1) == labels) * mask) / \
+                jnp.maximum(jnp.sum(mask), 1)
+            return loss, ({"acc": acc}, model_state)
+        return fn

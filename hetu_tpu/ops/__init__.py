@@ -61,3 +61,6 @@ from hetu_tpu.ops.moe_ops import (
 from hetu_tpu.ops.attention import (
     attention, causal_attention,
 )
+from hetu_tpu.ops.graph_ops import (
+    coo_spmm, gcn_norm, gcn_conv,
+)

