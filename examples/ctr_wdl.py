@@ -71,15 +71,24 @@ def main():
     logger = MetricLogger()
     t0 = time.perf_counter()
     n = sparse.shape[0]
-    for it in range(args.steps):
+
+    def batch_at(it):
         lo = (it * args.batch) % (n - args.batch)
-        ids = sparse[lo:lo + args.batch]
-        dx = dense_x[lo:lo + args.batch]
-        yy = y[lo:lo + args.batch]
-        rows = emb.pull(ids)                       # host: PS/cache pull
+        return (sparse[lo:lo + args.batch], dense_x[lo:lo + args.batch],
+                y[lo:lo + args.batch])
+
+    # prefetch pipeline (reference executor.py:384): batch k+1's pull is
+    # submitted AFTER batch k's push (the documented discipline — pulls must
+    # see the newest rows), overlapping with metric logging + batching work
+    emb.prefetch(batch_at(0)[0])
+    for it in range(args.steps):
+        ids, dx, yy = batch_at(it)
+        rows = emb.pull_prefetched()               # host: PS/cache pull
         params, opt_state, model_state, loss, logit, ge = step(
             params, opt_state, model_state, dx, rows, yy)
         emb.push(ids, np.asarray(ge))              # host: PS/cache push
+        if it + 1 < args.steps:
+            emb.prefetch(batch_at(it + 1)[0])
         logger.log({"loss": float(loss),
                     "auc": metrics.auc(np.asarray(logit), yy)})
         if (it + 1) % 50 == 0:

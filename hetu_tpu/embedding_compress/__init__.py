@@ -3,6 +3,8 @@ from hetu_tpu.embedding_compress.layers import (
     TensorTrainEmbedding, DHEEmbedding, ROBEEmbedding, QuantizedEmbedding,
     ALPTEmbedding, PrunedEmbedding, PEPEmbedding, OptEmbedEmbedding,
     AutoSRHEmbedding, MixedDimEmbedding, AutoDimEmbedding, DedupEmbedding,
-    AdaptiveEmbedding,
+    AdaptiveEmbedding, SparseEmbedding, MaskedEmbedding,
+    pep_to_retrain, autosrh_to_retrain, autodim_to_retrain,
+    optembed_row_pruned,
 )
 from hetu_tpu.embedding_compress.scheduler import CompressionScheduler

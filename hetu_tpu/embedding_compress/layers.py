@@ -542,6 +542,111 @@ class DedupEmbedding(Module):
             variables["state"]
 
 
+class SparseEmbedding(Module):
+    """Sparse-format serving embedding (reference sparse.py: CSR inference
+    after pruning).  TPU form: ELL (padded per-row nnz) so lookups stay
+    static-shaped — values [N, max_nnz], cols [N, max_nnz] with -1 padding.
+    """
+
+    def __init__(self, num_embeddings: int, dim: int, *, max_nnz: int):
+        self.n, self.dim, self.max_nnz = num_embeddings, dim, max_nnz
+
+    @staticmethod
+    def from_dense(table, max_nnz: int):
+        """Convert a (pruned) dense table to ELL state (dense_to_sparse
+        analog)."""
+        table = jnp.asarray(table)
+        n, dim = table.shape
+        # top-|max_nnz| magnitudes per row keep the surviving entries
+        mag = jnp.abs(table)
+        _, cols = jax.lax.top_k(mag, max_nnz)                 # [N, max_nnz]
+        vals = jnp.take_along_axis(table, cols, axis=1)
+        keep = jnp.take_along_axis(mag, cols, axis=1) > 0
+        cols = jnp.where(keep, cols, -1)
+        vals = jnp.where(keep, vals, 0.0)
+        return {"params": {}, "state": {"values": vals,
+                                        "cols": cols.astype(jnp.int32)}}
+
+    def init(self, key):  # serving-only: build via from_dense
+        z = jnp.zeros((self.n, self.max_nnz))
+        return {"params": {}, "state": {
+            "values": z, "cols": jnp.full((self.n, self.max_nnz), -1,
+                                          jnp.int32)}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        s = variables["state"]
+        ids = ids.astype(jnp.int32)
+        vals = jnp.take(s["values"], ids, axis=0)             # [..., max_nnz]
+        cols = jnp.take(s["cols"], ids, axis=0)
+        safe = jnp.where(cols >= 0, cols, 0)
+        contrib = jnp.where(cols >= 0, vals, 0.0)
+        # scatter the nnz entries into their dense positions
+        one_hot = jax.nn.one_hot(safe, self.dim, dtype=vals.dtype)
+        out = jnp.einsum("...k,...kd->...d", contrib, one_hot)
+        return out, s
+
+
+class MaskedEmbedding(Module):
+    """Finetuning module for the retrain conversions: lookups apply the
+    FROZEN sparsity mask in the forward pass, so masked positions produce
+    zero output AND zero gradient — the pattern survives any number of
+    optimizer steps (the reference's *Retrain modules do the same)."""
+
+    def __init__(self, num_embeddings: int, dim: int):
+        self.n, self.dim = num_embeddings, dim
+
+    def init(self, key):  # build via a retrain converter
+        return {"params": {"w": jnp.zeros((self.n, self.dim))},
+                "state": {"mask": jnp.ones((self.n, self.dim))}}
+
+    def apply(self, variables, ids, *, train=False, rng=None):
+        p, s = variables["params"], variables["state"]
+        ids = ids.astype(jnp.int32)
+        w = jnp.take(p["w"], ids, axis=0)
+        m = jnp.take(s["mask"], ids, axis=0)
+        return w * m, s
+
+
+def pep_to_retrain(pep_module: "PEPEmbedding", variables):
+    """PEPRetrainEmbedding analog: freeze the learned sparsity pattern.
+    Returns MaskedEmbedding variables (finetune through MaskedEmbedding so
+    the mask is enforced in forward/backward)."""
+    p = variables["params"]
+    w, gthr = p["w"], jax.nn.sigmoid(p["g"])
+    mask = (jnp.abs(w) > gthr).astype(w.dtype)
+    return {"params": {"w": w * mask}, "state": {"mask": mask}}
+
+
+def autosrh_to_retrain(module: "AutoSRHEmbedding", variables,
+                       keep_fraction: float = 0.5):
+    """AutoSrhRetrainEmbedding analog: prune dimension gates below the
+    keep-fraction quantile.  Returns MaskedEmbedding variables."""
+    p = variables["params"]
+    a = jnp.abs(p["alpha"])
+    thresh = jnp.quantile(a, 1.0 - keep_fraction)
+    mask = (a >= thresh).astype(p["w"].dtype)
+    return {"params": {"w": p["w"] * mask}, "state": {"mask": mask}}
+
+
+def autodim_to_retrain(module: "AutoDimEmbedding", variables):
+    """AutoDimRetrainEmbedding analog: keep only the argmax candidate dim's
+    table + projection."""
+    best = int(jnp.argmax(variables["params"]["arch"]))
+    p = variables["params"]
+    return {"params": {"t": p[f"t{best}"], "p": p[f"p{best}"]},
+            "state": {"dim": module.cands[best]}}
+
+
+def optembed_row_pruned(module: "OptEmbedEmbedding", variables):
+    """OptEmbeddingAfterRowPruning analog: zero masked-off rows (compact
+    remap happens host-side when materializing the smaller table)."""
+    p = variables["params"]
+    score = jnp.linalg.norm(p["w"], axis=-1) - jax.nn.softplus(p["t"])
+    mask = (score > 0).astype(p["w"].dtype)
+    return {"params": {"w": p["w"] * mask[:, None]},
+            "state": {"row_mask": mask}}
+
+
 class AdaptiveEmbedding(MixedDimEmbedding):
     """Adaptive embedding (reference adapt.py, Transformer-XL style): alias
     of the tiered mixed-dim scheme with geometric dim decay per tier."""

@@ -1,4 +1,8 @@
 from hetu_tpu.parallel.strategies.base import Strategy
 from hetu_tpu.parallel.strategies.simple import (
-    DataParallel, MegatronLM,
+    DataParallel, MegatronLM, ModelParallel4CNN, OneWeirdTrick4CNN,
+)
+from hetu_tpu.parallel.strategies.search import (
+    FlexFlowSearching, GalvatronSearching, GPipeSearching, OptCNNSearching,
+    PipeDreamSearching, PipeOptSearching, Plan,
 )

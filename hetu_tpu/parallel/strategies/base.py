@@ -38,8 +38,25 @@ class Strategy:
         return jax.tree_util.tree_unflatten(treedef, specs)
 
     def shardings(self, params, mesh: Mesh) -> Any:
+        """Materialize NamedShardings; dims whose size does not divide the
+        assigned axis product fall back to replication (the reference
+        requires divisible splits — we degrade gracefully instead, e.g. a
+        10-class FC head under tp=4)."""
+        def fit(spec: P, leaf) -> NamedSharding:
+            dims = []
+            for i, entry in enumerate(spec):
+                if entry is None or i >= leaf.ndim:
+                    dims.append(entry)
+                    continue
+                axes = entry if isinstance(entry, tuple) else (entry,)
+                k = 1
+                for a in axes:
+                    k *= mesh.shape[a]
+                dims.append(entry if leaf.shape[i] % k == 0 else None)
+            return NamedSharding(mesh, P(*dims))
+
         return jax.tree_util.tree_map(
-            lambda spec: NamedSharding(mesh, spec), self.param_specs(params),
+            fit, self.param_specs(params), params,
             is_leaf=lambda x: isinstance(x, P))
 
     def place(self, params, mesh: Mesh):

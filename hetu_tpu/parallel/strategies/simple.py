@@ -28,6 +28,30 @@ class DataParallel(Strategy):
         return P()
 
 
+class ModelParallel4CNN(Strategy):
+    """CNN model parallelism (simple.py:46): fully-connected layers split
+    over tp (column-parallel), convolutions replicated."""
+
+    FC_MARKERS = ("fc", "linear", "dense")
+
+    def param_spec(self, path, leaf):
+        ndim = leaf.ndim if hasattr(leaf, "ndim") else len(leaf.shape)
+        low = path.lower()
+        if any(m in low for m in self.FC_MARKERS) and "weight" in low \
+                and ndim == 2:
+            return P(None, AXIS_TP)   # column split
+        if any(m in low for m in self.FC_MARKERS) and "bias" in low:
+            return P(AXIS_TP)
+        return P()
+
+
+class OneWeirdTrick4CNN(ModelParallel4CNN):
+    """Krizhevsky's one-weird-trick (simple.py:119): data parallel for the
+    conv trunk, model parallel for the FC head — the spec is identical to
+    ModelParallel4CNN (convs replicated so dp shards batch; FC tp-split);
+    the difference is the runtime pairing with a dp axis in the mesh."""
+
+
 class MegatronLM(Strategy):
     """Megatron-style tensor parallel for the transformer models.
 

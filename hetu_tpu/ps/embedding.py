@@ -14,6 +14,7 @@ it's pure host work.
 
 from __future__ import annotations
 
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Optional
 
 import numpy as np
@@ -35,6 +36,11 @@ class PSEmbedding:
                                        cache_policy, pull_bound=pull_bound)
                       if cache_capacity else None)
         self.dim = dim
+        # one worker thread: prefetch overlaps the NEXT batch's pull with
+        # the current device step (reference prefetch pipeline,
+        # executor.py:384 + PSEvent discipline)
+        self._prefetcher = ThreadPoolExecutor(max_workers=1)
+        self._pending: Optional[Future] = None
 
     def pull(self, indices) -> np.ndarray:
         """rows for this batch: [*indices.shape, dim] float32."""
@@ -43,6 +49,34 @@ class PSEmbedding:
         return self.table.sparse_pull(
             np.asarray(indices).reshape(-1)).reshape(
                 *np.asarray(indices).shape, self.dim)
+
+    def prefetch(self, indices) -> None:
+        """Start pulling `indices` on the worker thread; pull_prefetched()
+        collects.  Note: push() for rows being prefetched should happen
+        BEFORE the prefetch to keep the reference's bounded-staleness
+        semantics (the cache tier tolerates the race within its bound)."""
+        if self._pending is not None:
+            raise RuntimeError(
+                "previous prefetch not collected; call pull_prefetched() "
+                "first (silently dropping it would misalign the pipeline)")
+        idx = np.array(indices, copy=True)
+        self._pending = self._prefetcher.submit(self.pull, idx)
+
+    def close(self) -> None:
+        self._prefetcher.shutdown(wait=False)
+
+    def __del__(self):  # pragma: no cover - interpreter-shutdown best effort
+        try:
+            self._prefetcher.shutdown(wait=False)
+        except Exception:
+            pass
+
+    def pull_prefetched(self) -> np.ndarray:
+        if self._pending is None:
+            raise RuntimeError("no prefetch in flight")
+        out = self._pending.result()
+        self._pending = None
+        return out
 
     def push(self, indices, row_grads) -> None:
         """apply d(loss)/d(rows) on the server (or into the cache tier)."""

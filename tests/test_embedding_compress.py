@@ -116,6 +116,64 @@ def test_dedup_shared_rows():
     assert uniq.shape[0] <= 10
 
 
+def test_sparse_ell_serving_matches_pruned_dense():
+    g = np.random.default_rng(5)
+    table = g.standard_normal((20, D)).astype(np.float32)
+    table[np.abs(table) < 0.8] = 0.0  # pruned dense
+    max_nnz = int((table != 0).sum(axis=1).max())
+    m = ec.SparseEmbedding(20, D, max_nnz=max_nnz)
+    v = ec.SparseEmbedding.from_dense(table, max_nnz)
+    rows, _ = m.apply(v, jnp.asarray([0, 3, 19]))
+    np.testing.assert_allclose(np.asarray(rows), table[[0, 3, 19]],
+                               rtol=1e-6)
+    # ELL storage smaller than dense when sparse enough
+    nbytes = (np.asarray(v["state"]["values"]).nbytes
+              + np.asarray(v["state"]["cols"]).nbytes)
+    assert nbytes < 20 * D * 4 or max_nnz * 2 >= D  # only if actually sparse
+
+
+def test_retrain_conversions():
+    # PEP → frozen mask
+    pep = ec.PEPEmbedding(N, D)
+    vp = pep.init(jax.random.PRNGKey(0))
+    r = ec.pep_to_retrain(pep, vp)
+    assert set(r["params"]) == {"w"} and "mask" in r["state"]
+    # AutoSrh → pruned gates (alpha randomized as it would be post-training;
+    # the all-ones init makes the quantile degenerate)
+    asrh = ec.AutoSRHEmbedding(N, D)
+    va = asrh.init(jax.random.PRNGKey(0))
+    va["params"]["alpha"] = jax.random.normal(jax.random.PRNGKey(2), (N, D))
+    ra = ec.autosrh_to_retrain(asrh, va, keep_fraction=0.3)
+    kept = float(np.asarray(ra["state"]["mask"]).mean())
+    assert 0.25 < kept < 0.35
+    # AutoDim → single winner table
+    ad = ec.AutoDimEmbedding(N, D)
+    vd = ad.init(jax.random.PRNGKey(0))
+    rd = ec.autodim_to_retrain(ad, vd)
+    assert rd["params"]["t"].shape[0] == N
+    assert rd["params"]["t"].shape[1] == rd["state"]["dim"]
+    # OptEmbed → row-pruned
+    oe = ec.OptEmbedEmbedding(N, D)
+    vo = oe.init(jax.random.PRNGKey(0))
+    ro = ec.optembed_row_pruned(oe, vo)
+    assert ro["state"]["row_mask"].shape == (N,)
+
+    # finetuning through MaskedEmbedding keeps the pattern frozen:
+    # masked positions get ZERO gradient (regression: mask was unused)
+    me = ec.MaskedEmbedding(N, D)
+    ids = jnp.arange(10)
+
+    def loss(params):
+        rows, _ = me.apply({"params": params, "state": ra["state"]}, ids)
+        return jnp.sum(rows ** 2)
+
+    grad = jax.grad(loss)({"w": ra["params"]["w"]})
+    g = np.asarray(grad["w"][:10])
+    m = np.asarray(ra["state"]["mask"][:10])
+    assert np.all(g[m == 0] == 0)  # no gradient where masked
+    assert np.any(g[m == 1] != 0)
+
+
 def test_scheduler_stages_and_hooks():
     from hetu_tpu.embedding_compress.scheduler import (
         CompressionScheduler, Stage, prune_rate_setter, switch_to_quantized)

@@ -256,6 +256,25 @@ def test_preduce_matchmaking():
     assert solo == [3]
 
 
+def test_ps_embedding_prefetch_pipeline():
+    """prefetch/pull_prefetched overlaps host pulls with compute; disjoint
+    batches match direct pulls exactly."""
+    emb = PSEmbedding(100, 4, optimizer="sgd", lr=0.1, seed=3)
+    batches = [np.arange(10), np.arange(50, 60), np.arange(20, 30)]
+    direct = [emb.pull(b).copy() for b in batches]
+    emb.prefetch(batches[0])
+    for i, b in enumerate(batches):
+        rows = emb.pull_prefetched()
+        if i + 1 < len(batches):
+            emb.prefetch(batches[i + 1])
+        np.testing.assert_allclose(rows, direct[i])
+        emb.push(b, np.zeros((10, 4), np.float32))  # no-op grads
+
+    import pytest
+    with pytest.raises(RuntimeError, match="no prefetch"):
+        emb.pull_prefetched()
+
+
 def test_ps_embedding_learns():
     """Tiny CTR-style hybrid step: PS embedding + host loop learns XOR-ish
     labels (reference analog: examples/ctr PS mode)."""
