@@ -1,0 +1,373 @@
+// TCP "van" for the PS plane: multi-host transport over the table core.
+//
+// Reference: ps-lite/src/van.cc (580 LoC zmq transport), zmq_van.h,
+// postoffice.cc (node management) — the message plane carrying typed PS
+// functions between workers and servers across hosts.
+//
+// TPU-VM translation: servers run on host CPUs; workers (one per TPU-VM
+// host) reach them over DCN with a length-prefixed binary protocol.  The
+// data path stays in C++ end to end: frames decode straight into the table
+// handlers in hetu_ps.cpp (same process = same ABI, no serialization of
+// table state).  Thread-per-connection is plenty for worker counts here;
+// an epoll van is a drop-in upgrade behind the same C ABI.
+//
+// Frame: request  [u32 body_len][u8 op][payload...]
+//        response [u32 body_len][i32 rc][payload...]
+// Integers little-endian; payload layouts per op documented inline.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+// table core (same TU group; declared in hetu_ps.cpp)
+extern "C" {
+int ps_table_create(int id, int64_t rows, int64_t dim, int init_kind,
+                    double a, double b, uint64_t seed);
+int ps_table_set_optimizer(int id, int kind, float lr, float mom, float eps,
+                           float b1, float b2);
+int64_t ps_table_rows(int id);
+int64_t ps_table_dim(int id);
+int ps_dense_pull(int id, float* out);
+int ps_dense_push(int id, const float* grad);
+int ps_sparse_pull(int id, const int64_t* idx, int64_t n, float* out,
+                   uint64_t* versions_out);
+int ps_sparse_push(int id, const int64_t* idx, const float* grads, int64_t n);
+int ps_sparse_set(int id, const int64_t* idx, const float* vals, int64_t n);
+int ps_table_save(int id, const char* path);
+int ps_table_load(int id, const char* path);
+}
+
+namespace {
+
+enum VanOp : uint8_t {
+  OP_CREATE = 1, OP_SET_OPT = 2, OP_DENSE_PULL = 3, OP_DENSE_PUSH = 4,
+  OP_SPARSE_PULL = 5, OP_SPARSE_PUSH = 6, OP_SPARSE_SET = 7, OP_SAVE = 8,
+  OP_LOAD = 9, OP_PING = 10,
+};
+
+bool read_all(int fd, void* buf, size_t n) {
+  auto* p = (char*)buf;
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r; n -= r;
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  auto* p = (const char*)buf;
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r; n -= r;
+  }
+  return true;
+}
+
+bool send_resp(int fd, int32_t rc, const void* payload, uint32_t plen) {
+  uint32_t blen = 4 + plen;
+  if (!write_all(fd, &blen, 4)) return false;
+  if (!write_all(fd, &rc, 4)) return false;
+  return plen == 0 || write_all(fd, payload, plen);
+}
+
+template <typename T>
+T rd(const char*& p) {
+  T v;
+  std::memcpy(&v, p, sizeof(T));
+  p += sizeof(T);
+  return v;
+}
+
+void handle_conn(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<char> body;
+  std::vector<float> fbuf;
+  std::vector<uint64_t> vbuf;
+  while (true) {
+    uint32_t blen;
+    if (!read_all(fd, &blen, 4)) break;
+    if (blen < 1 || blen > (1u << 30)) break;
+    body.resize(blen);
+    if (!read_all(fd, body.data(), blen)) break;
+    const char* p = body.data();
+    uint8_t op = rd<uint8_t>(p);
+    switch (op) {
+      case OP_PING: {
+        send_resp(fd, 0, nullptr, 0);
+        break;
+      }
+      case OP_CREATE: {
+        int id = rd<int32_t>(p);
+        int64_t rows = rd<int64_t>(p), dim = rd<int64_t>(p);
+        int init_kind = rd<int32_t>(p);
+        double a = rd<double>(p), b = rd<double>(p);
+        uint64_t seed = rd<uint64_t>(p);
+        send_resp(fd, ps_table_create(id, rows, dim, init_kind, a, b, seed),
+                  nullptr, 0);
+        break;
+      }
+      case OP_SET_OPT: {
+        int id = rd<int32_t>(p);
+        int kind = rd<int32_t>(p);
+        float lr = rd<float>(p), mom = rd<float>(p), eps = rd<float>(p);
+        float b1 = rd<float>(p), b2 = rd<float>(p);
+        send_resp(fd, ps_table_set_optimizer(id, kind, lr, mom, eps, b1, b2),
+                  nullptr, 0);
+        break;
+      }
+      case OP_DENSE_PULL: {
+        int id = rd<int32_t>(p);
+        int64_t n = ps_table_rows(id) * ps_table_dim(id);
+        if (n <= 0) { send_resp(fd, -1, nullptr, 0); break; }
+        fbuf.resize(n);
+        int rc = ps_dense_pull(id, fbuf.data());
+        send_resp(fd, rc, fbuf.data(),
+                  rc == 0 ? (uint32_t)(n * sizeof(float)) : 0);
+        break;
+      }
+      case OP_DENSE_PUSH: {
+        int id = rd<int32_t>(p);
+        send_resp(fd, ps_dense_push(id, (const float*)p), nullptr, 0);
+        break;
+      }
+      case OP_SPARSE_PULL: {
+        int id = rd<int32_t>(p);
+        int64_t n = rd<int64_t>(p);
+        uint8_t with_ver = rd<uint8_t>(p);
+        const auto* idx = (const int64_t*)p;
+        int64_t dim = ps_table_dim(id);
+        if (dim <= 0) { send_resp(fd, -1, nullptr, 0); break; }
+        fbuf.resize(n * dim);
+        vbuf.resize(with_ver ? n : 0);
+        int rc = ps_sparse_pull(id, idx, n, fbuf.data(),
+                                with_ver ? vbuf.data() : nullptr);
+        if (rc != 0) { send_resp(fd, rc, nullptr, 0); break; }
+        uint32_t plen = (uint32_t)(fbuf.size() * sizeof(float)
+                                   + vbuf.size() * sizeof(uint64_t));
+        uint32_t blen2 = 4 + plen;
+        int32_t rc32 = rc;
+        if (!write_all(fd, &blen2, 4) || !write_all(fd, &rc32, 4) ||
+            !write_all(fd, fbuf.data(), fbuf.size() * sizeof(float)))
+          return;
+        if (with_ver &&
+            !write_all(fd, vbuf.data(), vbuf.size() * sizeof(uint64_t)))
+          return;
+        break;
+      }
+      case OP_SPARSE_PUSH: {
+        int id = rd<int32_t>(p);
+        int64_t n = rd<int64_t>(p);
+        const auto* idx = (const int64_t*)p;
+        const auto* grads = (const float*)(p + n * sizeof(int64_t));
+        send_resp(fd, ps_sparse_push(id, idx, grads, n), nullptr, 0);
+        break;
+      }
+      case OP_SPARSE_SET: {
+        int id = rd<int32_t>(p);
+        int64_t n = rd<int64_t>(p);
+        const auto* idx = (const int64_t*)p;
+        const auto* vals = (const float*)(p + n * sizeof(int64_t));
+        send_resp(fd, ps_sparse_set(id, idx, vals, n), nullptr, 0);
+        break;
+      }
+      case OP_SAVE: case OP_LOAD: {
+        int id = rd<int32_t>(p);
+        uint32_t plen = rd<uint32_t>(p);
+        std::string path(p, p + plen);
+        int rc = op == OP_SAVE ? ps_table_save(id, path.c_str())
+                               : ps_table_load(id, path.c_str());
+        send_resp(fd, rc, nullptr, 0);
+        break;
+      }
+      default:
+        send_resp(fd, -100, nullptr, 0);
+    }
+  }
+  ::close(fd);
+}
+
+std::atomic<bool> g_van_running{false};
+std::atomic<int> g_van_fd{-1};
+std::thread g_van_thread;
+
+}  // namespace
+
+extern "C" {
+
+// Start the server van on `port`; returns the bound port (0 on error).
+int ps_van_start(int port) {
+  if (g_van_running.exchange(true)) return 0;
+  int sfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (sfd < 0) { g_van_running = false; return 0; }
+  int one = 1;
+  setsockopt(sfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (::bind(sfd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      ::listen(sfd, 64) != 0) {
+    ::close(sfd);
+    g_van_running = false;
+    return 0;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(sfd, (sockaddr*)&addr, &alen);
+  int bound = ntohs(addr.sin_port);
+  g_van_fd = sfd;
+  g_van_thread = std::thread([sfd]() {
+    while (g_van_running) {
+      int cfd = ::accept(sfd, nullptr, nullptr);
+      if (cfd < 0) break;
+      std::thread(handle_conn, cfd).detach();
+    }
+  });
+  g_van_thread.detach();
+  return bound;
+}
+
+void ps_van_stop() {
+  if (!g_van_running.exchange(false)) return;
+  int fd = g_van_fd.exchange(-1);
+  if (fd >= 0) { ::shutdown(fd, SHUT_RDWR); ::close(fd); }
+}
+
+// ---- client side ----
+
+int ps_van_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void ps_van_close(int fd) { if (fd >= 0) ::close(fd); }
+
+}  // extern "C" (reopened below — templates need C++ linkage)
+
+namespace {
+std::mutex g_req_mu;  // one request in flight per client handle is enough
+                      // for the worker pattern; callers may also shard
+                      // across connections
+
+bool request(int fd, const std::vector<char>& body, int32_t* rc,
+             std::vector<char>* payload) {
+  std::lock_guard<std::mutex> lk(g_req_mu);
+  uint32_t blen = (uint32_t)body.size();
+  if (!write_all(fd, &blen, 4) || !write_all(fd, body.data(), body.size()))
+    return false;
+  uint32_t rlen;
+  if (!read_all(fd, &rlen, 4) || rlen < 4) return false;
+  if (!read_all(fd, rc, 4)) return false;
+  payload->resize(rlen - 4);
+  return rlen == 4 || read_all(fd, payload->data(), rlen - 4);
+}
+
+template <typename T>
+void put(std::vector<char>& b, T v) {
+  size_t o = b.size();
+  b.resize(o + sizeof(T));
+  std::memcpy(b.data() + o, &v, sizeof(T));
+}
+}  // namespace
+
+extern "C" {
+
+int ps_van_ping(int fd) {
+  std::vector<char> b{(char)OP_PING}, pay;
+  int32_t rc = -1;
+  return request(fd, b, &rc, &pay) ? rc : -1;
+}
+
+int ps_van_table_create(int fd, int id, int64_t rows, int64_t dim,
+                        int init_kind, double a, double bb, uint64_t seed) {
+  std::vector<char> b{(char)OP_CREATE}, pay;
+  put<int32_t>(b, id); put<int64_t>(b, rows); put<int64_t>(b, dim);
+  put<int32_t>(b, init_kind); put<double>(b, a); put<double>(b, bb);
+  put<uint64_t>(b, seed);
+  int32_t rc = -1;
+  return request(fd, b, &rc, &pay) ? rc : -1;
+}
+
+int ps_van_set_optimizer(int fd, int id, int kind, float lr, float mom,
+                         float eps, float b1, float b2) {
+  std::vector<char> b{(char)OP_SET_OPT}, pay;
+  put<int32_t>(b, id); put<int32_t>(b, kind); put<float>(b, lr);
+  put<float>(b, mom); put<float>(b, eps); put<float>(b, b1);
+  put<float>(b, b2);
+  int32_t rc = -1;
+  return request(fd, b, &rc, &pay) ? rc : -1;
+}
+
+int ps_van_sparse_pull(int fd, int id, const int64_t* idx, int64_t n,
+                       float* out, int64_t dim) {
+  std::vector<char> b{(char)OP_SPARSE_PULL}, pay;
+  put<int32_t>(b, id); put<int64_t>(b, n); put<uint8_t>(b, 0);
+  size_t o = b.size();
+  b.resize(o + n * sizeof(int64_t));
+  std::memcpy(b.data() + o, idx, n * sizeof(int64_t));
+  int32_t rc = -1;
+  if (!request(fd, b, &rc, &pay) || rc != 0) return rc ? rc : -1;
+  if ((int64_t)pay.size() != n * dim * (int64_t)sizeof(float)) return -5;
+  std::memcpy(out, pay.data(), pay.size());
+  return 0;
+}
+
+int ps_van_sparse_push(int fd, int id, const int64_t* idx,
+                       const float* grads, int64_t n, int64_t dim) {
+  std::vector<char> b{(char)OP_SPARSE_PUSH}, pay;
+  put<int32_t>(b, id); put<int64_t>(b, n);
+  size_t o = b.size();
+  b.resize(o + n * sizeof(int64_t) + n * dim * sizeof(float));
+  std::memcpy(b.data() + o, idx, n * sizeof(int64_t));
+  std::memcpy(b.data() + o + n * sizeof(int64_t), grads,
+              n * dim * sizeof(float));
+  int32_t rc = -1;
+  return request(fd, b, &rc, &pay) ? rc : -1;
+}
+
+int ps_van_dense_pull(int fd, int id, float* out, int64_t count) {
+  std::vector<char> b{(char)OP_DENSE_PULL}, pay;
+  put<int32_t>(b, id);
+  int32_t rc = -1;
+  if (!request(fd, b, &rc, &pay) || rc != 0) return rc ? rc : -1;
+  if ((int64_t)pay.size() != count * (int64_t)sizeof(float)) return -5;
+  std::memcpy(out, pay.data(), pay.size());
+  return 0;
+}
+
+int ps_van_dense_push(int fd, int id, const float* grad, int64_t count) {
+  std::vector<char> b{(char)OP_DENSE_PUSH}, pay;
+  put<int32_t>(b, id);
+  size_t o = b.size();
+  b.resize(o + count * sizeof(float));
+  std::memcpy(b.data() + o, grad, count * sizeof(float));
+  int32_t rc = -1;
+  return request(fd, b, &rc, &pay) ? rc : -1;
+}
+
+}  // extern "C"

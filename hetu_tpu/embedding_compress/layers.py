@@ -625,16 +625,20 @@ def autosrh_to_retrain(module: "AutoSRHEmbedding", variables,
     a = jnp.abs(p["alpha"])
     thresh = jnp.quantile(a, 1.0 - keep_fraction)
     mask = (a >= thresh).astype(p["w"].dtype)
-    return {"params": {"w": p["w"] * mask}, "state": {"mask": mask}}
+    # bake the learned gates in: the trained forward is w*alpha, so the
+    # retrain weights must start from w*alpha (masked), not raw w
+    return {"params": {"w": p["w"] * p["alpha"] * mask},
+            "state": {"mask": mask}}
 
 
 def autodim_to_retrain(module: "AutoDimEmbedding", variables):
-    """AutoDimRetrainEmbedding analog: keep only the argmax candidate dim's
-    table + projection."""
-    best = int(jnp.argmax(variables["params"]["arch"]))
+    """AutoDimRetrainEmbedding analog: keep only the winning candidate dim's
+    table + projection (winner chosen by the module's own selected_dim)."""
+    dim = module.selected_dim(variables)
+    best = module.cands.index(dim)
     p = variables["params"]
     return {"params": {"t": p[f"t{best}"], "p": p[f"p{best}"]},
-            "state": {"dim": module.cands[best]}}
+            "state": {"dim": dim}}
 
 
 def optembed_row_pruned(module: "OptEmbedEmbedding", variables):

@@ -45,8 +45,11 @@ class Strategy:
         def fit(spec: P, leaf) -> NamedSharding:
             dims = []
             for i, entry in enumerate(spec):
-                if entry is None or i >= leaf.ndim:
-                    dims.append(entry)
+                if i >= leaf.ndim:
+                    dims.append(None)  # over-long spec degrades, not errors
+                    continue
+                if entry is None:
+                    dims.append(None)
                     continue
                 axes = entry if isinstance(entry, tuple) else (entry,)
                 k = 1

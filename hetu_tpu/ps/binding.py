@@ -14,7 +14,8 @@ import threading
 from pathlib import Path
 
 _HERE = Path(__file__).resolve().parent
-_SRC = _HERE.parent.parent / "csrc" / "hetu_ps.cpp"
+_CSRC = _HERE.parent.parent / "csrc"
+_SRCS = [_CSRC / "hetu_ps.cpp", _CSRC / "hetu_ps_van.cpp"]
 _BUILD = _HERE / "_build"
 _SO = _BUILD / "libhetu_ps.so"
 
@@ -25,10 +26,11 @@ _err = None
 
 def _build() -> None:
     _BUILD.mkdir(parents=True, exist_ok=True)
-    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+    newest = max(src.stat().st_mtime for src in _SRCS)
+    if _SO.exists() and _SO.stat().st_mtime >= newest:
         return
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           str(_SRC), "-o", str(_SO)]
+           *[str(s) for s in _SRCS], "-o", str(_SO)]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
 
 
@@ -79,6 +81,26 @@ def _load():
             "ps_cache_update": ([c.c_int, i64p, f32p, c.c_int64], c.c_int),
             "ps_cache_flush": ([c.c_int], c.c_int),
             "ps_cache_size": ([c.c_int], c.c_int64),
+            # TCP van (multi-host transport, csrc/hetu_ps_van.cpp)
+            "ps_van_start": ([c.c_int], c.c_int),
+            "ps_van_stop": ([], None),
+            "ps_van_connect": ([c.c_char_p, c.c_int], c.c_int),
+            "ps_van_close": ([c.c_int], None),
+            "ps_van_ping": ([c.c_int], c.c_int),
+            "ps_van_table_create": ([c.c_int, c.c_int, c.c_int64, c.c_int64,
+                                     c.c_int, c.c_double, c.c_double,
+                                     c.c_uint64], c.c_int),
+            "ps_van_set_optimizer": ([c.c_int, c.c_int, c.c_int, c.c_float,
+                                      c.c_float, c.c_float, c.c_float,
+                                      c.c_float], c.c_int),
+            "ps_van_sparse_pull": ([c.c_int, c.c_int, i64p, c.c_int64, f32p,
+                                    c.c_int64], c.c_int),
+            "ps_van_sparse_push": ([c.c_int, c.c_int, i64p, f32p, c.c_int64,
+                                    c.c_int64], c.c_int),
+            "ps_van_dense_pull": ([c.c_int, c.c_int, f32p, c.c_int64],
+                                  c.c_int),
+            "ps_van_dense_push": ([c.c_int, c.c_int, f32p, c.c_int64],
+                                  c.c_int),
         }
         for name, (argtypes, restype) in sigs.items():
             fn = getattr(lib, name)

@@ -1,0 +1,104 @@
+"""Multi-host PS transport: server + remote table client.
+
+Reference: ps-lite van/postoffice — the message plane between workers and
+servers.  hetu_tpu's van is a C++ TCP server embedded in the native lib
+(csrc/hetu_ps_van.cpp); a server process calls `serve()`, workers construct
+`RemotePSTable`s addressing it.  The launcher (`heturun`) starts server
+processes from the cluster yaml exactly like the reference's
+scheduler/server roles.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from hetu_tpu.ps.binding import lib
+from hetu_tpu.ps.client import _check, _f32p, _i64p
+
+
+def _fresh_remote_id() -> int:
+    # ids must be unique ACROSS worker processes sharing one server; random
+    # 30-bit ids above the local range make cross-process clashes negligible
+    return (1 << 24) + int.from_bytes(os.urandom(3), "little")
+
+
+def serve(port: int = 0) -> int:
+    """Start the in-process van server; returns the bound port."""
+    bound = lib.ps_van_start(port)
+    if bound == 0:
+        raise RuntimeError("ps van failed to start (already running?)")
+    return bound
+
+
+def stop() -> None:
+    lib.ps_van_stop()
+
+
+class RemotePSTable:
+    """PSTable API over the van (reference worker-side kvworker)."""
+
+    def __init__(self, host: str, port: int, rows: int, dim: int, *,
+                 table_id: Optional[int] = None, create: bool = True,
+                 init: str = "normal", init_a: float = 0.0,
+                 init_b: float = 0.01, seed: int = 0,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 momentum: float = 0.9, eps: float = 1e-7,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 connect_timeout_s: float = 10.0):
+        from hetu_tpu.ps.client import _INIT_KINDS, _OPT_KINDS
+        self.rows, self.dim = rows, dim
+        deadline = time.time() + connect_timeout_s
+        self.fd = -1
+        while self.fd < 0:
+            self.fd = lib.ps_van_connect(host.encode(), port)
+            if self.fd < 0 and time.time() > deadline:
+                raise ConnectionError(f"cannot reach PS van {host}:{port}")
+            if self.fd < 0:
+                time.sleep(0.05)
+        self.id = table_id if table_id is not None else _fresh_remote_id()
+        if create:
+            _check(lib.ps_van_table_create(
+                self.fd, self.id, rows, dim, _INIT_KINDS[init], init_a,
+                init_b, seed), "van_table_create")
+            _check(lib.ps_van_set_optimizer(
+                self.fd, self.id, _OPT_KINDS[optimizer], lr, momentum, eps,
+                beta1, beta2), "van_set_optimizer")
+
+    def ping(self) -> bool:
+        return lib.ps_van_ping(self.fd) == 0
+
+    def sparse_pull(self, indices) -> np.ndarray:
+        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
+        out = np.empty((idx.shape[0], self.dim), np.float32)
+        _check(lib.ps_van_sparse_pull(self.fd, self.id, _i64p(idx),
+                                      idx.shape[0], _f32p(out), self.dim),
+               "van_sparse_pull")
+        return out
+
+    def sparse_push(self, indices, grads) -> None:
+        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
+        g = np.ascontiguousarray(grads, np.float32).reshape(idx.shape[0],
+                                                            self.dim)
+        _check(lib.ps_van_sparse_push(self.fd, self.id, _i64p(idx), _f32p(g),
+                                      idx.shape[0], self.dim),
+               "van_sparse_push")
+
+    def dense_pull(self) -> np.ndarray:
+        out = np.empty((self.rows, self.dim), np.float32)
+        _check(lib.ps_van_dense_pull(self.fd, self.id, _f32p(out),
+                                     self.rows * self.dim), "van_dense_pull")
+        return out
+
+    def dense_push(self, grad) -> None:
+        g = np.ascontiguousarray(grad, np.float32)
+        _check(lib.ps_van_dense_push(self.fd, self.id, _f32p(g),
+                                     self.rows * self.dim), "van_dense_push")
+
+    def close(self) -> None:
+        if self.fd >= 0:
+            lib.ps_van_close(self.fd)
+            self.fd = -1
