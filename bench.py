@@ -51,14 +51,19 @@ def main():
         float(m["loss"])  # true sync: value fetch
         return state
 
-    state = run(state0, 5)  # warmup/compile
-    t0 = time.perf_counter()
-    state = run(state, K1)
-    t1 = time.perf_counter()
-    state = run(state, K2)
-    t2 = time.perf_counter()
+    def timed(state, k):
+        t0 = time.perf_counter()
+        state = run(state, k)
+        return state, time.perf_counter() - t0
 
-    per_step = ((t2 - t1) - (t1 - t0)) / (K2 - K1)
+    state = run(state0, 5)  # warmup/compile
+    # median of 3 slope measurements: tunnel jitter makes single pairs noisy
+    slopes = []
+    for _ in range(3):
+        state, t_small = timed(state, K1)
+        state, t_big = timed(state, K2)
+        slopes.append((t_big - t_small) / (K2 - K1))
+    per_step = float(np.median(slopes))
     sps = BATCH / per_step
     print(json.dumps({
         "metric": "resnet18_cifar10_train_samples_per_sec_per_chip",

@@ -24,6 +24,8 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <map>
+#include <memory>
 #include <mutex>
 #include <thread>
 #include <vector>
@@ -139,6 +141,9 @@ void handle_conn(int fd) {
       }
       case OP_DENSE_PUSH: {
         int id = rd<int32_t>(p);
+        int64_t want = ps_table_rows(id) * ps_table_dim(id);
+        int64_t have = (body.data() + blen - p) / (int64_t)sizeof(float);
+        if (want <= 0 || have < want) { send_resp(fd, -3, nullptr, 0); break; }
         send_resp(fd, ps_dense_push(id, (const float*)p), nullptr, 0);
         break;
       }
@@ -149,6 +154,10 @@ void handle_conn(int fd) {
         const auto* idx = (const int64_t*)p;
         int64_t dim = ps_table_dim(id);
         if (dim <= 0) { send_resp(fd, -1, nullptr, 0); break; }
+        int64_t have = body.data() + blen - p;
+        if (n < 0 || n > (1 << 24) || have < n * (int64_t)sizeof(int64_t)) {
+          send_resp(fd, -3, nullptr, 0); break;
+        }
         fbuf.resize(n * dim);
         vbuf.resize(with_ver ? n : 0);
         int rc = ps_sparse_pull(id, idx, n, fbuf.data(),
@@ -159,32 +168,37 @@ void handle_conn(int fd) {
         uint32_t blen2 = 4 + plen;
         int32_t rc32 = rc;
         if (!write_all(fd, &blen2, 4) || !write_all(fd, &rc32, 4) ||
-            !write_all(fd, fbuf.data(), fbuf.size() * sizeof(float)))
-          return;
+            !write_all(fd, fbuf.data(), fbuf.size() * sizeof(float))) {
+          ::close(fd); return;
+        }
         if (with_ver &&
-            !write_all(fd, vbuf.data(), vbuf.size() * sizeof(uint64_t)))
-          return;
+            !write_all(fd, vbuf.data(), vbuf.size() * sizeof(uint64_t))) {
+          ::close(fd); return;
+        }
         break;
       }
-      case OP_SPARSE_PUSH: {
+      case OP_SPARSE_PUSH: case OP_SPARSE_SET: {
         int id = rd<int32_t>(p);
         int64_t n = rd<int64_t>(p);
+        int64_t dim = ps_table_dim(id);
+        int64_t have = body.data() + blen - p;
+        if (dim <= 0 || n < 0 || n > (1 << 24) ||
+            have < n * (int64_t)(sizeof(int64_t) + dim * sizeof(float))) {
+          send_resp(fd, -3, nullptr, 0); break;
+        }
         const auto* idx = (const int64_t*)p;
-        const auto* grads = (const float*)(p + n * sizeof(int64_t));
-        send_resp(fd, ps_sparse_push(id, idx, grads, n), nullptr, 0);
-        break;
-      }
-      case OP_SPARSE_SET: {
-        int id = rd<int32_t>(p);
-        int64_t n = rd<int64_t>(p);
-        const auto* idx = (const int64_t*)p;
-        const auto* vals = (const float*)(p + n * sizeof(int64_t));
-        send_resp(fd, ps_sparse_set(id, idx, vals, n), nullptr, 0);
+        const auto* dat = (const float*)(p + n * sizeof(int64_t));
+        int rc = op == OP_SPARSE_PUSH ? ps_sparse_push(id, idx, dat, n)
+                                      : ps_sparse_set(id, idx, dat, n);
+        send_resp(fd, rc, nullptr, 0);
         break;
       }
       case OP_SAVE: case OP_LOAD: {
         int id = rd<int32_t>(p);
         uint32_t plen = rd<uint32_t>(p);
+        if (plen > (uint32_t)(body.data() + blen - p)) {
+          send_resp(fd, -3, nullptr, 0); break;
+        }
         std::string path(p, p + plen);
         int rc = op == OP_SAVE ? ps_table_save(id, path.c_str())
                                : ps_table_load(id, path.c_str());
@@ -270,13 +284,21 @@ void ps_van_close(int fd) { if (fd >= 0) ::close(fd); }
 }  // extern "C" (reopened below — templates need C++ linkage)
 
 namespace {
-std::mutex g_req_mu;  // one request in flight per client handle is enough
-                      // for the worker pattern; callers may also shard
-                      // across connections
+// one request in flight per CONNECTION: sharding across connections
+// genuinely parallelizes (each fd gets its own mutex)
+std::mutex g_handles_mu;
+std::map<int, std::unique_ptr<std::mutex>> g_handle_mu;
+
+std::mutex& handle_mutex(int fd) {
+  std::lock_guard<std::mutex> lk(g_handles_mu);
+  auto& slot = g_handle_mu[fd];
+  if (!slot) slot.reset(new std::mutex());
+  return *slot;
+}
 
 bool request(int fd, const std::vector<char>& body, int32_t* rc,
              std::vector<char>* payload) {
-  std::lock_guard<std::mutex> lk(g_req_mu);
+  std::lock_guard<std::mutex> lk(handle_mutex(fd));
   uint32_t blen = (uint32_t)body.size();
   if (!write_all(fd, &blen, 4) || !write_all(fd, body.data(), body.size()))
     return false;

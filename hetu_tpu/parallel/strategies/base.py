@@ -46,8 +46,8 @@ class Strategy:
             dims = []
             for i, entry in enumerate(spec):
                 if i >= leaf.ndim:
-                    dims.append(None)  # over-long spec degrades, not errors
-                    continue
+                    break  # truncate over-long specs (NamedSharding rejects
+                           # len(spec) > rank even with trailing Nones)
                 if entry is None:
                     dims.append(None)
                     continue

@@ -94,7 +94,8 @@ class RemotePSTable:
         return out
 
     def dense_push(self, grad) -> None:
-        g = np.ascontiguousarray(grad, np.float32)
+        g = np.ascontiguousarray(grad, np.float32).reshape(self.rows,
+                                                           self.dim)
         _check(lib.ps_van_dense_push(self.fd, self.id, _f32p(g),
                                      self.rows * self.dim), "van_dense_push")
 
