@@ -105,6 +105,15 @@ void handle_conn(int fd) {
     if (!read_all(fd, body.data(), blen)) break;
     const char* p = body.data();
     uint8_t op = rd<uint8_t>(p);
+    // minimum fixed-header bytes per op AFTER the op byte: reject short
+    // frames BEFORE any rd<> touches the body (overread-proof)
+    static const uint32_t kMinBody[] = {
+        0, 48, 28, 4, 4, 21, 12, 12, 8, 8, 0};
+    if (op < sizeof(kMinBody) / sizeof(uint32_t) &&
+        blen < 1 + kMinBody[op]) {
+      send_resp(fd, -3, nullptr, 0);
+      continue;
+    }
     switch (op) {
       case OP_PING: {
         send_resp(fd, 0, nullptr, 0);
@@ -155,7 +164,12 @@ void handle_conn(int fd) {
         int64_t dim = ps_table_dim(id);
         if (dim <= 0) { send_resp(fd, -1, nullptr, 0); break; }
         int64_t have = body.data() + blen - p;
-        if (n < 0 || n > (1 << 24) || have < n * (int64_t)sizeof(int64_t)) {
+        // bound the RESPONSE size too: n*dim floats (+versions) must fit a
+        // u32 frame with headroom, else plen overflows and desyncs the wire
+        int64_t resp_bytes = n * dim * (int64_t)sizeof(float)
+                             + (with_ver ? n * (int64_t)sizeof(uint64_t) : 0);
+        if (n < 0 || n > (1 << 24) || have < n * (int64_t)sizeof(int64_t) ||
+            resp_bytes > (int64_t)(1u << 30)) {
           send_resp(fd, -3, nullptr, 0); break;
         }
         fbuf.resize(n * dim);
@@ -279,8 +293,6 @@ int ps_van_connect(const char* host, int port) {
   return fd;
 }
 
-void ps_van_close(int fd) { if (fd >= 0) ::close(fd); }
-
 }  // extern "C" (reopened below — templates need C++ linkage)
 
 namespace {
@@ -318,6 +330,13 @@ void put(std::vector<char>& b, T v) {
 }  // namespace
 
 extern "C" {
+
+void ps_van_close(int fd) {
+  if (fd < 0) return;
+  ::close(fd);
+  std::lock_guard<std::mutex> lk(g_handles_mu);
+  g_handle_mu.erase(fd);  // fd numbers are reused; stale entries leak
+}
 
 int ps_van_ping(int fd) {
   std::vector<char> b{(char)OP_PING}, pay;

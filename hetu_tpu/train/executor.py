@@ -84,12 +84,19 @@ class Executor:
 
     def __init__(self, loss_fn: Callable, optimizer: Optional[Optimizer] = None,
                  *, mesh: Optional[Mesh] = None, dp_axis: str = AXIS_DP,
-                 param_sharding=None, seed: Optional[int] = None):
+                 param_sharding=None, dist_strategy=None,
+                 seed: Optional[int] = None):
+        """dist_strategy: a parallel.strategies.Strategy — init_state places
+        params (and mirrored optimizer slots) per its specs, the reference's
+        `Executor(..., dist_strategy=...)` ergonomics."""
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh
         self.dp_axis = dp_axis
         self.param_sharding = param_sharding  # pytree of NamedSharding, optional
+        self.dist_strategy = dist_strategy
+        if dist_strategy is not None and mesh is None:
+            raise ValueError("dist_strategy requires a mesh")
         if seed is not None:
             hrng.set_random_seed(seed)
         self._compiled: Dict[str, Callable] = {}
@@ -108,7 +115,19 @@ class Executor:
         state = TrainState(params=params, opt_state=opt_state,
                            model_state=model_state, rng=rng_key,
                            step=jnp.zeros((), jnp.int32))
-        if self.mesh is not None:
+        if self.dist_strategy is not None:
+            sh = self.dist_strategy.shardings(state.params, self.mesh)
+            placed = jax.tree_util.tree_map(jax.device_put, state.params, sh)
+            slots = {k: jax.tree_util.tree_map(jax.device_put, v, sh)
+                     for k, v in state.opt_state.get("slots", {}).items()} \
+                if isinstance(state.opt_state, dict) else {}
+            opt_state2 = (dict(state.opt_state, slots=slots)
+                          if isinstance(state.opt_state, dict)
+                          else state.opt_state)
+            state = TrainState(params=placed, opt_state=opt_state2,
+                               model_state=state.model_state,
+                               rng=state.rng, step=state.step)
+        elif self.mesh is not None:
             shard = (self.param_sharding if self.param_sharding is not None
                      else NamedSharding(self.mesh, P()))
             state = jax.device_put(state, shard) if not isinstance(

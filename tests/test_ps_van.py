@@ -57,6 +57,32 @@ def test_remote_matches_local_semantics(server_port):
     remote.close()
 
 
+def test_malformed_frames_rejected(server_port):
+    """Short/garbage frames get rc=-3 and the server survives
+    (regression: header fields were read past short bodies)."""
+    import socket
+    import struct
+
+    s = socket.create_connection(("127.0.0.1", server_port), timeout=5)
+    try:
+        # OP_CREATE (1) with a 1-byte body — far short of its 48-byte header
+        s.sendall(struct.pack("<IB", 2, 1) + b"x")
+        blen, = struct.unpack("<I", s.recv(4))
+        rc, = struct.unpack("<i", s.recv(4))
+        assert rc == -3, rc
+        # unknown op
+        s.sendall(struct.pack("<IB", 1, 200))
+        s.recv(4)
+        rc, = struct.unpack("<i", s.recv(4))
+        assert rc == -100, rc
+    finally:
+        s.close()
+    # server still healthy for real clients
+    t = van.RemotePSTable("127.0.0.1", server_port, 4, 2, init="zeros")
+    assert t.ping()
+    t.close()
+
+
 def test_connection_refused_raises():
     with pytest.raises(ConnectionError):
         van.RemotePSTable("127.0.0.1", 1, 4, 4, connect_timeout_s=0.2)
