@@ -108,7 +108,7 @@ void handle_conn(int fd) {
     // minimum fixed-header bytes per op AFTER the op byte: reject short
     // frames BEFORE any rd<> touches the body (overread-proof)
     static const uint32_t kMinBody[] = {
-        0, 48, 28, 4, 4, 21, 12, 12, 8, 8, 0};
+        0, 48, 28, 4, 4, 13, 12, 12, 8, 8, 0};
     if (op < sizeof(kMinBody) / sizeof(uint32_t) &&
         blen < 1 + kMinBody[op]) {
       send_resp(fd, -3, nullptr, 0);
@@ -333,9 +333,19 @@ extern "C" {
 
 void ps_van_close(int fd) {
   if (fd < 0) return;
+  // detach the per-fd mutex BEFORE closing: erase-while-locked is UB and
+  // closing first lets the fd number be reused and re-registered
+  std::unique_ptr<std::mutex> mu;
+  {
+    std::lock_guard<std::mutex> lk(g_handles_mu);
+    auto it = g_handle_mu.find(fd);
+    if (it != g_handle_mu.end()) {
+      mu = std::move(it->second);
+      g_handle_mu.erase(it);
+    }
+  }
+  if (mu) { mu->lock(); mu->unlock(); }  // drain any in-flight request
   ::close(fd);
-  std::lock_guard<std::mutex> lk(g_handles_mu);
-  g_handle_mu.erase(fd);  // fd numbers are reused; stale entries leak
 }
 
 int ps_van_ping(int fd) {

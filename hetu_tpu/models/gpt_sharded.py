@@ -62,6 +62,8 @@ class ShardedGPTConfig:
     n_microbatches: int = 2
     aux_weight: float = 1e-2
     dtype: object = jnp.float32
+    vocab_parallel: bool = True   # Megatron vocab-split embedding + CE
+    remat: bool = False           # rematerialize blocks (activation memory)
 
 
 class ShardedGPT:
@@ -77,6 +79,8 @@ class ShardedGPT:
         assert c.num_heads % self.tp == 0
         assert c.ffn_size % self.tp == 0
         assert c.num_experts % self.ep == 0
+        self.vocab_parallel = c.vocab_parallel and \
+            c.vocab_size % self.tp == 0
 
     # ---- parameters ----
     def init(self, key):
@@ -111,7 +115,11 @@ class ShardedGPT:
     def param_specs(self):
         pp, tp, ep = "pp", "tp", "ep"
         return {
-            "tok_emb": P(), "pos_emb": P(),
+            # vocab-parallel: embedding rows split over tp (reference
+            # MegatronLM vocab-parallel embedding + softmax-CE with partial,
+            # distributed_strategies/simple.py:174-283)
+            "tok_emb": P("tp") if self.vocab_parallel else P(),
+            "pos_emb": P(),
             "blocks": {
                 "ln1_scale": P(pp), "ln1_bias": P(pp),
                 "qkv_w": P(pp, None, tp), "qkv_b": P(pp, tp),
@@ -219,15 +227,29 @@ class ShardedGPT:
 
         # embeddings (replicated over pp; each (dp,sp) shard embeds its slice)
         pos = sp_idx * s_loc + jnp.arange(s_loc)
-        h = ops.embedding_lookup(params["tok_emb"], ids)
+        emb = params["tok_emb"]           # [V/tp, D] when vocab-parallel
+        if self.vocab_parallel:
+            tp_idx = lax.axis_index("tp")
+            v_loc = emb.shape[0]
+            rel = ids.astype(jnp.int32) - tp_idx * v_loc
+            in_rng = (rel >= 0) & (rel < v_loc)
+            h = jnp.take(emb, jnp.clip(rel, 0, v_loc - 1), axis=0)
+            h = jnp.where(in_rng[..., None], h, 0.0)
+            h = lax.psum(h, "tp")         # assemble full embedding
+        else:
+            h = ops.embedding_lookup(emb, ids)
         h = h + jnp.take(params["pos_emb"], pos, axis=0)[None]
         xs = h.reshape(M, mb, s_loc, c.hidden_size)
 
         blocks = params["blocks"]  # leaves [L/pp, ...]
 
+        block = self._block
+        if c.remat:
+            block = jax.checkpoint(block)
+
         def stage_apply(h_mb):
             def body(carry, p_l):
-                h, aux = self._block(p_l, carry)
+                h, aux = block(p_l, carry)
                 return (h, aux), None
             (h_out, aux), _ = lax.scan(body, (h_mb, jnp.asarray(0.0)), blocks)
             return h_out, aux
@@ -254,12 +276,33 @@ class ShardedGPT:
         (buf, outs, aux_total), _ = lax.scan(
             tick, (buf, outs, aux_total), jnp.arange(T))
 
-        # head + loss on the last stage
+        # head + loss on the last stage (tied weights)
         hs = outs.reshape(b_loc, s_loc, c.hidden_size).astype(jnp.float32)
         hs = ops.layer_norm(hs, params["ln_f_scale"], params["ln_f_bias"])
-        logits = hs @ params["tok_emb"].T
-        per_tok = ops.softmax_cross_entropy_sparse(logits, labels,
-                                                   ignored_index=-1)
+        if self.vocab_parallel:
+            # vocab-parallel CE: each tp rank scores its vocab slice; the
+            # softmax normalizer and target logit assemble via pmax/psum —
+            # the [b, s, V] logits never materialize on one chip
+            tp_idx = lax.axis_index("tp")
+            v_loc = emb.shape[0]
+            logits_loc = hs @ emb.T                      # [b, s, V/tp]
+            # global max for stability via all_gather (pmax lacks an AD
+            # rule); stop_gradient is exact — the max is stability-only
+            m_loc = lax.stop_gradient(jnp.max(logits_loc, axis=-1))
+            m = jnp.max(lax.all_gather(m_loc, "tp", axis=0), axis=0)
+            se = jnp.sum(jnp.exp(logits_loc - m[..., None]), axis=-1)
+            lse = jnp.log(lax.psum(se, "tp")) + m
+            rell = labels.astype(jnp.int32) - tp_idx * v_loc
+            in_rng = (rell >= 0) & (rell < v_loc)
+            tgt_loc = jnp.take_along_axis(
+                logits_loc, jnp.clip(rell, 0, v_loc - 1)[..., None],
+                axis=-1)[..., 0]
+            tgt = lax.psum(jnp.where(in_rng, tgt_loc, 0.0), "tp")
+            per_tok = jnp.where(labels == -1, 0.0, lse - tgt)
+        else:
+            logits = hs @ params["tok_emb"].T
+            per_tok = ops.softmax_cross_entropy_sparse(logits, labels,
+                                                       ignored_index=-1)
         # global sum / global count (NOT mean-of-shard-ratios): keeps the
         # sharded loss bit-comparable to single-device
         num = lax.psum(jnp.sum(per_tok), ("dp", "sp"))

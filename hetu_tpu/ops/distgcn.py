@@ -29,6 +29,8 @@ except ImportError:  # pragma: no cover
 
 from jax.sharding import Mesh, PartitionSpec as P
 
+from hetu_tpu.ops.graph_ops import coo_spmm
+
 
 def dist_gcn_aggregate(h, edge_src, edge_dst, edge_weight, mesh: Mesh, *,
                        axis: str = "dp", ring: bool = False):
@@ -47,11 +49,8 @@ def dist_gcn_aggregate(h, edge_src, edge_dst, edge_weight, mesh: Mesh, *,
     def local_gather(h_loc, src, dst, w):
         i = lax.axis_index(axis)
         h_all = lax.all_gather(h_loc, axis, axis=0, tiled=True)  # [N, F]
-        msgs = h_all[src.astype(jnp.int32)]
-        if w is not None:
-            msgs = msgs * w[:, None]
         local_dst = dst.astype(jnp.int32) - i * n_loc
-        return jax.ops.segment_sum(msgs, local_dst, num_segments=n_loc)
+        return coo_spmm(src, local_dst, w, h_all, n_loc)
 
     def local_ring(h_loc, src, dst, w):
         i = lax.axis_index(axis)
@@ -78,7 +77,6 @@ def dist_gcn_aggregate(h, edge_src, edge_dst, edge_weight, mesh: Mesh, *,
         return out
 
     fn = local_ring if ring else local_gather
-    w_spec = P(axis) if edge_weight is not None else P()
     return shard_map(
         fn, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis),
@@ -94,6 +92,9 @@ def shard_edges_by_dst(edge_src, edge_dst, edge_weight, n_nodes: int,
     arrays of shape [n_shards * max_per_shard] laid out shard-major, ready
     to device_put with P('dp') sharding."""
     import numpy as np
+    assert n_nodes % n_shards == 0, (
+        f"{n_nodes} nodes not divisible by {n_shards} shards: edges owned "
+        "by the remainder would be silently dropped")
     src = np.asarray(edge_src)
     dst = np.asarray(edge_dst)
     w = np.asarray(edge_weight) if edge_weight is not None else None

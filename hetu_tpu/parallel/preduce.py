@@ -60,7 +60,12 @@ def preduce_step_fn(loss_fn, optimizer, mesh: Mesh, *, axis: str = "dp"):
     def step(params, opt_state, batch, member_mask):
         mask = jnp.asarray(member_mask, jnp.float32)
         loss, grads = shmapped(params, batch, mask)
-        params, opt_state = optimizer.update(grads, opt_state, params)
-        return params, opt_state, loss
+        # empty group = nobody pushed = NO update: stateful optimizers
+        # (momentum decay, adam step) must not advance either
+        new_params, new_opt = optimizer.update(grads, opt_state, params)
+        has_members = jnp.sum(mask) > 0
+        pick = lambda new, old: jax.tree_util.tree_map(
+            lambda a, b: jnp.where(has_members, a, b), new, old)
+        return pick(new_params, params), pick(new_opt, opt_state), loss
 
     return jax.jit(step, donate_argnums=(0, 1)), n

@@ -182,6 +182,55 @@ class Executor:
         batch = _device_batch(batch, self.mesh, self.dp_axis)
         return self._compiled[name](state, batch)
 
+    def profile(self, state: TrainState, batch, *, name: str = "train",
+                k1: int = 3, k2: int = 9):
+        """Per-step timing + compiled cost/collective breakdown.
+
+        Reference analog: TimerSubExecutor (`Executor(timing=...)`,
+        timer_subexecutor.py) + HetuProfiler — here one call returns the
+        slope-timed step wall time (tunnel-safe: two chained runs ended by a
+        value fetch) and XLA's own cost analysis with the collectives the
+        partitioner inserted (parallel/planner.py audit).
+        Note: does NOT mutate `state` (runs on copies).
+        """
+        import time as _time
+
+        from hetu_tpu.parallel.planner import audit
+
+        if name != "train":
+            raise ValueError("profile supports the train subexecutor")
+        if name not in self._compiled:
+            self._compiled[name] = self._compile(name)
+        batch = _device_batch(batch, self.mesh, self.dp_axis)
+        # private copy: the compiled step donates its input state
+        s0 = jax.tree_util.tree_map(lambda a: jnp.asarray(a).copy(), state)
+
+        def run_k(s, k):
+            m = None
+            for _ in range(k):
+                s, m = self._compiled[name](s, batch)
+            float(m["loss"])  # value fetch = true sync
+            return s
+
+        s = run_k(s0, 2)  # warmup
+        t0 = _time.perf_counter()
+        s = run_k(s, k1)
+        t1 = _time.perf_counter()
+        s = run_k(s, k2)
+        t2 = _time.perf_counter()
+        per_step = max(((t2 - t1) - (t1 - t0)) / (k2 - k1), 1e-9)
+
+        # audit only lowers/compiles (no execution, no donation): the
+        # caller's state is safe to pass directly
+        a = audit(self._train_step, state, batch)
+        return {
+            "per_step_s": per_step,
+            "steps_per_s": 1.0 / per_step,
+            "flops": a.flops,
+            "hbm_bytes": a.bytes_accessed,
+            "comm_bytes_by_kind": a.by_kind(),
+        }
+
 
 def _device_batch(batch, mesh, dp_axis):
     if mesh is None:

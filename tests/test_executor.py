@@ -108,6 +108,23 @@ def test_dp_mesh_matches_single_device():
         s1.params, s8.params)
 
 
+def test_profile_reports_costs():
+    """Executor.profile: slope-timed step + XLA cost/collective breakdown
+    (TimerSubExecutor analog)."""
+    model = make_model()
+    mesh = ht.make_mesh(dp=8)
+    ex = Executor(make_loss_fn(model), optim.SGDOptimizer(0.1), mesh=mesh,
+                  seed=0)
+    state = ex.init_state(model.init(jax.random.PRNGKey(0)))
+    rep = ex.profile(state, toy_batch(64), k1=2, k2=4)
+    assert rep["per_step_s"] > 0 and rep["steps_per_s"] > 0
+    assert rep["flops"] > 0
+    assert "all-reduce" in rep["comm_bytes_by_kind"]  # dp grad reduction
+    # profile must not consume the caller's state
+    _, m = ex.run("train", state, toy_batch(64))
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_state_dict_paths():
     model = make_model()
     ex = Executor(make_loss_fn(model), optim.SGDOptimizer(0.1), seed=0)

@@ -62,6 +62,26 @@ def test_preduce_partial_mask_excludes_stragglers():
     np.testing.assert_allclose(float(p2["w"]), 0.0)
 
 
+def test_preduce_empty_group_freezes_stateful_optimizer():
+    """Empty round: momentum buffers must not decay params (regression)."""
+    mesh = ht.make_mesh(dp=8)
+
+    def loss_fn(params, batch):
+        return jnp.mean(params["w"] * batch)
+
+    opt = optim.MomentumOptimizer(1.0, 0.9)
+    step, _ = preduce_step_fn(loss_fn, opt, mesh)
+    batch = np.ones(8, np.float32)
+    p = {"w": jnp.zeros(())}
+    s = opt.init_state(p)
+    p, s, _ = step(p, s, batch, np.ones(8))      # real step: builds velocity
+    w_after = float(p["w"])
+    p, s, _ = step(p, s, batch, np.zeros(8))     # empty round
+    assert float(p["w"]) == w_after              # no momentum drift
+    p, s, _ = step(p, s, batch, np.ones(8))      # training resumes
+    assert float(p["w"]) != w_after
+
+
 def test_dist_gcn_matches_single_device():
     g = np.random.default_rng(0)
     N, F, E, P_ = 32, 8, 120, 8

@@ -22,8 +22,8 @@ def data(B=8, S=16, seed=0):
     return ids, labels
 
 
-def run_steps(mesh_axes, n_steps=3, B=8, S=16):
-    cfg = ShardedGPTConfig(**CFG)
+def run_steps(mesh_axes, n_steps=3, B=8, S=16, **cfg_over):
+    cfg = ShardedGPTConfig(**{**CFG, **cfg_over})
     mesh = ht.make_mesh(**mesh_axes)
     model = ShardedGPT(cfg, mesh)
     params = model.place(model.init(jax.random.PRNGKey(0)))
@@ -56,3 +56,13 @@ def test_dp_ep_tp_matches_single_device():
 def test_loss_decreases_under_full_sharding():
     losses, _ = run_steps({"pp": 2, "tp": 2, "sp": 2}, n_steps=6)
     assert losses[-1] < losses[0]
+
+
+def test_remat_and_vocab_replicated_match_default():
+    """Rematerialized blocks and non-vocab-parallel head are exact
+    reformulations: identical losses."""
+    ref, _ = run_steps({"tp": 2, "pp": 2})
+    remat, _ = run_steps({"tp": 2, "pp": 2}, remat=True)
+    np.testing.assert_allclose(remat, ref, rtol=1e-5)
+    no_vp, _ = run_steps({"tp": 2, "pp": 2}, vocab_parallel=False)
+    np.testing.assert_allclose(no_vp, ref, rtol=2e-4)
