@@ -5,3 +5,4 @@ from hetu_tpu.layers.misc import (
     MaxPool2d, AvgPool2d, Relu, Gelu, Tanh, Sigmoid, DropOut, Flatten,
 )
 from hetu_tpu.layers.attention import MultiHeadAttention
+from hetu_tpu.layers.rnn import RNN, RNNCell, LSTMCell, GRUCell

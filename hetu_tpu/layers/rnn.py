@@ -1,0 +1,109 @@
+"""Recurrent layers (RNN / LSTM / GRU) via lax.scan.
+
+Reference: examples/cnn/models/rnn.py and the RNN ops assembled from matmul
+primitives in the reference op zoo; tests/onnx round-trips RNN graphs.
+
+TPU notes: the time loop is a lax.scan (single compiled program, no
+per-step dispatch); gates are fused into one [D+H, k*H] matmul per step so
+each step is one MXU call.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import init as initializers
+from hetu_tpu.layers.base import Module
+
+
+class RNNCellBase(Module):
+    n_gates = 1
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 weight_init=None, dtype=jnp.float32):
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.w_init = weight_init or initializers.xavier_uniform()
+        self.dtype = dtype
+
+    def init(self, key):
+        k = self.n_gates * self.hidden_size
+        return {"params": {
+            "w": self.w_init(key, (self.input_size + self.hidden_size, k),
+                             self.dtype),
+            "b": jnp.zeros((k,), self.dtype)}, "state": {}}
+
+
+class RNNCell(RNNCellBase):
+    """h' = tanh([x, h] @ W + b)."""
+
+    def step(self, p, carry, x):
+        h = carry
+        z = jnp.concatenate([x, h], axis=-1) @ p["w"] + p["b"]
+        h2 = jnp.tanh(z)
+        return h2, h2
+
+    def initial_carry(self, batch):
+        return jnp.zeros((batch, self.hidden_size), self.dtype)
+
+
+class LSTMCell(RNNCellBase):
+    n_gates = 4  # i, f, g, o
+
+    def step(self, p, carry, x):
+        h, c = carry
+        z = jnp.concatenate([x, h], axis=-1) @ p["w"] + p["b"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c2 = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    def initial_carry(self, batch):
+        z = jnp.zeros((batch, self.hidden_size), self.dtype)
+        return (z, z)
+
+
+class GRUCell(RNNCellBase):
+    n_gates = 3  # r, z, n
+
+    def step(self, p, carry, x):
+        h = carry
+        H = self.hidden_size
+        w_rz = p["w"][:, :2 * H]
+        rz = jax.nn.sigmoid(jnp.concatenate([x, h], -1) @ w_rz
+                            + p["b"][:2 * H])
+        r, z = jnp.split(rz, 2, axis=-1)
+        w_n = p["w"][:, 2 * H:]
+        n = jnp.tanh(jnp.concatenate([x, r * h], -1) @ w_n + p["b"][2 * H:])
+        h2 = (1 - z) * n + z * h
+        return h2, h2
+
+    def initial_carry(self, batch):
+        return jnp.zeros((batch, self.hidden_size), self.dtype)
+
+
+class RNN(Module):
+    """Scan a cell over [B, T, D] → outputs [B, T, H] (+ final carry).
+
+    cell_type: 'rnn' | 'lstm' | 'gru'.
+    """
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 cell_type: str = "lstm", **kw):
+        cells = {"rnn": RNNCell, "lstm": LSTMCell, "gru": GRUCell}
+        self.cell = cells[cell_type](input_size, hidden_size, **kw)
+
+    def init(self, key):
+        return self.cell.init(key)
+
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        p = variables["params"]
+        B = x.shape[0]
+        carry0 = self.cell.initial_carry(B)
+
+        def body(carry, x_t):
+            return self.cell.step(p, carry, x_t)
+
+        carry, ys = jax.lax.scan(body, carry0, jnp.swapaxes(x, 0, 1))
+        return jnp.swapaxes(ys, 0, 1), {}

@@ -8,3 +8,6 @@ from hetu_tpu.models.resnet import ResNet, ResNet18, ResNet34
 from hetu_tpu.models.mlp import MLP
 from hetu_tpu.models.bert import BertConfig, BertModel, bert_base, bert_large
 from hetu_tpu.models.gpt import GPTConfig, GPTModel, gpt2_small
+from hetu_tpu.models.cnn_zoo import LeNet, VGG
+from hetu_tpu.models.gcn import GCN
+from hetu_tpu.models.wdl import WideDeep

@@ -1,0 +1,50 @@
+"""Classic CNN zoo: LeNet and VGG.
+
+Reference: examples/cnn/models/{lenet.py, vgg.py} (+ mlp.py, resnet.py
+elsewhere in hetu_tpu.models).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hetu_tpu import layers
+
+
+def LeNet(num_classes: int = 10, in_channels: int = 1):
+    """LeNet-5 for 32x32 inputs (pad MNIST to 32; reference lenet.py)."""
+    return layers.Sequential(
+        layers.Conv2d(in_channels, 6, 5, padding=2),
+        layers.Relu(), layers.MaxPool2d(2, 2),
+        layers.Conv2d(6, 16, 5),
+        layers.Relu(), layers.MaxPool2d(2, 2),
+        layers.Flatten(),
+        layers.Linear(16 * 6 * 6, 120), layers.Relu(),
+        layers.Linear(120, 84), layers.Relu(),
+        layers.Linear(84, num_classes),
+    )
+
+
+_VGG_CFGS = {
+    11: (1, 1, 2, 2, 2),
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+
+
+def VGG(depth: int = 16, num_classes: int = 10, in_channels: int = 3):
+    """VGG-11/16/19 with BN for 32x32 inputs (reference vgg.py)."""
+    cfg = _VGG_CFGS[depth]
+    chans = (64, 128, 256, 512, 512)
+    mods = []
+    c_in = in_channels
+    for n_convs, c_out in zip(cfg, chans):
+        for _ in range(n_convs):
+            mods += [layers.Conv2d(c_in, c_out, 3, padding=1, bias=False),
+                     layers.BatchNorm(c_out), layers.Relu()]
+            c_in = c_out
+        mods.append(layers.MaxPool2d(2, 2))
+    mods += [layers.Flatten(),
+             layers.Linear(512, 512), layers.Relu(), layers.DropOut(0.5),
+             layers.Linear(512, num_classes)]
+    return layers.Sequential(*mods)

@@ -1,0 +1,115 @@
+"""RNN/LSTM/GRU layers, CNN zoo, and HTIR import round-trip tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import optim
+from hetu_tpu.layers.rnn import RNN
+from hetu_tpu.models.cnn_zoo import LeNet, VGG
+
+
+@pytest.mark.parametrize("cell", ["rnn", "lstm", "gru"])
+def test_rnn_shapes_and_learning(cell):
+    m = RNN(8, 16, cell_type=cell)
+    v = m.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 10, 8))
+    y, _ = m.apply(v, x)
+    assert y.shape == (4, 10, 16)
+
+    # the last output should be able to fit a simple sequence-sum target
+    g = np.random.default_rng(0)
+    xs = g.standard_normal((32, 6, 8)).astype(np.float32)
+    tgt = xs.sum(axis=(1, 2), keepdims=False).astype(np.float32)
+
+    def loss(params):
+        out, _ = m.apply({"params": params, "state": {}}, xs)
+        pred = out[:, -1].sum(-1)
+        return jnp.mean((pred - tgt) ** 2)
+
+    opt = optim.AdamOptimizer(1e-2)
+    p = v["params"]
+    st = opt.init_state(p)
+    l0 = float(loss(p))
+    for _ in range(30):
+        grads = jax.grad(loss)(p)
+        p, st = opt.update(grads, st, p)
+    assert float(loss(p)) < l0, cell
+
+
+def test_lenet_vgg_forward():
+    lenet = LeNet(num_classes=10, in_channels=1)
+    v = lenet.init(jax.random.PRNGKey(0))
+    y, _ = lenet.apply(v, jnp.ones((2, 1, 32, 32)))
+    assert y.shape == (2, 10)
+
+    vgg = VGG(11, num_classes=10)
+    vv = vgg.init(jax.random.PRNGKey(0))
+    y2, st = vgg.apply(vv, jnp.ones((2, 3, 32, 32)), train=True,
+                       rng=jax.random.PRNGKey(1))
+    assert y2.shape == (2, 10)
+
+
+def test_htir_import_executes(tmp_path):
+    """Export → import → outputs match the original function."""
+    from hetu_tpu import onnx as honnx
+
+    def fn(x, w, b):
+        return jax.nn.sigmoid(x @ w + b) * 2.0
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((3, 4)),
+                    jnp.float32)
+    w = jnp.asarray(np.random.default_rng(1).standard_normal((4, 2)),
+                    jnp.float32)
+    b = jnp.ones((2,))
+    path = honnx.export_graph(fn, (x, w, b), tmp_path / "m.json")
+    fn2 = honnx.import_graph(path)
+    out = fn2(x, w, b)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(fn(x, w, b)),
+                               rtol=1e-5, atol=1e-6)
+    # imported fn is jittable
+    out_j = jax.jit(fn2)(x, w, b)
+    np.testing.assert_allclose(np.asarray(out_j), np.asarray(fn(x, w, b)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_htir_import_rejects_unconsumed_params(tmp_path):
+    """A primitive param the handler would silently drop (lax.reshape's
+    `dimensions` permutation) must be rejected, not mis-imported."""
+    from hetu_tpu import onnx as honnx
+
+    def fn(x):
+        return jax.lax.reshape(x, (6,), dimensions=(1, 0))
+
+    path = honnx.export_graph(fn, (jnp.arange(6.0).reshape(2, 3),),
+                              tmp_path / "p.json")
+    with pytest.raises(ValueError, match="does not consume"):
+        honnx.import_graph(path)
+
+
+def test_htir_preserves_dtypes(tmp_path):
+    """bf16 weights round-trip as bf16 (regression: came back f32)."""
+    from hetu_tpu import onnx as honnx
+
+    w = jnp.ones((4, 2), jnp.bfloat16)
+
+    def fn(x):
+        return x.astype(jnp.bfloat16) @ w
+
+    x = jnp.ones((3, 4))
+    path = honnx.export_graph(fn, (x,), tmp_path / "d.json")
+    fn2 = honnx.import_graph(path)
+    assert fn2(x).dtype == fn(x).dtype
+
+
+def test_htir_import_rejects_unsupported(tmp_path):
+    from hetu_tpu import onnx as honnx
+
+    def fn(x):
+        return jnp.cumsum(x)  # cumsum has no import handler
+
+    path = honnx.export_graph(fn, (jnp.ones((4,)),), tmp_path / "u.json")
+    with pytest.raises(ValueError, match="unsupported primitives"):
+        honnx.import_graph(path)
