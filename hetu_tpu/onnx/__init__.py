@@ -133,12 +133,15 @@ def load_graph(path) -> dict:
 # per-op handler table analog (reference onnx/onnx_opset/*)
 def _mk_dot(attrs):
     dn = attrs.get("dimension_numbers")
+    # honor the EXPORTED accumulation dtype: inventing one would change the
+    # original model's output dtype/numerics
+    pet = attrs.get("preferred_element_type")
+
     def run(a, b):
         return jax.lax.dot_general(
             a, b, tuple(map(lambda t: tuple(map(tuple, t)), dn))
             if dn else (((a.ndim - 1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16
-            else None)
+            preferred_element_type=pet)
     return run
 
 
