@@ -11,3 +11,4 @@ from hetu_tpu.models.gpt import GPTConfig, GPTModel, gpt2_small
 from hetu_tpu.models.cnn_zoo import LeNet, VGG
 from hetu_tpu.models.gcn import GCN
 from hetu_tpu.models.wdl import WideDeep
+from hetu_tpu.models.gpt_hetero import HeteroGPT, PlanStrategy

@@ -1,0 +1,133 @@
+"""HeteroGPT: executes a searched per-layer parallelism Plan.
+
+Reference: tools/Galvatron — the runtime half of the planner: each layer
+gets its own TP degree / DP type from the searched JSON config
+(core/hybrid_parallel_config.py) and activations are redistributed between
+differently-parallelized layers (core/redistribute.py).
+
+TPU form: per-layer (non-stacked) parameters so every layer can carry its
+own PartitionSpec from a `strategies.search.Plan`; XLA's SPMD partitioner
+inserts the activation resharding between layers (the redistribute.py
+split/gather pairs) from the sharding mismatch.  `PlanStrategy` adapts a
+Plan to the Executor's dist_strategy hook, so the full loop is:
+
+    layers = transformer_layer_specs(...)          # cost IR
+    plan = OptCNNSearching(sim, dp).search(layers) # search
+    model = HeteroGPT(cfg)
+    ex = Executor(model.lm_loss_fn(), opt, mesh=mesh,
+                  dist_strategy=PlanStrategy(plan))
+
+Pipeline plans (stage_bounds / meta['pp'] > 1) are NOT executable here —
+PlanStrategy covers the intra-stage SPMD layout; pair it with the GPipe
+executor for the pipeline dimension.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+import re
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from hetu_tpu import init as initializers
+from hetu_tpu import ops
+from hetu_tpu.layers.transformer import TransformerBlock
+from hetu_tpu.models.gpt import GPTConfig, GPTModel
+from hetu_tpu.parallel.strategies.base import Strategy
+from hetu_tpu.parallel.strategies.search import Plan
+
+
+class HeteroGPT(GPTModel):
+    """GPT with per-layer parameter trees (plan-shardable).
+
+    Subclasses GPTModel: the loss (lm_loss_fn) is inherited — only the
+    parameter layout (per-layer dicts instead of scan-stacked) and the
+    layer loop differ.
+    """
+
+    def __init__(self, config: GPTConfig):
+        super().__init__(config)
+
+    def init(self, key):
+        c = self.c
+        ks = jax.random.split(key, c.num_layers + 3)
+        params = {
+            "tok_emb": self.w_init(ks[0], (c.vocab_size, c.hidden_size)),
+            "pos_emb": self.w_init(ks[1], (c.max_position, c.hidden_size)),
+            "ln_f_scale": jnp.ones((c.hidden_size,)),
+            "ln_f_bias": jnp.zeros((c.hidden_size,)),
+        }
+        for i in range(c.num_layers):
+            params[f"layer{i}"] = self.block.init(ks[2 + i])["params"]
+        return {"params": params, "state": {}}
+
+    def apply(self, variables, input_ids, *, train: bool = False, rng=None):
+        p = variables["params"]
+        c = self.c
+        b, s = input_ids.shape
+        h = ops.embedding_lookup(p["tok_emb"], input_ids)
+        h = h + p["pos_emb"][None, :s]
+        if train and c.dropout_rate > 0:  # same regularization as GPTModel
+            h = ops.dropout(h, c.dropout_rate, jax.random.fold_in(rng, 999),
+                            train=True)
+        h = h.astype(c.dtype)
+        for i in range(c.num_layers):
+            h, _ = self.block.apply({"params": p[f"layer{i}"], "state": {}},
+                                    h, train=train,
+                                    rng=None if rng is None else
+                                    jax.random.fold_in(rng, i))
+        h = ops.layer_norm(h.astype(jnp.float32), p["ln_f_scale"],
+                           p["ln_f_bias"])
+        return ops.linear(h, p["tok_emb"].T), {}
+
+
+_LAYER_RE = re.compile(r"\['layer(\d+)'\]")
+
+
+class PlanStrategy(Strategy):
+    """Adapt a searched Plan to per-layer PartitionSpecs.
+
+    The Plan's layer_options are matched to HeteroGPT's transformer layers
+    in order, skipping non-transformer entries (embed/head LayerSpecs).
+    Layers whose option has tp > 1 get Megatron col/row splits; 'dp'
+    layers stay replicated (grad-allreduce DP via the sharded batch).
+    """
+
+    COL = ("qkv_weight", "qkv_bias")
+    ROW = ("out_weight",)
+
+    def __init__(self, plan: Plan):
+        if plan.stage_bounds or plan.meta.get("pp", 1) > 1:
+            raise ValueError(
+                "plan carries pipeline stages; PlanStrategy executes the "
+                "intra-stage SPMD layout only — run the pipeline dimension "
+                "with parallel.pipeline.GPipe")
+        # the transformer_layer_specs chain is [embed, (attn_i, ffn_i)*,
+        # head]; keep attn and ffn tp SEPARATE so the executed layout is
+        # exactly what the searcher costed
+        body = plan.layer_options[1:-1]
+        self.block_tp = {}
+        for li in range(len(body) // 2):
+            attn, ffn = body[2 * li], body[2 * li + 1]
+            self.block_tp[li] = (attn.tp, ffn.tp)
+
+    def param_spec(self, path, leaf):
+        m = _LAYER_RE.search(path)
+        ndim = leaf.ndim if hasattr(leaf, "ndim") else len(leaf.shape)
+        if not m:
+            return P()
+        attn_tp, ffn_tp = self.block_tp.get(int(m.group(1)), (1, 1))
+        is_attn = "attn" in path or any(k in path for k in
+                                        self.COL + self.ROW)
+        tp = attn_tp if is_attn else ffn_tp
+        if tp <= 1:
+            return P()
+        if any(k in path for k in self.COL) or "ffn_in" in path:
+            return P(*((None,) * (ndim - 1)), "tp")
+        if "bias" not in path and (any(k in path for k in self.ROW)
+                                   or "ffn_out" in path):
+            if ndim >= 2:
+                return P(*((None,) * (ndim - 2)), "tp", None)
+        return P()

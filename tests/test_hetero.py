@@ -1,0 +1,120 @@
+"""Galvatron-loop test: search a Plan → execute it with per-layer TP."""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import hetu_tpu as ht
+from hetu_tpu import models, optim
+from hetu_tpu.models.gpt_hetero import HeteroGPT, PlanStrategy
+from hetu_tpu.parallel.strategies.search import Plan
+from hetu_tpu.profiler.simulator import ShardOption, transformer_layer_specs
+
+
+def make_plan(num_layers, tps):
+    """Hand-build a Plan shaped like the searchers' output."""
+    opts = [ShardOption("dp")]  # embed
+    for tp in tps:
+        kind = "tp_col" if tp > 1 else "dp"
+        opts.append(ShardOption(kind, tp))                      # attn
+        opts.append(ShardOption("tp_row" if tp > 1 else "dp", tp))  # ffn
+    opts.append(ShardOption("dp"))  # head
+    return Plan(opts)
+
+
+def test_hetero_per_layer_shardings_and_training():
+    cfg = models.GPTConfig(vocab_size=64, hidden_size=32, num_layers=3,
+                           num_heads=4, ffn_size=64, max_position=16,
+                           dropout_rate=0.0)
+    model = HeteroGPT(cfg)
+    mesh = ht.make_mesh(dp=2, tp=4)
+    plan = make_plan(3, [1, 4, 1])  # only the middle layer is TP
+
+    ex = ht.Executor(model.lm_loss_fn(), optim.AdamOptimizer(1e-3),
+                     mesh=mesh, dist_strategy=PlanStrategy(plan), seed=0)
+    state = ex.init_state(model.init(jax.random.PRNGKey(0)))
+
+    s0 = state.params["layer0"]["ffn_in"]["weight"].sharding.spec
+    s1 = state.params["layer1"]["ffn_in"]["weight"].sharding.spec
+    assert "tp" not in str(s0), s0          # dp layer replicated
+    assert "tp" in str(s1), s1              # planned layer split
+
+    ids = np.random.default_rng(0).integers(0, 64, (8, 16)).astype(np.int32)
+    losses = []
+    for _ in range(6):
+        state, m = ex.run("train", state, (ids,))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    # per-layer shardings survive donated updates
+    assert "tp" in str(state.params["layer1"]["ffn_in"]["weight"]
+                       .sharding.spec)
+
+
+def test_hetero_matches_homogeneous_trajectory():
+    """Heterogeneous plan must not change the math — just the layout."""
+    cfg = models.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                           num_heads=4, ffn_size=64, max_position=16,
+                           dropout_rate=0.0)
+    model = HeteroGPT(cfg)
+    ids = np.random.default_rng(1).integers(0, 64, (8, 16)).astype(np.int32)
+
+    ex1 = ht.Executor(model.lm_loss_fn(), optim.AdamOptimizer(1e-2), seed=0)
+    s1 = ex1.init_state(model.init(jax.random.PRNGKey(0)))
+    mesh = ht.make_mesh(dp=2, tp=4)
+    ex2 = ht.Executor(model.lm_loss_fn(), optim.AdamOptimizer(1e-2),
+                      mesh=mesh, dist_strategy=PlanStrategy(
+                          make_plan(2, [4, 1])), seed=0)
+    s2 = ex2.init_state(model.init(jax.random.PRNGKey(0)))
+    for _ in range(4):
+        s1, m1 = ex1.run("train", s1, (ids,))
+        s2, m2 = ex2.run("train", s2, (ids,))
+    np.testing.assert_allclose(float(m2["loss"]), float(m1["loss"]),
+                               rtol=2e-4)
+
+
+def test_mixed_attn_ffn_tp_and_pipeline_rejection():
+    """attn and ffn tp degrees apply independently (regression: folded to
+    max); pipeline plans are rejected with guidance."""
+    import pytest
+    cfg = models.GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                           num_heads=4, ffn_size=64, max_position=16,
+                           dropout_rate=0.0)
+    # attn dp, ffn tp4 for the single layer
+    from hetu_tpu.profiler.simulator import ShardOption
+    plan = Plan([ShardOption("dp"), ShardOption("dp", 1),
+                 ShardOption("tp_row", 4), ShardOption("dp")])
+    strat = PlanStrategy(plan)
+    import jax.numpy as jnp
+    qkv = strat.param_spec("['layer0']['attn']['qkv_weight']",
+                           jnp.zeros((32, 96)))
+    ffn = strat.param_spec("['layer0']['ffn_out']['weight']",
+                           jnp.zeros((64, 32)))
+    assert "tp" not in str(qkv), qkv
+    assert "tp" in str(ffn), ffn
+
+    with pytest.raises(ValueError, match="pipeline stages"):
+        PlanStrategy(Plan([ShardOption("dp")], stage_bounds=[2, 4]))
+
+
+def test_searched_plan_executes_end_to_end():
+    """The actual searcher's Plan drives the runtime (full Galvatron loop)."""
+    from hetu_tpu.profiler.cost_model import CHIPS
+    from hetu_tpu.profiler.simulator import Simulator
+    from hetu_tpu.parallel.strategies.search import OptCNNSearching
+
+    cfg = models.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                           num_heads=4, ffn_size=64, max_position=16,
+                           dropout_rate=0.0)
+    layers = transformer_layer_specs(
+        cfg.num_layers, cfg.hidden_size, cfg.ffn_size, seq=16, batch=8,
+        vocab=cfg.vocab_size, tp_candidates=(1, 4))
+    plan = OptCNNSearching(Simulator(CHIPS["v5e"]), dp=2).search(layers)
+
+    model = HeteroGPT(cfg)
+    mesh = ht.make_mesh(dp=2, tp=4)
+    ex = ht.Executor(model.lm_loss_fn(), optim.AdamOptimizer(1e-3),
+                     mesh=mesh, dist_strategy=PlanStrategy(plan), seed=0)
+    state = ex.init_state(model.init(jax.random.PRNGKey(0)))
+    ids = np.random.default_rng(2).integers(0, 64, (8, 16)).astype(np.int32)
+    state, m = ex.run("train", state, (ids,))
+    assert np.isfinite(float(m["loss"]))
