@@ -62,6 +62,43 @@ def test_heturun_cli_local(tmp_path):
     assert "pid" in out.stdout
 
 
+def test_heturun_multiprocess_global_mesh(tmp_path):
+    """Two launcher-spawned processes form ONE global device mesh via
+    jax.distributed and agree on a cross-process psum — the multi-host
+    collective-plane contract (reference: heturun + mpirun workers)."""
+    script = tmp_path / "mh.py"
+    script.write_text(f"""
+import os, sys
+sys.path.insert(0, {str(REPO)!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from hetu_tpu.launcher import initialize_from_env
+initialize_from_env()
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(np.asarray(jax.devices()).reshape(-1), ("dp",))
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")),
+    np.full((jax.local_device_count(),), float(jax.process_index() + 1),
+            np.float32))
+total = jax.jit(lambda a: jnp.sum(a),
+                out_shardings=NamedSharding(mesh, P()))(arr)
+print("SUM", float(total), flush=True)
+""")
+    cfg = tmp_path / "cluster.yml"
+    cfg.write_text("nodes:\n  - host: localhost\n    chips: 2\n"
+                   "  - host: localhost\n    chips: 2\n"
+                   "coordinator: 127.0.0.1:18476\n")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bin" / "heturun"), "-c", str(cfg),
+         "-n", "2", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-2000:]
+    # 2 local devices * (1 + 2) = 6
+    assert out.stdout.count("SUM 6.0") == 2, out.stdout
+
+
 def test_graphboard_export(tmp_path):
     from hetu_tpu.graphboard import export_html, jaxpr_graph
 
