@@ -99,12 +99,45 @@ class GPipe:
                                 *leaf.shape[1:])
         return jax.tree_util.tree_map(reshape, per_layer_params)
 
-    def __call__(self, stacked_params, h):
+    def stack_params_unequal(self, per_layer_params, stage_bounds):
+        """Pack UNEQUAL stages (a searcher's Plan.stage_bounds) by padding
+        every stage to the longest one; returns (stacked, layer_mask) where
+        layer_mask [n_stages, L_max] marks real (non-padding) layer slots.
+
+        per_layer_params: leaves stacked on a leading layer dim [L, ...]
+        (same layout stack_params takes).  stage_bounds: ascending layer
+        end-indices, one per stage (GPipeSearching output).
+        """
+        bounds = list(stage_bounds)
+        assert len(bounds) == self.n_stages, (bounds, self.n_stages)
+        starts = [0] + bounds[:-1]
+        sizes = [e - s for s, e in zip(starts, bounds)]
+        l_max = max(sizes)
+        mask = jnp.asarray([[1.0] * n + [0.0] * (l_max - n) for n in sizes])
+
+        def pack(leaf):
+            segs = []
+            for s, n in zip(starts, sizes):
+                seg = leaf[s:s + n]
+                if n < l_max:
+                    pad = jnp.zeros((l_max - n, *leaf.shape[1:]), leaf.dtype)
+                    seg = jnp.concatenate([seg, pad], axis=0)
+                segs.append(seg)
+            return jnp.stack(segs)
+
+        return jax.tree_util.tree_map(pack, per_layer_params), mask
+
+    def __call__(self, stacked_params, h, *, layer_mask=None):
+        """layer_mask [n_stages, L_max]: 1 = real layer, 0 = padding slot
+        (identity) — produced by stack_params_unequal for searched plans."""
         M = self.n_microbatches
         B = h.shape[0]
         assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
         mb = B // M
         xs = h.reshape(M, mb, *h.shape[1:])
+        if layer_mask is None:
+            n_per = jax.tree_util.tree_leaves(stacked_params)[0].shape[1]
+            layer_mask = jnp.ones((self.n_stages, n_per))
 
         block = self.block_fn
         if self.remat:
@@ -112,18 +145,22 @@ class GPipe:
         axis = self.axis
         n = self.n_stages
 
-        def local(params, xs):
+        def local(params, xs, mask):
             # params leaves arrive [1, Lps, ...] (this stage's slice)
             params = jax.tree_util.tree_map(lambda a: a[0], params)
+            mask = mask[0]
             s = lax.axis_index(axis)
             T = M + n - 1
             buf = jnp.zeros_like(xs[0])
             outs = jnp.zeros_like(xs)
 
             def stage_apply(h):
-                def body(carry, p_l):
-                    return block(p_l, carry), None
-                out, _ = lax.scan(body, h, params)
+                def body(carry, xs_l):
+                    p_l, valid = xs_l
+                    out = block(p_l, carry)
+                    # padding slots pass activations through unchanged
+                    return jnp.where(valid > 0, out, carry), None
+                out, _ = lax.scan(body, h, (params, mask))
                 return out
 
             def tick(carry, t):
@@ -151,6 +188,7 @@ class GPipe:
         in_param_spec = jax.tree_util.tree_map(
             lambda _: P(self.axis), stacked_params)
         out = shard_map(local, mesh=self.mesh,
-                        in_specs=(in_param_spec, P()), out_specs=P(),
-                        check_vma=False)(stacked_params, xs)
+                        in_specs=(in_param_spec, P(), P(self.axis)),
+                        out_specs=P(),
+                        check_vma=False)(stacked_params, xs, layer_mask)
         return out.reshape(B, *h.shape[1:])

@@ -79,6 +79,48 @@ def test_gpipe_with_dp_batch_outside():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_gpipe_unequal_stages_from_searched_plan():
+    """A GPipeSearching plan with unequal stage cuts executes via padded
+    stages + layer masks and still matches the sequential oracle."""
+    from hetu_tpu.profiler.cost_model import CHIPS
+    from hetu_tpu.profiler.simulator import LayerSpec, ShardOption, Simulator
+    from hetu_tpu.parallel.strategies.search import GPipeSearching
+
+    D, L, B = 8, 6, 8
+    mesh = ht.make_mesh(pp=4)
+    layers = make_layers(L, D, jax.random.PRNGKey(7))
+    h = jax.random.normal(jax.random.PRNGKey(8), (B, D))
+
+    # heterogeneous per-layer costs → unequal cuts
+    specs = [LayerSpec(f"l{i}", flops=1e12 * (1 + 3 * (i == 0)),
+                       param_bytes=1e6, act_bytes=1e6,
+                       options=[ShardOption("dp")]) for i in range(L)]
+    plan = GPipeSearching(Simulator(CHIPS["v5e"]), n_stages=4,
+                          n_microbatches=4).search(specs)
+    assert len(plan.stage_bounds) == 4
+    sizes = [e - s for s, e in zip([0] + plan.stage_bounds[:-1],
+                                   plan.stage_bounds)]
+    assert len(set(sizes)) > 1, sizes  # genuinely unequal
+
+    pipe = GPipe(block_fn, mesh, n_microbatches=4, remat=False)
+    stacked, mask = pipe.stack_params_unequal(layers, plan.stage_bounds)
+    out = pipe(stacked, h, layer_mask=mask)
+    ref = sequential_oracle(layers, h)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                               atol=1e-6)
+
+    # grads flow through the masked pipeline too
+    def loss(layers):
+        st, mk = pipe.stack_params_unequal(layers, plan.stage_bounds)
+        return jnp.sum(pipe(st, h, layer_mask=mk) ** 2)
+
+    g = jax.grad(loss)(layers)
+    g_ref = jax.grad(lambda ls: jnp.sum(sequential_oracle(ls, h) ** 2))(
+        layers)
+    np.testing.assert_allclose(np.asarray(g["w"]), np.asarray(g_ref["w"]),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_pipedream_schedule_contract():
     """1F1B invariants (reference pipedream_subexecutor.py:25-48): per stage,
     fwd i precedes bwd i; stage s warmup = n_stages-s-1; total ops = 2M."""
