@@ -25,7 +25,9 @@ class MultiHeadAttention(Module):
                  attention_impl: str = "xla"):
         """attention_impl: 'xla' (compiler-fused composition) or 'flash'
         (Pallas kernel, hetu_tpu/ops/pallas_kernels) — flash requires seq
-        divisible by its block size and no explicit mask."""
+        divisible by its block size and no explicit mask (masked calls warn
+        and fall back to xla)."""
+        assert attention_impl in ("xla", "flash"), attention_impl
         assert hidden_size % num_heads == 0
         self.hidden_size = hidden_size
         self.num_heads = num_heads
@@ -56,6 +58,12 @@ class MultiHeadAttention(Module):
                          p["qkv_bias"])  # [B,S,3H]
         qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
         q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))  # [B,Hd,S,D]
+        if self.attention_impl == "flash" and mask is not None:
+            import warnings
+            warnings.warn(
+                "attention_impl='flash' ignores explicit masks; falling "
+                "back to the xla path for this call (flash covers the "
+                "causal/unmasked cases)", stacklevel=2)
         if self.attention_impl == "flash" and mask is None:
             from hetu_tpu.ops.pallas_kernels import flash_attention
             out = flash_attention(q, k, v, causal=self.causal)

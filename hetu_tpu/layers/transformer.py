@@ -27,11 +27,12 @@ class TransformerBlock(Module):
     def __init__(self, hidden_size: int, num_heads: int, ffn_size: int = None,
                  *, dropout_rate: float = 0.0, causal: bool = False,
                  pre_norm: bool = False, activation=ops.gelu,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, attention_impl: str = "xla"):
         ffn_size = ffn_size or 4 * hidden_size
         self.attn = MultiHeadAttention(hidden_size, num_heads,
                                        dropout_rate=dropout_rate,
-                                       causal=causal, dtype=dtype)
+                                       causal=causal, dtype=dtype,
+                                       attention_impl=attention_impl)
         self.ln1 = LayerNorm(hidden_size)
         self.ffn_in = Linear(hidden_size, ffn_size, dtype=dtype)
         self.ffn_out = Linear(ffn_size, hidden_size, dtype=dtype)

@@ -34,6 +34,8 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout_rate: float = 0.1
     dtype: object = jnp.float32
+    attention_impl: str = "xla"  # 'flash' = Pallas kernel (TPU); only
+    # applies when no attention_mask is passed (masked calls warn + use xla)
 
 
 class BertModel(Module):
@@ -42,7 +44,7 @@ class BertModel(Module):
         self.block = TransformerBlock(
             config.hidden_size, config.num_heads, config.ffn_size,
             dropout_rate=config.dropout_rate, causal=False, pre_norm=False,
-            dtype=config.dtype)
+            dtype=config.dtype, attention_impl=config.attention_impl)
         self.w_init = initializers.truncated_normal(stddev=0.02)
 
     def init(self, key):

@@ -29,6 +29,7 @@ class GPTConfig:
     max_position: int = 1024
     dropout_rate: float = 0.1
     dtype: object = jnp.float32
+    attention_impl: str = "xla"  # 'flash' = Pallas kernel (TPU)
 
 
 class GPTModel(Module):
@@ -37,7 +38,7 @@ class GPTModel(Module):
         self.block = TransformerBlock(
             config.hidden_size, config.num_heads, config.ffn_size,
             dropout_rate=config.dropout_rate, causal=True, pre_norm=True,
-            dtype=config.dtype)
+            dtype=config.dtype, attention_impl=config.attention_impl)
         self.w_init = initializers.normal(stddev=0.02)
 
     def init(self, key):

@@ -56,6 +56,21 @@ def test_bert_tiny_pretrain_step():
     assert float(m["loss"]) < l0
 
 
+def test_gpt_flash_attention_impl_matches_xla():
+    """GPTConfig(attention_impl='flash') must match the xla path (interpret
+    mode on CPU; the real Pallas kernel runs on TPU)."""
+    base = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                ffn_size=64, max_position=128, dropout_rate=0.0)
+    m_xla = models.GPTModel(models.GPTConfig(**base))
+    m_fl = models.GPTModel(models.GPTConfig(**base, attention_impl="flash"))
+    v = m_xla.init(jax.random.PRNGKey(0))
+    ids = np.random.default_rng(0).integers(0, 64, (2, 128)).astype(np.int32)
+    la, _ = m_xla.apply(v, jnp.asarray(ids))
+    lb, _ = m_fl.apply(v, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=2e-4,
+                               atol=2e-5)
+
+
 def test_gpt_tiny_lm_step():
     cfg = models.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
                            num_heads=4, ffn_size=64, max_position=32,
