@@ -67,6 +67,12 @@ class MultiHeadAttention(Module):
         if self.attention_impl == "flash" and mask is None:
             from hetu_tpu.ops.pallas_kernels import flash_attention
             out = flash_attention(q, k, v, causal=self.causal)
+        elif self.causal and mask is not None:
+            # honor BOTH the causal structure and the user's mask
+            causal = jnp.tril(jnp.ones((s, s), bool))
+            out = ops.attention(q, k, v,
+                                mask=jnp.logical_and(mask.astype(bool),
+                                                     causal))
         elif self.causal:
             out = ops.causal_attention(q, k, v)
         else:
