@@ -91,5 +91,58 @@ class Dataloader:
             batch = [a[sel] for a in arrs]
             yield batch[0] if self._single else tuple(batch)
 
+    def prefetch(self, depth: int = 2):
+        """Iterate with a background thread keeping `depth` batches ready —
+        host batch assembly overlaps the device step (the reference's
+        dataloader worker, dataloader.py batching thread).
+
+        Producer exceptions re-raise in the consumer; abandoning the
+        generator early (break / close) stops and joins the producer.
+        """
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=depth)
+        DONE = object()
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for batch in self:
+                    while not stop.is_set():
+                        try:
+                            q.put(batch, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # forward into the consumer
+                try:
+                    q.put(e, timeout=1.0)
+                except queue.Full:
+                    pass
+                return
+            finally:
+                if not stop.is_set():
+                    try:
+                        q.put(DONE, timeout=1.0)
+                    except queue.Full:
+                        pass
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+
     def __len__(self):
         return self.num_batches

@@ -12,3 +12,4 @@ from hetu_tpu.models.cnn_zoo import LeNet, VGG
 from hetu_tpu.models.gcn import GCN
 from hetu_tpu.models.wdl import WideDeep
 from hetu_tpu.models.gpt_hetero import HeteroGPT, PlanStrategy
+from hetu_tpu.models.ctr_zoo import DeepFM, DCN, CrossNet

@@ -1,0 +1,57 @@
+"""Shared CTR building blocks: the hidden-MLP tower and the hybrid step.
+
+One source of truth for what WideDeep / DeepFM / DCN all repeat: the deep
+tower construction and the PS-hybrid train step (dense params updated
+on-device, embedding-row gradients returned for the host push — reference
+ParameterServerCommunicate flow).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import layers, ops
+
+
+def mlp_tower(in_dim: int, hidden, out_dim=None) -> layers.Sequential:
+    """Linear+Relu stack, optional linear head (shared by the CTR zoo)."""
+    mods = []
+    prev = in_dim
+    for h in hidden:
+        mods += [layers.Linear(prev, h), layers.Relu()]
+        prev = h
+    if out_dim is not None:
+        mods.append(layers.Linear(prev, out_dim))
+    return layers.Sequential(*mods)
+
+
+def make_hybrid_step(model, optimizer, n_sparse_inputs: int = 1):
+    """Build the jitted hybrid train step for a CTR model whose apply is
+    (variables, dense_x, *sparse_rows) -> logit [B].
+
+    Returns step(params, opt_state, model_state, dense_x, *sparse_rows,
+    labels) -> (params, opt_state, model_state, loss, logit,
+    *sparse_row_grads).
+    """
+
+    def step(params, opt_state, model_state, dense_x, *rest):
+        sparse_rows = rest[:n_sparse_inputs]
+        labels = rest[n_sparse_inputs]
+
+        def loss_fn(params, *sparse_rows):
+            logit, new_state = model.apply(
+                {"params": params, "state": model_state}, dense_x,
+                *sparse_rows, train=True)
+            loss = jnp.mean(
+                ops.binary_cross_entropy_with_logits(logit, labels))
+            return loss, (logit, new_state)
+
+        argnums = tuple(range(1 + n_sparse_inputs))
+        (loss, (logit, new_state)), grads = jax.value_and_grad(
+            loss_fn, argnums=argnums, has_aux=True)(params, *sparse_rows)
+        gp, ge = grads[0], grads[1:]
+        params, opt_state = optimizer.update(gp, opt_state, params)
+        return (params, opt_state, new_state, loss, logit, *ge)
+
+    return jax.jit(step, donate_argnums=(0, 1))

@@ -1,0 +1,125 @@
+"""CTR model zoo beyond Wide&Deep: DeepFM and DCN.
+
+Reference: examples/ctr/models/{deepfm.py, dcn.py} (alongside wdl.py →
+hetu_tpu/models/wdl.py).  Same hybrid contract as WideDeep: the huge sparse
+embeddings live on the PS plane and arrive as pulled rows; these modules
+hold only dense parameters and return d(loss)/d(rows) for the host push.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import layers, ops
+from hetu_tpu.layers.base import Module
+
+
+class DeepFM(Module):
+    """FM second-order interactions + deep MLP (reference deepfm.py).
+
+    Inputs: dense_x [B, dense_dim]; emb_rows [B, fields, emb_dim] (the FM
+    latent vectors, PS-pulled); fm_linear_rows [B, fields, 1] (first-order
+    weights per feature id — a dim-1 PS table, like the reference's
+    separate linear embedding).
+    """
+
+    def __init__(self, num_sparse_fields: int, emb_dim: int, dense_dim: int,
+                 hidden=(256, 256)):
+        from hetu_tpu.models.ctr_common import mlp_tower
+        self.fields = num_sparse_fields
+        self.emb_dim = emb_dim
+        self.deep = mlp_tower(num_sparse_fields * emb_dim + dense_dim,
+                              hidden, out_dim=1)
+        self.dense_linear = layers.Linear(dense_dim, 1)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        d = self.deep.init(k1)
+        l = self.dense_linear.init(k2)
+        return {"params": {"deep": d["params"], "lin": l["params"]},
+                "state": {"deep": d["state"], "lin": {}}}
+
+    def apply(self, variables, dense_x, emb_rows, fm_linear_rows, *,
+              train: bool = False, rng=None):
+        p, s = variables["params"], variables["state"]
+        # FM 2nd order: 0.5 * (sum v)^2 - sum v^2, summed over emb dim
+        sum_v = jnp.sum(emb_rows, axis=1)
+        fm2 = 0.5 * jnp.sum(sum_v * sum_v
+                            - jnp.sum(emb_rows * emb_rows, axis=1), axis=-1)
+        fm1 = jnp.sum(fm_linear_rows[..., 0], axis=1)
+        deep_in = jnp.concatenate(
+            [emb_rows.reshape(emb_rows.shape[0], -1), dense_x], axis=-1)
+        deep_out, ds = self.deep.apply(
+            {"params": p["deep"], "state": s["deep"]}, deep_in, train=train,
+            rng=rng)
+        lin_out, _ = self.dense_linear.apply(
+            {"params": p["lin"], "state": {}}, dense_x)
+        logit = fm1 + fm2 + deep_out[:, 0] + lin_out[:, 0]
+        return logit, {"deep": ds, "lin": {}}
+
+    def hybrid_step_fn(self, optimizer):
+        """Dense update + (emb_grads, fm_linear_grads) for the PS push."""
+        from hetu_tpu.models.ctr_common import make_hybrid_step
+        return make_hybrid_step(self, optimizer, n_sparse_inputs=2)
+
+
+class CrossNet(Module):
+    """DCN cross layers: x_{l+1} = x0 * (w^T x_l) + b + x_l."""
+
+    def __init__(self, dim: int, n_layers: int = 3):
+        self.dim, self.n = dim, n_layers
+
+    def init(self, key):
+        ks = jax.random.split(key, self.n)
+        return {"params": {
+            "w": jnp.stack([jax.random.normal(k, (self.dim,)) * 0.01
+                            for k in ks]),
+            "b": jnp.zeros((self.n, self.dim))}, "state": {}}
+
+    def apply(self, variables, x0, *, train: bool = False, rng=None):
+        p = variables["params"]
+        x = x0
+        for l in range(self.n):
+            xw = jnp.einsum("bd,d->b", x, p["w"][l])[:, None]
+            x = x0 * xw + p["b"][l] + x
+        return x, {}
+
+
+class DCN(Module):
+    """Deep & Cross Network (reference dcn.py): cross net + deep MLP on the
+    concatenated [embeddings, dense] features."""
+
+    def __init__(self, num_sparse_fields: int, emb_dim: int, dense_dim: int,
+                 hidden=(256, 256), n_cross: int = 3):
+        from hetu_tpu.models.ctr_common import mlp_tower
+        self.in_dim = num_sparse_fields * emb_dim + dense_dim
+        self.cross = CrossNet(self.in_dim, n_cross)
+        self.deep = mlp_tower(self.in_dim, hidden)
+        self.head = layers.Linear(hidden[-1] + self.in_dim, 1)
+
+    def init(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        c = self.cross.init(k1)
+        d = self.deep.init(k2)
+        h = self.head.init(k3)
+        return {"params": {"cross": c["params"], "deep": d["params"],
+                           "head": h["params"]},
+                "state": {"deep": d["state"]}}
+
+    def apply(self, variables, dense_x, emb_rows, *, train: bool = False,
+              rng=None):
+        p, s = variables["params"], variables["state"]
+        x0 = jnp.concatenate(
+            [emb_rows.reshape(emb_rows.shape[0], -1), dense_x], axis=-1)
+        xc, _ = self.cross.apply({"params": p["cross"], "state": {}}, x0)
+        xd, ds = self.deep.apply({"params": p["deep"], "state": s["deep"]},
+                                 x0, train=train, rng=rng)
+        logit, _ = self.head.apply(
+            {"params": p["head"], "state": {}},
+            jnp.concatenate([xc, xd], axis=-1))
+        return logit[:, 0], {"deep": ds}
+
+    def hybrid_step_fn(self, optimizer):
+        from hetu_tpu.models.ctr_common import make_hybrid_step
+        return make_hybrid_step(self, optimizer, n_sparse_inputs=1)

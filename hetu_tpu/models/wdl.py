@@ -22,16 +22,12 @@ from hetu_tpu.layers.base import Module
 class WideDeep(Module):
     def __init__(self, num_sparse_fields: int, emb_dim: int, dense_dim: int,
                  hidden=(256, 256)):
+        from hetu_tpu.models.ctr_common import mlp_tower
         self.num_sparse_fields = num_sparse_fields
         self.emb_dim = emb_dim
         self.dense_dim = dense_dim
-        mods = []
-        prev = num_sparse_fields * emb_dim + dense_dim
-        for h in hidden:
-            mods += [layers.Linear(prev, h), layers.Relu()]
-            prev = h
-        mods.append(layers.Linear(prev, 1))
-        self.deep = layers.Sequential(*mods)
+        self.deep = mlp_tower(num_sparse_fields * emb_dim + dense_dim,
+                              hidden, out_dim=1)
         self.wide = layers.Linear(dense_dim, 1)
 
     def init(self, key):
@@ -57,17 +53,7 @@ class WideDeep(Module):
 
     def hybrid_step_fn(self, optimizer):
         """Jitted hybrid train step: updates dense params, returns embedding
-        row grads for the PS push (the ParameterServerCommunicate analog)."""
-        def step(params, opt_state, model_state, dense_x, emb_rows, labels):
-            def loss_fn(params, emb_rows):
-                logit, new_state = self.apply(
-                    {"params": params, "state": model_state},
-                    dense_x, emb_rows, train=True)
-                loss = jnp.mean(
-                    ops.binary_cross_entropy_with_logits(logit, labels))
-                return loss, (logit, new_state)
-            (loss, (logit, new_state)), (gp, ge) = jax.value_and_grad(
-                loss_fn, argnums=(0, 1), has_aux=True)(params, emb_rows)
-            params, opt_state = optimizer.update(gp, opt_state, params)
-            return params, opt_state, new_state, loss, logit, ge
-        return jax.jit(step, donate_argnums=(0, 1))
+        row grads for the PS push (the ParameterServerCommunicate analog;
+        shared builder in ctr_common)."""
+        from hetu_tpu.models.ctr_common import make_hybrid_step
+        return make_hybrid_step(self, optimizer, n_sparse_inputs=1)
