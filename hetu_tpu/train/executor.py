@@ -182,6 +182,18 @@ class Executor:
         batch = _device_batch(batch, self.mesh, self.dp_axis)
         return self._compiled[name](state, batch)
 
+    def save(self, path, state: TrainState, *, extra=None) -> None:
+        """Reference-parity convenience (executor.py:558): checkpoint the
+        full TrainState incl. (seed, seqnum) RNG."""
+        from hetu_tpu.train import checkpoint
+        checkpoint.save(path, state, extra=extra)
+
+    def load(self, path, state_template: TrainState) -> TrainState:
+        """Restore into the template's structure/shardings (executor.py:630
+        load_dict(consider_splits=True) analog — re-sharding is device_put)."""
+        from hetu_tpu.train import checkpoint
+        return checkpoint.load(path, state_template)
+
     def profile(self, state: TrainState, batch, *, name: str = "train",
                 k1: int = 3, k2: int = 9):
         """Per-step timing + compiled cost/collective breakdown.
