@@ -43,6 +43,17 @@ except ImportError:  # pragma: no cover
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def stack_stage_params(per_layer_params, n_stages: int):
+    """[L, ...] stacked layer params → [n_stages, L/n_stages, ...] (shared
+    by the GPipe and 1F1B executors)."""
+    def reshape(leaf):
+        L = leaf.shape[0]
+        assert L % n_stages == 0, (
+            f"{L} layers not divisible by {n_stages} stages")
+        return leaf.reshape(n_stages, L // n_stages, *leaf.shape[1:])
+    return jax.tree_util.tree_map(reshape, per_layer_params)
+
+
 def pipedream_schedule(n_stages: int, n_microbatches: int):
     """1F1B order per stage (reference pipedream_subexecutor.py:25-48).
 
@@ -91,13 +102,7 @@ class GPipe:
 
     def stack_params(self, per_layer_params):
         """[L, ...] stacked layer params → [n_stages, L/n_stages, ...]."""
-        def reshape(leaf):
-            L = leaf.shape[0]
-            assert L % self.n_stages == 0, (
-                f"{L} layers not divisible by {self.n_stages} stages")
-            return leaf.reshape(self.n_stages, L // self.n_stages,
-                                *leaf.shape[1:])
-        return jax.tree_util.tree_map(reshape, per_layer_params)
+        return stack_stage_params(per_layer_params, self.n_stages)
 
     def stack_params_unequal(self, per_layer_params, stage_bounds):
         """Pack UNEQUAL stages (a searcher's Plan.stage_bounds) by padding
