@@ -31,30 +31,7 @@ BATCH = 128
 K1, K2 = 10, 40
 
 
-def _device_watchdog(timeout_s: float = 180.0):
-    """Fail fast (exit 3, no stdout JSON) if the TPU is unreachable —
-    jax.devices() hangs forever when the tunnel is down, which would stall
-    the whole round-end bench run."""
-    import sys
-    import threading
-
-    found = {}
-
-    def probe():
-        try:
-            found["devs"] = jax.devices()
-        except Exception as e:  # pragma: no cover
-            found["err"] = e
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if "devs" not in found:
-        msg = (f"device backend error: {found['err']!r}" if "err" in found
-               else f"device backend unreachable within {timeout_s}s — "
-                    "tunnel down?")
-        print(json.dumps({"error": msg}), file=sys.stderr)
-        raise SystemExit(3)
+from hetu_tpu.utils.platform import device_watchdog as _device_watchdog
 
 
 def main():

@@ -1,0 +1,92 @@
+"""Platform forcing + dead-backend watchdog, shared by every entry point.
+
+The harness presets ``JAX_PLATFORMS=axon`` (a tunneled TPU) and a
+sitecustomize pre-imports jax, which creates two recurring hazards:
+
+1. env vars alone cannot switch platforms after import — only a post-import
+   ``jax.config.update("jax_platforms", ...)`` works (before the first
+   backend query);
+2. the first backend touch (``jax.devices()`` / ``jax.device_count()``)
+   blocks *forever* when the tunnel is down, so unguarded entry points hang
+   until an external timeout kills them.
+
+Counterpart of the reference's device bootstrap in
+``python/hetu/gpu_ops/executor.py`` (wrapped_mpi_nccl_init) — there the
+failure mode is an MPI abort; here it is a silent hang, hence the watchdog.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def device_watchdog(timeout_s: float = 180.0, *, exit_code: int = 3,
+                    label: str = "device backend"):
+    """Touch the backend under a timeout; exit ``exit_code`` fast on a hang.
+
+    Returns the device list on success.  A dead tunnel otherwise hangs the
+    process until the driver's own timeout fires (rc=124) — exiting nonzero
+    quickly is strictly better for any batch runner.
+    """
+    import sys
+
+    import jax
+
+    found = {}
+
+    def probe():
+        try:
+            found["devs"] = jax.devices()
+        except Exception as e:  # pragma: no cover - backend-specific
+            found["err"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "devs" not in found:
+        msg = (f"{label} error: {found['err']!r}" if "err" in found
+               else f"{label} unreachable within {timeout_s}s — tunnel down?")
+        print(msg, file=sys.stderr, flush=True)
+        os._exit(exit_code)
+    return found["devs"]
+
+
+def force_cpu_devices(n_devices: int, timeout_s: float = 120.0):
+    """Force an ``n_devices``-virtual-device CPU backend, safely.
+
+    Sets/repairs ``XLA_FLAGS`` (replacing a stale smaller count), forces the
+    CPU platform via config (env alone is too late once jax is imported),
+    then touches the backend under a watchdog.  Returns the jax module.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(_COUNT_FLAG + r"=(\d+)", flags)
+    if m is None or int(m.group(1)) < n_devices:
+        if m is not None:
+            flags = flags.replace(m.group(0), f"{_COUNT_FLAG}={n_devices}")
+        else:
+            flags = f"{flags} {_COUNT_FLAG}={n_devices}".strip()
+        os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devs = device_watchdog(timeout_s, label="cpu backend")
+    if len(devs) < n_devices:
+        # a backend initialized before we could force flags; one retry after
+        # dropping it (re-init reads the updated XLA_FLAGS + platform config)
+        try:
+            import jax.extend.backend
+            jax.extend.backend.clear_backends()
+        except Exception:
+            pass
+        if jax.device_count() < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices, have {jax.device_count()}; set "
+                f"XLA_FLAGS={_COUNT_FLAG}=N and JAX_PLATFORMS=cpu before "
+                "importing jax")
+    return jax
