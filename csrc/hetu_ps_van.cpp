@@ -142,6 +142,11 @@ void handle_conn(int fd) {
         int id = rd<int32_t>(p);
         int64_t n = ps_table_rows(id) * ps_table_dim(id);
         if (n <= 0) { send_resp(fd, -1, nullptr, 0); break; }
+        // same u32-frame bound as the sparse path: a >=1GiB response would
+        // truncate plen and desync the wire
+        if (n * (int64_t)sizeof(float) > (int64_t)(1u << 30)) {
+          send_resp(fd, -3, nullptr, 0); break;
+        }
         fbuf.resize(n);
         int rc = ps_dense_pull(id, fbuf.data());
         send_resp(fd, rc, fbuf.data(),
@@ -152,7 +157,10 @@ void handle_conn(int fd) {
         int id = rd<int32_t>(p);
         int64_t want = ps_table_rows(id) * ps_table_dim(id);
         int64_t have = (body.data() + blen - p) / (int64_t)sizeof(float);
-        if (want <= 0 || have < want) { send_resp(fd, -3, nullptr, 0); break; }
+        if (want <= 0 || have < want ||
+            want * (int64_t)sizeof(float) > (int64_t)(1u << 30)) {
+          send_resp(fd, -3, nullptr, 0); break;
+        }
         send_resp(fd, ps_dense_push(id, (const float*)p), nullptr, 0);
         break;
       }

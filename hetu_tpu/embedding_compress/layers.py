@@ -123,11 +123,18 @@ class DPQEmbedding(Module):
         out = jnp.einsum("...mc,mcs->...ms", assign, p["codebooks"])
         return out.reshape(*ids.shape, self.dim), {}
 
+    def _serving_logits(self, p):
+        """Logits used for serving argmax; subclasses apply their train-time
+        masking here so serving picks the same codes as the hard path."""
+        return p["logits"]
+
     def to_serving(self, variables):
-        """Compress to the serving form: int8 codes [N, m] + codebooks —
+        """Compress to the serving form: narrow-int codes [N, m] + codebooks —
         the actual memory win (logits are train-time only)."""
         p = variables["params"]
-        codes = jnp.argmax(p["logits"], axis=-1).astype(jnp.int8)
+        # code ids range 0..codes-1, so int8 holds codes <= 128
+        code_dtype = jnp.int8 if self.codes <= 128 else jnp.int16
+        codes = jnp.argmax(self._serving_logits(p), axis=-1).astype(code_dtype)
         return {"params": {}, "state": {"codes": codes,
                                         "codebooks": p["codebooks"]}}
 
@@ -166,6 +173,14 @@ class MGQEEmbedding(DPQEmbedding):
         assign = soft + jax.lax.stop_gradient(hard - soft)
         out = jnp.einsum("...mc,mcs->...ms", assign, p["codebooks"])
         return out.reshape(*ids.shape, self.dim), {}
+
+    def _serving_logits(self, p):
+        # same cold-id code mask as apply(): without it, argmax over the
+        # untrained masked logit entries can emit codes >= cold_codes that the
+        # model never used at train time
+        is_hot = (jnp.arange(self.n) < self.hot_cut)[:, None, None]
+        code_ok = jnp.arange(self.codes) < self.cold_codes
+        return jnp.where(is_hot | code_ok, p["logits"], -1e30)
 
 
 class TensorTrainEmbedding(Module):

@@ -180,6 +180,15 @@ class AdamOptimizer(Optimizer):
         super().__init__(learning_rate, l2reg)
         self.beta1, self.beta2, self.eps = beta1, beta2, eps
 
+    def init_state(self, params):
+        # Slots are float32 from step 0: apply_dense accumulates in float32,
+        # so bf16-initialized slots would change dtype after step 1, forcing
+        # a recompile and breaking buffer donation on step 2.
+        st = super().init_state(params)
+        st["slots"] = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32), st["slots"])
+        return st
+
     def apply_dense(self, p, g, slots, lr, step):
         m, v = slots
         g = g.astype(jnp.float32)

@@ -6,7 +6,9 @@ for server-held embeddings, and records the RNG (seed, seqnum)
 (executor.py:597-617); `load_dict(consider_splits=True)` (:630) re-splits
 tensors when the model-parallel layout changed.
 
-TPU version: the state is one pytree; we save numpy leaves + treedef + RNG.
+TPU version: the state is one pytree; we save numpy leaves + RNG via
+``np.savez`` with a JSON header — no pickle anywhere, so loading an untrusted
+checkpoint cannot execute code (the reference's pickle format can).
 Resharding on load is free — jax.device_put with the current sharding lays
 out each leaf for whatever mesh the restore runs under, which subsumes
 `consider_splits`.  (orbax is available for async multi-host checkpointing;
@@ -15,7 +17,7 @@ this built-in format keeps zero deps and byte-stable tests.)
 
 from __future__ import annotations
 
-import pickle
+import json
 from pathlib import Path
 from typing import Any, Optional
 
@@ -24,7 +26,7 @@ import numpy as np
 
 from hetu_tpu import rng as hrng
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2
 
 
 def state_dict(state) -> dict:
@@ -34,39 +36,92 @@ def state_dict(state) -> dict:
             for path, leaf in flat}
 
 
+def _json_default(o):
+    if isinstance(o, np.generic):
+        return o.item()
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"checkpoint extra must be JSON-serializable; got "
+                    f"{type(o).__name__}")
+
+
+def _is_native(dtype: np.dtype) -> bool:
+    """True when np.savez round-trips the dtype (bf16/fp8 come back as |V)."""
+    return dtype.kind in "biufc" and not dtype.metadata
+
+
+def _lookup_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency; owns bfloat16/float8_* etc.
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def save(path, state, *, extra: Optional[dict] = None) -> None:
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    leaves, treedef = jax.tree_util.tree_flatten(state)
-    payload = {
+    leaves, _ = jax.tree_util.tree_flatten(state)
+    seed, seqnum = hrng.get_seed_status()
+    arrays, dtypes, shapes = {}, [], []
+    for i, l in enumerate(leaves):
+        arr = np.asarray(l)
+        dtypes.append(arr.dtype.name)
+        shapes.append(list(arr.shape))
+        if not _is_native(arr.dtype):
+            # ml_dtypes leaves (bf16, fp8) become opaque |V blobs under savez;
+            # store raw bytes and rebuild from the header dtype on load
+            arr = np.frombuffer(np.ascontiguousarray(arr).tobytes(), np.uint8)
+        arrays[f"leaf_{i}"] = arr
+    header = {
         "version": _FORMAT_VERSION,
-        "leaves": [np.asarray(l) for l in leaves],
-        "rng": hrng.get_seed_status(),
+        "n_leaves": len(leaves),
+        "dtypes": dtypes,
+        "shapes": shapes,
+        "rng": [int(seed), int(seqnum)],
         "extra": extra or {},
     }
+    arrays["header"] = np.frombuffer(
+        json.dumps(header, default=_json_default).encode("utf-8"),
+        dtype=np.uint8)
     with open(path, "wb") as f:
-        pickle.dump(payload, f)
+        np.savez(f, **arrays)
 
 
 def load(path, state_template, *, restore_rng: bool = True):
     """Restore into the structure (and shardings) of `state_template`."""
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
+    with np.load(path, allow_pickle=False) as z:
+        header = json.loads(bytes(z["header"]).decode("utf-8"))
+        if header["version"] > _FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint format version {header['version']} is newer "
+                f"than supported ({_FORMAT_VERSION})")
+        leaves = []
+        for i in range(header["n_leaves"]):
+            arr = z[f"leaf_{i}"]
+            dtype = _lookup_dtype(header["dtypes"][i])
+            if arr.dtype != dtype:  # raw-bytes path (or |V from v2 files)
+                arr = np.frombuffer(arr.tobytes(), dtype).reshape(
+                    header["shapes"][i])
+            leaves.append(arr)
     leaves_t, treedef = jax.tree_util.tree_flatten(state_template)
-    leaves = payload["leaves"]
     if len(leaves) != len(leaves_t):
         raise ValueError(
             f"checkpoint has {len(leaves)} leaves, template {len(leaves_t)}")
     out = []
-    for i, (saved, tmpl) in enumerate(zip(leaves, leaves_t)):
-        arr = np.asarray(saved)
+    for i, (arr, tmpl) in enumerate(zip(leaves, leaves_t)):
         if hasattr(tmpl, "shape") and tuple(arr.shape) != tuple(tmpl.shape):
             raise ValueError(
                 f"checkpoint leaf {i} shape {arr.shape} != template "
                 f"{tuple(tmpl.shape)} — wrong architecture?")
+        if hasattr(tmpl, "dtype") and arr.dtype != tmpl.dtype:
+            # restore into the template's dtype (e.g. old bf16 Adam slots
+            # into the new f32-slot layout) so the state stays dtype-stable
+            arr = arr.astype(tmpl.dtype)
         if hasattr(tmpl, "sharding"):
             arr = jax.device_put(arr, tmpl.sharding)  # re-split for new layout
         out.append(arr)
     if restore_rng:
-        hrng.set_seed_status(*payload["rng"])
+        hrng.set_seed_status(*header["rng"])
     return jax.tree_util.tree_unflatten(treedef, out)
