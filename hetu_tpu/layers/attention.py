@@ -55,7 +55,7 @@ class MultiHeadAttention(Module):
         b, s, h = x.shape
         x = x.astype(self.dtype)
         qkv = ops.linear(x, p["qkv_weight"].astype(self.dtype),
-                         p["qkv_bias"])  # [B,S,3H]
+                         p["qkv_bias"].astype(self.dtype))  # [B,S,3H]
         qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
         q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))  # [B,Hd,S,D]
         if self.attention_impl == "flash" and mask is not None:
@@ -81,5 +81,6 @@ class MultiHeadAttention(Module):
         if train and self.dropout_rate > 0.0:
             out = ops.dropout(out, self.dropout_rate, rng, train=True)
         y = ops.linear(out.astype(self.dtype),
-                       p["out_weight"].astype(self.dtype), p["out_bias"])
+                       p["out_weight"].astype(self.dtype),
+                       p["out_bias"].astype(self.dtype))
         return y, {}

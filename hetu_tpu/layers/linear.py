@@ -42,7 +42,9 @@ class Linear(Module):
         # compute in self.dtype (bf16 on TPU keeps f32 master weights and
         # f32 MXU accumulation via preferred_element_type in ops.linear)
         w = p["weight"].astype(self.dtype)
-        y = ops.linear(x.astype(self.dtype), w, p.get("bias"))
+        b = p.get("bias")
+        y = ops.linear(x.astype(self.dtype), w,
+                       None if b is None else b.astype(self.dtype))
         if self.activation is not None:
             y = self.activation(y)
         return y, {}

@@ -30,6 +30,11 @@ class GPTConfig:
     dropout_rate: float = 0.1
     dtype: object = jnp.float32
     attention_impl: str = "xla"  # 'flash' = Pallas kernel (TPU)
+    remat: bool = False  # recompute each layer in backward: O(L*S*H) residuals
+    # instead of O(L*S^2) attention scores — the jax.checkpoint analog of the
+    # reference's recompute/checkpoint knobs (Galvatron's ckpt flag)
+    remat_policy: str = "full"  # 'full' = save only layer inputs;
+    # 'dots' = also save matmul outputs (recompute elementwise only)
 
 
 class GPTModel(Module):
@@ -73,12 +78,17 @@ class GPTModel(Module):
                                       train=train, rng=k_l)
             return out, None
 
+        if c.remat:
+            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                      if c.remat_policy == "dots" else None)
+            layer = jax.checkpoint(layer, policy=policy)
         keys = (jax.random.split(rng, c.num_layers) if rng is not None
                 else jnp.zeros((c.num_layers, 2), jnp.uint32))
         h, _ = jax.lax.scan(layer, h, (p["blocks"], keys))
-        h = h.astype(jnp.float32)
         h = ops.layer_norm(h, p["ln_f_scale"], p["ln_f_bias"])
-        logits = ops.linear(h, p["tok_emb"].T)  # tied LM head
+        # tied LM head in the compute dtype: an f32 matmul would skip the
+        # MXU bf16 path; CE upcasts to f32 for the reduction
+        logits = ops.linear(h, p["tok_emb"].T.astype(c.dtype))
         return logits, {}
 
     def lm_loss_fn(self):

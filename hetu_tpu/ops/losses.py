@@ -46,8 +46,12 @@ def softmax_cross_entropy(logits, label):
 
 
 def softmax_cross_entropy_sparse(logits, label, ignored_index: int = -1):
-    """Fused softmax+CE on integer labels (gpu_ops/SoftmaxCrossEntropySparse.py)."""
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    """Fused softmax+CE on integer labels (gpu_ops/SoftmaxCrossEntropySparse.py).
+
+    The reduction runs in float32 regardless of logits dtype — bf16
+    log-softmax over a 50k vocab loses the loss signal entirely.
+    """
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     picked = jnp.take_along_axis(
         logp, jnp.maximum(label, 0)[..., None].astype(jnp.int32), axis=-1)[..., 0]
     return jnp.where(label == ignored_index, 0.0, -picked)

@@ -16,11 +16,20 @@ from jax import lax
 
 
 def _acc_dtype(a, b):
-    # bf16 x bf16 accumulates in f32 on the MXU; keep f32 outputs for parity
-    # with the reference's fp32 kernels unless both inputs are low precision.
+    # bf16 x bf16 accumulates in f32 on the MXU — the TPU-native analog of
+    # cuBLAS's fp32 compute type for fp16/bf16 GEMMs.
     if a.dtype == jnp.bfloat16 or b.dtype == jnp.bfloat16:
         return jnp.float32
     return None
+
+
+def _mm(a, b):
+    """Matmul with f32 MXU accumulation, result cast back to the inputs'
+    promoted dtype — a bf16 network stays bf16 (half the HBM traffic on every
+    activation) while each dot still accumulates in full precision."""
+    y = jnp.matmul(a, b, preferred_element_type=_acc_dtype(a, b))
+    out = jnp.promote_types(a.dtype, b.dtype)
+    return y.astype(out) if y.dtype != out else y
 
 
 def matmul(a, b, trans_a: bool = False, trans_b: bool = False):
@@ -29,14 +38,14 @@ def matmul(a, b, trans_a: bool = False, trans_b: bool = False):
         a = a.T
     if trans_b:
         b = b.T
-    return jnp.matmul(a, b, preferred_element_type=_acc_dtype(a, b))
+    return _mm(a, b)
 
 
 def linear(x, w, bias=None, trans_w: bool = False):
     """x @ w (+ bias) — gpu_ops/Linear.py."""
     if trans_w:
         w = w.T
-    y = jnp.matmul(x, w, preferred_element_type=_acc_dtype(x, w))
+    y = _mm(x, w)
     if bias is not None:
         y = y + bias
     return y
@@ -48,7 +57,7 @@ def batch_matmul(a, b, trans_a: bool = False, trans_b: bool = False):
         a = jnp.swapaxes(a, -1, -2)
     if trans_b:
         b = jnp.swapaxes(b, -1, -2)
-    return jnp.matmul(a, b, preferred_element_type=_acc_dtype(a, b))
+    return _mm(a, b)
 
 
 def addmm(input_, a, b, alpha: float = 1.0, beta: float = 1.0):

@@ -37,11 +37,16 @@ def batch_norm(x, scale, bias, running_mean, running_var, *,
 
 
 def layer_norm(x, scale, bias, *, eps: float = 1e-5, axis: int = -1):
-    """Layer norm over the trailing axis (gpu_ops/LayerNorm.py)."""
-    mean = jnp.mean(x, axis=axis, keepdims=True)
-    var = jnp.var(x, axis=axis, keepdims=True)
-    y = (x - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
-    return y * scale + bias
+    """Layer norm over the trailing axis (gpu_ops/LayerNorm.py).
+
+    Stats are computed in float32 (bf16 mean/var underflows), but the result
+    is cast back to x.dtype so a bf16 residual stream stays bf16 end to end.
+    """
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
+    y = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (y * scale + bias).astype(x.dtype)
 
 
 def instance_norm2d(x, *, eps: float = 1e-7):

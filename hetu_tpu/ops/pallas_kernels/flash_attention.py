@@ -1,24 +1,37 @@
-"""Flash attention forward kernel (Pallas/TPU).
+"""Flash attention forward + fused backward kernels (Pallas/TPU).
 
 The reference has no fused attention (its MHA composes batch_matmul +
 softmax ops, layers/attention.py); on TPU the fusion matters because the
-[S, S] score matrix otherwise round-trips HBM.  This kernel streams K/V
-BLOCKS through VMEM — grid = (batch*heads, q_blocks, k_blocks) with the k
+[S, S] score matrix otherwise round-trips HBM.  The forward streams K/V
+blocks through VMEM — grid = (batch*heads, q_blocks, k_blocks) with the k
 dimension innermost, online-softmax state held in VMEM scratch across the
 k iterations — so VMEM usage is O(block_q * D + block_k * D) regardless of
-sequence length.
+sequence length.  It also emits the log-sum-exp rows (LSE), which the
+backward uses to recompute probabilities tile-by-tile.
+
+Backward is the standard FlashAttention-2 two-kernel scheme:
+
+  * delta = rowsum(dO * O)                       (one cheap XLA reduction)
+  * dK/dV kernel: grid (bh, k_blocks, q_blocks), accumulating
+        p   = exp(q k^T * scale - lse)
+        dv += p^T dO
+        ds  = p * (dO v^T - delta) * scale
+        dk += ds^T q
+    in VMEM f32 scratch across the q iterations;
+  * dQ kernel: grid (bh, q_blocks, k_blocks), accumulating dq += ds k.
+
+No O(S^2) tensor ever touches HBM in either direction — this beats the
+reference's training memory profile (its attention materializes scores for
+the backward), and it is what makes S >= 8k practical on one chip.
 
 Causal masking is BOTTOM-RIGHT aligned (query i attends to keys
 <= i + (S_k - S_q)), matching ops.causal_attention, so cross-length
-(prefix/KV-cache) calls agree with the oracle in both forward and the
-recompute backward.
-
-Scope: forward fusion + custom_vjp whose backward recomputes through the
-XLA composition in hetu_tpu/ops/attention.py (single source of truth for
-attention semantics; saves the forward's O(S^2) HBM traffic — the
-memory-optimal *training* path for very long sequences is ring attention,
-hetu_tpu/parallel/ring_attention.py).  Interpret mode runs the same kernel
-on CPU for correctness tests.
+(prefix/KV-cache) calls agree with the oracle in both directions — except
+query rows whose mask hides EVERY key (only possible when s_q > s_k):
+there the kernel returns 0 output and 0 gradients, whereas the XLA
+composition softmaxes the uniform -1e30 scores into garbage averages.
+Zero is the deliberate semantics for an all-masked row.
+Interpret mode runs the same kernels on CPU for correctness tests.
 """
 
 from __future__ import annotations
@@ -31,19 +44,19 @@ from jax import lax
 
 from jax.experimental import pallas as pl
 
-from hetu_tpu.ops.attention import attention as _xla_attention
-from hetu_tpu.ops.attention import causal_attention as _xla_causal_attention
-
 NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                      block_q: int, block_k: int, scale: float, causal: bool,
-                      causal_offset: int):
+# ---------------------------------------------------------------- forward
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                      l_ref, *, block_q: int, block_k: int, scale: float,
+                      causal: bool, causal_offset: int):
     """Program (bh, qi, ki): one [block_q, block_k] tile of the attention.
 
     q_ref [block_q, D]; k_ref/v_ref [block_k, D]; o_ref [block_q, D];
-    acc/m/l: VMEM scratch carrying online-softmax state across ki.
+    lse_ref [block_q]; acc/m/l: VMEM scratch carrying online-softmax state
+    across ki.
     """
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -61,11 +74,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(live)
     def _():
-        q = q_ref[:].astype(jnp.float32) * scale
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        scores = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+        # dots stay in the input dtype (bf16 hits the fast MXU path) with
+        # f32 accumulation; scale is applied to the f32 scores
+        scores = lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * block_q + causal_offset + \
                 lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -82,21 +94,34 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1)
         m_ref[:] = m_new
         acc_ref[:] = acc_ref[:] * corr[:, None] + lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == n_k - 1)
     def _():
         l = jnp.maximum(l_ref[:], 1e-20)
         o_ref[:] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[:] = (m_ref[:] + jnp.log(l))[:, None]
+
+
+def _fit_block(s: int, want: int) -> int:
+    """Largest block <= want dividing s: s itself when s <= want, else the
+    first halving of want that divides s (>=8 for TPU tiles)."""
+    b = min(want, s)
+    while b > 8 and s % b:
+        b //= 2
+    if s % b:
+        raise ValueError(
+            f"sequence length {s} is not divisible by any block size <= "
+            f"{want}; pad the sequence (flash blocks must tile it exactly)")
+    return b
 
 
 def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
-    bq = min(block_q, s_q)
-    bk = min(block_k, s_k)
-    assert s_q % bq == 0 and s_k % bk == 0, (s_q, bq, s_k, bk)
+    bq = _fit_block(s_q, block_q)
+    bk = _fit_block(s_k, block_k)
 
     qf = q.reshape(b * h, s_q, d)
     kf = k.reshape(b * h, s_k, d)
@@ -105,7 +130,7 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=bq, block_k=bk, scale=scale,
         causal=causal, causal_offset=s_k - s_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, s_q // bq, s_k // bk),
         in_specs=[
@@ -113,12 +138,21 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((None, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((None, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            # TPU blocks need the trailing dims (8,128)-aligned or full; a
+            # trailing singleton keeps the row vector legal: block (bq, 1)
+            pl.BlockSpec((None, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32),
+        ],
         scratch_shapes=_scratch(bq, d),
+        compiler_params=_params(),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, s_q, d)
+    return out.reshape(b, h, s_q, d), lse
 
 
 def _scratch(bq, d):
@@ -128,45 +162,227 @@ def _scratch(bq, d):
             pltpu.VMEM((bq,), jnp.float32)]
 
 
+def _params():
+    """bh and the outer block axis are parallel; the innermost axis carries
+    the VMEM accumulator and must run in order."""
+    from jax.experimental.pallas import tpu as pltpu
+    try:
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    except TypeError:  # older API name
+        return pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+# ---------------------------------------------------------------- backward
+
+def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, *, block_q, block_k, scale,
+                 causal, causal_offset):
+    """Recompute one probability tile p = exp(q k^T * scale - lse)."""
+    scores = lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = qi * block_q + causal_offset + \
+            lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + \
+            lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+    p = jnp.exp(scores - lse_ref[:])  # lse block is [bq, 1]
+    if causal:
+        # guard fully-masked rows: lse there is ~NEG_INF and the subtraction
+        # above would overflow exp
+        p = jnp.where(scores <= NEG_INF / 2, 0.0, p)
+    return p, scores
+
+
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                           block_k: int, scale: float, causal: bool,
+                           causal_offset: int):
+    """Program (bh, ki, qi): accumulate dk/dv for one k block over q blocks."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_last = (qi + 1) * block_q - 1 + causal_offset
+    k_first = ki * block_k
+    live = (not causal) or (k_first <= q_last)
+
+    @pl.when(live)
+    def _():
+        p, _ = _recompute_p(q_ref, k_ref, lse_ref, qi, ki, block_q=block_q,
+                            block_k=block_k, scale=scale, causal=causal,
+                            causal_offset=causal_offset)
+        pc = p.astype(do_ref.dtype)
+        # dv += p^T dO
+        dv_acc[:] += lax.dot_general(pc, do_ref[:], (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        # dp = dO v^T ; ds = p * (dp - delta) * scale
+        dp = lax.dot_general(do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[:]) * scale).astype(q_ref.dtype)
+        # dk += ds^T q
+        dk_acc[:] += lax.dot_general(ds, q_ref[:], (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, block_q: int, block_k: int,
+                         scale: float, causal: bool, causal_offset: int):
+    """Program (bh, qi, ki): accumulate dq for one q block over k blocks."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_last = (qi + 1) * block_q - 1 + causal_offset
+    k_first = ki * block_k
+    live = (not causal) or (k_first <= q_last)
+
+    @pl.when(live)
+    def _():
+        p, _ = _recompute_p(q_ref, k_ref, lse_ref, qi, ki, block_q=block_q,
+                            block_k=block_k, scale=scale, causal=causal,
+                            causal_offset=causal_offset)
+        dp = lax.dot_general(do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[:]) * scale).astype(k_ref.dtype)
+        # dq += ds k
+        dq_acc[:] += lax.dot_general(ds, k_ref[:], (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _():
+        dq_ref[:] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, *, scale, causal, block_q, block_k,
+               interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    bq = _fit_block(s_q, block_q)
+    bk = _fit_block(s_k, block_k)
+
+    qf = q.reshape(b * h, s_q, d)
+    kf = k.reshape(b * h, s_k, d)
+    vf = v.reshape(b * h, s_k, d)
+    dof = g.reshape(b * h, s_q, d)
+    # delta = rowsum(dO * O): one fused elementwise+reduce, O(S*D) traffic
+    delta = jnp.sum(dof.astype(jnp.float32)
+                    * out.reshape(b * h, s_q, d).astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    common = dict(block_q=bq, block_k=bk, scale=scale, causal=causal,
+                  causal_offset=s_k - s_q)
+
+    # dK/dV kernel: grid (bh, ki, qi) — q blocks innermost
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkdv_kernel, **common),
+        grid=(b * h, s_k // bk, s_q // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, d), lambda bh, ki, qi: (bh, qi, 0)),  # q
+            pl.BlockSpec((None, bk, d), lambda bh, ki, qi: (bh, ki, 0)),  # k
+            pl.BlockSpec((None, bk, d), lambda bh, ki, qi: (bh, ki, 0)),  # v
+            pl.BlockSpec((None, bq, d), lambda bh, ki, qi: (bh, qi, 0)),  # dO
+            pl.BlockSpec((None, bq, 1), lambda bh, ki, qi: (bh, qi, 0)),  # lse
+            pl.BlockSpec((None, bq, 1), lambda bh, ki, qi: (bh, qi, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((None, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_k, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=_params(),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    # dQ kernel: grid (bh, qi, ki) — k blocks innermost
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(b * h, s_q // bq, s_k // bk),
+        in_specs=[
+            pl.BlockSpec((None, bq, d), lambda bh, qi, ki: (bh, qi, 0)),  # q
+            pl.BlockSpec((None, bk, d), lambda bh, qi, ki: (bh, ki, 0)),  # k
+            pl.BlockSpec((None, bk, d), lambda bh, qi, ki: (bh, ki, 0)),  # v
+            pl.BlockSpec((None, bq, d), lambda bh, qi, ki: (bh, qi, 0)),  # dO
+            pl.BlockSpec((None, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),  # lse
+            pl.BlockSpec((None, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),  # delta
+        ],
+        out_specs=pl.BlockSpec((None, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_params(),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    return (dq.reshape(b, h, s_q, d), dk.reshape(b, h, s_k, d),
+            dv.reshape(b, h, s_k, d))
+
+
+# ---------------------------------------------------------------- public op
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
-                      block_k=block_k, interpret=interpret)
+    out, _ = _flash_fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                        block_k=block_k, interpret=interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = _flash(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # recompute-backward through the shared XLA composition (ops/attention.py
-    # — also bottom-right causal); memory O(S^2) during bwd, see docstring
-    if causal:
-        ref = lambda q, k, v: _xla_causal_attention(q, k, v, scale=scale)
-    else:
-        ref = lambda q, k, v: _xla_attention(q, k, v, scale=scale)
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k, interpret=interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False, scale=None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 256, block_k: int = 256,
                     interpret=None):
     """Fused attention: q,k,v [B, H, S, D] → [B, H, S_q, D].
 
+    Fully fused in both directions: forward streams K/V blocks with online
+    softmax; backward recomputes probability tiles from the saved LSE
+    (FlashAttention-2) — no O(S^2) tensor in HBM either way.
+
     interpret=None auto-selects: real kernel on TPU, interpret mode
-    elsewhere.  Sequence lengths must be multiples of the block sizes
-    (pad upstream; hetu_tpu keeps static shapes everywhere).  Causal
-    masking is bottom-right aligned for S_q != S_k.
+    elsewhere.  Block sizes auto-fit down to the sequence length (any S
+    divisible by a power-of-two >= 8 works; only truly odd lengths need
+    upstream padding).  Causal masking is bottom-right aligned for
+    S_q != S_k.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
+        from hetu_tpu.utils.platform import default_backend_is_tpu
+        interpret = not default_backend_is_tpu()
     return _flash(q, k, v, float(scale), bool(causal), int(block_q),
                   int(block_k), bool(interpret))

@@ -55,6 +55,25 @@ def device_watchdog(timeout_s: float = 180.0, *, exit_code: int = 3,
     return found["devs"]
 
 
+def default_backend_is_tpu() -> bool:
+    """Whether the default backend is a real TPU (cached after first call).
+
+    Used by kernels to auto-select compiled vs interpret mode.  Callers are
+    expected to be on an execution path where the backend is already live
+    (inside/around jit) — entry points that might race a dead tunnel should
+    go through :func:`device_watchdog` first.
+    """
+    global _IS_TPU
+    if _IS_TPU is None:
+        import jax
+
+        _IS_TPU = jax.default_backend() == "tpu"
+    return _IS_TPU
+
+
+_IS_TPU = None
+
+
 def force_cpu_devices(n_devices: int, timeout_s: float = 120.0):
     """Force an ``n_devices``-virtual-device CPU backend, safely.
 
@@ -71,6 +90,8 @@ def force_cpu_devices(n_devices: int, timeout_s: float = 120.0):
             flags = f"{flags} {_COUNT_FLAG}={n_devices}".strip()
         os.environ["XLA_FLAGS"] = flags
     os.environ["JAX_PLATFORMS"] = "cpu"
+    global _IS_TPU
+    _IS_TPU = False  # invalidate the backend-kind cache: we just switched
 
     import jax
 

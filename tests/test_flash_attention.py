@@ -82,3 +82,35 @@ def test_flash_bf16():
                            v.astype(jnp.float32))
     np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
                                rtol=5e-2, atol=5e-2)
+
+
+def test_flash_block_autofit():
+    """S not divisible by the default 256 block auto-fits down (S=384 -> 128)."""
+    q, k, v = qkv(S=384, seed=5)
+    out = flash_attention(q, k, v, causal=True)
+    ref = causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_flash_fully_masked_rows_zero():
+    """s_q > s_k bottom-right causal: rows that see no key return 0 output
+    and 0 grads (the XLA composition instead softmaxes -inf rows into a
+    garbage average — zero is the deliberate kernel semantics)."""
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(ks[0], (1, 2, 96, 32))
+    k = jax.random.normal(ks[1], (1, 2, 32, 32))
+    v = jax.random.normal(ks[2], (1, 2, 32, 32))
+    out, vjp = jax.vjp(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=32,
+                                        block_k=32), q, k, v)
+    # offset = 32 - 96 = -64: query rows 0..63 see no keys
+    np.testing.assert_array_equal(np.asarray(out[:, :, :64]), 0.0)
+    ref = causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out[:, :, 64:]),
+                               np.asarray(ref[:, :, 64:]), rtol=2e-4,
+                               atol=2e-5)
+    dq, dk, dv = vjp(jnp.ones_like(out))
+    np.testing.assert_array_equal(np.asarray(dq[:, :, :64]), 0.0)
+    assert np.all(np.isfinite(np.asarray(dk)))
+    assert np.all(np.isfinite(np.asarray(dv)))
