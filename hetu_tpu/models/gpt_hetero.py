@@ -37,6 +37,7 @@ from hetu_tpu.layers.transformer import TransformerBlock
 from hetu_tpu.models.gpt import GPTConfig, GPTModel
 from hetu_tpu.parallel.strategies.base import Strategy
 from hetu_tpu.parallel.strategies.search import Plan
+from hetu_tpu.profiler.simulator import ShardOption
 
 
 class HeteroGPT(GPTModel):
@@ -86,6 +87,21 @@ class HeteroGPT(GPTModel):
 _LAYER_RE = re.compile(r"\['layer(\d+)'\]")
 
 
+def _add_dp_axis(spec: P, ndim: int) -> P:
+    """Shard the first unsharded dim over 'dp' (FSDP/ZeRO param slicing).
+
+    Combined with tp: e.g. qkv [H,3H] tp_col P(None,'tp') -> P('dp','tp');
+    ffn_out [F,H] tp_row P('tp',None) -> P('tp','dp').  Dims that don't
+    divide fall back to replication in Strategy._fit.
+    """
+    dims = list(spec) + [None] * (ndim - len(spec))
+    for i, e in enumerate(dims):
+        if e is None:
+            dims[i] = "dp"
+            return P(*dims)
+    return spec  # every dim already sharded
+
+
 class PlanStrategy(Strategy):
     """Adapt a searched Plan to per-layer PartitionSpecs.
 
@@ -93,12 +109,22 @@ class PlanStrategy(Strategy):
     in order, skipping non-transformer entries (embed/head LayerSpecs).
     Layers whose option has tp > 1 get Megatron col/row splits; 'dp'
     layers stay replicated (grad-allreduce DP via the sharded batch).
+
+    Per-layer dp_type executes Galvatron's DP-flavor axis
+    (core/hybrid_parallel_config.py:26,70,76 / comm_groups.py:58-196):
+      'sdp'   — params sharded over the dp mesh axis too (FSDP): XLA SPMD
+                inserts the param allgathers and gradient reduce_scatters;
+      'zero1' — params replicated but optimizer slots sharded over dp
+                (slot_spec below): the slot update runs shard-wise and XLA
+                allgathers the updated params.
+    embed_sdp mirrors the reference's flag: apply sdp to the (untied
+    position/token) embedding tables as well.
     """
 
     COL = ("qkv_weight", "qkv_bias")
     ROW = ("out_weight",)
 
-    def __init__(self, plan: Plan):
+    def __init__(self, plan: Plan, *, embed_sdp: bool = False):
         if plan.stage_bounds or plan.meta.get("pp", 1) > 1:
             raise ValueError(
                 "plan carries pipeline stages; PlanStrategy executes the "
@@ -108,20 +134,22 @@ class PlanStrategy(Strategy):
         # head]; keep attn and ffn tp SEPARATE so the executed layout is
         # exactly what the searcher costed
         body = plan.layer_options[1:-1]
-        self.block_tp = {}
+        self.block_opt = {}
         for li in range(len(body) // 2):
-            attn, ffn = body[2 * li], body[2 * li + 1]
-            self.block_tp[li] = (attn.tp, ffn.tp)
+            self.block_opt[li] = (body[2 * li], body[2 * li + 1])
+        self.embed_sdp = embed_sdp
 
-    def param_spec(self, path, leaf):
+    def _layer_opt(self, path):
         m = _LAYER_RE.search(path)
-        ndim = leaf.ndim if hasattr(leaf, "ndim") else len(leaf.shape)
         if not m:
-            return P()
-        attn_tp, ffn_tp = self.block_tp.get(int(m.group(1)), (1, 1))
+            return None
+        attn_opt, ffn_opt = self.block_opt.get(
+            int(m.group(1)), (ShardOption("dp"), ShardOption("dp")))
         is_attn = "attn" in path or any(k in path for k in
                                         self.COL + self.ROW)
-        tp = attn_tp if is_attn else ffn_tp
+        return attn_opt if is_attn else ffn_opt
+
+    def _tp_spec(self, path, ndim, tp):
         if tp <= 1:
             return P()
         if any(k in path for k in self.COL) or "ffn_in" in path:
@@ -131,3 +159,25 @@ class PlanStrategy(Strategy):
             if ndim >= 2:
                 return P(*((None,) * (ndim - 2)), "tp", None)
         return P()
+
+    def param_spec(self, path, leaf):
+        ndim = leaf.ndim if hasattr(leaf, "ndim") else len(leaf.shape)
+        opt = self._layer_opt(path)
+        if opt is None:
+            if self.embed_sdp and ("tok_emb" in path or "pos_emb" in path):
+                return _add_dp_axis(P(), ndim)
+            return P()
+        spec = self._tp_spec(path, ndim, opt.tp)
+        if opt.dp_type == "sdp":
+            spec = _add_dp_axis(spec, ndim)
+        return spec
+
+    def slot_spec(self, path, leaf):
+        ndim = leaf.ndim if hasattr(leaf, "ndim") else len(leaf.shape)
+        opt = self._layer_opt(path)
+        if opt is None:
+            return self.param_spec(path, leaf)
+        spec = self._tp_spec(path, ndim, opt.tp)
+        if opt.dp_type in ("sdp", "zero1"):
+            spec = _add_dp_axis(spec, ndim)
+        return spec

@@ -142,6 +142,69 @@ def audit(fn, *args, static_argnums=(), donate_argnums=()) -> PlanAudit:
     return result
 
 
+def verify_spec_transition(mesh, shape, src, dst, dtype=None):
+    """Assert XLA realizes a src→dst ShardSpec transition with the collective
+    the NodeStatus algebra predicts (spec.predict_collective).
+
+    This is the executable bridge between the reference's pattern checks
+    (context.py:769-783) and GSPMD: we build the minimal program whose
+    producer has spec `src` (partial specs are produced authentically, by a
+    matmul whose contraction dim is sharded over the partial axes) and whose
+    consumer demands `dst`, audit the compiled HLO, and compare.
+
+    Returns (predicted_kind, audited_kinds).  Raises AssertionError on
+    mismatch — a failing searcher/strategy would mis-price its plan.
+    """
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hetu_tpu.parallel.spec import predict_collective
+
+    dtype = dtype or jnp.float32
+    pred = predict_collective(src, dst)
+    dst_sh = NamedSharding(mesh, dst.pspec())
+
+    if src.partial:
+        # authentic partial producer: y = x @ w with the contraction dim
+        # sharded over the partial axes — each device holds a partial sum
+        k = 8 * int(np.prod([mesh.shape[a] for a in src.partial]))
+        x = jnp.ones((shape[0], k), dtype)
+        w = jnp.ones((k,) + tuple(shape[1:]), dtype)
+        x = jax.device_put(x, NamedSharding(mesh, P(src.dims[0],
+                                                    src.partial)))
+        w = jax.device_put(w, NamedSharding(mesh, P(src.partial,
+                                                    *src.dims[1:])))
+
+        def prog(x, w):
+            return jax.lax.with_sharding_constraint(x @ w, dst_sh)
+
+        a = audit(prog, x, w)
+    else:
+        x = jax.device_put(jnp.ones(shape, dtype),
+                           NamedSharding(mesh, src.pspec()))
+
+        def prog(x):
+            return jax.lax.with_sharding_constraint(x * 2, dst_sh)
+
+        a = audit(prog, x)
+
+    audited = sorted({c.kind for c in a.collectives})
+    if pred is None:
+        assert audited in ([], ["collective-permute"]), (
+            f"algebra predicts a local transition but XLA inserted "
+            f"{audited}")
+        return None, audited
+    kind = pred[0]
+    # GSPMD may realize a reduce-scatter as all-reduce + local slice (it
+    # does on the CPU backend); that is the same pattern priced pessimally,
+    # so accept the superset collective for the RS check
+    ok = {kind} | ({"all-reduce"} if kind == "reduce-scatter" else set())
+    assert ok & set(audited), (
+        f"algebra predicts {kind} for {src}→{dst} but XLA inserted "
+        f"{audited or 'nothing'}")
+    return kind, audited
+
+
 def report(audit_result: PlanAudit, *, chip: Optional[ChipSpec] = None,
            n_devices: int = 8) -> str:
     lines = [f"flops/step:        {audit_result.flops:.3e}",

@@ -104,6 +104,28 @@ class ShardSpec:
 NodeStatus = ShardSpec
 
 
+def predict_collective(src: ShardSpec, dst: ShardSpec):
+    """Which collective a src→dst transition needs, by the NodeStatus
+    pattern checks (context.py:769-783 check_allreduce/allgather + the
+    reduce-scatter special case).
+
+    Returns (kind, detail) with kind in {'all-reduce', 'reduce-scatter',
+    'all-gather'} or None when the transition is local (slice/no-op).
+    The planner's audit asserts XLA's SPMD partitioner inserts exactly
+    this collective — see parallel.planner.verify_spec_transition.
+    """
+    ar = src.check_allreduce(dst)
+    if ar is not None:
+        return ("all-reduce", ar)
+    rs = src.check_reducescatter(dst)
+    if rs is not None:
+        return ("reduce-scatter", rs)
+    ag = src.check_allgather(dst)
+    if ag is not None:
+        return ("all-gather", ag)
+    return None
+
+
 def constrain(x, mesh: Mesh, spec: ShardSpec):
     """with_sharding_constraint under a spec — the annotation primitive the
     planner uses where the reference inserted comm ops."""

@@ -20,12 +20,36 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _fit(spec: P, leaf, mesh: Mesh) -> NamedSharding:
+    """Drop spec entries whose mesh-axis product does not divide the dim."""
+    dims = []
+    for i, entry in enumerate(spec):
+        if i >= leaf.ndim:
+            break  # truncate over-long specs (NamedSharding rejects
+                   # len(spec) > rank even with trailing Nones)
+        if entry is None:
+            dims.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        k = 1
+        for a in axes:
+            k *= mesh.shape[a]
+        dims.append(entry if leaf.shape[i] % k == 0 else None)
+    return NamedSharding(mesh, P(*dims))
+
+
 class Strategy:
     """Assign PartitionSpecs to parameters by tree-path pattern."""
 
     def param_spec(self, path: str, leaf) -> P:
         """Override: spec for one parameter, by its tree path string."""
         return P()
+
+    def slot_spec(self, path: str, leaf) -> P:
+        """Spec for one optimizer slot — defaults to the param's spec.
+        Override for ZeRO-1 style layouts where slots shard over dp while
+        params stay replicated."""
+        return self.param_spec(path, leaf)
 
     def batch_spec(self) -> P:
         return P("dp")
@@ -42,25 +66,17 @@ class Strategy:
         assigned axis product fall back to replication (the reference
         requires divisible splits — we degrade gracefully instead, e.g. a
         10-class FC head under tp=4)."""
-        def fit(spec: P, leaf) -> NamedSharding:
-            dims = []
-            for i, entry in enumerate(spec):
-                if i >= leaf.ndim:
-                    break  # truncate over-long specs (NamedSharding rejects
-                           # len(spec) > rank even with trailing Nones)
-                if entry is None:
-                    dims.append(None)
-                    continue
-                axes = entry if isinstance(entry, tuple) else (entry,)
-                k = 1
-                for a in axes:
-                    k *= mesh.shape[a]
-                dims.append(entry if leaf.shape[i] % k == 0 else None)
-            return NamedSharding(mesh, P(*dims))
-
         return jax.tree_util.tree_map(
-            fit, self.param_specs(params), params,
+            lambda spec, leaf: _fit(spec, leaf, mesh),
+            self.param_specs(params), params,
             is_leaf=lambda x: isinstance(x, P))
+
+    def slot_shardings(self, params, mesh: Mesh) -> Any:
+        """NamedShardings for optimizer slots (one tree, reused per slot)."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        shs = [_fit(self.slot_spec(jax.tree_util.keystr(path), leaf), leaf,
+                    mesh) for path, leaf in flat]
+        return jax.tree_util.tree_unflatten(treedef, shs)
 
     def place(self, params, mesh: Mesh):
         """device_put the parameter tree according to this strategy."""

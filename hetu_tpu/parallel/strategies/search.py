@@ -37,7 +37,8 @@ class Plan:
 
     def to_json(self, layers: Sequence[LayerSpec]) -> str:
         return json.dumps({
-            "layers": {l.name: {"kind": o.kind, "tp": o.tp}
+            "layers": {l.name: {"kind": o.kind, "tp": o.tp,
+                                "dp_type": o.dp_type}
                        for l, o in zip(layers, self.layer_options)},
             "stage_bounds": self.stage_bounds,
             "dp": self.dp,
@@ -53,7 +54,9 @@ class Plan:
     def load(path, layers: Sequence[LayerSpec]) -> "Plan":
         d = json.loads(Path(path).read_text())
         opts = [ShardOption(d["layers"][l.name]["kind"],
-                            d["layers"][l.name]["tp"]) for l in layers]
+                            d["layers"][l.name]["tp"],
+                            d["layers"][l.name].get("dp_type", "dp"))
+                for l in layers]
         return Plan(opts, d["stage_bounds"], d["dp"], d["n_microbatches"],
                     d["predicted_time"], d.get("meta", {}))
 
@@ -264,16 +267,15 @@ class GalvatronSearching:
         B = max(self.buckets, 4 * len(layers))
         unit = self.budget / B
         INF = float("inf")
-        # dp[b] = (time, choices) best using <= b*unit memory
-        dp: List[Tuple[float, List[Tuple[ShardOption, bool]]]] = \
-            [(0.0, [])] + [(INF, [])] * B
-        dp = [(0.0, [])] * 1 + [(INF, [])] * B
-        cur = {0: (0.0, [])}
+        cur = {0: (0.0, [])}  # used_buckets -> (time, choices)
+        dp_types = ("dp", "zero1", "sdp") if self.dp > 1 else ("dp",)
         for layer in layers:
             nxt: Dict[int, Tuple[float, List]] = {}
             for used, (t_acc, choices) in cur.items():
-                for opt in layer.options:
+                for base_opt in layer.options:
                     for remat in (False, True):
+                      for dpt in dp_types:
+                        opt = ShardOption(base_opt.kind, base_opt.tp, dpt)
                         mem = self.sim.layer_memory(layer, opt, self.dp,
                                                     remat=remat)
                         nb = used + max(1, int(math.ceil(mem / unit)))
@@ -300,6 +302,7 @@ class GalvatronSearching:
                     predicted_time=t_total,
                     meta={"searcher": "galvatron",
                           "remat": [c[1] for c in choices],
+                          "dp_types": [c[0].dp_type for c in choices],
                           "memory_buckets_used": used,
                           "budget_bytes": self.budget})
         return plan

@@ -32,13 +32,23 @@ class ShardOption:
     'col' (split output dim; needs allgather of output or stays split),
     'row' (split input dim; needs psum of output), 'seq' (sequence split;
     ring comm amortized into compute).
+
+    dp_type is Galvatron's per-layer data-parallel flavor
+    (tools/Galvatron/galvatron/core/hybrid_parallel_config.py:26,70):
+      'dp'    — replicated params, gradient allreduce;
+      'zero1' — optimizer state sharded over dp (ZeRO-1): same comm, slots
+                memory / dp;
+      'sdp'   — fully sharded (FSDP/ZeRO-3): params+grads+slots / dp, comm
+                becomes allgather(fwd) + allgather(bwd) + reduce_scatter
+                (~1.5x the allreduce bytes).
     """
 
     kind: str           # 'dp' | 'tp_col' | 'tp_row' | 'replicate' | 'seq'
     tp: int = 1
+    dp_type: str = "dp"  # 'dp' | 'zero1' | 'sdp'
 
     def key(self):
-        return (self.kind, self.tp)
+        return (self.kind, self.tp, self.dp_type)
 
 
 @dataclass
@@ -67,8 +77,14 @@ class Simulator:
         compute *= self.cal
         t = compute
         if train and dp > 1:
-            # gradient allreduce over dp, overlappable but bounded by wire
-            t += allreduce_time(self.chip, layer.param_bytes, dp)
+            if opt.dp_type == "sdp":
+                # FSDP: allgather params fwd + bwd, reduce_scatter grads —
+                # ~1.5x the allreduce wire bytes (ring AR = AG + RS)
+                t += 1.5 * allreduce_time(self.chip, layer.param_bytes, dp)
+            else:
+                # 'dp' and 'zero1' both move allreduce-equivalent bytes
+                # (zero1 = reduce_scatter grads + allgather updated params)
+                t += allreduce_time(self.chip, layer.param_bytes, dp)
         if opt.kind == "tp_row" and opt.tp > 1:
             t += allreduce_time(self.chip, layer.act_bytes / dp, opt.tp)
         if opt.kind == "tp_col" and opt.tp > 1:
@@ -118,10 +134,14 @@ class Simulator:
     # ---- memory ----
     def layer_memory(self, layer: LayerSpec, opt: ShardOption, dp: int,
                      *, optimizer_slots: int = 2, remat: bool = False) -> float:
-        shards = opt.tp
-        params = layer.param_bytes / shards
+        dp = max(dp, 1)
+        params = layer.param_bytes / opt.tp
+        if opt.dp_type == "sdp":
+            params /= dp
         opt_state = params * optimizer_slots
-        acts = 0.0 if remat else layer.act_bytes / max(dp, 1) / max(opt.tp, 1)
+        if opt.dp_type == "zero1":  # slots sharded, params replicated
+            opt_state /= dp
+        acts = 0.0 if remat else layer.act_bytes / dp / max(opt.tp, 1)
         return params + opt_state + acts
 
 

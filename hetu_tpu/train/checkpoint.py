@@ -91,7 +91,15 @@ def save(path, state, *, extra: Optional[dict] = None) -> None:
 
 def load(path, state_template, *, restore_rng: bool = True):
     """Restore into the structure (and shardings) of `state_template`."""
-    with np.load(path, allow_pickle=False) as z:
+    try:
+        z = np.load(path, allow_pickle=False)
+    except ValueError as e:
+        raise ValueError(
+            f"{path} is not a v2 (npz) checkpoint — v1 checkpoints were "
+            "pickle files; re-save with this version's save() (v1 loading is "
+            "not supported because unpickling executes arbitrary code)"
+        ) from e
+    with z:
         header = json.loads(bytes(z["header"]).decode("utf-8"))
         if header["version"] > _FORMAT_VERSION:
             raise ValueError(

@@ -118,7 +118,11 @@ class Executor:
         if self.dist_strategy is not None:
             sh = self.dist_strategy.shardings(state.params, self.mesh)
             placed = jax.tree_util.tree_map(jax.device_put, state.params, sh)
-            slots = {k: jax.tree_util.tree_map(jax.device_put, v, sh)
+            # slots get their own shardings: under ZeRO-1 they shard over dp
+            # while the params they mirror stay replicated
+            slot_sh = self.dist_strategy.slot_shardings(state.params,
+                                                        self.mesh)
+            slots = {k: jax.tree_util.tree_map(jax.device_put, v, slot_sh)
                      for k, v in state.opt_state.get("slots", {}).items()} \
                 if isinstance(state.opt_state, dict) else {}
             opt_state2 = (dict(state.opt_state, slots=slots)
