@@ -41,7 +41,12 @@ extern "C" {
 
 // ---------------------------------------------------------------- tables
 
-enum OptKind { OPT_SGD = 0, OPT_MOMENTUM = 1, OPT_ADAGRAD = 2, OPT_ADAM = 3 };
+// Server-side optimizers (reference ps-lite/include/ps/server/optimizer.h:
+// SGD, Momentum, Nesterov, AdaGrad, Adam — all five).
+enum OptKind {
+  OPT_SGD = 0, OPT_MOMENTUM = 1, OPT_ADAGRAD = 2, OPT_ADAM = 3,
+  OPT_NESTEROV = 4,
+};
 
 struct Table {
   int64_t rows = 0, dim = 0;
@@ -100,7 +105,8 @@ int ps_table_set_optimizer(int id, int kind, float lr, float mom, float eps,
   t->opt = kind; t->lr = lr; t->mom = mom; t->eps = eps; t->b1 = b1;
   t->b2 = b2;
   size_t n = t->data.size();
-  if (kind == OPT_MOMENTUM || kind == OPT_ADAGRAD) t->s1.assign(n, 0.f);
+  if (kind == OPT_MOMENTUM || kind == OPT_NESTEROV || kind == OPT_ADAGRAD)
+    t->s1.assign(n, 0.f);
   if (kind == OPT_ADAM) {
     t->s1.assign(n, 0.f); t->s2.assign(n, 0.f);
     t->step.assign(t->rows, 0);
@@ -144,6 +150,15 @@ int ps_dense_push(int id, const float* grad) {
       for (size_t i = 0; i < n; i++) {
         t->s1[i] = t->mom * t->s1[i] - t->lr * grad[i];
         t->data[i] += t->s1[i];
+      }
+      break;
+    case OPT_NESTEROV:
+      // lookahead form: v' = mom*v - lr*g; w += -mom*v + (1+mom)*v'
+      for (size_t i = 0; i < n; i++) {
+        float v = t->s1[i];
+        float vn = t->mom * v - t->lr * grad[i];
+        t->s1[i] = vn;
+        t->data[i] += -t->mom * v + (1.f + t->mom) * vn;
       }
       break;
     case OPT_ADAGRAD:
@@ -209,6 +224,15 @@ static void apply_row(Table* t, int64_t r, const float* g) {
       for (int64_t d = 0; d < t->dim; d++) {
         v[d] = t->mom * v[d] - t->lr * g[d];
         w[d] += v[d];
+      }
+      break;
+    }
+    case OPT_NESTEROV: {
+      float* v = t->s1.data() + r * t->dim;
+      for (int64_t d = 0; d < t->dim; d++) {
+        float vn = t->mom * v[d] - t->lr * g[d];
+        w[d] += -t->mom * v[d] + (1.f + t->mom) * vn;
+        v[d] = vn;
       }
       break;
     }

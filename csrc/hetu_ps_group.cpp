@@ -1,0 +1,455 @@
+// Worker-side partitioned PS group: one logical table key-range-partitioned
+// over N van servers, with heartbeats, reconnect+retry, and restarted-server
+// recovery.
+//
+// Reference analogs: ps-lite/include/ps/worker/partitioner.h:125 (the
+// worker's key-range partitioner slicing KVPairs per server),
+// ps-lite/src/postoffice.cc (node management + heartbeats),
+// ps-lite/src/resender.h (timeout + resend reliability layer).
+//
+// TPU-VM translation: the group lives in the worker process and fans each
+// request out over per-shard threads (DCN sockets).  Ranges are the ps-lite
+// even split start_i = rows*i/n.  Reliability is request-level rather than
+// message-level: a transport failure (kTransportErr from the van client)
+// triggers reconnect + bounded retry; a server that answers but lost the
+// table (restart) gets the shard re-created from the recorded init/optimizer
+// spec, and `ps_group_recovered` exposes the count so callers can re-push
+// checkpointed weights (the reference's recovery story is also
+// checkpoint-based: SaveParam/LoadParam).
+//
+// All integers little-endian via the van framing; this file only uses the
+// van *client* C ABI, so the wire protocol stays defined in one place.
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int ps_van_connect(const char* host, int port);
+void ps_van_close(int fd);
+int ps_van_ping(int fd);
+int ps_van_table_create(int fd, int id, int64_t rows, int64_t dim,
+                        int init_kind, double a, double b, uint64_t seed);
+int ps_van_set_optimizer(int fd, int id, int kind, float lr, float mom,
+                         float eps, float b1, float b2);
+int ps_van_sparse_pull(int fd, int id, const int64_t* idx, int64_t n,
+                       float* out, int64_t dim);
+int ps_van_sparse_push(int fd, int id, const int64_t* idx, const float* grads,
+                       int64_t n, int64_t dim);
+int ps_van_sparse_set(int fd, int id, const int64_t* idx, const float* vals,
+                      int64_t n, int64_t dim);
+int ps_van_dense_pull(int fd, int id, float* out, int64_t count);
+int ps_van_dense_push(int fd, int id, const float* grad, int64_t count);
+int ps_van_table_save(int fd, int id, const char* path);
+int ps_van_table_load(int fd, int id, const char* path);
+}
+
+namespace {
+
+constexpr int kTransportErr = -101;
+constexpr int kNoTable = -1;        // server-side "no such table"
+constexpr int kDesync = -5;         // payload size mismatch
+
+struct Shard {
+  std::string host;
+  int port = 0;
+  int fd = -1;
+  int64_t start = 0, rows = 0;      // global row range [start, start+rows)
+  std::atomic<bool> alive{false};
+  std::mutex mu;                    // serializes this shard's traffic
+};
+
+struct Group {
+  int table_id = 0;
+  int64_t rows = 0, dim = 0;
+  // recorded creation spec so a restarted server's shard can be rebuilt
+  int init_kind = 0;
+  double init_a = 0, init_b = 0;
+  uint64_t seed = 0;
+  bool opt_set = false;
+  int opt_kind = 0;
+  float lr = 0, mom = 0, eps = 0, b1 = 0, b2 = 0;
+  int retry_max = 3;
+  int retry_backoff_ms = 100;
+  std::vector<std::unique_ptr<Shard>> shards;
+  std::atomic<uint64_t> recovered{0};
+  std::atomic<bool> hb_running{false};
+  std::thread hb_thread;
+};
+
+std::mutex g_groups_mu;
+std::map<int, Group*> g_groups;
+int g_next_group = 1;
+
+Group* get_group(int gid) {
+  std::lock_guard<std::mutex> lk(g_groups_mu);
+  auto it = g_groups.find(gid);
+  return it == g_groups.end() ? nullptr : it->second;
+}
+
+// (re)build the shard's table on its server from the recorded spec.
+// rc -2 ("id exists") counts as success: another worker created it first.
+int create_shard_table(Group* g, Shard* s, int shard_idx) {
+  int rc = ps_van_table_create(s->fd, g->table_id, s->rows, g->dim,
+                               g->init_kind, g->init_a, g->init_b,
+                               g->seed + (uint64_t)shard_idx);
+  if (rc != 0 && rc != -2) return rc;
+  if (g->opt_set) {
+    rc = ps_van_set_optimizer(s->fd, g->table_id, g->opt_kind, g->lr, g->mom,
+                              g->eps, g->b1, g->b2);
+    if (rc != 0) return rc;
+  }
+  return 0;
+}
+
+// Run `op(fd)` against one shard with the resender-style reliability loop:
+//   transport error / desync -> reconnect, retry
+//   "no such table"          -> server restarted blank: re-create, retry
+// Caller must NOT hold s->mu.
+template <typename Op>
+int shard_call(Group* g, Shard* s, int shard_idx, Op op) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  int rc = s->fd >= 0 ? op(s->fd) : kTransportErr;
+  for (int attempt = 0; attempt < g->retry_max && rc != 0; ++attempt) {
+    if (rc == kTransportErr || rc == kDesync) {
+      if (s->fd >= 0) { ps_van_close(s->fd); s->fd = -1; }
+      s->alive = false;
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(g->retry_backoff_ms * (attempt + 1)));
+      int fd = ps_van_connect(s->host.c_str(), s->port);
+      if (fd < 0) { rc = kTransportErr; continue; }
+      s->fd = fd;
+      s->alive = true;
+      // a fresh connection to a restarted server: the table may be gone;
+      // fall through and let the op discover it (kNoTable path below)
+      rc = op(s->fd);
+    } else if (rc == kNoTable) {
+      // server answered but lost the table (restart): rebuild and count it
+      int crc = create_shard_table(g, s, shard_idx);
+      if (crc != 0) { rc = crc; continue; }
+      g->recovered.fetch_add(1);
+      rc = op(s->fd);
+    } else {
+      break;  // genuine server-side error (-3 bad frame etc.): don't retry
+    }
+  }
+  if (rc == kTransportErr) s->alive = false;
+  return rc;
+}
+
+// shard index owning global row k (even ranges, binary search for safety)
+int shard_of(const Group* g, int64_t k) {
+  int lo = 0, hi = (int)g->shards.size() - 1;
+  while (lo < hi) {
+    int mid = (lo + hi + 1) / 2;
+    if (g->shards[mid]->start <= k) lo = mid; else hi = mid - 1;
+  }
+  return lo;
+}
+
+// Fan `fn(shard_idx)` out over the given shard indices on threads; returns
+// the first nonzero rc (0 if all succeeded).
+template <typename Fn>
+int fan_out(const std::vector<int>& idxs, Fn fn);
+
+template <typename Fn>
+int fan_out_all(const Group* g, Fn fn) {
+  std::vector<int> all(g->shards.size());
+  for (size_t i = 0; i < all.size(); ++i) all[i] = (int)i;
+  return fan_out(all, fn);
+}
+
+template <typename Fn>
+int fan_out(const std::vector<int>& idxs, Fn fn) {
+  std::atomic<int> bad_rc{0};
+  std::vector<std::thread> ts;
+  ts.reserve(idxs.size());
+  for (int i : idxs) {
+    ts.emplace_back([&, i]() {
+      int rc = fn(i);
+      if (rc != 0) {
+        int expect = 0;
+        bad_rc.compare_exchange_strong(expect, rc);
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  return bad_rc.load();
+}
+
+void heartbeat_loop(Group* g, int hb_ms) {
+  while (g->hb_running.load()) {
+    for (size_t i = 0; i < g->shards.size(); ++i) {
+      if (!g->hb_running.load()) return;
+      Shard* s = g->shards[i].get();
+      std::unique_lock<std::mutex> lk(s->mu, std::try_to_lock);
+      if (!lk.owns_lock()) continue;  // shard busy = alive enough
+      if (s->fd >= 0 && ps_van_ping(s->fd) == 0) {
+        s->alive = true;
+        continue;
+      }
+      if (s->fd >= 0) { ps_van_close(s->fd); s->fd = -1; }
+      s->alive = false;
+      int fd = ps_van_connect(s->host.c_str(), s->port);
+      if (fd >= 0) { s->fd = fd; s->alive = true; }
+    }
+    for (int slept = 0; slept < hb_ms && g->hb_running.load(); slept += 50)
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// endpoints: "host:port,host:port,..." — one logical table of `rows` keys
+// range-partitioned over them.  hb_ms > 0 starts a heartbeat thread.
+// Returns a group handle (> 0) or a negative error.
+int ps_group_create(const char* endpoints, int table_id, int64_t rows,
+                    int64_t dim, int init_kind, double a, double b,
+                    uint64_t seed, double connect_timeout_s, int hb_ms) {
+  if (!endpoints || rows <= 0 || dim <= 0) return -3;
+  auto g = std::make_unique<Group>();
+  g->table_id = table_id;
+  g->rows = rows; g->dim = dim;
+  g->init_kind = init_kind; g->init_a = a; g->init_b = b; g->seed = seed;
+  // parse "h:p,h:p"
+  std::string s(endpoints);
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t comma = s.find(',', pos);
+    if (comma == std::string::npos) comma = s.size();
+    std::string ep = s.substr(pos, comma - pos);
+    pos = comma + 1;
+    size_t colon = ep.rfind(':');
+    if (colon == std::string::npos) return -3;
+    auto sh = std::make_unique<Shard>();
+    sh->host = ep.substr(0, colon);
+    sh->port = std::atoi(ep.c_str() + colon + 1);
+    if (sh->port <= 0) return -3;
+    g->shards.push_back(std::move(sh));
+  }
+  int n = (int)g->shards.size();
+  if (n == 0 || n > 64) return -3;  // alive mask is u64
+  if (rows < n) return -3;  // every shard must own >= 1 row
+  for (int i = 0; i < n; ++i) {
+    g->shards[i]->start = rows * i / n;
+    g->shards[i]->rows = rows * (i + 1) / n - rows * i / n;
+  }
+  // connect all shards within the deadline
+  auto fail = [&](int rc) {
+    for (auto& sh : g->shards)
+      if (sh->fd >= 0) ps_van_close(sh->fd);
+    return rc;
+  };
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(connect_timeout_s);
+  for (int i = 0; i < n; ++i) {
+    Shard* sh = g->shards[i].get();
+    while (sh->fd < 0) {
+      sh->fd = ps_van_connect(sh->host.c_str(), sh->port);
+      if (sh->fd >= 0) break;
+      if (std::chrono::steady_clock::now() > deadline) return fail(-4);
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    sh->alive = true;
+    int rc = create_shard_table(g.get(), sh, i);
+    if (rc != 0) return fail(rc);
+  }
+  Group* gp = g.release();
+  int gid;
+  {
+    std::lock_guard<std::mutex> lk(g_groups_mu);
+    gid = g_next_group++;
+    g_groups[gid] = gp;
+  }
+  if (hb_ms > 0) {
+    gp->hb_running = true;
+    gp->hb_thread = std::thread(heartbeat_loop, gp, hb_ms);
+  }
+  return gid;
+}
+
+int ps_group_set_optimizer(int gid, int kind, float lr, float mom, float eps,
+                           float b1, float b2) {
+  Group* g = get_group(gid);
+  if (!g) return -1;
+  g->opt_kind = kind; g->lr = lr; g->mom = mom; g->eps = eps;
+  g->b1 = b1; g->b2 = b2; g->opt_set = true;
+  return fan_out_all(g, [&](int i) {
+    return shard_call(g, g->shards[i].get(), i, [&](int fd) {
+      return ps_van_set_optimizer(fd, g->table_id, kind, lr, mom, eps, b1,
+                                  b2);
+    });
+  });
+}
+
+int ps_group_n(int gid) {
+  Group* g = get_group(gid);
+  return g ? (int)g->shards.size() : -1;
+}
+
+int64_t ps_group_start(int gid, int i) {
+  Group* g = get_group(gid);
+  if (!g || i < 0 || i >= (int)g->shards.size()) return -1;
+  return g->shards[i]->start;
+}
+
+int ps_group_sparse_pull(int gid, const int64_t* idx, int64_t n, float* out) {
+  Group* g = get_group(gid);
+  if (!g) return -1;
+  int ns = (int)g->shards.size();
+  // slice keys per shard, remembering output positions (partitioner.h:125)
+  std::vector<std::vector<int64_t>> local(ns), pos(ns);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t k = idx[i];
+    if (k < 0 || k >= g->rows) {  // out-of-range: zeros, like the core table
+      std::memset(out + i * g->dim, 0, g->dim * sizeof(float));
+      continue;
+    }
+    int sidx = shard_of(g, k);
+    local[sidx].push_back(k - g->shards[sidx]->start);
+    pos[sidx].push_back(i);
+  }
+  std::vector<int> nonempty;
+  for (int i = 0; i < ns; ++i)
+    if (!local[i].empty()) nonempty.push_back(i);
+  std::vector<std::vector<float>> bufs(ns);
+  int rc = fan_out(nonempty, [&](int i) {
+    bufs[i].resize(local[i].size() * g->dim);
+    return shard_call(g, g->shards[i].get(), i, [&](int fd) {
+      return ps_van_sparse_pull(fd, g->table_id, local[i].data(),
+                                (int64_t)local[i].size(), bufs[i].data(),
+                                g->dim);
+    });
+  });
+  if (rc != 0) return rc;
+  for (int i : nonempty)
+    for (size_t j = 0; j < pos[i].size(); ++j)
+      std::memcpy(out + pos[i][j] * g->dim, bufs[i].data() + j * g->dim,
+                  g->dim * sizeof(float));
+  return 0;
+}
+
+static int group_sparse_write(int gid, const int64_t* idx, const float* vals,
+                              int64_t n, bool is_set) {
+  Group* g = get_group(gid);
+  if (!g) return -1;
+  int ns = (int)g->shards.size();
+  std::vector<std::vector<int64_t>> local(ns);
+  std::vector<std::vector<float>> vbuf(ns);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t k = idx[i];
+    if (k < 0 || k >= g->rows) continue;  // ignore, like the core table
+    int sidx = shard_of(g, k);
+    local[sidx].push_back(k - g->shards[sidx]->start);
+    vbuf[sidx].insert(vbuf[sidx].end(), vals + i * g->dim,
+                      vals + (i + 1) * g->dim);
+  }
+  std::vector<int> nonempty;
+  for (int i = 0; i < ns; ++i)
+    if (!local[i].empty()) nonempty.push_back(i);
+  return fan_out(nonempty, [&](int i) {
+    return shard_call(g, g->shards[i].get(), i, [&](int fd) {
+      auto* fn = is_set ? ps_van_sparse_set : ps_van_sparse_push;
+      return fn(fd, g->table_id, local[i].data(), vbuf[i].data(),
+                (int64_t)local[i].size(), g->dim);
+    });
+  });
+}
+
+int ps_group_sparse_push(int gid, const int64_t* idx, const float* grads,
+                         int64_t n) {
+  return group_sparse_write(gid, idx, grads, n, false);
+}
+
+int ps_group_sparse_set(int gid, const int64_t* idx, const float* vals,
+                        int64_t n) {
+  return group_sparse_write(gid, idx, vals, n, true);
+}
+
+int ps_group_dense_pull(int gid, float* out) {
+  Group* g = get_group(gid);
+  if (!g) return -1;
+  return fan_out_all(g, [&](int i) {
+    Shard* s = g->shards[i].get();
+    return shard_call(g, s, i, [&](int fd) {
+      return ps_van_dense_pull(fd, g->table_id, out + s->start * g->dim,
+                               s->rows * g->dim);
+    });
+  });
+}
+
+int ps_group_dense_push(int gid, const float* grad) {
+  Group* g = get_group(gid);
+  if (!g) return -1;
+  return fan_out_all(g, [&](int i) {
+    Shard* s = g->shards[i].get();
+    return shard_call(g, s, i, [&](int fd) {
+      return ps_van_dense_push(fd, g->table_id, grad + s->start * g->dim,
+                               s->rows * g->dim);
+    });
+  });
+}
+
+// Each shard saves/loads "<path>.shard<i>" on ITS host's filesystem.
+static int group_file_op(int gid, const char* path, bool is_save) {
+  Group* g = get_group(gid);
+  if (!g) return -1;
+  return fan_out_all(g, [&](int i) {
+    std::string p = std::string(path) + ".shard" + std::to_string(i);
+    return shard_call(g, g->shards[i].get(), i, [&](int fd) {
+      return is_save ? ps_van_table_save(fd, g->table_id, p.c_str())
+                     : ps_van_table_load(fd, g->table_id, p.c_str());
+    });
+  });
+}
+
+int ps_group_save(int gid, const char* path) {
+  return group_file_op(gid, path, true);
+}
+
+int ps_group_load(int gid, const char* path) {
+  return group_file_op(gid, path, false);
+}
+
+uint64_t ps_group_alive_mask(int gid) {
+  Group* g = get_group(gid);
+  if (!g) return 0;
+  uint64_t m = 0;
+  for (size_t i = 0; i < g->shards.size(); ++i)
+    if (g->shards[i]->alive.load()) m |= (uint64_t)1 << i;
+  return m;
+}
+
+uint64_t ps_group_recovered(int gid) {
+  Group* g = get_group(gid);
+  return g ? g->recovered.load() : 0;
+}
+
+void ps_group_close(int gid) {
+  Group* g = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_groups_mu);
+    auto it = g_groups.find(gid);
+    if (it == g_groups.end()) return;
+    g = it->second;
+    g_groups.erase(it);
+  }
+  if (g->hb_running.exchange(false) && g->hb_thread.joinable())
+    g->hb_thread.join();
+  for (auto& s : g->shards) {
+    std::lock_guard<std::mutex> lk(s->mu);
+    if (s->fd >= 0) { ps_van_close(s->fd); s->fd = -1; }
+  }
+  delete g;
+}
+
+}  // extern "C"

@@ -356,10 +356,15 @@ void ps_van_close(int fd) {
   ::close(fd);
 }
 
+// Transport failures (connection dead, frame desync) return kTransportErr,
+// distinct from every server-side rc, so the partitioned group layer
+// (hetu_ps_group.cpp) can tell "reconnect and retry" from "server said no".
+static const int32_t kTransportErr = -101;
+
 int ps_van_ping(int fd) {
   std::vector<char> b{(char)OP_PING}, pay;
-  int32_t rc = -1;
-  return request(fd, b, &rc, &pay) ? rc : -1;
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
 }
 
 int ps_van_table_create(int fd, int id, int64_t rows, int64_t dim,
@@ -368,8 +373,8 @@ int ps_van_table_create(int fd, int id, int64_t rows, int64_t dim,
   put<int32_t>(b, id); put<int64_t>(b, rows); put<int64_t>(b, dim);
   put<int32_t>(b, init_kind); put<double>(b, a); put<double>(b, bb);
   put<uint64_t>(b, seed);
-  int32_t rc = -1;
-  return request(fd, b, &rc, &pay) ? rc : -1;
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
 }
 
 int ps_van_set_optimizer(int fd, int id, int kind, float lr, float mom,
@@ -378,8 +383,8 @@ int ps_van_set_optimizer(int fd, int id, int kind, float lr, float mom,
   put<int32_t>(b, id); put<int32_t>(b, kind); put<float>(b, lr);
   put<float>(b, mom); put<float>(b, eps); put<float>(b, b1);
   put<float>(b, b2);
-  int32_t rc = -1;
-  return request(fd, b, &rc, &pay) ? rc : -1;
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
 }
 
 int ps_van_sparse_pull(int fd, int id, const int64_t* idx, int64_t n,
@@ -389,31 +394,43 @@ int ps_van_sparse_pull(int fd, int id, const int64_t* idx, int64_t n,
   size_t o = b.size();
   b.resize(o + n * sizeof(int64_t));
   std::memcpy(b.data() + o, idx, n * sizeof(int64_t));
-  int32_t rc = -1;
-  if (!request(fd, b, &rc, &pay) || rc != 0) return rc ? rc : -1;
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
   if ((int64_t)pay.size() != n * dim * (int64_t)sizeof(float)) return -5;
   std::memcpy(out, pay.data(), pay.size());
   return 0;
 }
 
-int ps_van_sparse_push(int fd, int id, const int64_t* idx,
-                       const float* grads, int64_t n, int64_t dim) {
-  std::vector<char> b{(char)OP_SPARSE_PUSH}, pay;
+static int van_sparse_write(uint8_t op, int fd, int id, const int64_t* idx,
+                            const float* grads, int64_t n, int64_t dim) {
+  std::vector<char> b{(char)op}, pay;
   put<int32_t>(b, id); put<int64_t>(b, n);
   size_t o = b.size();
   b.resize(o + n * sizeof(int64_t) + n * dim * sizeof(float));
   std::memcpy(b.data() + o, idx, n * sizeof(int64_t));
   std::memcpy(b.data() + o + n * sizeof(int64_t), grads,
               n * dim * sizeof(float));
-  int32_t rc = -1;
-  return request(fd, b, &rc, &pay) ? rc : -1;
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
+}
+
+int ps_van_sparse_push(int fd, int id, const int64_t* idx,
+                       const float* grads, int64_t n, int64_t dim) {
+  return van_sparse_write(OP_SPARSE_PUSH, fd, id, idx, grads, n, dim);
+}
+
+int ps_van_sparse_set(int fd, int id, const int64_t* idx,
+                      const float* vals, int64_t n, int64_t dim) {
+  return van_sparse_write(OP_SPARSE_SET, fd, id, idx, vals, n, dim);
 }
 
 int ps_van_dense_pull(int fd, int id, float* out, int64_t count) {
   std::vector<char> b{(char)OP_DENSE_PULL}, pay;
   put<int32_t>(b, id);
-  int32_t rc = -1;
-  if (!request(fd, b, &rc, &pay) || rc != 0) return rc ? rc : -1;
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
   if ((int64_t)pay.size() != count * (int64_t)sizeof(float)) return -5;
   std::memcpy(out, pay.data(), pay.size());
   return 0;
@@ -425,8 +442,28 @@ int ps_van_dense_push(int fd, int id, const float* grad, int64_t count) {
   size_t o = b.size();
   b.resize(o + count * sizeof(float));
   std::memcpy(b.data() + o, grad, count * sizeof(float));
-  int32_t rc = -1;
-  return request(fd, b, &rc, &pay) ? rc : -1;
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
+}
+
+static int van_file_op(uint8_t op, int fd, int id, const char* path) {
+  std::vector<char> b{(char)op}, pay;
+  put<int32_t>(b, id);
+  uint32_t plen = (uint32_t)std::strlen(path);
+  put<uint32_t>(b, plen);
+  size_t o = b.size();
+  b.resize(o + plen);
+  std::memcpy(b.data() + o, path, plen);
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
+}
+
+int ps_van_table_save(int fd, int id, const char* path) {
+  return van_file_op(OP_SAVE, fd, id, path);
+}
+
+int ps_van_table_load(int fd, int id, const char* path) {
+  return van_file_op(OP_LOAD, fd, id, path);
 }
 
 }  // extern "C"

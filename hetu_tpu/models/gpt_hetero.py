@@ -137,7 +137,14 @@ class PlanStrategy(Strategy):
         self.block_opt = {}
         for li in range(len(body) // 2):
             self.block_opt[li] = (body[2 * li], body[2 * li + 1])
-        self.embed_sdp = embed_sdp
+        # honor the searcher's dp_type choice for the embed/head LayerSpecs
+        # too (the memory budget was certified WITH them): tok_emb is tied
+        # to the head here, so either edge option requesting sharding wins
+        edge = [plan.layer_options[0], plan.layer_options[-1]]
+        self.embed_sdp = embed_sdp or any(
+            getattr(o, "dp_type", "dp") == "sdp" for o in edge)
+        self.embed_zero1 = any(
+            getattr(o, "dp_type", "dp") == "zero1" for o in edge)
 
     def _layer_opt(self, path):
         m = _LAYER_RE.search(path)
@@ -176,6 +183,9 @@ class PlanStrategy(Strategy):
         ndim = leaf.ndim if hasattr(leaf, "ndim") else len(leaf.shape)
         opt = self._layer_opt(path)
         if opt is None:
+            if (self.embed_sdp or self.embed_zero1) and \
+                    ("tok_emb" in path or "pos_emb" in path):
+                return _add_dp_axis(P(), ndim)
             return self.param_spec(path, leaf)
         spec = self._tp_spec(path, ndim, opt.tp)
         if opt.dp_type in ("sdp", "zero1"):

@@ -15,7 +15,8 @@ from pathlib import Path
 
 _HERE = Path(__file__).resolve().parent
 _CSRC = _HERE.parent.parent / "csrc"
-_SRCS = [_CSRC / "hetu_ps.cpp", _CSRC / "hetu_ps_van.cpp"]
+_SRCS = [_CSRC / "hetu_ps.cpp", _CSRC / "hetu_ps_van.cpp",
+         _CSRC / "hetu_ps_group.cpp"]
 _BUILD = _HERE / "_build"
 _SO = _BUILD / "libhetu_ps.so"
 
@@ -101,6 +102,32 @@ def _load():
                                   c.c_int),
             "ps_van_dense_push": ([c.c_int, c.c_int, f32p, c.c_int64],
                                   c.c_int),
+            "ps_van_sparse_set": ([c.c_int, c.c_int, i64p, f32p, c.c_int64,
+                                   c.c_int64], c.c_int),
+            "ps_van_table_save": ([c.c_int, c.c_int, c.c_char_p], c.c_int),
+            "ps_van_table_load": ([c.c_int, c.c_int, c.c_char_p], c.c_int),
+            # partitioned multi-server group (csrc/hetu_ps_group.cpp)
+            "ps_group_create": ([c.c_char_p, c.c_int, c.c_int64, c.c_int64,
+                                 c.c_int, c.c_double, c.c_double, c.c_uint64,
+                                 c.c_double, c.c_int], c.c_int),
+            "ps_group_set_optimizer": ([c.c_int, c.c_int, c.c_float,
+                                        c.c_float, c.c_float, c.c_float,
+                                        c.c_float], c.c_int),
+            "ps_group_n": ([c.c_int], c.c_int),
+            "ps_group_start": ([c.c_int, c.c_int], c.c_int64),
+            "ps_group_sparse_pull": ([c.c_int, i64p, c.c_int64, f32p],
+                                     c.c_int),
+            "ps_group_sparse_push": ([c.c_int, i64p, f32p, c.c_int64],
+                                     c.c_int),
+            "ps_group_sparse_set": ([c.c_int, i64p, f32p, c.c_int64],
+                                    c.c_int),
+            "ps_group_dense_pull": ([c.c_int, f32p], c.c_int),
+            "ps_group_dense_push": ([c.c_int, f32p], c.c_int),
+            "ps_group_save": ([c.c_int, c.c_char_p], c.c_int),
+            "ps_group_load": ([c.c_int, c.c_char_p], c.c_int),
+            "ps_group_alive_mask": ([c.c_int], c.c_uint64),
+            "ps_group_recovered": ([c.c_int], c.c_uint64),
+            "ps_group_close": ([c.c_int], None),
         }
         for name, (argtypes, restype) in sigs.items():
             fn = getattr(lib, name)

@@ -37,7 +37,8 @@ def _check(rc, what: str):
 
 
 _INIT_KINDS = {"zeros": 0, "constant": 1, "uniform": 2, "normal": 3}
-_OPT_KINDS = {"sgd": 0, "momentum": 1, "adagrad": 2, "adam": 3}
+_OPT_KINDS = {"sgd": 0, "momentum": 1, "adagrad": 2, "adam": 3,
+              "nesterov": 4}
 
 
 class PSTable:

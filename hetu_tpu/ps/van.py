@@ -99,7 +99,134 @@ class RemotePSTable:
         _check(lib.ps_van_dense_push(self.fd, self.id, _f32p(g),
                                      self.rows * self.dim), "van_dense_push")
 
+    def sparse_set(self, indices, values) -> None:
+        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
+        v = np.ascontiguousarray(values, np.float32).reshape(idx.shape[0],
+                                                             self.dim)
+        _check(lib.ps_van_sparse_set(self.fd, self.id, _i64p(idx), _f32p(v),
+                                     idx.shape[0], self.dim),
+               "van_sparse_set")
+
+    def save(self, path) -> None:
+        _check(lib.ps_van_table_save(self.fd, self.id, str(path).encode()),
+               "van_table_save")
+
+    def load(self, path) -> None:
+        _check(lib.ps_van_table_load(self.fd, self.id, str(path).encode()),
+               "van_table_load")
+
     def close(self) -> None:
         if self.fd >= 0:
             lib.ps_van_close(self.fd)
             self.fd = -1
+
+
+class PartitionedPSTable:
+    """One logical table key-range-partitioned over N van servers.
+
+    Reference analogs: the ps-lite worker's range partitioner
+    (ps-lite/include/ps/worker/partitioner.h:125) slicing each request per
+    server, postoffice heartbeats, and resender-style retry — all of which
+    live in the native group layer (csrc/hetu_ps_group.cpp).  Keys in
+    [rows*i/n, rows*(i+1)/n) live on server i.
+
+    Recovery contract: if a server restarts blank, the worker transparently
+    re-creates its shard (fresh init) and `recovered` increments — the
+    caller decides whether to re-push weights (e.g. via `sparse_set` from a
+    checkpoint), matching the reference's SaveParam/LoadParam story.
+    """
+
+    def __init__(self, endpoints, rows: int, dim: int, *,
+                 table_id: Optional[int] = None,
+                 init: str = "normal", init_a: float = 0.0,
+                 init_b: float = 0.01, seed: int = 0,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 momentum: float = 0.9, eps: float = 1e-7,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 connect_timeout_s: float = 10.0,
+                 heartbeat_ms: int = 0):
+        from hetu_tpu.ps.client import _INIT_KINDS, _OPT_KINDS
+        if not isinstance(endpoints, str):
+            endpoints = ",".join(f"{h}:{p}" for h, p in endpoints)
+        self.rows, self.dim = rows, dim
+        self.id = table_id if table_id is not None else _fresh_remote_id()
+        gid = lib.ps_group_create(
+            endpoints.encode(), self.id, rows, dim, _INIT_KINDS[init],
+            init_a, init_b, seed, connect_timeout_s, heartbeat_ms)
+        if gid <= 0:
+            raise ConnectionError(
+                f"cannot establish PS group over {endpoints} (rc={gid})")
+        self.gid = gid
+        try:
+            _check(lib.ps_group_set_optimizer(
+                gid, _OPT_KINDS[optimizer], lr, momentum, eps, beta1, beta2),
+                "group_set_optimizer")
+        except Exception:
+            # don't leak the native group + heartbeat thread on a failed init
+            self.gid = 0
+            lib.ps_group_close(gid)
+            raise
+
+    @property
+    def n_servers(self) -> int:
+        return int(lib.ps_group_n(self.gid))
+
+    @property
+    def shard_starts(self) -> list[int]:
+        return [int(lib.ps_group_start(self.gid, i))
+                for i in range(self.n_servers)]
+
+    @property
+    def alive(self) -> list[bool]:
+        mask = int(lib.ps_group_alive_mask(self.gid))
+        return [bool(mask & (1 << i)) for i in range(self.n_servers)]
+
+    @property
+    def recovered(self) -> int:
+        """How many times a restarted-blank server shard was re-created."""
+        return int(lib.ps_group_recovered(self.gid))
+
+    def sparse_pull(self, indices) -> np.ndarray:
+        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
+        out = np.empty((idx.shape[0], self.dim), np.float32)
+        _check(lib.ps_group_sparse_pull(self.gid, _i64p(idx), idx.shape[0],
+                                        _f32p(out)), "group_sparse_pull")
+        return out
+
+    def sparse_push(self, indices, grads) -> None:
+        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
+        g = np.ascontiguousarray(grads, np.float32).reshape(idx.shape[0],
+                                                            self.dim)
+        _check(lib.ps_group_sparse_push(self.gid, _i64p(idx), _f32p(g),
+                                        idx.shape[0]), "group_sparse_push")
+
+    def sparse_set(self, indices, values) -> None:
+        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
+        v = np.ascontiguousarray(values, np.float32).reshape(idx.shape[0],
+                                                             self.dim)
+        _check(lib.ps_group_sparse_set(self.gid, _i64p(idx), _f32p(v),
+                                       idx.shape[0]), "group_sparse_set")
+
+    def dense_pull(self) -> np.ndarray:
+        out = np.empty((self.rows, self.dim), np.float32)
+        _check(lib.ps_group_dense_pull(self.gid, _f32p(out)),
+               "group_dense_pull")
+        return out
+
+    def dense_push(self, grad) -> None:
+        g = np.ascontiguousarray(grad, np.float32).reshape(self.rows,
+                                                           self.dim)
+        _check(lib.ps_group_dense_push(self.gid, _f32p(g)),
+               "group_dense_push")
+
+    def save(self, path) -> None:
+        """Each server saves `<path>.shard<i>` on its own host."""
+        _check(lib.ps_group_save(self.gid, str(path).encode()), "group_save")
+
+    def load(self, path) -> None:
+        _check(lib.ps_group_load(self.gid, str(path).encode()), "group_load")
+
+    def close(self) -> None:
+        if getattr(self, "gid", 0) > 0:
+            lib.ps_group_close(self.gid)
+            self.gid = 0
