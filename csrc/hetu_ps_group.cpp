@@ -27,6 +27,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <random>
 #include <string>
 #include <thread>
 #include <vector>
@@ -47,6 +48,11 @@ int ps_van_sparse_set(int fd, int id, const int64_t* idx, const float* vals,
                       int64_t n, int64_t dim);
 int ps_van_dense_pull(int fd, int id, float* out, int64_t count);
 int ps_van_dense_push(int fd, int id, const float* grad, int64_t count);
+int ps_van_dense_push_id(int fd, int id, const float* grad, int64_t count,
+                         uint64_t req);
+int ps_van_sparse_push_id(int fd, int id, const int64_t* idx,
+                          const float* grads, int64_t n, int64_t dim,
+                          uint64_t req);
 int ps_van_table_save(int fd, int id, const char* path);
 int ps_van_table_load(int fd, int id, const char* path);
 }
@@ -82,17 +88,41 @@ struct Group {
   std::atomic<uint64_t> recovered{0};
   std::atomic<bool> hb_running{false};
   std::thread hb_thread;
+  std::atomic<int> inflight{0};    // ops holding a ref (close drains this)
 };
+
+// Push request ids: unique across workers (random 64-bit base + counter);
+// constant across one shard_call's retries = exactly-once on the server.
+std::atomic<uint64_t> g_req_ctr{0};
+uint64_t next_req_id() {
+  static const uint64_t base = [] {
+    std::random_device rd;
+    return ((uint64_t)rd() << 32) ^ rd();
+  }();
+  return base + g_req_ctr.fetch_add(1);
+}
 
 std::mutex g_groups_mu;
 std::map<int, Group*> g_groups;
 int g_next_group = 1;
 
+// Acquire a ref: close() waits for inflight to drain before deleting, so
+// a raw Group* from here stays valid until the matching GroupRef release.
 Group* get_group(int gid) {
   std::lock_guard<std::mutex> lk(g_groups_mu);
   auto it = g_groups.find(gid);
-  return it == g_groups.end() ? nullptr : it->second;
+  if (it == g_groups.end()) return nullptr;
+  it->second->inflight.fetch_add(1);
+  return it->second;
 }
+
+struct GroupRef {
+  Group* g;
+  explicit GroupRef(int gid) : g(get_group(gid)) {}
+  ~GroupRef() { if (g) g->inflight.fetch_sub(1); }
+  GroupRef(const GroupRef&) = delete;
+  GroupRef& operator=(const GroupRef&) = delete;
+};
 
 // (re)build the shard's table on its server from the recorded spec.
 // rc -2 ("id exists") counts as success: another worker created it first.
@@ -279,7 +309,8 @@ int ps_group_create(const char* endpoints, int table_id, int64_t rows,
 
 int ps_group_set_optimizer(int gid, int kind, float lr, float mom, float eps,
                            float b1, float b2) {
-  Group* g = get_group(gid);
+  GroupRef ref(gid);
+  Group* g = ref.g;
   if (!g) return -1;
   g->opt_kind = kind; g->lr = lr; g->mom = mom; g->eps = eps;
   g->b1 = b1; g->b2 = b2; g->opt_set = true;
@@ -292,18 +323,20 @@ int ps_group_set_optimizer(int gid, int kind, float lr, float mom, float eps,
 }
 
 int ps_group_n(int gid) {
-  Group* g = get_group(gid);
-  return g ? (int)g->shards.size() : -1;
+  GroupRef ref(gid);
+  return ref.g ? (int)ref.g->shards.size() : -1;
 }
 
 int64_t ps_group_start(int gid, int i) {
-  Group* g = get_group(gid);
+  GroupRef ref(gid);
+  Group* g = ref.g;
   if (!g || i < 0 || i >= (int)g->shards.size()) return -1;
   return g->shards[i]->start;
 }
 
 int ps_group_sparse_pull(int gid, const int64_t* idx, int64_t n, float* out) {
-  Group* g = get_group(gid);
+  GroupRef ref(gid);
+  Group* g = ref.g;
   if (!g) return -1;
   int ns = (int)g->shards.size();
   // slice keys per shard, remembering output positions (partitioner.h:125)
@@ -340,7 +373,8 @@ int ps_group_sparse_pull(int gid, const int64_t* idx, int64_t n, float* out) {
 
 static int group_sparse_write(int gid, const int64_t* idx, const float* vals,
                               int64_t n, bool is_set) {
-  Group* g = get_group(gid);
+  GroupRef ref(gid);
+  Group* g = ref.g;
   if (!g) return -1;
   int ns = (int)g->shards.size();
   std::vector<std::vector<int64_t>> local(ns);
@@ -357,10 +391,15 @@ static int group_sparse_write(int gid, const int64_t* idx, const float* vals,
   for (int i = 0; i < ns; ++i)
     if (!local[i].empty()) nonempty.push_back(i);
   return fan_out(nonempty, [&](int i) {
+    uint64_t req = next_req_id();
     return shard_call(g, g->shards[i].get(), i, [&](int fd) {
-      auto* fn = is_set ? ps_van_sparse_set : ps_van_sparse_push;
-      return fn(fd, g->table_id, local[i].data(), vbuf[i].data(),
-                (int64_t)local[i].size(), g->dim);
+      if (is_set)
+        return ps_van_sparse_set(fd, g->table_id, local[i].data(),
+                                 vbuf[i].data(), (int64_t)local[i].size(),
+                                 g->dim);
+      return ps_van_sparse_push_id(fd, g->table_id, local[i].data(),
+                                   vbuf[i].data(), (int64_t)local[i].size(),
+                                   g->dim, req);
     });
   });
 }
@@ -376,7 +415,8 @@ int ps_group_sparse_set(int gid, const int64_t* idx, const float* vals,
 }
 
 int ps_group_dense_pull(int gid, float* out) {
-  Group* g = get_group(gid);
+  GroupRef ref(gid);
+  Group* g = ref.g;
   if (!g) return -1;
   return fan_out_all(g, [&](int i) {
     Shard* s = g->shards[i].get();
@@ -388,20 +428,24 @@ int ps_group_dense_pull(int gid, float* out) {
 }
 
 int ps_group_dense_push(int gid, const float* grad) {
-  Group* g = get_group(gid);
+  GroupRef ref(gid);
+  Group* g = ref.g;
   if (!g) return -1;
   return fan_out_all(g, [&](int i) {
     Shard* s = g->shards[i].get();
+    uint64_t req = next_req_id();
     return shard_call(g, s, i, [&](int fd) {
-      return ps_van_dense_push(fd, g->table_id, grad + s->start * g->dim,
-                               s->rows * g->dim);
+      return ps_van_dense_push_id(fd, g->table_id,
+                                  grad + s->start * g->dim,
+                                  s->rows * g->dim, req);
     });
   });
 }
 
 // Each shard saves/loads "<path>.shard<i>" on ITS host's filesystem.
 static int group_file_op(int gid, const char* path, bool is_save) {
-  Group* g = get_group(gid);
+  GroupRef ref(gid);
+  Group* g = ref.g;
   if (!g) return -1;
   return fan_out_all(g, [&](int i) {
     std::string p = std::string(path) + ".shard" + std::to_string(i);
@@ -421,7 +465,8 @@ int ps_group_load(int gid, const char* path) {
 }
 
 uint64_t ps_group_alive_mask(int gid) {
-  Group* g = get_group(gid);
+  GroupRef ref(gid);
+  Group* g = ref.g;
   if (!g) return 0;
   uint64_t m = 0;
   for (size_t i = 0; i < g->shards.size(); ++i)
@@ -430,8 +475,8 @@ uint64_t ps_group_alive_mask(int gid) {
 }
 
 uint64_t ps_group_recovered(int gid) {
-  Group* g = get_group(gid);
-  return g ? g->recovered.load() : 0;
+  GroupRef ref(gid);
+  return ref.g ? ref.g->recovered.load() : 0;
 }
 
 void ps_group_close(int gid) {
@@ -445,6 +490,10 @@ void ps_group_close(int gid) {
   }
   if (g->hb_running.exchange(false) && g->hb_thread.joinable())
     g->hb_thread.join();
+  // the map entry is gone, so no NEW refs can be taken; wait out the ones
+  // already held (use-after-free guard for concurrent ops)
+  while (g->inflight.load() > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
   for (auto& s : g->shards) {
     std::lock_guard<std::mutex> lk(s->mu);
     if (s->fd >= 0) { ps_van_close(s->fd); s->fd = -1; }

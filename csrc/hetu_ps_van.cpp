@@ -24,10 +24,13 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <thread>
+#include <utility>
 #include <vector>
 
 // table core (same TU group; declared in hetu_ps.cpp)
@@ -54,7 +57,41 @@ enum VanOp : uint8_t {
   OP_CREATE = 1, OP_SET_OPT = 2, OP_DENSE_PULL = 3, OP_DENSE_PUSH = 4,
   OP_SPARSE_PULL = 5, OP_SPARSE_PUSH = 6, OP_SPARSE_SET = 7, OP_SAVE = 8,
   OP_LOAD = 9, OP_PING = 10,
+  // push variants carrying a u64 request id the server dedups on, so a
+  // reconnect-and-resend retry is exactly-once (ps-lite resender.h dedups
+  // by message id the same way); non-idempotent ops only
+  OP_DENSE_PUSH_ID = 11, OP_SPARSE_PUSH_ID = 12,
 };
+
+// Per-table bounded set of recently applied push request-ids.  A repeated
+// id is acknowledged rc=0 without re-applying the gradient.
+class DedupSet {
+ public:
+  bool contains(int table, uint64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return seen_.count(std::make_pair(table, id)) != 0;
+  }
+
+  // record only AFTER a successful apply: a failed-validation retry must
+  // not be mistaken for a duplicate
+  void record(int table, uint64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto key = std::make_pair(table, id);
+    if (!seen_.insert(key).second) return;
+    order_.push_back(key);
+    while (order_.size() > kCap) {
+      seen_.erase(order_.front());
+      order_.pop_front();
+    }
+  }
+
+ private:
+  static constexpr size_t kCap = 4096;
+  std::mutex mu_;
+  std::set<std::pair<int, uint64_t>> seen_;
+  std::deque<std::pair<int, uint64_t>> order_;
+};
+DedupSet g_push_dedup;
 
 bool read_all(int fd, void* buf, size_t n) {
   auto* p = (char*)buf;
@@ -108,7 +145,7 @@ void handle_conn(int fd) {
     // minimum fixed-header bytes per op AFTER the op byte: reject short
     // frames BEFORE any rd<> touches the body (overread-proof)
     static const uint32_t kMinBody[] = {
-        0, 48, 28, 4, 4, 13, 12, 12, 8, 8, 0};
+        0, 48, 28, 4, 4, 13, 12, 12, 8, 8, 0, 12, 20};
     if (op < sizeof(kMinBody) / sizeof(uint32_t) &&
         blen < 1 + kMinBody[op]) {
       send_resp(fd, -3, nullptr, 0);
@@ -153,15 +190,25 @@ void handle_conn(int fd) {
                   rc == 0 ? (uint32_t)(n * sizeof(float)) : 0);
         break;
       }
-      case OP_DENSE_PUSH: {
+      case OP_DENSE_PUSH: case OP_DENSE_PUSH_ID: {
         int id = rd<int32_t>(p);
+        uint64_t req = 0;
+        if (op == OP_DENSE_PUSH_ID) {
+          req = rd<uint64_t>(p);
+          if (g_push_dedup.contains(id, req)) {
+            send_resp(fd, 0, nullptr, 0);  // duplicate: ack, don't re-apply
+            break;
+          }
+        }
         int64_t want = ps_table_rows(id) * ps_table_dim(id);
         int64_t have = (body.data() + blen - p) / (int64_t)sizeof(float);
         if (want <= 0 || have < want ||
             want * (int64_t)sizeof(float) > (int64_t)(1u << 30)) {
           send_resp(fd, -3, nullptr, 0); break;
         }
-        send_resp(fd, ps_dense_push(id, (const float*)p), nullptr, 0);
+        int rc = ps_dense_push(id, (const float*)p);
+        if (rc == 0 && op == OP_DENSE_PUSH_ID) g_push_dedup.record(id, req);
+        send_resp(fd, rc, nullptr, 0);
         break;
       }
       case OP_SPARSE_PULL: {
@@ -199,9 +246,17 @@ void handle_conn(int fd) {
         }
         break;
       }
-      case OP_SPARSE_PUSH: case OP_SPARSE_SET: {
+      case OP_SPARSE_PUSH: case OP_SPARSE_SET: case OP_SPARSE_PUSH_ID: {
         int id = rd<int32_t>(p);
         int64_t n = rd<int64_t>(p);
+        uint64_t req = 0;
+        if (op == OP_SPARSE_PUSH_ID) {
+          req = rd<uint64_t>(p);
+          if (g_push_dedup.contains(id, req)) {
+            send_resp(fd, 0, nullptr, 0);  // duplicate: ack, don't re-apply
+            break;
+          }
+        }
         int64_t dim = ps_table_dim(id);
         int64_t have = body.data() + blen - p;
         if (dim <= 0 || n < 0 || n > (1 << 24) ||
@@ -210,8 +265,9 @@ void handle_conn(int fd) {
         }
         const auto* idx = (const int64_t*)p;
         const auto* dat = (const float*)(p + n * sizeof(int64_t));
-        int rc = op == OP_SPARSE_PUSH ? ps_sparse_push(id, idx, dat, n)
-                                      : ps_sparse_set(id, idx, dat, n);
+        int rc = op == OP_SPARSE_SET ? ps_sparse_set(id, idx, dat, n)
+                                     : ps_sparse_push(id, idx, dat, n);
+        if (rc == 0 && op == OP_SPARSE_PUSH_ID) g_push_dedup.record(id, req);
         send_resp(fd, rc, nullptr, 0);
         break;
       }
@@ -442,6 +498,36 @@ int ps_van_dense_push(int fd, int id, const float* grad, int64_t count) {
   size_t o = b.size();
   b.resize(o + count * sizeof(float));
   std::memcpy(b.data() + o, grad, count * sizeof(float));
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
+}
+
+// request-id variants: safe to resend after a transport failure — the
+// server acks duplicates without re-applying (resender.h analog)
+
+int ps_van_dense_push_id(int fd, int id, const float* grad, int64_t count,
+                         uint64_t req) {
+  std::vector<char> b{(char)OP_DENSE_PUSH_ID}, pay;
+  put<int32_t>(b, id);
+  put<uint64_t>(b, req);
+  size_t o = b.size();
+  b.resize(o + count * sizeof(float));
+  std::memcpy(b.data() + o, grad, count * sizeof(float));
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
+}
+
+int ps_van_sparse_push_id(int fd, int id, const int64_t* idx,
+                          const float* grads, int64_t n, int64_t dim,
+                          uint64_t req) {
+  std::vector<char> b{(char)OP_SPARSE_PUSH_ID}, pay;
+  put<int32_t>(b, id); put<int64_t>(b, n);
+  put<uint64_t>(b, req);
+  size_t o = b.size();
+  b.resize(o + n * sizeof(int64_t) + n * dim * sizeof(float));
+  std::memcpy(b.data() + o, idx, n * sizeof(int64_t));
+  std::memcpy(b.data() + o + n * sizeof(int64_t), grads,
+              n * dim * sizeof(float));
   int32_t rc = kTransportErr;
   return request(fd, b, &rc, &pay) ? rc : kTransportErr;
 }

@@ -3,3 +3,4 @@ from hetu_tpu.parallel.mesh import (
     AXIS_SP,
 )
 from hetu_tpu.parallel.spec import ShardSpec, NodeStatus
+from hetu_tpu.parallel.hetpipe import HetPipeWorker, make_weight_table

@@ -1,0 +1,175 @@
+"""HetPipe: pipelined virtual workers with PS-synced weights (WSP).
+
+Reference: python/hetu/gpu_ops/pipedream_subexecutor.py — the
+``pipeline == "hetpipe"`` mode: per-weight gradient accumulation across the
+wave (`grad_accum_map`, :77-87), a LOCAL optimizer update between PS syncs
+(`update_gradient_local` + `run_optimizer`, :149-176), and a push of the
+accumulated gradients through the parameter server every `pp_nrank`
+microbatches (`need_sync`, :293-318).  Cross-worker staleness is bounded by
+the PS's SSP clocks (ssp_handler.h), realizing the HetPipe paper's Wave
+Synchronous Parallel.
+
+TPU form: one *virtual worker* = one `PipeDream1F1B` pipeline over a `pp`
+mesh axis (the wave's microbatch grads come back already accumulated from
+the single compiled 1F1B pass).  The PS plane is the native C++ table core
+— local (`PSTable`), remote (`van.RemotePSTable`), or range-partitioned
+over many servers (`van.PartitionedPSTable`) — whose *server-side*
+optimizer applies pushed gradients to the global weights (DDPushPull).
+Between syncs the worker advances a local weight copy with plain SGD
+exactly like the reference's `run_optimizer` (w -= lr * g), then discards
+the lookahead when the fresh global weights arrive.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.parallel.pipedream import PipeDream1F1B
+
+
+def _flatten_spec(tree):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+    return treedef, shapes, sizes
+
+
+def flatten_params(tree) -> np.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return np.concatenate(
+        [np.asarray(l, np.float32).ravel() for l in leaves])
+
+
+def unflatten_params(flat: np.ndarray, template):
+    treedef, shapes, sizes = _flatten_spec(template)
+    out, off = [], 0
+    for shape, size in zip(shapes, sizes):
+        out.append(jnp.asarray(flat[off:off + size].reshape(shape)))
+        off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# the van server bounds one sparse op at 2^24 rows and a 1 GiB frame; stay
+# comfortably under both for any dim
+_PUBLISH_CHUNK = 1 << 20
+
+
+def publish_weights(table, params) -> None:
+    """Write a parameter pytree into a PS weight table (chunked: models
+    larger than the van's per-request row bound need multiple sets).  Also
+    the caller-driven restore path after a server recovery."""
+    flat = flatten_params(params)
+    n = flat.shape[0]
+    for off in range(0, n, _PUBLISH_CHUNK):
+        end = min(off + _PUBLISH_CHUNK, n)
+        table.sparse_set(np.arange(off, end),
+                         flat[off:end].reshape(end - off, 1))
+
+
+class HetPipeWorker:
+    """One HetPipe virtual worker: 1F1B pipeline + PS weight sync.
+
+    Parameters
+    ----------
+    pipe : PipeDream1F1B
+        The compiled pipeline runtime (the wave = its n_microbatches).
+    params : pytree
+        Initial stacked stage parameters (`pipe.stack_params(...)`).
+    table
+        A PS table handle with ``dense_push/dense_pull/sparse_set`` and
+        ``rows == total param count, dim == 1`` — `ps.PSTable`,
+        `ps.van.RemotePSTable`, or `ps.van.PartitionedPSTable`.  Its
+        server-side optimizer is the GLOBAL optimizer.
+    publish_init : bool
+        True on exactly one worker: seeds the server table with `params`.
+    sync_every : int
+        Waves between PS syncs (reference `need_sync`: every pp_nrank
+        microbatches == 1 wave here; >1 stretches the lookahead run).
+    local_lr : float
+        SGD rate for the local lookahead updates between syncs
+        (reference `run_optimizer`).
+    ssp : ps.SSPController, optional
+        Bounded-staleness clocks across virtual workers; `worker_id`
+        indexes this worker's clock.
+    """
+
+    def __init__(self, pipe: PipeDream1F1B, params, table, *,
+                 publish_init: bool = False, sync_every: int = 1,
+                 local_lr: float = 0.01, worker_id: int = 0,
+                 ssp=None, ssp_timeout_ms: int = 60_000):
+        self.pipe = pipe
+        self.params = params
+        self.table = table
+        self.sync_every = max(1, sync_every)
+        self.local_lr = local_lr
+        self.worker_id = worker_id
+        self.ssp = ssp
+        self.ssp_timeout_ms = ssp_timeout_ms
+        self.wave = 0
+        self._accum = None
+        n = flatten_params(params).shape[0]
+        if table.rows * table.dim != n:
+            raise ValueError(
+                f"PS table holds {table.rows * table.dim} floats but the "
+                f"model has {n} parameters")
+        if publish_init:
+            publish_weights(table, params)
+
+    def pull_weights(self) -> None:
+        """Replace local weights with the server's global weights."""
+        flat = np.asarray(self.table.dense_pull(), np.float32).ravel()
+        self.params = unflatten_params(flat, self.params)
+
+    def step(self, h, loss_fn: Callable) -> float:
+        """Run one wave (M microbatches through the 1F1B pipeline) and the
+        HetPipe weight protocol; returns the wave's loss."""
+        loss, grads = self.pipe.value_and_grad(self.params, h, loss_fn)
+        self._accum = grads if self._accum is None else \
+            jax.tree_util.tree_map(jnp.add, self._accum, grads)
+        self.wave += 1
+        if self.wave % self.sync_every == 0:
+            # DDPushPull: server optimizer applies the accumulated wave
+            # grads to the global weights; the local lookahead is discarded
+            flat_g = flatten_params(self._accum)
+            self.table.dense_push(flat_g.reshape(self.table.rows,
+                                                 self.table.dim))
+            self._accum = None
+            self.pull_weights()
+            if self.ssp is not None:
+                ok = self.ssp.clock_and_wait(self.worker_id,
+                                             self.ssp_timeout_ms)
+                if not ok:
+                    raise RuntimeError(
+                        f"HetPipe worker {self.worker_id}: staleness bound "
+                        "not restored within timeout (straggler?)")
+        else:
+            # local lookahead between syncs (reference run_optimizer)
+            self.params = jax.tree_util.tree_map(
+                lambda w, g: w - self.local_lr * g, self.params, grads)
+        return float(loss)
+
+
+def make_weight_table(params, *, optimizer: str = "sgd", lr: float = 0.01,
+                      remote: Optional[tuple] = None, **opt_kwargs):
+    """Create the PS weight table for a HetPipe worker group.
+
+    Local by default; pass ``remote=(host, port)`` for a van server, or a
+    list of ``(host, port)`` endpoints for a range-partitioned multi-server
+    group."""
+    from hetu_tpu import ps
+    n = flatten_params(params).shape[0]
+    if remote is None:
+        return ps.PSTable(n, 1, init="zeros", optimizer=optimizer, lr=lr,
+                          **opt_kwargs)
+    from hetu_tpu.ps import van
+    if isinstance(remote, list):
+        return van.PartitionedPSTable(remote, n, 1, init="zeros",
+                                      optimizer=optimizer, lr=lr,
+                                      **opt_kwargs)
+    host, port = remote
+    return van.RemotePSTable(host, port, n, 1, init="zeros",
+                             optimizer=optimizer, lr=lr, **opt_kwargs)

@@ -29,6 +29,18 @@ def _f32p(a):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
 
 
+def _as_idx(a) -> np.ndarray:
+    """Index marshalling shared by every table front-end (local/remote/
+    partitioned): contiguous flat int64."""
+    return np.ascontiguousarray(a, np.int64).reshape(-1)
+
+
+def _as_mat(a, n, dim) -> np.ndarray:
+    """Value marshalling shared by every table front-end: contiguous
+    (n, dim) float32."""
+    return np.ascontiguousarray(a, np.float32).reshape(n, dim)
+
+
 def _check(rc, what: str):
     """Raise on native-call failure (NOT assert: asserts vanish under -O)."""
     if rc != 0:

@@ -17,7 +17,7 @@ from typing import Optional
 import numpy as np
 
 from hetu_tpu.ps.binding import lib
-from hetu_tpu.ps.client import _check, _f32p, _i64p
+from hetu_tpu.ps.client import _as_idx, _as_mat, _check, _f32p, _i64p
 
 
 def _fresh_remote_id() -> int:
@@ -72,7 +72,7 @@ class RemotePSTable:
         return lib.ps_van_ping(self.fd) == 0
 
     def sparse_pull(self, indices) -> np.ndarray:
-        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
+        idx = _as_idx(indices)
         out = np.empty((idx.shape[0], self.dim), np.float32)
         _check(lib.ps_van_sparse_pull(self.fd, self.id, _i64p(idx),
                                       idx.shape[0], _f32p(out), self.dim),
@@ -80,9 +80,8 @@ class RemotePSTable:
         return out
 
     def sparse_push(self, indices, grads) -> None:
-        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
-        g = np.ascontiguousarray(grads, np.float32).reshape(idx.shape[0],
-                                                            self.dim)
+        idx = _as_idx(indices)
+        g = _as_mat(grads, idx.shape[0], self.dim)
         _check(lib.ps_van_sparse_push(self.fd, self.id, _i64p(idx), _f32p(g),
                                       idx.shape[0], self.dim),
                "van_sparse_push")
@@ -94,15 +93,13 @@ class RemotePSTable:
         return out
 
     def dense_push(self, grad) -> None:
-        g = np.ascontiguousarray(grad, np.float32).reshape(self.rows,
-                                                           self.dim)
+        g = _as_mat(grad, self.rows, self.dim)
         _check(lib.ps_van_dense_push(self.fd, self.id, _f32p(g),
                                      self.rows * self.dim), "van_dense_push")
 
     def sparse_set(self, indices, values) -> None:
-        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
-        v = np.ascontiguousarray(values, np.float32).reshape(idx.shape[0],
-                                                             self.dim)
+        idx = _as_idx(indices)
+        v = _as_mat(values, idx.shape[0], self.dim)
         _check(lib.ps_van_sparse_set(self.fd, self.id, _i64p(idx), _f32p(v),
                                      idx.shape[0], self.dim),
                "van_sparse_set")
@@ -187,23 +184,21 @@ class PartitionedPSTable:
         return int(lib.ps_group_recovered(self.gid))
 
     def sparse_pull(self, indices) -> np.ndarray:
-        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
+        idx = _as_idx(indices)
         out = np.empty((idx.shape[0], self.dim), np.float32)
         _check(lib.ps_group_sparse_pull(self.gid, _i64p(idx), idx.shape[0],
                                         _f32p(out)), "group_sparse_pull")
         return out
 
     def sparse_push(self, indices, grads) -> None:
-        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
-        g = np.ascontiguousarray(grads, np.float32).reshape(idx.shape[0],
-                                                            self.dim)
+        idx = _as_idx(indices)
+        g = _as_mat(grads, idx.shape[0], self.dim)
         _check(lib.ps_group_sparse_push(self.gid, _i64p(idx), _f32p(g),
                                         idx.shape[0]), "group_sparse_push")
 
     def sparse_set(self, indices, values) -> None:
-        idx = np.ascontiguousarray(indices, np.int64).reshape(-1)
-        v = np.ascontiguousarray(values, np.float32).reshape(idx.shape[0],
-                                                             self.dim)
+        idx = _as_idx(indices)
+        v = _as_mat(values, idx.shape[0], self.dim)
         _check(lib.ps_group_sparse_set(self.gid, _i64p(idx), _f32p(v),
                                        idx.shape[0]), "group_sparse_set")
 
@@ -214,8 +209,7 @@ class PartitionedPSTable:
         return out
 
     def dense_push(self, grad) -> None:
-        g = np.ascontiguousarray(grad, np.float32).reshape(self.rows,
-                                                           self.dim)
+        g = _as_mat(grad, self.rows, self.dim)
         _check(lib.ps_group_dense_push(self.gid, _f32p(g)),
                "group_dense_push")
 

@@ -191,6 +191,41 @@ def test_uneven_rows_partition(two_servers):
     t.close()
 
 
+def test_push_request_id_dedup():
+    """A re-sent push with the same request id is acked but applied ONCE
+    (the resender at-least-once retry must be exactly-once on the server;
+    reference ps-lite dedups by message id)."""
+    import ctypes
+
+    from hetu_tpu.ps import lib
+    from hetu_tpu.ps.client import _f32p, _i64p
+
+    port = van.serve(0)
+    try:
+        t = van.RemotePSTable("127.0.0.1", port, 4, 2, init="zeros",
+                              optimizer="sgd", lr=1.0)
+        g = np.ones((4, 2), np.float32)
+        for _ in range(2):  # same req id sent twice == one apply
+            rc = lib.ps_van_dense_push_id(t.fd, t.id, _f32p(g), 8, 42)
+            assert rc == 0, rc
+        np.testing.assert_allclose(t.dense_pull(), -1.0)
+        idx = np.arange(2, dtype=np.int64)
+        gs = np.ones((2, 2), np.float32)
+        for _ in range(2):
+            rc = lib.ps_van_sparse_push_id(t.fd, t.id, _i64p(idx), _f32p(gs),
+                                           2, 2, 43)
+            assert rc == 0, rc
+        np.testing.assert_allclose(t.sparse_pull([0, 1]), -2.0)
+        np.testing.assert_allclose(t.sparse_pull([2, 3]), -1.0)
+        # a NEW id applies again
+        rc = lib.ps_van_dense_push_id(t.fd, t.id, _f32p(g), 8, 44)
+        assert rc == 0
+        np.testing.assert_allclose(t.sparse_pull([3]), -2.0)
+        t.close()
+    finally:
+        van.stop()
+
+
 def test_nesterov_server_optimizer():
     """Server-side Nesterov (reference optimizer.h has 5 optimizers) matches
     the lookahead-form numpy oracle."""
