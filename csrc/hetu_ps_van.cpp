@@ -22,6 +22,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <deque>
@@ -64,31 +65,47 @@ enum VanOp : uint8_t {
 };
 
 // Per-table bounded set of recently applied push request-ids.  A repeated
-// id is acknowledged rc=0 without re-applying the gradient.
+// id is acknowledged rc=0 without re-applying the gradient.  begin/finish
+// make claim-apply-record atomic ACROSS connections: a same-id request
+// racing an in-flight apply waits for its outcome instead of re-applying.
 class DedupSet {
  public:
-  bool contains(int table, uint64_t id) {
-    std::lock_guard<std::mutex> lk(mu_);
-    return seen_.count(std::make_pair(table, id)) != 0;
+  enum Claim { NEW, DUPLICATE };
+
+  Claim begin(int table, uint64_t id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto key = std::make_pair(table, id);
+    for (;;) {
+      if (done_.count(key)) return DUPLICATE;
+      if (!inflight_.count(key)) {
+        inflight_.insert(key);
+        return NEW;
+      }
+      cv_.wait(lk);  // another connection is applying this id right now
+    }
   }
 
-  // record only AFTER a successful apply: a failed-validation retry must
-  // not be mistaken for a duplicate
-  void record(int table, uint64_t id) {
+  // ok=false (apply failed validation): drop the claim so a retry with the
+  // same id is not mistaken for a duplicate
+  void finish(int table, uint64_t id, bool ok) {
     std::lock_guard<std::mutex> lk(mu_);
     auto key = std::make_pair(table, id);
-    if (!seen_.insert(key).second) return;
-    order_.push_back(key);
-    while (order_.size() > kCap) {
-      seen_.erase(order_.front());
-      order_.pop_front();
+    inflight_.erase(key);
+    if (ok && done_.insert(key).second) {
+      order_.push_back(key);
+      while (order_.size() > kCap) {
+        done_.erase(order_.front());
+        order_.pop_front();
+      }
     }
+    cv_.notify_all();
   }
 
  private:
   static constexpr size_t kCap = 4096;
   std::mutex mu_;
-  std::set<std::pair<int, uint64_t>> seen_;
+  std::condition_variable cv_;
+  std::set<std::pair<int, uint64_t>> done_, inflight_;
   std::deque<std::pair<int, uint64_t>> order_;
 };
 DedupSet g_push_dedup;
@@ -193,21 +210,27 @@ void handle_conn(int fd) {
       case OP_DENSE_PUSH: case OP_DENSE_PUSH_ID: {
         int id = rd<int32_t>(p);
         uint64_t req = 0;
-        if (op == OP_DENSE_PUSH_ID) {
+        bool dedup = op == OP_DENSE_PUSH_ID;
+        if (dedup) {
           req = rd<uint64_t>(p);
-          if (g_push_dedup.contains(id, req)) {
+          if (g_push_dedup.begin(id, req) == DedupSet::DUPLICATE) {
             send_resp(fd, 0, nullptr, 0);  // duplicate: ack, don't re-apply
             break;
           }
         }
-        int64_t want = ps_table_rows(id) * ps_table_dim(id);
+        int64_t rows = ps_table_rows(id), dim = ps_table_dim(id);
+        int64_t want = rows * dim;
         int64_t have = (body.data() + blen - p) / (int64_t)sizeof(float);
-        if (want <= 0 || have < want ||
-            want * (int64_t)sizeof(float) > (int64_t)(1u << 30)) {
-          send_resp(fd, -3, nullptr, 0); break;
+        int rc;
+        if (rows < 0 || dim < 0) {
+          rc = -1;  // no such table: lets the group layer re-create it
+        } else if (want <= 0 || have < want ||
+                   want * (int64_t)sizeof(float) > (int64_t)(1u << 30)) {
+          rc = -3;
+        } else {
+          rc = ps_dense_push(id, (const float*)p);
         }
-        int rc = ps_dense_push(id, (const float*)p);
-        if (rc == 0 && op == OP_DENSE_PUSH_ID) g_push_dedup.record(id, req);
+        if (dedup) g_push_dedup.finish(id, req, rc == 0);
         send_resp(fd, rc, nullptr, 0);
         break;
       }
@@ -250,24 +273,30 @@ void handle_conn(int fd) {
         int id = rd<int32_t>(p);
         int64_t n = rd<int64_t>(p);
         uint64_t req = 0;
-        if (op == OP_SPARSE_PUSH_ID) {
+        bool dedup = op == OP_SPARSE_PUSH_ID;
+        if (dedup) {
           req = rd<uint64_t>(p);
-          if (g_push_dedup.contains(id, req)) {
+          if (g_push_dedup.begin(id, req) == DedupSet::DUPLICATE) {
             send_resp(fd, 0, nullptr, 0);  // duplicate: ack, don't re-apply
             break;
           }
         }
         int64_t dim = ps_table_dim(id);
         int64_t have = body.data() + blen - p;
-        if (dim <= 0 || n < 0 || n > (1 << 24) ||
-            have < n * (int64_t)(sizeof(int64_t) + dim * sizeof(float))) {
-          send_resp(fd, -3, nullptr, 0); break;
+        int rc;
+        if (dim < 0) {
+          rc = -1;  // no such table (NOT a bad frame): group recovery cue
+        } else if (dim == 0 || n < 0 || n > (1 << 24) ||
+                   have < n * (int64_t)(sizeof(int64_t) +
+                                        dim * sizeof(float))) {
+          rc = -3;
+        } else {
+          const auto* idx = (const int64_t*)p;
+          const auto* dat = (const float*)(p + n * sizeof(int64_t));
+          rc = op == OP_SPARSE_SET ? ps_sparse_set(id, idx, dat, n)
+                                   : ps_sparse_push(id, idx, dat, n);
         }
-        const auto* idx = (const int64_t*)p;
-        const auto* dat = (const float*)(p + n * sizeof(int64_t));
-        int rc = op == OP_SPARSE_SET ? ps_sparse_set(id, idx, dat, n)
-                                     : ps_sparse_push(id, idx, dat, n);
-        if (rc == 0 && op == OP_SPARSE_PUSH_ID) g_push_dedup.record(id, req);
+        if (dedup) g_push_dedup.finish(id, req, rc == 0);
         send_resp(fd, rc, nullptr, 0);
         break;
       }

@@ -4,7 +4,7 @@ Reference: examples/cnn (ResNet/VGG/LeNet/MLP), examples/nlp (BERT),
 examples/moe, examples/ctr (Wide&Deep etc.), tools/Galvatron (gpt/llama).
 """
 
-from hetu_tpu.models.resnet import ResNet, ResNet18, ResNet34
+from hetu_tpu.models.resnet import BasicBlock, ResNet, ResNet18, ResNet34
 from hetu_tpu.models.mlp import MLP
 from hetu_tpu.models.bert import BertConfig, BertModel, bert_base, bert_large
 from hetu_tpu.models.gpt import GPTConfig, GPTModel, gpt2_small

@@ -31,26 +31,19 @@ import numpy as np
 from hetu_tpu.parallel.pipedream import PipeDream1F1B
 
 
-def _flatten_spec(tree):
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    shapes = [l.shape for l in leaves]
-    sizes = [int(np.prod(s)) for s in shapes]
-    return treedef, shapes, sizes
-
-
 def flatten_params(tree) -> np.ndarray:
-    leaves = jax.tree_util.tree_leaves(tree)
-    return np.concatenate(
-        [np.asarray(l, np.float32).ravel() for l in leaves])
+    """Pytree -> flat f32 vector (jax.flatten_util.ravel_pytree order)."""
+    from jax.flatten_util import ravel_pytree
+    flat, _ = ravel_pytree(tree)
+    return np.asarray(flat, np.float32)
 
 
 def unflatten_params(flat: np.ndarray, template):
-    treedef, shapes, sizes = _flatten_spec(template)
-    out, off = [], 0
-    for shape, size in zip(shapes, sizes):
-        out.append(jnp.asarray(flat[off:off + size].reshape(shape)))
-        off += size
-    return jax.tree_util.tree_unflatten(treedef, out)
+    """Inverse of flatten_params against a same-structure template.  For
+    the hot path, HetPipeWorker caches the unravel closure instead."""
+    from jax.flatten_util import ravel_pytree
+    _, unravel = ravel_pytree(template)
+    return unravel(jnp.asarray(flat))
 
 
 # the van server bounds one sparse op at 2^24 rows and a 1 GiB frame; stay
@@ -111,7 +104,9 @@ class HetPipeWorker:
         self.ssp_timeout_ms = ssp_timeout_ms
         self.wave = 0
         self._accum = None
-        n = flatten_params(params).shape[0]
+        from jax.flatten_util import ravel_pytree
+        flat0, self._unravel = ravel_pytree(params)
+        n = int(flat0.size)
         if table.rows * table.dim != n:
             raise ValueError(
                 f"PS table holds {table.rows * table.dim} floats but the "
@@ -122,7 +117,7 @@ class HetPipeWorker:
     def pull_weights(self) -> None:
         """Replace local weights with the server's global weights."""
         flat = np.asarray(self.table.dense_pull(), np.float32).ravel()
-        self.params = unflatten_params(flat, self.params)
+        self.params = self._unravel(jnp.asarray(flat))
 
     def step(self, h, loss_fn: Callable) -> float:
         """Run one wave (M microbatches through the 1F1B pipeline) and the

@@ -6,3 +6,4 @@ from hetu_tpu.parallel.strategies.search import (
     FlexFlowSearching, GalvatronSearching, GPipeSearching, OptCNNSearching,
     PipeDreamSearching, PipeOptSearching, Plan,
 )
+from hetu_tpu.parallel.strategies.graph_plan import GraphPlanStrategy

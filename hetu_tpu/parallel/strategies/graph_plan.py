@@ -1,0 +1,68 @@
+"""Execute a graph-searched Plan on a branching CNN (ResNet & friends).
+
+Reference: the FlexFlow searcher's output is applied per-node to the real
+graph (distributed_strategies/flexflow.py → executor NodeStatus); here the
+searched per-node options become PartitionSpecs keyed by the GraphSpec's
+node names, which `profiler.graph_ir.resnet_graph_spec` keeps aligned with
+`models.resnet.ResNet` parameter paths.
+
+Conv kernels are OIHW: 'tp_col' = output-channel split (dim 0), 'tp_row' =
+input-channel split (dim 1; XLA inserts the partial-sum allreduce).  FC
+weights are (in, out): 'tp_col' splits out (dim 1), 'tp_row' splits in
+(dim 0).  Everything else (BN, biases) stays replicated — the indivisible
+cases degrade to replication in Strategy._fit.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from hetu_tpu.parallel.mesh import AXIS_TP
+from hetu_tpu.parallel.strategies.base import Strategy
+from hetu_tpu.parallel.strategies.search import Plan
+
+
+class GraphPlanStrategy(Strategy):
+    """Adapt a `FlexFlowSearching.search_graph` Plan to parameter specs.
+
+    The plan's meta['nodes'] gives the GraphSpec node names in option
+    order; a node named 'layer0_1.conv2' governs the parameter at tree
+    path "...['layer0_1']['conv2']['weight']"."""
+
+    def __init__(self, plan: Plan, gspec=None):
+        names = plan.meta.get("nodes")
+        if names is None:
+            if gspec is None:
+                raise ValueError("plan lacks meta['nodes']; pass the "
+                                 "GraphSpec it was searched on")
+            names = [l.name for l in gspec.layers]
+        if len(names) != len(plan.layer_options):
+            raise ValueError("node-name/option count mismatch")
+        self.node_opt = dict(zip(names, plan.layer_options))
+
+    def _match(self, path: str):
+        # node 'layer0_0.conv1' ↔ keystr "['layer0_0']['conv1']['weight']".
+        # Anchor at the path START: the stem node 'conv1' must not shadow
+        # every block's "...['conv1']..." parameter.
+        for name, opt in self.node_opt.items():
+            pat = "['" + name.replace(".", "']['") + "']"
+            if path.startswith(pat):
+                return opt
+        return None
+
+    def param_spec(self, path, leaf):
+        ndim = leaf.ndim if hasattr(leaf, "ndim") else len(leaf.shape)
+        opt = self._match(path)
+        if opt is None or opt.tp <= 1 or "weight" not in path:
+            return P()
+        if ndim == 4:  # conv OIHW
+            if opt.kind == "tp_col":
+                return P(AXIS_TP, None, None, None)
+            if opt.kind == "tp_row":
+                return P(None, AXIS_TP, None, None)
+        if ndim == 2:  # fc (in, out)
+            if opt.kind == "tp_col":
+                return P(None, AXIS_TP)
+            if opt.kind == "tp_row":
+                return P(AXIS_TP, None)
+        return P()

@@ -135,6 +135,47 @@ class FlexFlowSearching:
         return Plan(best, dp=self.dp, predicted_time=best_t,
                     meta={"searcher": "flexflow", "iters": self.iters})
 
+    def search_graph(self, gspec) -> Plan:
+        """Per-node MCMC over the real op DAG (reference flexflow.py:33
+        mutates one node's (status, device-group) per step and
+        delta-simulates).  Branch edges are priced, so a skip connection
+        penalizes option flips that the chain IR ignored.  A final greedy
+        coordinate-descent sweep polishes the MCMC result."""
+        layers = gspec.layers
+        cur = [l.options[0] for l in layers]
+        cur_t = self.sim.graph_time(gspec, cur, self.dp)
+        best, best_t = list(cur), cur_t
+        for _ in range(self.iters):
+            i = self.rng.randrange(len(layers))
+            if len(layers[i].options) <= 1:
+                continue
+            cand = list(cur)
+            cand[i] = self.rng.choice(layers[i].options)
+            t = self.sim.graph_time(gspec, cand, self.dp)
+            if t < cur_t or self.rng.random() < math.exp(
+                    -(t - cur_t) / max(self.temp * cur_t, 1e-12)):
+                cur, cur_t = cand, t
+                if t < best_t:
+                    best, best_t = list(cand), t
+        # greedy polish: one full sweep of single-node improvements
+        improved = True
+        while improved:
+            improved = False
+            for i, layer in enumerate(layers):
+                for o in layer.options:
+                    if o.key() == best[i].key():
+                        continue
+                    cand = list(best)
+                    cand[i] = o
+                    t = self.sim.graph_time(gspec, cand, self.dp)
+                    if t < best_t:
+                        best, best_t = cand, t
+                        improved = True
+        return Plan(best, dp=self.dp, predicted_time=best_t,
+                    meta={"searcher": "flexflow-graph",
+                          "iters": self.iters,
+                          "nodes": [l.name for l in layers]})
+
 
 class GPipeSearching:
     """Balanced stage partitioning by DP minimizing sum of squared stage

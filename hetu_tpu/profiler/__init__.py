@@ -1,3 +1,6 @@
 from hetu_tpu.profiler.profiler import OpProfiler, CollectiveProfiler
 from hetu_tpu.profiler.cost_model import ChipSpec, CHIPS, detect_chip
 from hetu_tpu.profiler.simulator import Simulator, LayerSpec, ShardOption
+from hetu_tpu.profiler.graph_ir import (
+    GraphSpec, graph_spec_from_node, resnet_graph_spec,
+)

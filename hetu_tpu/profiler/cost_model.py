@@ -41,16 +41,49 @@ CHIPS = {
 }
 
 
-def detect_chip() -> ChipSpec:
-    d = jax.devices()[0]
+_DETECTED: dict = {}
+
+
+def detect_chip(timeout_s: float = 15.0) -> ChipSpec:
+    """Identify the chip for the cost model (memoized).
+
+    The backend query runs under a timeout: with the TPU tunnel down,
+    ``jax.devices()`` blocks forever, and an OFFLINE plan search must not
+    hang on it — it falls back to the generic TPU spec (search results only
+    need costs to be mutually consistent, not absolutely calibrated).  The
+    probe outcome is cached so repeated Simulator/Planner constructions pay
+    the timeout at most once per process."""
+    import threading
+
+    if "spec" in _DETECTED:
+        return _DETECTED["spec"]
+
+    found = {}
+
+    def probe():
+        try:
+            found["d"] = jax.devices()[0]
+        except Exception:  # pragma: no cover - backend-specific
+            pass
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "d" not in found:
+        _DETECTED["spec"] = CHIPS["v5e"]  # offline default: bench target
+        return _DETECTED["spec"]
+    d = found["d"]
     kind = getattr(d, "device_kind", "").lower()
     if "v5 lite" in kind or "v5e" in kind:
-        return CHIPS["v5e"]
-    if "v5p" in kind or "v5" in kind:
-        return CHIPS["v5p"]
-    if "v4" in kind:
-        return CHIPS["v4"]
-    return CHIPS["cpu"]
+        spec = CHIPS["v5e"]
+    elif "v5p" in kind or "v5" in kind:
+        spec = CHIPS["v5p"]
+    elif "v4" in kind:
+        spec = CHIPS["v4"]
+    else:
+        spec = CHIPS["cpu"]
+    _DETECTED["spec"] = spec
+    return spec
 
 
 def matmul_time(spec: ChipSpec, m: int, k: int, n: int,

@@ -1,0 +1,235 @@
+"""Graph-shaped search IR: a DAG of LayerSpecs (branches, skip connections).
+
+Reference: python/hetu/distributed_strategies/flexflow.py:33 — FlexFlow
+searches per-NODE (status, device-group) over the *actual op graph*, not a
+layer chain; base.py:47-156 forms node groups from the traced graph.  The
+chain IR (profiler/simulator.py LayerSpec list) cannot represent ResNet
+skip connections or multi-tower CTR models; this module adds the DAG form
+and two builders:
+
+  * `resnet_graph_spec` — the branching ResNet cost graph whose node names
+    match `models.resnet.ResNet` parameter paths, so a searched plan
+    executes end-to-end via `GraphPlanStrategy`;
+  * `graph_spec_from_node` — derive the DAG from a define-then-run facade
+    graph (`hetu_tpu.graph.Node`), the direct analog of the reference
+    searching its user-built op graph.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from hetu_tpu.profiler.simulator import LayerSpec, ShardOption
+
+
+@dataclass
+class GraphSpec:
+    """A DAG of cost nodes in topological order.
+
+    `preds[i]` lists the indices of node i's dataflow predecessors; an edge
+    (p -> i) carries `layers[p].act_bytes` and is priced with the
+    simulator's reshard model when the two ends pick mismatched options.
+    """
+
+    layers: List[LayerSpec]
+    preds: List[List[int]] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.preds:
+            # default: a chain (makes GraphSpec a strict superset of the
+            # chain IR)
+            self.preds = [[i - 1] if i > 0 else [] for i in
+                          range(len(self.layers))]
+        for i, ps in enumerate(self.preds):
+            for p in ps:
+                if not 0 <= p < i:
+                    raise ValueError(
+                        f"preds must be topological: node {i} <- {p}")
+
+    @property
+    def names(self) -> List[str]:
+        return [l.name for l in self.layers]
+
+    def edges(self):
+        for i, ps in enumerate(self.preds):
+            for p in ps:
+                yield p, i
+
+
+def _conv_options(tp_candidates) -> List[ShardOption]:
+    """Channel-split options for a conv node: 'tp_col' = output-channel
+    split (OIHW dim 0), 'tp_row' = input-channel split (dim 1, partial-sum
+    output)."""
+    opts = [ShardOption("dp")]
+    for t in tp_candidates:
+        if t > 1:
+            opts.append(ShardOption("tp_col", t))
+            opts.append(ShardOption("tp_row", t))
+    return opts
+
+
+def resnet_graph_spec(num_blocks: Sequence[int] = (2, 2, 2, 2),
+                      num_classes: int = 10, *, batch: int = 128,
+                      image: int = 32, base_width: int = 64,
+                      tp_candidates=(1, 2, 4),
+                      bytes_per_el: int = 4) -> GraphSpec:
+    """Branching cost DAG for `models.resnet.ResNet(BasicBlock, num_blocks)`.
+
+    Each BasicBlock contributes conv1 -> conv2 -> add, with the add's
+    second predecessor the block INPUT (identity skip) or a downsample
+    conv — the branch structure the chain IR could not express.  Node names
+    mirror the model's parameter paths (`layer{si}_{bi}.conv1`, ...) so
+    `GraphPlanStrategy` can execute the searched plan.
+    """
+    layers: List[LayerSpec] = []
+    preds: List[List[int]] = []
+
+    def conv_node(name, cin, cout, hw, stride, *, k=3, prev=None):
+        out_hw = hw // stride
+        flops = 2.0 * batch * cout * out_hw * out_hw * cin * k * k
+        layers.append(LayerSpec(
+            name=name, flops=flops,
+            param_bytes=float(cout * cin * k * k * 4),
+            act_bytes=float(batch * cout * out_hw * out_hw * bytes_per_el),
+            options=_conv_options(tp_candidates)))
+        preds.append([] if prev is None else [prev])
+        return len(layers) - 1, out_hw
+
+    def add_node(name, cout, hw, a, b):
+        layers.append(LayerSpec(
+            name=name, flops=float(batch * cout * hw * hw),
+            param_bytes=0.0,
+            act_bytes=float(batch * cout * hw * hw * bytes_per_el),
+            options=[ShardOption("dp")]))
+        preds.append([a, b])
+        return len(layers) - 1
+
+    stem, hw = conv_node("conv1", 3, base_width, image, 1)
+    cur, cin = stem, base_width
+    planes = base_width
+    for si, n in enumerate(num_blocks):
+        stride = 1 if si == 0 else 2
+        for bi in range(n):
+            s = stride if bi == 0 else 1
+            blk = f"layer{si}_{bi}"
+            block_in = cur
+            c1, hw1 = conv_node(f"{blk}.conv1", cin, planes, hw, s,
+                                prev=block_in)
+            c2, _ = conv_node(f"{blk}.conv2", planes, planes, hw1, 1,
+                              prev=c1)
+            if s != 1 or cin != planes:
+                ds, _ = conv_node(f"{blk}.ds_conv", cin, planes, hw, s, k=1,
+                                  prev=block_in)
+                skip = ds
+            else:
+                skip = block_in
+            cur = add_node(f"{blk}.add", planes, hw1, c2, skip)
+            hw, cin = hw1, planes
+        planes *= 2
+    # global pool + fc head
+    layers.append(LayerSpec(
+        name="fc", flops=2.0 * batch * cin * num_classes,
+        param_bytes=float(cin * num_classes * 4),
+        act_bytes=float(batch * num_classes * bytes_per_el),
+        options=[ShardOption("dp")] + [ShardOption("tp_col", t)
+                                       for t in tp_candidates if t > 1]))
+    preds.append([cur])
+    return GraphSpec(layers, preds)
+
+
+# ---------------------------------------------------------------- facade
+
+def graph_spec_from_node(outputs, *, batch_hint: int = 1,
+                         tp_candidates=(1, 2, 4),
+                         bytes_per_el: int = 4) -> GraphSpec:
+    """Build the cost DAG from a define-then-run facade graph.
+
+    Walks the `hetu_tpu.graph.Node` DAG reachable from `outputs` (reference:
+    FlexFlow operating on the user's op graph, flexflow.py:33).  Shapes come
+    from abstract evaluation over the topo order; matmul/conv nodes get
+    tensor-split options, everything else is data-parallel only.  Variable
+    inputs fold into their consumer's param_bytes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from hetu_tpu.graph import Node, topo_sort
+
+    if isinstance(outputs, Node):
+        outputs = [outputs]
+    topo = topo_sort(outputs)
+
+    # abstract-eval every node's output shape
+    shapes: Dict[int, tuple] = {}
+    avals: Dict[int, jax.ShapeDtypeStruct] = {}
+
+    def node_aval(n: Node):
+        if n.id in avals:
+            return avals[n.id]
+        if n.kind == "placeholder":
+            shape = n.attrs.get("shape")
+            if shape is None:
+                raise ValueError(
+                    f"placeholder {n.name} needs a shape for graph search")
+            av = jax.ShapeDtypeStruct(tuple(shape),
+                                      n.attrs.get("dtype", jnp.float32))
+        elif n.kind in ("variable", "constant"):
+            v = n.attrs["value"]
+            av = jax.ShapeDtypeStruct(jnp.shape(v), jnp.result_type(v))
+        else:
+            in_avals = [node_aval(i) for i in n.inputs]
+            kw = {k: v for k, v in n.attrs.items()}
+            av = jax.eval_shape(lambda *a: n.fn(*a, **kw), *in_avals)
+        avals[n.id] = av
+        shapes[n.id] = tuple(av.shape)
+        return av
+
+    for n in topo:
+        node_aval(n)
+
+    # op nodes become cost nodes; variables fold into consumers
+    op_nodes = [n for n in topo if n.kind == "op"]
+    index: Dict[int, int] = {n.id: i for i, n in enumerate(op_nodes)}
+    layers: List[LayerSpec] = []
+    preds: List[List[int]] = []
+    for n in op_nodes:
+        shape = shapes[n.id]
+        size = float(np.prod(shape)) if shape else 1.0
+        param_bytes = sum(
+            float(np.prod(shapes[i.id])) * 4 for i in n.inputs
+            if isinstance(i, Node) and i.kind == "variable")
+        fname = getattr(n.fn, "__name__", "")
+        if fname in ("matmul", "linear") or "conv" in fname:
+            # FLOPs = 2 * out_size * contracted dim.  For convs the
+            # contraction is over cin*kh*kw — read it off the OIHW weight,
+            # not the input's trailing (spatial) dim.
+            w_shapes = [shapes[i.id] for i in n.inputs
+                        if isinstance(i, Node) and i.kind == "variable"]
+            in_shapes = [shapes[i.id] for i in n.inputs
+                         if isinstance(i, Node)]
+            if "conv" in fname and any(len(s) == 4 for s in w_shapes):
+                w = next(s for s in w_shapes if len(s) == 4)
+                contracted = int(np.prod(w[1:]))        # cin * kh * kw
+            elif w_shapes and len(w_shapes[0]) == 2:
+                contracted = w_shapes[0][0]             # (in, out) weight
+            else:
+                contracted = in_shapes[0][-1] if in_shapes and \
+                    in_shapes[0] else 1
+            flops = 2.0 * size * contracted
+            options = [ShardOption("dp")] + [
+                ShardOption("tp_col", t) for t in tp_candidates if t > 1] + [
+                ShardOption("tp_row", t) for t in tp_candidates if t > 1]
+        else:
+            flops = size
+            options = [ShardOption("dp")]
+        layers.append(LayerSpec(
+            name=n.name, flops=flops * max(batch_hint, 1),
+            param_bytes=param_bytes,
+            act_bytes=size * bytes_per_el * max(batch_hint, 1),
+            options=options))
+        preds.append(sorted(index[i.id] for i in n.inputs
+                            if isinstance(i, Node) and i.id in index))
+    return GraphSpec(layers, preds)

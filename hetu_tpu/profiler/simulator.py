@@ -112,12 +112,30 @@ class Simulator:
     # ---- whole-chain ----
     def chain_time(self, layers: Sequence[LayerSpec],
                    choice: Sequence[ShardOption], dp: int) -> float:
+        """Total chain time; the resharded tensor on an edge is the
+        PRODUCER's output, so its act_bytes price the edge (same convention
+        as graph_time — a chain-shaped GraphSpec costs identically)."""
         t = 0.0
-        prev = None
+        prev = prev_layer = None
         for layer, opt in zip(layers, choice):
-            t += self.reshard_time(prev, opt, layer.act_bytes, dp)
+            if prev_layer is not None:
+                t += self.reshard_time(prev, opt, prev_layer.act_bytes, dp)
             t += self.layer_time(layer, opt, dp)
-            prev = opt
+            prev, prev_layer = opt, layer
+        return t
+
+    # ---- whole-DAG (graph IR: branches priced per edge) ----
+    def graph_time(self, gspec, choice: Sequence[ShardOption],
+                   dp: int) -> float:
+        """Total time of a GraphSpec under per-node choices: node compute +
+        reshard on every EDGE (a skip connection whose two ends disagree
+        pays for its reconciliation, which the chain model missed)."""
+        t = 0.0
+        for layer, opt in zip(gspec.layers, choice):
+            t += self.layer_time(layer, opt, dp)
+        for p, i in gspec.edges():
+            t += self.reshard_time(choice[p], choice[i],
+                                   gspec.layers[p].act_bytes, dp)
         return t
 
     # ---- pipeline (GPipe bubble model) ----

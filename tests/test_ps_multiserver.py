@@ -171,6 +171,26 @@ def test_killed_server_fails_cleanly_then_recovers(two_servers, tmp_path):
     # caller-driven weight restore onto the recovered shard works
     t.sparse_set([7], np.full((1, 2), 5.0, np.float32))
     np.testing.assert_allclose(t.sparse_pull([7]), 5.0)
+
+    # regression: a sparse WRITE must itself trigger recovery (the server
+    # must answer 'no table' (-1), not 'bad frame' (-3), for sparse ops on
+    # a restarted-blank server)
+    procs[1].kill()
+    procs[1].wait()
+    procs[1] = _spawn_server(tmp_path, ports[1], "s1c")
+    rec_before = t.recovered
+    deadline = time.time() + 20
+    ok = False
+    while time.time() < deadline:
+        try:
+            t.sparse_set([8], np.full((1, 2), 9.0, np.float32))
+            ok = True
+            break
+        except RuntimeError:
+            time.sleep(0.2)
+    assert ok, "sparse_set never recovered after restart"
+    assert t.recovered > rec_before
+    np.testing.assert_allclose(t.sparse_pull([8]), 9.0)
     t.close()
 
 
