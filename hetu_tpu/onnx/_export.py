@@ -1,0 +1,356 @@
+"""jaxpr -> ONNX model bytes (the hetu2onnx.export analog).
+
+Reference: python/hetu/onnx/hetu2onnx.py:27 walks the hetu op graph and
+emits ONNX nodes through per-op opset handlers (onnx_opset/*); here the
+traced jaxpr is walked and each primitive lowered through `_EMITTERS`,
+writing the wire format directly via `hetu_tpu.onnx.proto` (no `onnx`
+package in this environment).
+
+Weights (jaxpr consts) become graph initializers, as ONNX stores them.
+pjit / custom_jvp / closed_call sub-jaxprs are inlined; `scan` is rejected
+with a pointer at the per-layer model variants (HeteroGPT) whose traces are
+flat.  Target opset 13.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from hetu_tpu.onnx import proto as P
+
+
+class _Ctx:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.names: Dict[int, str] = {}   # id(var) -> name
+        self.counter = 0
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def init_tensor(self, arr, hint="const"):
+        name = self.fresh(hint)
+        self.initializers.append(P.tensor_proto(name, np.asarray(arr)))
+        return name
+
+    def emit(self, op_type, inputs, outputs, attrs=None):
+        self.nodes.append(P.node_proto(op_type, inputs, outputs,
+                                       attrs=attrs))
+
+
+def _std_matmul(dn) -> bool:
+    """dot_general patterns ONNX MatMul covers: contract lhs last with rhs
+    first non-batch dim, batch dims leading and aligned."""
+    (lc, rc), (lb, rb) = dn
+    if len(lc) != 1 or len(rc) != 1:
+        return False
+    nb = len(lb)
+    if tuple(lb) != tuple(range(nb)) or tuple(rb) != tuple(range(nb)):
+        return False
+    return rc[0] == nb  # rhs contracts its first non-batch dim
+    # (lhs contract position is free: Einsum handles the rest)
+
+
+def _einsum_eq(dn, lhs_ndim, rhs_ndim) -> str:
+    (lc, rc), (lb, rb) = dn
+    letters = "abcdefghijklmnopqrstuvwxyz"
+    it = iter(letters)
+    lhs = [None] * lhs_ndim
+    rhs = [None] * rhs_ndim
+    for i, j in zip(lb, rb):
+        c = next(it)
+        lhs[i] = c
+        rhs[j] = c
+    for i, j in zip(lc, rc):
+        c = next(it)
+        lhs[i] = c
+        rhs[j] = c
+    for i in range(lhs_ndim):
+        if lhs[i] is None:
+            lhs[i] = next(it)
+    for j in range(rhs_ndim):
+        if rhs[j] is None:
+            rhs[j] = next(it)
+    out = [lhs[i] for i in lb]
+    out += [lhs[i] for i in range(lhs_ndim) if i not in lb and i not in lc]
+    out += [rhs[j] for j in range(rhs_ndim) if j not in rb and j not in rc]
+    return f"{''.join(lhs)},{''.join(rhs)}->{''.join(out)}"
+
+
+# ---- per-primitive emitters: fn(ctx, eqn, ins, outs) ----
+
+_SIMPLE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div", "neg": "Neg",
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "sqrt": "Sqrt",
+    "abs": "Abs", "sign": "Sign", "floor": "Floor", "ceil": "Ceil",
+    "max": "Max", "min": "Min", "pow": "Pow", "logistic": "Sigmoid",
+    "erf": "Erf", "stop_gradient": "Identity", "copy": "Identity",
+    "and": "And", "or": "Or", "not": "Not", "eq": "Equal",
+}
+_COMPARE = {"lt": ("Less", False), "le": ("LessOrEqual", False),
+            "gt": ("Greater", False), "ge": ("GreaterOrEqual", False)}
+
+
+def _emit_eqn(ctx: _Ctx, eqn, ins, outs):
+    prim = eqn.primitive.name
+    p = eqn.params
+    if prim in _SIMPLE:
+        ctx.emit(_SIMPLE[prim], ins, outs)
+    elif prim in _COMPARE:
+        ctx.emit(_COMPARE[prim][0], ins, outs)
+    elif prim == "rsqrt":
+        mid = ctx.fresh("sqrt")
+        ctx.emit("Sqrt", ins, [mid])
+        ctx.emit("Reciprocal", [mid], outs)
+    elif prim == "is_finite":
+        # finite == Not(Or(IsInf, IsNaN))
+        m1, m2, m3 = ctx.fresh("inf"), ctx.fresh("nan"), ctx.fresh("or")
+        ctx.emit("IsInf", ins, [m1])
+        ctx.emit("IsNaN", ins, [m2])
+        ctx.emit("Or", [m1, m2], [m3])
+        ctx.emit("Not", [m3], outs)
+    elif prim == "square":
+        ctx.emit("Mul", [ins[0], ins[0]], outs)
+    elif prim == "cube":
+        mid = ctx.fresh("sq")
+        ctx.emit("Mul", [ins[0], ins[0]], [mid])
+        ctx.emit("Mul", [mid, ins[0]], outs)
+    elif prim == "integer_pow":
+        dt = np.dtype(eqn.invars[0].aval.dtype)
+        y = ctx.init_tensor(np.asarray(p["y"], dt), "pow_exp")
+        ctx.emit("Pow", [ins[0], y], outs)
+    elif prim == "dot_general":
+        dn = p["dimension_numbers"]
+        lhs_nd = len(eqn.invars[0].aval.shape)
+        rhs_nd = len(eqn.invars[1].aval.shape)
+        (lc, rc), (lb, rb) = dn
+        if _std_matmul(dn) and lc[0] == lhs_nd - 1:
+            ctx.emit("MatMul", ins, outs)
+        else:
+            ctx.emit("Einsum", ins, outs,
+                     {"equation": _einsum_eq(dn, lhs_nd, rhs_nd)})
+    elif prim == "conv_general_dilated":
+        dn = p["dimension_numbers"]
+        if (dn.lhs_spec[0], dn.lhs_spec[1]) != (0, 1) or \
+                (dn.rhs_spec[0], dn.rhs_spec[1]) != (0, 1) or \
+                (dn.out_spec[0], dn.out_spec[1]) != (0, 1):
+            raise ValueError("ONNX export: conv must be NCHW/OIHW")
+        if any(d != 1 for d in p["lhs_dilation"]):
+            raise ValueError("ONNX export: transposed conv unsupported")
+        pads = [lo for lo, _ in p["padding"]] + \
+               [hi for _, hi in p["padding"]]
+        ctx.emit("Conv", ins, outs, {
+            "strides": list(p["window_strides"]),
+            "pads": pads,
+            "dilations": list(p["rhs_dilation"]),
+            "group": int(p["feature_group_count"]),
+        })
+    elif prim == "reshape":
+        if p.get("dimensions") is not None:
+            raise ValueError("ONNX export: reshape with permutation")
+        shape = ctx.init_tensor(np.asarray(p["new_sizes"], np.int64),
+                                "shape")
+        ctx.emit("Reshape", [ins[0], shape], outs)
+    elif prim == "transpose":
+        ctx.emit("Transpose", ins, outs, {"perm": list(p["permutation"])})
+    elif prim == "broadcast_in_dim":
+        shape = p["shape"]
+        bdims = p["broadcast_dimensions"]
+        inter = [1] * len(shape)
+        for src, dst in enumerate(bdims):
+            inter[dst] = eqn.invars[0].aval.shape[src]
+        cur = ins[0]
+        if tuple(inter) != tuple(eqn.invars[0].aval.shape):
+            rs = ctx.init_tensor(np.asarray(inter, np.int64), "shape")
+            mid = ctx.fresh("rshp")
+            ctx.emit("Reshape", [cur, rs], [mid])
+            cur = mid
+        tgt = ctx.init_tensor(np.asarray(shape, np.int64), "shape")
+        ctx.emit("Expand", [cur, tgt], outs)
+    elif prim == "reduce_sum":
+        axes = ctx.init_tensor(np.asarray(p["axes"], np.int64), "axes")
+        ctx.emit("ReduceSum", [ins[0], axes], outs, {"keepdims": 0})
+    elif prim in ("reduce_max", "reduce_min", "reduce_prod"):
+        op = {"reduce_max": "ReduceMax", "reduce_min": "ReduceMin",
+              "reduce_prod": "ReduceProd"}[prim]
+        ctx.emit(op, ins, outs, {"axes": list(p["axes"]), "keepdims": 0})
+    elif prim == "reduce_and":
+        # bool all(): Cast -> ReduceMin -> Cast (opset-13 has no ReduceAnd)
+        m1, m2 = ctx.fresh("c"), ctx.fresh("r")
+        ctx.emit("Cast", ins, [m1], {"to": P.INT32})
+        ctx.emit("ReduceMin", [m1], [m2],
+                 {"axes": list(p["axes"]), "keepdims": 0})
+        ctx.emit("Cast", [m2], outs, {"to": P.BOOL})
+    elif prim == "convert_element_type":
+        dt = P.NP_TO_ONNX.get(np.dtype(p["new_dtype"]))
+        if dt is None:
+            raise ValueError(f"ONNX export: no dtype for {p['new_dtype']}")
+        ctx.emit("Cast", ins, outs, {"to": dt})
+    elif prim == "select_n":
+        if len(ins) != 3:
+            raise ValueError("ONNX export: select_n with >2 cases")
+        # select_n(pred, a, b) -> b where pred else a
+        ctx.emit("Where", [ins[0], ins[2], ins[1]], outs)
+    elif prim == "squeeze":
+        axes = ctx.init_tensor(np.asarray(p["dimensions"], np.int64),
+                               "axes")
+        ctx.emit("Squeeze", [ins[0], axes], outs)
+    elif prim == "concatenate":
+        ctx.emit("Concat", ins, outs, {"axis": int(p["dimension"])})
+    elif prim == "slice":
+        if p.get("strides") and any(s != 1 for s in p["strides"]):
+            steps = list(p["strides"])
+        else:
+            steps = [1] * len(p["start_indices"])
+        starts = ctx.init_tensor(
+            np.asarray(p["start_indices"], np.int64), "starts")
+        ends = ctx.init_tensor(
+            np.asarray(p["limit_indices"], np.int64), "ends")
+        axes = ctx.init_tensor(
+            np.arange(len(p["start_indices"]), dtype=np.int64), "axes")
+        st = ctx.init_tensor(np.asarray(steps, np.int64), "steps")
+        ctx.emit("Slice", [ins[0], starts, ends, axes, st], outs)
+    elif prim == "pad":
+        cfg = p["padding_config"]
+        if any(i != 0 for _, _, i in cfg):
+            raise ValueError("ONNX export: interior padding unsupported")
+        pads = [lo for lo, _, _ in cfg] + [hi for _, hi, _ in cfg]
+        pt = ctx.init_tensor(np.asarray(pads, np.int64), "pads")
+        ctx.emit("Pad", [ins[0], pt, ins[1]], outs, {"mode": "constant"})
+    elif prim == "clamp":
+        # Clip needs scalars; Max(Min(x, hi), lo) is universal
+        mid = ctx.fresh("clip")
+        ctx.emit("Min", [ins[1], ins[2]], [mid])
+        ctx.emit("Max", [mid, ins[0]], outs)
+    elif prim == "iota":
+        dt = np.dtype(p["dtype"])
+        dim = p["dimension"]
+        shape = p["shape"]
+        ar = np.arange(shape[dim], dtype=dt)
+        ar = np.broadcast_to(
+            ar.reshape([-1 if i == dim else 1
+                        for i in range(len(shape))]), shape)
+        name = ctx.init_tensor(ar, "iota")
+        ctx.emit("Identity", [name], outs)
+    elif prim == "gather":
+        _emit_gather(ctx, eqn, ins, outs)
+    elif prim == "argmax":
+        axes = p["axes"]
+        if len(axes) != 1:
+            raise ValueError("ONNX export: multi-axis argmax")
+        mid = ctx.fresh("am")
+        ctx.emit("ArgMax", ins, [mid],
+                 {"axis": int(axes[0]), "keepdims": 0})
+        dt = P.NP_TO_ONNX[np.dtype(p["index_dtype"])]
+        ctx.emit("Cast", [mid], outs, {"to": dt})
+    else:
+        raise ValueError(
+            f"ONNX export: unsupported primitive '{prim}'"
+            + (" — scan-stacked models can't flatten; export the per-layer"
+               " variant (e.g. HeteroGPT)" if prim == "scan" else ""))
+
+
+def _emit_gather(ctx, eqn, ins, outs):
+    """lax.gather -> ONNX Gather for the embedding/take pattern:
+    one collapsed slice dim indexed by the (squeezed) indices, full slices
+    elsewhere."""
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    operand = eqn.invars[0].aval
+    slice_sizes = tuple(p["slice_sizes"])
+    if len(dn.start_index_map) != 1 or \
+            dn.collapsed_slice_dims != dn.start_index_map:
+        raise ValueError("ONNX export: general lax.gather unsupported")
+    axis = dn.start_index_map[0]
+    for d, s in enumerate(slice_sizes):
+        want = 1 if d == axis else operand.shape[d]
+        if s != want:
+            raise ValueError("ONNX export: partial-slice gather")
+    # indices carry a trailing length-1 coordinate dim: squeeze it
+    idx = eqn.invars[1].aval
+    idx_in = ins[1]
+    if idx.shape and idx.shape[-1] == 1:
+        ax = ctx.init_tensor(np.asarray([idx.ndim - 1], np.int64), "axes")
+        mid = ctx.fresh("sq")
+        ctx.emit("Squeeze", [idx_in, ax], [mid])
+        idx_in = mid
+    ctx.emit("Gather", [ins[0], idx_in], outs, {"axis": int(axis)})
+
+
+def _flat_eqns(jaxpr, ctx, env):
+    """Yield eqns with pjit/custom_jvp/closed_call sub-jaxprs inlined
+    (env maps var id -> onnx name; sub-jaxpr vars get bridged)."""
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim in ("pjit", "jit", "closed_call", "custom_jvp_call",
+                    "custom_vjp_call", "custom_vjp_call_jaxpr",
+                    "remat", "checkpoint"):
+            sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") \
+                or eqn.params.get("fun_jaxpr")
+            if sub is None:
+                raise ValueError(f"ONNX export: opaque call '{prim}'")
+            consts = getattr(sub, "consts", [])
+            inner = getattr(sub, "jaxpr", sub)
+            # bind actual args to sub invars
+            for iv, ov in zip(inner.invars, eqn.invars):
+                env[id(iv)] = _name_of(ctx, env, ov)
+            for cv, c in zip(inner.constvars, consts):
+                env[id(cv)] = ctx.init_tensor(np.asarray(c), "w")
+            yield from _flat_eqns(inner, ctx, env)
+            for souter, sinner in zip(eqn.outvars, inner.outvars):
+                env[id(souter)] = _name_of(ctx, env, sinner)
+        else:
+            yield eqn
+
+
+def _name_of(ctx, env, var):
+    from jax.extend.core import Literal
+    if isinstance(var, Literal):
+        return ctx.init_tensor(np.asarray(var.val), "lit")
+    key = id(var)
+    if key not in env:
+        env[key] = ctx.fresh("v")
+    return env[key]
+
+
+def jaxpr_to_onnx(fn, *example_args, graph_name="hetu_tpu") -> bytes:
+    """Trace `fn` and lower the jaxpr to ONNX model bytes (opset 13)."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*example_args)
+    jaxpr = closed.jaxpr
+    ctx = _Ctx()
+    env: Dict[int, str] = {}
+
+    graph_inputs = []
+    for v in jaxpr.invars:
+        name = _name_of(ctx, env, v)
+        dt = P.NP_TO_ONNX.get(np.dtype(v.aval.dtype))
+        if dt is None:
+            raise ValueError(f"ONNX export: input dtype {v.aval.dtype}")
+        graph_inputs.append(P.value_info_proto(name, dt,
+                                               list(v.aval.shape)))
+    for cv, c in zip(jaxpr.constvars, closed.consts):
+        env[id(cv)] = ctx.init_tensor(np.asarray(c), "w")
+
+    for eqn in _flat_eqns(jaxpr, ctx, env):
+        ins = [_name_of(ctx, env, v) for v in eqn.invars]
+        outs = [_name_of(ctx, env, v) for v in eqn.outvars]
+        _emit_eqn(ctx, eqn, ins, outs)
+
+    graph_outputs = []
+    for v in jaxpr.outvars:
+        name = _name_of(ctx, env, v)
+        aval = getattr(v, "aval", None)
+        dt = P.NP_TO_ONNX.get(np.dtype(aval.dtype)) if aval is not None \
+            else P.FLOAT
+        shape = list(aval.shape) if aval is not None else []
+        graph_outputs.append(P.value_info_proto(name, dt, shape))
+
+    graph = P.graph_proto(ctx.nodes, graph_name, ctx.initializers,
+                          graph_inputs, graph_outputs)
+    return P.model_proto(graph)

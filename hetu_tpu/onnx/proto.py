@@ -1,0 +1,343 @@
+"""Minimal protobuf wire codec + the ONNX message subset, zero-dep.
+
+Reference: python/hetu/onnx/ emits real ONNX models via the `onnx` package;
+this environment has no `onnx`, so the stable protobuf wire format of the
+public onnx.proto schema is implemented directly (field numbers below are
+from that schema).  The reader tolerates both packed and unpacked repeated
+scalars and both raw_data and typed-array tensor payloads, so files written
+by other producers (e.g. torch.onnx) parse too — which is exactly how the
+codec is cross-validated in tests/test_onnx.py.
+
+Writer surface: `model_proto(...)` -> bytes.  Reader: `parse_model(bytes)`
+-> nested dicts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# ---- ONNX enums (onnx.proto TensorProto.DataType / AttributeProto.Type)
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64, STRING, BOOL = range(1, 10)
+FLOAT16, DOUBLE, UINT32, UINT64 = 10, 11, 12, 13
+BFLOAT16 = 16
+
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR = 1, 2, 3, 4
+AT_FLOATS, AT_INTS, AT_STRINGS = 6, 7, 8
+
+NP_TO_ONNX = {
+    np.dtype(np.float32): FLOAT, np.dtype(np.float64): DOUBLE,
+    np.dtype(np.float16): FLOAT16, np.dtype(np.int8): INT8,
+    np.dtype(np.uint8): UINT8, np.dtype(np.int16): INT16,
+    np.dtype(np.uint16): UINT16, np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64, np.dtype(np.uint32): UINT32,
+    np.dtype(np.uint64): UINT64, np.dtype(np.bool_): BOOL,
+}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+
+# -------------------------------------------------------------- wire write
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # two's-complement int64/enum negatives
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(int(v))
+
+
+def f_bytes(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def f_string(field: int, s: str) -> bytes:
+    return f_bytes(field, s.encode())
+
+
+def f_float32(field: int, v: float) -> bytes:
+    return _tag(field, 5) + np.float32(v).tobytes()
+
+
+def f_packed_ints(field: int, vs: Sequence[int]) -> bytes:
+    body = b"".join(_varint(int(v)) for v in vs)
+    return f_bytes(field, body)
+
+
+def f_packed_floats(field: int, vs: Sequence[float]) -> bytes:
+    return f_bytes(field, np.asarray(vs, np.float32).tobytes())
+
+
+# ------------------------------------------------------------ ONNX writers
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    arr = np.ascontiguousarray(arr)
+    dt = NP_TO_ONNX.get(arr.dtype)
+    if dt is None:
+        raise ValueError(f"no ONNX dtype for {arr.dtype}")
+    out = f_packed_ints(1, arr.shape)
+    out += f_varint(2, dt)
+    out += f_string(8, name)
+    out += f_bytes(9, arr.tobytes())
+    return out
+
+
+def attribute_proto(name: str, value) -> bytes:
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+    type=20."""
+    out = f_string(1, name)
+    if isinstance(value, bool):
+        out += f_varint(3, int(value)) + f_varint(20, AT_INT)
+    elif isinstance(value, int):
+        out += f_varint(3, value) + f_varint(20, AT_INT)
+    elif isinstance(value, float):
+        out += f_float32(2, value) + f_varint(20, AT_FLOAT)
+    elif isinstance(value, str):
+        out += f_bytes(4, value.encode()) + f_varint(20, AT_STRING)
+    elif isinstance(value, np.ndarray):
+        out += f_bytes(5, tensor_proto("", value)) + f_varint(20, AT_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            out += f_packed_floats(7, value) + f_varint(20, AT_FLOATS)
+        else:
+            out += f_packed_ints(8, value) + f_varint(20, AT_INTS)
+    else:
+        raise TypeError(f"unsupported attribute value: {value!r}")
+    return out
+
+
+def node_proto(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+               name: str = "", attrs: Optional[Dict] = None) -> bytes:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    out = b"".join(f_string(1, i) for i in inputs)
+    out += b"".join(f_string(2, o) for o in outputs)
+    if name:
+        out += f_string(3, name)
+    out += f_string(4, op_type)
+    for k, v in (attrs or {}).items():
+        out += f_bytes(5, attribute_proto(k, v))
+    return out
+
+
+def value_info_proto(name: str, elem_type: int,
+                     shape: Sequence[int]) -> bytes:
+    """ValueInfoProto{name=1, type=2}; TypeProto{tensor_type=1};
+    Tensor{elem_type=1, shape=2}; TensorShapeProto{dim=1{dim_value=1}}."""
+    dims = b"".join(f_bytes(1, f_varint(1, d)) for d in shape)
+    tensor_type = f_varint(1, elem_type) + f_bytes(2, dims)
+    type_proto = f_bytes(1, tensor_type)
+    return f_string(1, name) + f_bytes(2, type_proto)
+
+
+def graph_proto(nodes: Sequence[bytes], name: str,
+                initializers: Sequence[bytes],
+                inputs: Sequence[bytes], outputs: Sequence[bytes]) -> bytes:
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    out = b"".join(f_bytes(1, n) for n in nodes)
+    out += f_string(2, name)
+    out += b"".join(f_bytes(5, t) for t in initializers)
+    out += b"".join(f_bytes(11, i) for i in inputs)
+    out += b"".join(f_bytes(12, o) for o in outputs)
+    return out
+
+
+def model_proto(graph: bytes, *, opset: int = 13,
+                producer: str = "hetu_tpu") -> bytes:
+    """ModelProto: ir_version=1, producer_name=2, graph=7, opset_import=8;
+    OperatorSetIdProto: domain=1, version=2."""
+    out = f_varint(1, 8)  # IR version 8 (opset 13+ era)
+    out += f_string(2, producer)
+    out += f_bytes(7, graph)
+    out += f_bytes(8, f_string(1, "") + f_varint(2, opset))
+    return out
+
+
+# -------------------------------------------------------------- wire read
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def parse_fields(buf: bytes) -> Dict[int, List]:
+    """Generic message parse: field -> list of raw values (varint ints or
+    bytes for length-delimited; 4/8-byte scalars as bytes)."""
+    fields: Dict[int, List] = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            v = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            v = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append(v)
+    return fields
+
+
+def _ints(fields: Dict[int, List], field: int) -> List[int]:
+    """Repeated int64: accept packed (bytes) and unpacked (varints)."""
+    out: List[int] = []
+    for v in fields.get(field, []):
+        if isinstance(v, (bytes, bytearray)):
+            pos = 0
+            while pos < len(v):
+                x, pos = _read_varint(v, pos)
+                out.append(_signed64(x))
+        else:
+            out.append(_signed64(v))
+    return out
+
+
+def _signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _str(fields, field, default=""):
+    vs = fields.get(field)
+    return vs[0].decode() if vs else default
+
+
+def parse_tensor(buf: bytes) -> Dict:
+    f = parse_fields(buf)
+    dims = _ints(f, 1)
+    dt = f.get(2, [FLOAT])[0]
+    name = _str(f, 8)
+    np_dt = ONNX_TO_NP.get(dt)
+    if np_dt is None:
+        raise ValueError(f"unsupported tensor data_type {dt}")
+    if 9 in f:  # raw_data
+        arr = np.frombuffer(f[9][0], dtype=np_dt).reshape(dims)
+    elif 4 in f and dt == FLOAT:  # float_data (packed floats)
+        arr = np.frombuffer(f[4][0], np.float32).reshape(dims)
+    elif 7 in f and dt == INT64:  # int64_data
+        arr = np.asarray(_ints(f, 7), np.int64).reshape(dims)
+    elif 5 in f:  # int32_data (also holds bool/int8/... payloads)
+        arr = np.asarray(_ints(f, 5), np.int32).astype(np_dt).reshape(dims)
+    else:
+        arr = np.zeros(dims, np_dt)
+    return {"name": name, "array": arr}
+
+
+def parse_attribute(buf: bytes) -> Tuple[str, object]:
+    f = parse_fields(buf)
+    name = _str(f, 1)
+    at = f.get(20, [0])[0]
+    if at == AT_INT:
+        return name, _signed64(f.get(3, [0])[0])
+    if at == AT_FLOAT:
+        return name, float(np.frombuffer(f[2][0], np.float32)[0])
+    if at == AT_STRING:
+        return name, f[4][0].decode()
+    if at == AT_TENSOR:
+        return name, parse_tensor(f[5][0])["array"]
+    if at == AT_INTS:
+        return name, _ints(f, 8)
+    if at == AT_FLOATS:
+        out = []
+        for v in f.get(7, []):
+            if isinstance(v, (bytes, bytearray)) and len(v) != 4:
+                out.extend(np.frombuffer(v, np.float32).tolist())
+            else:
+                out.append(float(np.frombuffer(v, np.float32)[0]))
+        return name, out
+    # untyped fallback (some writers omit type=20): infer from presence
+    if 3 in f:
+        return name, _signed64(f[3][0])
+    if 8 in f:
+        return name, _ints(f, 8)
+    if 2 in f:
+        return name, float(np.frombuffer(f[2][0], np.float32)[0])
+    if 4 in f:
+        return name, f[4][0].decode()
+    if 5 in f:
+        return name, parse_tensor(f[5][0])["array"]
+    return name, None
+
+
+def parse_node(buf: bytes) -> Dict:
+    f = parse_fields(buf)
+    attrs = dict(parse_attribute(a) for a in f.get(5, []))
+    return {
+        "inputs": [v.decode() for v in f.get(1, [])],
+        "outputs": [v.decode() for v in f.get(2, [])],
+        "name": _str(f, 3),
+        "op_type": _str(f, 4),
+        "attrs": attrs,
+    }
+
+
+def parse_value_info(buf: bytes) -> Dict:
+    f = parse_fields(buf)
+    name = _str(f, 1)
+    elem_type, shape = None, []
+    if 2 in f:
+        tp = parse_fields(f[2][0])
+        if 1 in tp:  # tensor_type
+            tt = parse_fields(tp[1][0])
+            elem_type = tt.get(1, [None])[0]
+            if 2 in tt:
+                for dim in parse_fields(tt[2][0]).get(1, []):
+                    df = parse_fields(dim)
+                    shape.append(df.get(1, [None])[0])
+    return {"name": name, "elem_type": elem_type, "shape": shape}
+
+
+def parse_graph(buf: bytes) -> Dict:
+    f = parse_fields(buf)
+    return {
+        "nodes": [parse_node(n) for n in f.get(1, [])],
+        "name": _str(f, 2),
+        "initializers": [parse_tensor(t) for t in f.get(5, [])],
+        "inputs": [parse_value_info(v) for v in f.get(11, [])],
+        "outputs": [parse_value_info(v) for v in f.get(12, [])],
+    }
+
+
+def parse_model(buf: bytes) -> Dict:
+    f = parse_fields(buf)
+    opsets = []
+    for o in f.get(8, []):
+        of = parse_fields(o)
+        opsets.append({"domain": _str(of, 1),
+                       "version": of.get(2, [0])[0]})
+    if 7 not in f:
+        raise ValueError("not an ONNX model (no graph)")
+    return {
+        "ir_version": f.get(1, [0])[0],
+        "producer": _str(f, 2),
+        "graph": parse_graph(f[7][0]),
+        "opsets": opsets,
+    }
