@@ -25,8 +25,8 @@ class _Ctx:
     def __init__(self):
         self.nodes: List[bytes] = []
         self.initializers: List[bytes] = []
-        self.names: Dict[int, str] = {}   # id(var) -> name
         self.counter = 0
+        self._literal_cache: Dict = {}
 
     def fresh(self, hint="t"):
         self.counter += 1
@@ -37,22 +37,32 @@ class _Ctx:
         self.initializers.append(P.tensor_proto(name, np.asarray(arr)))
         return name
 
+    def init_literal(self, arr):
+        """Deduped initializer for jaxpr Literals: the same scalar (an
+        epsilon repeated per layer) serializes once."""
+        a = np.asarray(arr)
+        key = (a.tobytes(), str(a.dtype), a.shape)
+        if key not in self._literal_cache:
+            self._literal_cache[key] = self.init_tensor(a, "lit")
+        return self._literal_cache[key]
+
     def emit(self, op_type, inputs, outputs, attrs=None):
         self.nodes.append(P.node_proto(op_type, inputs, outputs,
                                        attrs=attrs))
 
 
-def _std_matmul(dn) -> bool:
-    """dot_general patterns ONNX MatMul covers: contract lhs last with rhs
-    first non-batch dim, batch dims leading and aligned."""
+def _std_matmul(dn, lhs_nd, rhs_nd) -> bool:
+    """dot_general patterns ONNX MatMul covers: [..., M, K] x [..., K, N]
+    — one contraction (lhs LAST dim with rhs first non-batch dim), batch
+    dims leading and aligned, and exactly ONE free dim on each side."""
     (lc, rc), (lb, rb) = dn
     if len(lc) != 1 or len(rc) != 1:
         return False
     nb = len(lb)
     if tuple(lb) != tuple(range(nb)) or tuple(rb) != tuple(range(nb)):
         return False
-    return rc[0] == nb  # rhs contracts its first non-batch dim
-    # (lhs contract position is free: Einsum handles the rest)
+    return (rc[0] == nb and lc[0] == lhs_nd - 1
+            and lhs_nd - nb == 2 and rhs_nd - nb == 2)
 
 
 def _einsum_eq(dn, lhs_ndim, rhs_ndim) -> str:
@@ -127,8 +137,7 @@ def _emit_eqn(ctx: _Ctx, eqn, ins, outs):
         dn = p["dimension_numbers"]
         lhs_nd = len(eqn.invars[0].aval.shape)
         rhs_nd = len(eqn.invars[1].aval.shape)
-        (lc, rc), (lb, rb) = dn
-        if _std_matmul(dn) and lc[0] == lhs_nd - 1:
+        if _std_matmul(dn, lhs_nd, rhs_nd):
             ctx.emit("MatMul", ins, outs)
         else:
             ctx.emit("Einsum", ins, outs,
@@ -310,7 +319,7 @@ def _flat_eqns(jaxpr, ctx, env):
 def _name_of(ctx, env, var):
     from jax.extend.core import Literal
     if isinstance(var, Literal):
-        return ctx.init_tensor(np.asarray(var.val), "lit")
+        return ctx.init_literal(np.asarray(var.val))
     key = id(var)
     if key not in env:
         env[key] = ctx.fresh("v")

@@ -73,6 +73,11 @@ def _batchnorm(node, ins):
 def _pool(node, ins, kind):
     x = ins[0]
     at = node["attrs"]
+    if at.get("ceil_mode"):
+        raise ValueError("ONNX import: pool ceil_mode=1 unsupported")
+    if at.get("auto_pad", "NOTSET") not in ("NOTSET", ""):
+        raise ValueError("ONNX import: pool auto_pad unsupported; use "
+                         "explicit pads")
     k = at["kernel_shape"]
     strides = at.get("strides", [1] * len(k))
     pads = _onnx_pads_to_jax(at.get("pads"), len(k))
@@ -185,8 +190,7 @@ _OPS = {
     "Concat": lambda n, i: jnp.concatenate(i, axis=n["attrs"]["axis"]),
     "Cast": lambda n, i: i[0].astype(P.ONNX_TO_NP[n["attrs"]["to"]]),
     "Where": lambda n, i: jnp.where(i[0].astype(bool), i[1], i[2]),
-    "Gather": lambda n, i: jnp.take(i[0], i[1].astype(jnp.int32),
-                                    axis=n["attrs"].get("axis", 0)),
+    "Gather": lambda n, i: _gather(n, i),
     "ReduceSum": lambda n, i: _reduce(n, i, jnp.sum),
     "ReduceMax": lambda n, i: _reduce(n, i, jnp.max),
     "ReduceMin": lambda n, i: _reduce(n, i, jnp.min),
@@ -214,6 +218,15 @@ _OPS = {
 }
 
 
+def _gather(node, ins):
+    axis = node["attrs"].get("axis", 0)
+    idx = ins[1].astype(jnp.int32)
+    dim = ins[0].shape[axis]
+    # ONNX allows negative indices (wrap-around); jnp.take would CLAMP them
+    idx = jnp.where(idx < 0, idx + dim, idx)
+    return jnp.take(ins[0], idx, axis=axis)
+
+
 def _argmax(node, ins):
     at = node["attrs"]
     r = jnp.argmax(ins[0], axis=at.get("axis", 0))
@@ -233,6 +246,9 @@ def _expand_shape(in_shape, target):
 
 
 def _pad(node, ins):
+    mode = node["attrs"].get("mode", "constant")
+    if mode not in ("constant", b"constant"):
+        raise ValueError(f"ONNX import: Pad mode {mode!r} unsupported")
     pads = [int(v) for v in np.asarray(ins[1]).ravel()]
     half = len(pads) // 2
     cfg = [(lo, hi, 0) for lo, hi in zip(pads[:half], pads[half:])]

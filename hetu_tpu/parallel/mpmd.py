@@ -1,0 +1,102 @@
+"""MPMD pipeline pieces: unequal per-stage DP over separate processes.
+
+Reference: python/hetu/gpu_ops/pipeline_subexecutor.py:87-128 — stages with
+DIFFERENT data-parallel degrees exchange activations through round-robin
+PipelineSend/ReceiveOp pairs whose targets come from the context's
+round-robin assignment (context.py:164-188).  SPMD (one jit over one mesh)
+cannot express two stages running different programs at different dp
+degrees; this module provides the TPU-native MPMD form:
+
+  * each stage range runs in its OWN process (own jax runtime, own mesh,
+    own dp degree) — `bin/heturun` can start them like any worker set;
+  * activations/cotangents hop processes through `VanMailbox` channels —
+    host-bridged transfers over the PS van plane (the DCN path; on real
+    multi-host TPU the bridge rides the same network the PS plane uses);
+  * `round_robin_assignments` reproduces the reference's microbatch ->
+    (sender replica, receiver replica) schedule.
+
+tests/test_mpmd.py runs the 2-process prototype (stage0 dp=2, stage1 dp=1)
+and checks end-to-end gradients against the single-process oracle —
+VERDICT #7's acceptance bar.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Tuple
+
+import numpy as np
+
+
+def round_robin_assignments(n_microbatches: int, n_src: int,
+                            n_dst: int) -> List[Tuple[int, int]]:
+    """Microbatch i is produced by stage-A replica i % n_src and consumed
+    by stage-B replica i % n_dst (reference context.py:164-188 round-robin
+    send/recv target computation)."""
+    return [(i % n_src, i % n_dst) for i in range(n_microbatches)]
+
+
+class VanMailbox:
+    """One-way single-slot channel over a PS van table.
+
+    Layout: rows [0, capacity) hold the payload, row `capacity` holds the
+    sequence flag.  `put` writes payload THEN flag; `get` polls the flag —
+    the van server applies one connection's requests in order, so the
+    reader observing seq implies the payload is complete.  A fresh `seq`
+    per message makes the channel reusable (ping-pong for fwd/bwd).
+    """
+
+    def __init__(self, host: str, port: int, channel_id: int,
+                 capacity: int, *, connect_timeout_s: float = 20.0):
+        from hetu_tpu.ps.van import RemotePSTable
+        self.capacity = capacity
+        deadline = time.time() + connect_timeout_s
+        # both endpoints race to create; -2 (exists) means the peer won
+        while True:
+            try:
+                self.table = RemotePSTable(
+                    host, port, capacity + 1, 1, table_id=channel_id,
+                    create=True, init="zeros",
+                    connect_timeout_s=connect_timeout_s)
+                break
+            except RuntimeError:
+                try:
+                    self.table = RemotePSTable(
+                        host, port, capacity + 1, 1, table_id=channel_id,
+                        create=False,
+                        connect_timeout_s=connect_timeout_s)
+                    break
+                except RuntimeError:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.05)
+
+    def put(self, arr, seq: int) -> None:
+        flat = np.ascontiguousarray(arr, np.float32).ravel()
+        if flat.size > self.capacity:
+            raise ValueError(f"message {flat.size} > capacity "
+                             f"{self.capacity}")
+        self.table.sparse_set(np.arange(flat.size), flat.reshape(-1, 1))
+        self.table.sparse_set([self.capacity],
+                              np.asarray([[float(seq)]], np.float32))
+
+    def get(self, shape, seq: int, *, timeout_s: float = 60.0,
+            poll_s: float = 0.002) -> np.ndarray:
+        n = int(np.prod(shape))
+        deadline = time.time() + timeout_s
+        while True:
+            try:
+                flag = float(self.table.sparse_pull([self.capacity])[0, 0])
+            except RuntimeError:
+                flag = None  # table not created yet / transient
+            if flag is not None and int(flag) == seq:
+                data = self.table.sparse_pull(np.arange(n))
+                return data.ravel().reshape(shape)
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"mailbox: seq {seq} not observed within {timeout_s}s "
+                    f"(last flag: {flag})")
+            time.sleep(poll_s)
+
+    def close(self) -> None:
+        self.table.close()

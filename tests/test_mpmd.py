@@ -1,0 +1,187 @@
+"""MPMD unequal-stage-DP prototype: stage0 at dp=2 and stage1 at dp=1 run
+in SEPARATE processes (different programs, different meshes), activations
+round-robin-bridged through the van — end-to-end grads match the
+single-process oracle.
+
+Reference: python/hetu/gpu_ops/pipeline_subexecutor.py:87-128 (round-robin
+send/recv between stages of unequal DP degree), context.py:164-188 (target
+assignment).  VERDICT #7.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from hetu_tpu.parallel.mpmd import round_robin_assignments
+from hetu_tpu.ps import available
+
+if not available():  # pragma: no cover
+    pytest.skip("native PS lib unavailable", allow_module_level=True)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_round_robin_assignments():
+    # 4 microbatches, 2 senders, 1 receiver: senders alternate, the single
+    # receiver consumes every message (the reference 2:1 case)
+    assert round_robin_assignments(4, 2, 1) == \
+        [(0, 0), (1, 0), (0, 0), (1, 0)]
+    # 2:3 — receivers also rotate
+    assert round_robin_assignments(6, 2, 3) == \
+        [(0, 0), (1, 1), (0, 2), (1, 0), (0, 1), (1, 2)]
+
+
+STAGE0 = """
+import sys
+sys.path.insert(0, {repo!r})
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from hetu_tpu.parallel.mpmd import VanMailbox, round_robin_assignments
+
+# stage 0: h = tanh(x @ w0), dp=2 over a real 2-device mesh
+D, B, M = {D}, {B}, {M}
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.standard_normal((B, D)), jnp.float32)
+w0 = jnp.asarray(rng.standard_normal((D, D)) * 0.4, jnp.float32)
+
+mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+xsh = jax.device_put(x, NamedSharding(mesh, P("dp")))
+
+def fwd(w, xs):
+    return jnp.tanh(xs @ w)
+
+h = jax.jit(fwd)(w0, xsh)           # [B, D], batch sharded over dp=2
+
+mb = B // M
+half = B // 2        # rows each dp replica's shard owns
+fwd_boxes = [VanMailbox("127.0.0.1", {port}, 1000 + i, mb * D)
+             for i in range(M)]
+bwd_boxes = [VanMailbox("127.0.0.1", {port}, 2000 + i, mb * D)
+             for i in range(M)]
+# round-robin: microbatch i is SENT BY replica src = i %% 2, i.e. its rows
+# come from that replica's shard region [src*half, (src+1)*half) — the
+# reference's alternating send pattern, not contiguous batch order
+def rows(i, src):
+    lo = src * half + (i // 2) * mb
+    return lo, lo + mb
+asg = round_robin_assignments(M, 2, 1)
+for i, (src, _dst) in enumerate(asg):
+    lo, hi = rows(i, src)
+    fwd_boxes[i].put(np.asarray(h[lo:hi]), seq=1)
+
+# collect cotangents back into shard order, bwd on the SAME dp=2 mesh
+g = np.zeros((B, D), np.float32)
+for i, (src, _dst) in enumerate(asg):
+    lo, hi = rows(i, src)
+    g[lo:hi] = bwd_boxes[i].get((mb, D), seq=1)
+gsh = jax.device_put(jnp.asarray(g), NamedSharding(mesh, P("dp")))
+
+def loss_like(w):
+    return jnp.vdot(fwd(w, xsh), gsh)   # vjp with cotangent g
+
+gw0 = jax.jit(jax.grad(loss_like))(w0)  # XLA psums across dp
+np.save({out!r}, np.asarray(gw0))
+print("STAGE0 DONE", flush=True)
+"""
+
+STAGE1 = """
+import sys
+sys.path.insert(0, {repo!r})
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from hetu_tpu.parallel.mpmd import VanMailbox
+
+# stage 1 (dp=1): loss = mean((tanh(h @ w1) - y)**2), consumes ALL
+# microbatches from stage 0's two replicas round-robin
+D, B, M = {D}, {B}, {M}
+rng = np.random.default_rng(1)
+w1 = jnp.asarray(rng.standard_normal((D, D)) * 0.4, jnp.float32)
+y = jnp.asarray(rng.standard_normal((B, D)) * 0.1, jnp.float32)
+
+mb = B // M
+half = B // 2
+fwd_boxes = [VanMailbox("127.0.0.1", {port}, 1000 + i, mb * D)
+             for i in range(M)]
+bwd_boxes = [VanMailbox("127.0.0.1", {port}, 2000 + i, mb * D)
+             for i in range(M)]
+
+def loss_fn(w, h, yy):
+    return jnp.mean((jnp.tanh(h @ w) - yy) ** 2)
+
+# microbatch i's rows follow the sender round-robin (replica i%2's shard
+# region), so the label slice must use the SAME mapping
+def rows(i):
+    src = i % 2
+    lo = src * half + (i // 2) * mb
+    return lo, lo + mb
+
+gw1 = jnp.zeros_like(w1)
+for i in range(M):
+    h = jnp.asarray(fwd_boxes[i].get((mb, D), seq=1))
+    lo, hi = rows(i)
+    yy = y[lo:hi]
+    # grads wrt BOTH the stage weight and the incoming activation; scale
+    # by mb/B so per-microbatch means sum to the full-batch mean
+    gw, gh = jax.jit(jax.grad(loss_fn, argnums=(0, 1)))(w1, h, yy)
+    gw1 = gw1 + gw * (mb / B)
+    bwd_boxes[i].put(np.asarray(gh) * (mb / B), seq=1)
+np.save({out!r}, np.asarray(gw1))
+print("STAGE1 DONE", flush=True)
+"""
+
+
+def test_unequal_stage_dp_two_processes(tmp_path):
+    D, B, M = 8, 8, 4
+    from hetu_tpu.ps import van
+    port = van.serve(0)
+    try:
+        out0 = str(tmp_path / "gw0.npy")
+        out1 = str(tmp_path / "gw1.npy")
+        s0 = tmp_path / "stage0.py"
+        s1 = tmp_path / "stage1.py"
+        s0.write_text(STAGE0.format(repo=str(REPO), D=D, B=B, M=M,
+                                    port=port, out=out0))
+        s1.write_text(STAGE1.format(repo=str(REPO), D=D, B=B, M=M,
+                                    port=port, out=out1))
+        procs = [subprocess.Popen([sys.executable, str(p)],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.PIPE, text=True)
+                 for p in (s0, s1)]
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=300)
+            assert p.returncode == 0, stderr
+            assert "DONE" in stdout
+
+        # single-process oracle: the SAME two-stage net, full batch
+        import jax
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((B, D)), jnp.float32)
+        w0 = jnp.asarray(rng.standard_normal((D, D)) * 0.4, jnp.float32)
+        rng1 = np.random.default_rng(1)
+        w1 = jnp.asarray(rng1.standard_normal((D, D)) * 0.4, jnp.float32)
+        y = jnp.asarray(rng1.standard_normal((B, D)) * 0.1, jnp.float32)
+
+        def full(w0_, w1_):
+            h = jnp.tanh(x @ w0_)
+            return jnp.mean((jnp.tanh(h @ w1_) - y) ** 2)
+
+        want0, want1 = jax.grad(full, argnums=(0, 1))(w0, w1)
+        got0 = np.load(out0)
+        got1 = np.load(out1)
+        np.testing.assert_allclose(got0, np.asarray(want0), rtol=1e-4,
+                                   atol=1e-6)
+        np.testing.assert_allclose(got1, np.asarray(want1), rtol=1e-4,
+                                   atol=1e-6)
+    finally:
+        van.stop()
