@@ -1,2 +1,5 @@
 from hetu_tpu.data.dataloader import Dataloader
 from hetu_tpu.data import datasets
+from hetu_tpu.data.graph_sampler import (
+    DistGraph, NeighborSampler, SampledBatch,
+)

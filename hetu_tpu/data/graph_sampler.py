@@ -1,0 +1,194 @@
+"""GraphMix-style distributed graph sampling over the PS plane.
+
+Reference: the GraphMix subproject (examples/gnn/run_dist.py launches
+graph-sampling PS servers feeding GNN minibatch workers; the submodule
+itself ships empty upstream).  The capability it names: the GRAPH lives on
+parameter servers, workers pull sampled neighbor frontiers to build GNN
+minibatches without ever materializing the full graph locally.
+
+TPU form: adjacency rows, features, and labels are PS tables — local
+(`PSTable`), one van server (`RemotePSTable`), or key-range partitioned
+over many servers (`van.PartitionedPSTable`, the distributed case).  A
+`NeighborSampler` pulls frontier rows, samples `fanout` neighbors per hop
+(GraphSAGE-style), relabels to a compact node set, and emits COO edges +
+features ready for `ops.graph_ops.gcn_norm`/`gcn_conv`.  Sampling runs on
+host CPU (it is control-flow-heavy and belongs off the TPU); the returned
+minibatch is static-shaped, so the training step stays jittable.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class DistGraph:
+    """A graph sharded into PS tables.
+
+    Adjacency row v: [degree, n_0, ..., n_{K-1}] (zero-padded to
+    max_degree).  Neighbors beyond max_degree are dropped at publish time
+    (uniform downsample) — the standard sampling-GNN tradeoff.
+    """
+
+    def __init__(self, adj_table, feat_table, label_table,
+                 max_degree: int):
+        self.adj = adj_table
+        self.feat = feat_table
+        self.label = label_table
+        self.max_degree = max_degree
+        self.num_nodes = adj_table.rows
+
+    # ---- construction ----
+    @staticmethod
+    def publish(edge_src, edge_dst, features, labels, *, max_degree: int,
+                table_factory, seed: int = 0) -> "DistGraph":
+        """Build the three tables from COO edges via `table_factory(rows,
+        dim, tag)` — returning PSTable / RemotePSTable / PartitionedPSTable
+        (the distributed GraphMix deployment)."""
+        features = np.asarray(features, np.float32)
+        labels = np.asarray(labels)
+        n, f = features.shape
+        if n >= 1 << 24:
+            # ids live in float32 table rows; beyond 2^24 they lose
+            # integer precision and would silently alias nodes
+            raise ValueError(
+                f"DistGraph.publish: {n} nodes exceeds the float32-exact "
+                "id range (2^24); shard the graph into multiple DistGraphs")
+        rng = np.random.default_rng(seed)
+        neigh: List[List[int]] = [[] for _ in range(n)]
+        for s, d in zip(np.asarray(edge_src), np.asarray(edge_dst)):
+            neigh[int(s)].append(int(d))
+        adj_rows = np.zeros((n, max_degree + 1), np.float32)
+        for v, ns in enumerate(neigh):
+            if len(ns) > max_degree:
+                ns = list(rng.choice(ns, max_degree, replace=False))
+            adj_rows[v, 0] = len(ns)
+            adj_rows[v, 1:1 + len(ns)] = ns
+        adj = table_factory(n, max_degree + 1, "adj")
+        feat = table_factory(n, f, "feat")
+        lab = table_factory(n, 1, "label")
+        ids = np.arange(n)
+        adj.sparse_set(ids, adj_rows)
+        feat.sparse_set(ids, features)
+        lab.sparse_set(ids, labels.reshape(n, 1).astype(np.float32))
+        return DistGraph(adj, feat, lab, max_degree)
+
+    # ---- pulls ----
+    def neighbors(self, nodes: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        rows = self.adj.sparse_pull(nodes)
+        deg = rows[:, 0].astype(np.int64)
+        return deg, rows[:, 1:].astype(np.int64)
+
+    def features(self, nodes: np.ndarray) -> np.ndarray:
+        return self.feat.sparse_pull(nodes)
+
+    def labels(self, nodes: np.ndarray) -> np.ndarray:
+        return self.label.sparse_pull(nodes)[:, 0].astype(np.int64)
+
+
+class NeighborSampler:
+    """GraphSAGE-style layered neighbor sampling from a DistGraph.
+
+    sample(seeds, fanouts) pulls `len(fanouts)` hops of neighbors from the
+    PS plane, unions them into a compact node set, and returns the induced
+    sampled edges relabeled to [0, n_sub) — directly consumable by
+    `gcn_norm`/`gcn_conv` on device.
+    """
+
+    def __init__(self, graph: DistGraph, *, seed: int = 0):
+        self.g = graph
+        self.rng = np.random.default_rng(seed)
+
+    def sample(self, seeds: Sequence[int], fanouts: Sequence[int],
+               ) -> "SampledBatch":
+        seeds = np.asarray(seeds, np.int64)
+        nodes = list(dict.fromkeys(seeds.tolist()))  # ordered unique
+        n_seed = len(nodes)                          # AFTER dedup
+        index = {v: i for i, v in enumerate(nodes)}
+        src_l: List[int] = []
+        dst_l: List[int] = []
+        frontier = seeds
+        for fanout in fanouts:
+            frontier = np.unique(frontier)
+            deg, neigh = self.g.neighbors(frontier)
+            nxt: List[int] = []
+            for row, (d, ns) in enumerate(zip(deg, neigh)):
+                v = int(frontier[row])
+                if d == 0:
+                    continue
+                cand = ns[:d]
+                take = cand if d <= fanout else \
+                    self.rng.choice(cand, fanout, replace=False)
+                for u in np.asarray(take, np.int64):
+                    u = int(u)
+                    if u not in index:
+                        index[u] = len(nodes)
+                        nodes.append(u)
+                    # edge u -> v (message flows neighbor -> seed)
+                    src_l.append(index[u])
+                    dst_l.append(index[v])
+                    nxt.append(u)
+            frontier = np.asarray(nxt, np.int64) if nxt else \
+                np.empty((0,), np.int64)
+        nodes_arr = np.asarray(nodes, np.int64)
+        feats = self.g.features(nodes_arr)
+        labels = self.g.labels(nodes_arr)
+        return SampledBatch(
+            nodes=nodes_arr,
+            edge_src=np.asarray(src_l, np.int64),
+            edge_dst=np.asarray(dst_l, np.int64),
+            features=feats,
+            labels=labels,
+            seed_mask=np.asarray(
+                [1.0 if i < n_seed else 0.0
+                 for i in range(len(nodes_arr))], np.float32),
+        )
+
+
+class SampledBatch:
+    """A host-side GNN minibatch: compact node ids, COO edges, features,
+    labels, and the seed mask (loss only on seeds, GraphSAGE-style)."""
+
+    def __init__(self, nodes, edge_src, edge_dst, features, labels,
+                 seed_mask):
+        self.nodes = nodes
+        self.edge_src = edge_src
+        self.edge_dst = edge_dst
+        self.features = features
+        self.labels = labels
+        self.seed_mask = seed_mask
+
+    def pad_to(self, n_nodes: int, n_edges: int) -> "SampledBatch":
+        """Pad to static shapes so successive minibatches hit ONE compiled
+        train step (padding edges are self-loops on a padding node with
+        zero weight via the seed mask)."""
+        cn = len(self.nodes)
+        ce = len(self.edge_src)
+        if cn > n_nodes or ce > n_edges:
+            raise ValueError(f"batch ({cn} nodes, {ce} edges) exceeds pad "
+                             f"target ({n_nodes}, {n_edges})")
+        if ce < n_edges and cn >= n_nodes:
+            # padding edges need a SYNTHETIC node to self-loop on; with the
+            # node budget exactly full they would land on a real node and
+            # corrupt its degree/messages
+            raise ValueError(
+                f"batch fills all {n_nodes} node slots but needs padding "
+                "edges; raise n_nodes by one")
+        f = self.features.shape[1]
+        feats = np.zeros((n_nodes, f), np.float32)
+        feats[:cn] = self.features
+        labels = np.zeros((n_nodes,), np.int64)
+        labels[:cn] = self.labels
+        mask = np.zeros((n_nodes,), np.float32)
+        mask[:cn] = self.seed_mask
+        pad_node = n_nodes - 1
+        src = np.full((n_edges,), pad_node, np.int64)
+        dst = np.full((n_edges,), pad_node, np.int64)
+        src[:ce] = self.edge_src
+        dst[:ce] = self.edge_dst
+        return SampledBatch(
+            nodes=np.concatenate([self.nodes,
+                                  np.full(n_nodes - cn, -1, np.int64)]),
+            edge_src=src, edge_dst=dst, features=feats, labels=labels,
+            seed_mask=mask)

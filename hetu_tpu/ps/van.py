@@ -61,12 +61,16 @@ class RemotePSTable:
                 time.sleep(0.05)
         self.id = table_id if table_id is not None else _fresh_remote_id()
         if create:
-            _check(lib.ps_van_table_create(
-                self.fd, self.id, rows, dim, _INIT_KINDS[init], init_a,
-                init_b, seed), "van_table_create")
-            _check(lib.ps_van_set_optimizer(
-                self.fd, self.id, _OPT_KINDS[optimizer], lr, momentum, eps,
-                beta1, beta2), "van_set_optimizer")
+            try:
+                _check(lib.ps_van_table_create(
+                    self.fd, self.id, rows, dim, _INIT_KINDS[init], init_a,
+                    init_b, seed), "van_table_create")
+                _check(lib.ps_van_set_optimizer(
+                    self.fd, self.id, _OPT_KINDS[optimizer], lr, momentum,
+                    eps, beta1, beta2), "van_set_optimizer")
+            except Exception:
+                self.close()  # don't leak the connection on a lost
+                raise         # create race / server-side failure
 
     def ping(self) -> bool:
         return lib.ps_van_ping(self.fd) == 0
