@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import hetu_tpu as ht
 from hetu_tpu import optim
 from hetu_tpu.models.ctr_zoo import DCN, CrossNet, DeepFM

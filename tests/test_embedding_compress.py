@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from hetu_tpu import embedding_compress as ec
 
 N, D = 1000, 16

@@ -12,6 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import hetu_tpu as ht
 from hetu_tpu.parallel.strategies import (
     FlexFlowSearching, GraphPlanStrategy, Plan,

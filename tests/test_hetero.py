@@ -1,5 +1,9 @@
 """Galvatron-loop test: search a Plan → execute it with per-layer TP."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import numpy as np
 from jax.sharding import PartitionSpec as P

@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import hetu_tpu as ht
 from hetu_tpu.parallel.pipedream import PipeDream1F1B
 from hetu_tpu.ps import available

@@ -5,6 +5,10 @@ Reference analogs: examples/moe scripts, gpu_ops/{Dispatch,LayoutTransform,
 AllToAll}.py tests.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

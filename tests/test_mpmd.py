@@ -15,6 +15,8 @@ from pathlib import Path
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from hetu_tpu.parallel.mpmd import round_robin_assignments
 from hetu_tpu.ps import available
 

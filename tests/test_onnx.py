@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from hetu_tpu import models
 from hetu_tpu.onnx import export_onnx, import_onnx
 from hetu_tpu.onnx import proto as P

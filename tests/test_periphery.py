@@ -1,5 +1,9 @@
 """Tokenizer, launcher config, graphboard, and HTIR export tests."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import subprocess
 import sys
 from pathlib import Path

@@ -1,6 +1,10 @@
 """1F1B runtime: outputs and parameter grads must match the sequential
 oracle, with stash memory independent of microbatch count."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

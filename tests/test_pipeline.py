@@ -1,5 +1,9 @@
 """Pipeline tests: GPipe SPMD loop vs sequential oracle, grads, schedules."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

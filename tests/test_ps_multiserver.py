@@ -17,6 +17,8 @@ from pathlib import Path
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from hetu_tpu.ps import available
 
 if not available():  # pragma: no cover

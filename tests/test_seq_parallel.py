@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import hetu_tpu as ht
 from hetu_tpu.ops.attention import attention, causal_attention
 from hetu_tpu.parallel.ring_attention import ring_attention

@@ -1,6 +1,10 @@
 """ShardedGPT: the fully-manual dp/pp/sp/tp/ep train step must reproduce the
 single-device trajectory."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import numpy as np
 
