@@ -64,3 +64,9 @@ from hetu_tpu.ops.attention import (
 from hetu_tpu.ops.graph_ops import (
     coo_spmm, gcn_norm, gcn_conv,
 )
+from hetu_tpu.ops.pallas_kernels import (
+    flash_attention as pallas_flash_attention,
+    embedding_gather as pallas_embedding_gather,
+    embedding_scatter_add as pallas_embedding_scatter_add,
+    topk_gating as pallas_topk_gating,
+)

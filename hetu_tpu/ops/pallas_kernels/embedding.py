@@ -1,0 +1,177 @@
+"""Pallas TPU kernels for the embedding hot path and MoE gating.
+
+Reference kernels being replaced: src/ops/EmbeddingLookUp.cu (gather with
+bounds check), its scatter-add gradient kernel, and gpu_ops/TopKIdx.py's
+CUDA top-k (src/ops/TopKIdx.cu).
+
+Why Pallas here: XLA lowers `jnp.take` over a huge vocab table to a gather
+that reads whole table tiles; with scalar-prefetched row ids the DMA engine
+streams EXACTLY the requested rows HBM->VMEM while the previous row is
+copied out — the classic Pallas sparse-gather pattern.  The scatter-add
+gradient exploits the TPU grid's sequential execution: revisiting a row is
+safe, so duplicate ids accumulate without atomics (which TPU lacks).  The
+top-k gate fuses k argmax passes + softmax into one VMEM-resident kernel,
+avoiding XLA's full sort for small k over the experts axis.
+
+All kernels run in interpret mode on CPU for tests; compiled mode needs a
+real TPU.  Row width D should be a multiple of 128 (lane width) for peak
+DMA efficiency — other widths work but pad internally.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hetu_tpu.utils.platform import default_backend_is_tpu
+
+
+def _auto_interpret(interpret):
+    if interpret is None:
+        return not default_backend_is_tpu()
+    return interpret
+
+
+# ---------------------------------------------------------------- gather
+
+def _gather_kernel(ids_ref, table_ref, out_ref, *, vocab: int):
+    i = pl.program_id(0)
+    rid = ids_ref[i]
+    valid = (rid >= 0) & (rid < vocab)
+    row = table_ref[...]
+    out_ref[...] = jnp.where(valid, row, jnp.zeros_like(row))
+
+
+def embedding_gather(table, ids, *, interpret=None):
+    """table [V, D], ids [N] int32 -> [N, D]; out-of-range ids give zero
+    rows (EmbeddingLookUp.cu bounds-check semantics).
+
+    One grid step per id; the table BlockSpec's index_map reads the
+    scalar-prefetched id, so only the requested row is DMA'd.
+    """
+    interpret = _auto_interpret(interpret)
+    V, D = table.shape
+    ids = ids.astype(jnp.int32)
+    (N,) = ids.shape
+    # clamp for the DMA (invalid ids fetch row 0, masked in-kernel)
+    safe = jnp.clip(ids, 0, V - 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(N,),
+        in_specs=[
+            pl.BlockSpec((1, D), lambda i, ids_ref: (ids_ref[i], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, D), lambda i, ids_ref: (i, 0)),
+    )
+    kernel = functools.partial(_gather_kernel, vocab=V)
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((N, D), table.dtype),
+        interpret=interpret,
+    )(safe, table)
+    # the kernel masked using the CLAMPED id; re-mask with the true ids
+    valid = (ids >= 0) & (ids < V)
+    return jnp.where(valid[:, None], out, 0)
+
+
+# ------------------------------------------------------------ scatter-add
+
+def _scatter_kernel(ids_ref, rows_ref, acc_ref, out_ref):
+    del ids_ref, acc_ref  # routing happens entirely in the index maps
+    out_ref[...] = rows_ref[...]
+
+
+def embedding_scatter_add(grads, ids, num_rows: int, *, interpret=None):
+    """grads [N, D], ids [N] -> dense table-grad [num_rows, D].
+
+    The gradient of embedding_gather.  Duplicates are pre-summed with an
+    XLA segment-sum over the SORTED ids (cheap: N log N on tiny int rows),
+    so the kernel scatters each unique row exactly once — no block is ever
+    revisited, which keeps the double-buffered write pipeline free of
+    read-back hazards.  The zeros accumulator aliases the output buffer, so
+    untouched vocab rows are zero without an extra HBM pass."""
+    interpret = _auto_interpret(interpret)
+    N, D = grads.shape
+    ids = ids.astype(jnp.int32)
+    order = jnp.argsort(ids)
+    sids = ids[order]
+    sgrads = grads[order]
+    # segment-sum consecutive duplicates: segment j = rank of unique id
+    new_seg = jnp.concatenate([jnp.ones((1,), jnp.int32),
+                               (sids[1:] != sids[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(new_seg) - 1                      # [N], 0..U-1
+    summed = jax.ops.segment_sum(sgrads, seg, num_segments=N)
+    uids = jnp.full((N,), -1, jnp.int32).at[seg].set(sids)
+
+    # invalid slots (duplicate padding, out-of-range ids) route to a
+    # SENTINEL row num_rows, sliced off below — they can't corrupt a real
+    # row, and out-of-range grads are dropped like the XLA oracle's
+    valid = (uids >= 0) & (uids < num_rows)
+    safe = jnp.where(valid, uids, num_rows).astype(jnp.int32)
+    acc = jnp.zeros((num_rows + 1, D), grads.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(N,),
+        in_specs=[
+            pl.BlockSpec((1, D), lambda i, ids_ref: (i, 0)),           # rows
+            pl.BlockSpec((1, D), lambda i, ids_ref: (ids_ref[i], 0)),  # acc
+        ],
+        out_specs=pl.BlockSpec((1, D), lambda i, ids_ref: (ids_ref[i], 0)),
+    )
+    out = pl.pallas_call(
+        _scatter_kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_rows + 1, D), grads.dtype),
+        input_output_aliases={2: 0},  # acc -> out: zero-init untouched rows
+        interpret=interpret,
+    )(safe, summed, acc)
+    return out[:num_rows]
+
+
+# ---------------------------------------------------------------- top-k
+
+def _topk_kernel(logits_ref, vals_ref, idx_ref, *, k: int, experts: int):
+    x = logits_ref[...].astype(jnp.float32)        # [bt, E]
+    bt = x.shape[0]
+    iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    for j in range(k):                             # k small, unrolled
+        m = jnp.max(x, axis=-1)                    # [bt]
+        # first position attaining the max
+        hit = x == m[:, None]
+        pos = jnp.min(jnp.where(hit, iota, experts), axis=-1)
+        vals_ref[:, j] = m
+        idx_ref[:, j] = pos
+        x = jnp.where(iota == pos[:, None], -jnp.inf, x)
+
+
+def topk_gating(logits, k: int, *, block_tokens: int = 256,
+                interpret=None):
+    """logits [T, E] -> (gates [T, k] softmaxed over the k, idx [T, k]).
+
+    The MoE gate's top-k + softmax fused in VMEM (TopKIdx.cu analog):
+    k repeated max/mask passes beat a full sort for the k << E regime.
+    Matches ops.top_k_idx_gate (ties resolved to the lowest index,
+    lax.top_k's order)."""
+    interpret = _auto_interpret(interpret)
+    T, E = logits.shape
+    bt = min(block_tokens, T)
+    if T % bt:
+        raise ValueError(f"tokens {T} not divisible by block {bt}")
+    kernel = functools.partial(_topk_kernel, k=k, experts=E)
+    vals, idx = pl.pallas_call(
+        kernel,
+        grid=(T // bt,),
+        in_specs=[pl.BlockSpec((bt, E), lambda i: (i, 0))],
+        out_specs=(pl.BlockSpec((bt, k), lambda i: (i, 0)),
+                   pl.BlockSpec((bt, k), lambda i: (i, 0))),
+        out_shape=(jax.ShapeDtypeStruct((T, k), jnp.float32),
+                   jax.ShapeDtypeStruct((T, k), jnp.int32)),
+        interpret=interpret,
+    )(logits)
+    gates = jax.nn.softmax(vals, axis=-1).astype(logits.dtype)
+    return gates, idx
