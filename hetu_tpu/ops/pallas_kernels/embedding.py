@@ -27,23 +27,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from hetu_tpu.utils.platform import default_backend_is_tpu
-
-
-def _auto_interpret(interpret):
-    if interpret is None:
-        return not default_backend_is_tpu()
-    return interpret
+from hetu_tpu.utils.platform import auto_interpret as _auto_interpret
 
 
 # ---------------------------------------------------------------- gather
 
-def _gather_kernel(ids_ref, table_ref, out_ref, *, vocab: int):
-    i = pl.program_id(0)
-    rid = ids_ref[i]
-    valid = (rid >= 0) & (rid < vocab)
-    row = table_ref[...]
-    out_ref[...] = jnp.where(valid, row, jnp.zeros_like(row))
+def _gather_kernel(ids_ref, table_ref, out_ref):
+    del ids_ref  # row routing happens in the BlockSpec index_map
+    out_ref[...] = table_ref[...]
 
 
 def embedding_gather(table, ids, *, interpret=None):
@@ -57,7 +48,8 @@ def embedding_gather(table, ids, *, interpret=None):
     V, D = table.shape
     ids = ids.astype(jnp.int32)
     (N,) = ids.shape
-    # clamp for the DMA (invalid ids fetch row 0, masked in-kernel)
+    # clamp for the DMA (invalid ids fetch row 0; masked AFTER the kernel
+    # with the true ids)
     safe = jnp.clip(ids, 0, V - 1)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -68,9 +60,8 @@ def embedding_gather(table, ids, *, interpret=None):
         ],
         out_specs=pl.BlockSpec((1, D), lambda i, ids_ref: (i, 0)),
     )
-    kernel = functools.partial(_gather_kernel, vocab=V)
     out = pl.pallas_call(
-        kernel, grid_spec=grid_spec,
+        _gather_kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((N, D), table.dtype),
         interpret=interpret,
     )(safe, table)
@@ -159,6 +150,9 @@ def topk_gating(logits, k: int, *, block_tokens: int = 256,
     lax.top_k's order)."""
     interpret = _auto_interpret(interpret)
     T, E = logits.shape
+    if k > E:
+        raise ValueError(f"top-{k} of only {E} experts (lax.top_k would "
+                         "reject this too)")
     bt = min(block_tokens, T)
     if T % bt:
         raise ValueError(f"tokens {T} not divisible by block {bt}")

@@ -381,8 +381,7 @@ def flash_attention(q, k, v, *, causal: bool = False, scale=None,
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    if interpret is None:
-        from hetu_tpu.utils.platform import default_backend_is_tpu
-        interpret = not default_backend_is_tpu()
+    from hetu_tpu.utils.platform import auto_interpret
+    interpret = auto_interpret(interpret)
     return _flash(q, k, v, float(scale), bool(causal), int(block_q),
                   int(block_k), bool(interpret))

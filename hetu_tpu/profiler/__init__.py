@@ -1,6 +1,12 @@
 from hetu_tpu.profiler.profiler import OpProfiler, CollectiveProfiler
 from hetu_tpu.profiler.cost_model import ChipSpec, CHIPS, detect_chip
-from hetu_tpu.profiler.simulator import Simulator, LayerSpec, ShardOption
+from hetu_tpu.profiler.simulator import (
+    Simulator, LayerSpec, ShardOption, transformer_layer_specs,
+)
 from hetu_tpu.profiler.graph_ir import (
     GraphSpec, graph_spec_from_node, resnet_graph_spec,
+)
+from hetu_tpu.profiler.calibrate import (
+    calibrate_simulator, fit_ici_bandwidth, fit_mxu_util,
+    layer_spec_from_measurement,
 )

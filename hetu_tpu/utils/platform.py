@@ -111,3 +111,12 @@ def force_cpu_devices(n_devices: int, timeout_s: float = 120.0):
                 f"XLA_FLAGS={_COUNT_FLAG}=N and JAX_PLATFORMS=cpu before "
                 "importing jax")
     return jax
+
+
+def auto_interpret(interpret):
+    """Pallas kernels' shared interpret default: compiled on a real TPU
+    backend, interpret elsewhere (CPU tests).  Pass an explicit bool to
+    override."""
+    if interpret is None:
+        return not default_backend_is_tpu()
+    return interpret
