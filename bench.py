@@ -34,10 +34,67 @@ import numpy as np
 from jax import lax
 
 from hetu_tpu.profiler.cost_model import detect_chip
-from hetu_tpu.utils.platform import device_watchdog as _device_watchdog
+from hetu_tpu.utils.platform import wait_for_devices as _wait_for_devices
 
 BASELINE_MFU = 0.35
 BASELINE_RESNET_SPS = 2000.0
+
+_LKG_PATH = None  # set in main(): repo-root .bench_lkg.json
+
+
+def _lkg_load():
+    import pathlib
+    global _LKG_PATH
+    if _LKG_PATH is None:
+        _LKG_PATH = pathlib.Path(__file__).resolve().parent / ".bench_lkg.json"
+    try:
+        return json.loads(_LKG_PATH.read_text())
+    except Exception:
+        return {}
+
+
+def _emit(result):
+    """Print the one JSON line and persist it as last-known-good.
+
+    Only a real-TPU measurement may become the LKG record — a CPU smoke
+    run (HETU_BENCH_SMOKE / JAX_PLATFORMS=cpu) must never masquerade as a
+    chip number in the stale-fallback path."""
+    import os
+    print(json.dumps(result))
+    if os.environ.get("HETU_BENCH_SMOKE"):
+        return
+    try:
+        if (jax.default_backend() != "tpu"
+                and not os.environ.get("HETU_BENCH_ALLOW_CPU_LKG")):
+            return  # tests set the override; production never does
+        lkg = _lkg_load()
+        lkg[result["metric"]] = dict(result, measured_unix=time.time())
+        _LKG_PATH.write_text(json.dumps(lkg, indent=1))
+    except Exception:
+        pass  # read-only checkout: LKG is best-effort
+
+
+def _emit_stale_or_die(metric_hint, exit_code=3):
+    """Dead tunnel at capture time: leave an honest breadcrumb.
+
+    If an earlier successful run on this machine left a last-known-good
+    record, re-emit it clearly labeled stale (value measured then, not now)
+    and exit 0 so the driver records a number instead of an error.  With no
+    LKG there is nothing honest to print — exit nonzero fast.
+    """
+    rec = _lkg_load().get(metric_hint)  # only the SAME metric is honest
+    if rec is None:
+        sys.exit(exit_code)
+    rec = dict(rec)
+    age_h = (time.time() - rec.pop("measured_unix", time.time())) / 3600.0
+    extra = dict(rec.get("extra") or {})
+    extra.update({"stale": True, "stale_age_hours": round(age_h, 2),
+                  "stale_reason": "device backend unreachable at capture; "
+                                  "value is last-known-good from an earlier "
+                                  "run on this machine"})
+    rec["extra"] = extra
+    print(json.dumps(rec))
+    sys.exit(0)
 
 
 def _slope(make_fn, args, n1, n2, reps=3):
@@ -95,7 +152,7 @@ def bench_gpt():
                        + 12 * cfg.num_layers * cfg.hidden_size * S)
     mfu = flops_per_token * B * S / step_s / peak
     tokens_per_s = B * S / step_s
-    print(json.dumps({
+    _emit({
         "metric": "gpt2s_bf16_train_mfu_1chip",
         "value": round(mfu, 4),
         "unit": "model_flops_utilization",
@@ -104,7 +161,7 @@ def bench_gpt():
                   "step_s": round(step_s, 5),
                   "tflops": round(flops_per_token * B * S / step_s / 1e12, 2),
                   "batch": B, "seq": S, "params_m": round(n_params / 1e6, 1)},
-    }))
+    })
 
 
 def bench_resnet():
@@ -137,12 +194,163 @@ def bench_resnet():
 
     step_s = _slope(make, (params, ostate, x, y), n1=4, n2=20)
     sps = BATCH / step_s
-    print(json.dumps({
+    _emit({
         "metric": "resnet18_cifar10_train_samples_per_sec_per_chip",
         "value": round(sps, 1),
         "unit": "samples/s/chip",
         "vs_baseline": round(sps / BASELINE_RESNET_SPS, 3),
-    }))
+    })
+
+
+def bench_ctr():
+    """BASELINE config-4: Wide&Deep at Criteo-Kaggle shape, embedding path.
+
+    Headline: device-resident W&D (2.1 GB table in HBM, Pallas gather,
+    IndexedSlices sparse update — models/wdl.py WideDeepDevice) samples/s
+    on one chip.  vs_baseline is achieved/roofline where the roofline
+    prices the step's HBM bytes (gather + sparse row update) plus the MLP
+    FLOPs on the detected chip — an MFU-style target for a bandwidth-bound
+    workload, not a soft stand-in.  extra carries the PS-hybrid-path
+    samples/s (host C++ PS tier + jitted dense step, the reference
+    hybrid_wdl config) measured at the same batch shape.
+    """
+    import os
+
+    from hetu_tpu import optim
+    from hetu_tpu.models.wdl import WideDeep, WideDeepDevice
+
+    B, FIELDS, DENSE, DIM = 2048, 26, 13, 16
+    VOCAB = 33_000_000  # Criteo-Kaggle total hash-bucket count scale
+    if os.environ.get("HETU_BENCH_SMOKE"):  # CI/CPU smoke: same code path
+        B, VOCAB = 64, 10_000
+    chip = detect_chip()
+
+    model = WideDeepDevice(VOCAB, FIELDS, DIM, DENSE)
+    opt = optim.SGDOptimizer(0.01)
+    v = model.init(jax.random.PRNGKey(0))
+    params, mstate = v["params"], v["state"]
+    ostate = opt.init_state(params)
+    step = model.sparse_step_fn(opt, jit=False)
+
+    g = np.random.default_rng(0)
+    ids = jnp.asarray(g.integers(0, VOCAB, (B, FIELDS)), jnp.int32)
+    dx = jnp.asarray(g.standard_normal((B, DENSE)), jnp.float32)
+    y = jnp.asarray(g.integers(0, 2, B), jnp.float32)
+
+    def make(n):
+        @jax.jit
+        def f(params, ostate, mstate, dx, ids, y):
+            def body(i, carry):
+                params, ostate, mstate = carry
+                params, ostate, mstate, _, _ = step(
+                    params, ostate, mstate, dx, ids, y)
+                return params, ostate, mstate
+            params, ostate, mstate = lax.fori_loop(
+                0, n, body, (params, ostate, mstate))
+            return params["net"]["wide"]["weight"].sum()
+        return f
+
+    step_s = _slope(make, (params, ostate, mstate, dx, ids, y), n1=2, n2=8)
+    sps = B / step_s
+
+    # roofline: gather read + sparse-update read/write of touched rows
+    # (3 row-passes of B*F*D f32) + dense MLP fwd+bwd FLOPs
+    row_bytes = 3.0 * B * FIELDS * DIM * 4
+    in_dim = FIELDS * DIM + DENSE
+    mlp_flops = 2.0 * B * (in_dim * 256 + 256 * 256 + 256) * 3
+    roofline_s = row_bytes / chip.hbm_bw + mlp_flops / chip.bf16_flops
+    roofline_sps = B / roofline_s
+
+    # PS-hybrid path at the same shapes, small vocab (host-RAM tier)
+    ps_sps = None
+    try:
+        from hetu_tpu.ps import PSEmbedding
+        emb = PSEmbedding(1_000_000, DIM, optimizer="sgd", lr=0.01, seed=0)
+        m2 = WideDeep(FIELDS, DIM, DENSE)
+        v2 = m2.init(jax.random.PRNGKey(1))
+        p2, ms2 = v2["params"], v2["state"]
+        o2 = opt.init_state(p2)
+        hstep = m2.hybrid_step_fn(opt)
+        np_ids = np.asarray(g.integers(0, 1_000_000, (B, FIELDS)))
+        rows = emb.pull(np_ids)  # warm
+        p2, o2, ms2, _, _, ge = hstep(p2, o2, ms2, dx, rows, y)
+        t0 = time.perf_counter()
+        iters = 8
+        for _ in range(iters):
+            rows = emb.pull(np_ids)
+            p2, o2, ms2, _, _, ge = hstep(p2, o2, ms2, dx, rows, y)
+            emb.push(np_ids, np.asarray(ge))
+        ps_sps = round(B * iters / (time.perf_counter() - t0), 1)
+    except Exception as e:  # PS lib unavailable: report, don't fail the bench
+        ps_sps = f"unavailable: {type(e).__name__}"
+
+    _emit({
+        "metric": "wdl_criteo_device_sparse_samples_per_sec_per_chip",
+        "value": round(sps, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(sps / roofline_sps, 3),
+        "extra": {"roofline_sps": round(roofline_sps, 1),
+                  "ps_hybrid_sps": ps_sps, "batch": B, "fields": FIELDS,
+                  "vocab": VOCAB, "emb_dim": DIM,
+                  "step_s": round(step_s, 6)},
+    })
+
+
+def bench_moe():
+    """BASELINE config-5: MoE transformer block train step, one chip.
+
+    GPT-class block with 8 experts, top-2 gather dispatch (Pallas
+    routed_gather + fused top-k gating on TPU).  MFU counts the expert
+    FFN + gate FLOPs actually routed (capacity-bounded), fwd+bwd, against
+    the chip peak — same discipline as the GPT headline.
+    """
+    import os
+
+    from hetu_tpu import optim
+    from hetu_tpu.layers.moe import Expert, MoELayer, TopKGate
+
+    T, D, F, E, K, CF = 16384, 768, 3072, 8, 2, 1.25
+    if os.environ.get("HETU_BENCH_SMOKE"):  # CI/CPU smoke: same code path
+        T, D, F = 256, 32, 64
+    gate = TopKGate(D, E, K)
+    experts = Expert(E, D, F)
+    layer = MoELayer(gate, experts, capacity_factor=CF,
+                     dispatch_impl="gather")
+    v = layer.init(jax.random.PRNGKey(0))
+    opt = optim.AdamWOptimizer(1e-4)
+    ostate = opt.init_state(v["params"])
+    x = jax.random.normal(jax.random.PRNGKey(1), (T, D), jnp.bfloat16)
+
+    def make(n):
+        @jax.jit
+        def f(params, ostate, x):
+            def body(i, carry):
+                params, ostate = carry
+                def loss_fn(p):
+                    (y, aux), _ = layer.apply({"params": p, "state": {}}, x)
+                    return jnp.sum(y.astype(jnp.float32) ** 2) / T + aux
+                grads = jax.grad(loss_fn)(params)
+                return opt.update(grads, ostate, params)
+            params, ostate = lax.fori_loop(0, n, body, (params, ostate))
+            return params["gate"]["gate_w"].sum()
+        return f
+
+    peak = detect_chip().bf16_flops
+    step_s = _slope(make, (v["params"], ostate, x), n1=2, n2=8)
+    # routed tokens bounded by capacity: C*E slots, <= T*K demanded
+    routed = min(int(CF * T * K / E) * E, T * K)
+    expert_flops = routed * 2 * (D * F + F * D) * 3      # fwd+bwd
+    gate_flops = T * 2 * D * E * 3
+    mfu = (expert_flops + gate_flops) / step_s / peak
+    _emit({
+        "metric": "moe_block_bf16_train_mfu_1chip",
+        "value": round(mfu, 4),
+        "unit": "model_flops_utilization",
+        "vs_baseline": round(mfu / BASELINE_MFU, 3),
+        "extra": {"tokens_per_s": round(T / step_s, 1),
+                  "step_s": round(step_s, 5), "tokens": T, "experts": E,
+                  "topk": K, "capacity_factor": CF},
+    })
 
 
 def _enable_compile_cache():
@@ -161,13 +369,35 @@ def _enable_compile_cache():
         pass  # read-only checkout / older jax: cache is best-effort
 
 
+_METRIC_BY_CMD = {
+    "gpt": "gpt2s_bf16_train_mfu_1chip",
+    "resnet": "resnet18_cifar10_train_samples_per_sec_per_chip",
+    "ctr": "wdl_criteo_device_sparse_samples_per_sec_per_chip",
+    "moe": "moe_block_bf16_train_mfu_1chip",
+}
+
+
 def main():
+    import os
+    want = os.environ.get("JAX_PLATFORMS", "").strip()
+    if want:
+        # the tunnel plugin's sitecustomize force-sets the platform config
+        # at interpreter start, so the env var alone is ignored once jax is
+        # imported — re-assert it (lets HETU_BENCH_SMOKE runs use cpu)
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
     _enable_compile_cache()
-    _device_watchdog()
-    if len(sys.argv) > 1 and sys.argv[1] == "resnet":
-        bench_resnet()
-    else:
-        bench_gpt()
+    cmd = sys.argv[1] if len(sys.argv) > 1 else "gpt"
+    # Once-per-round capture: retry a flaky tunnel for up to 10 minutes
+    # (subprocess probes so a hang can't wedge this process), then fall back
+    # to a clearly-labeled stale last-known-good rather than an error.
+    devs = _wait_for_devices(600.0)
+    if devs is None:
+        _emit_stale_or_die(_METRIC_BY_CMD.get(cmd, _METRIC_BY_CMD["gpt"]))
+    {"resnet": bench_resnet, "ctr": bench_ctr,
+     "moe": bench_moe}.get(cmd, bench_gpt)()
 
 
 if __name__ == "__main__":

@@ -68,6 +68,12 @@ enum VanOp : uint8_t {
 // id is acknowledged rc=0 without re-applying the gradient.  begin/finish
 // make claim-apply-record atomic ACROSS connections: a same-id request
 // racing an in-flight apply waits for its outcome instead of re-applying.
+// The done-set is a GLOBAL sliding window of kCap ids (all tables): the
+// exactly-once guarantee holds only while a retry lands within the last
+// kCap applied pushes.  Retries are prompt (client resends on reconnect,
+// not minutes later), so size kCap >= worker_count * max in-flight pushes
+// per worker; at 4096 that is ~64 workers x 64 outstanding — beyond the
+// tested deployment scale by two orders of magnitude.
 class DedupSet {
  public:
   enum Claim { NEW, DUPLICATE };

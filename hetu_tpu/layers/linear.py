@@ -95,14 +95,24 @@ class Conv2d(Module):
 
 
 class Embedding(Module):
-    """Dense embedding table (reference: layers/embedding.py)."""
+    """Dense embedding table (reference: layers/embedding.py).
+
+    ``impl='auto'`` routes the lookup (and its scatter-add gradient)
+    through the Pallas scalar-prefetch kernels on TPU — the
+    EmbeddingLookUp.cu analog — and plain XLA elsewhere; ``'xla'`` forces
+    the XLA gather (required when this layer's table is SPMD-sharded,
+    which the partitioner can't do through a pallas_call).
+    """
 
     def __init__(self, num_embeddings: int, embedding_dim: int, *,
-                 weight_init=None, dtype=jnp.float32):
+                 weight_init=None, dtype=jnp.float32, impl: str = "xla"):
+        if impl not in ("auto", "xla", "pallas"):
+            raise ValueError(f"impl {impl!r}: 'auto', 'xla' or 'pallas'")
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim
         self.weight_init = weight_init or initializers.normal(stddev=0.01)
         self.dtype = dtype
+        self.impl = impl
 
     def init(self, key):
         return {"params": {"weight": self.weight_init(
@@ -110,4 +120,9 @@ class Embedding(Module):
             "state": {}}
 
     def apply(self, variables, indices, *, train: bool = False, rng=None):
-        return ops.embedding_lookup(variables["params"]["weight"], indices), {}
+        w = variables["params"]["weight"]
+        if self.impl != "xla":
+            from hetu_tpu.ops.pallas_kernels import routed_gather
+            rows = routed_gather(w, indices.reshape(-1))
+            return rows.reshape(*indices.shape, self.embedding_dim), {}
+        return ops.embedding_lookup(w, indices), {}

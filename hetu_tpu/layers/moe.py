@@ -6,12 +6,15 @@ reverse layout) and the gate zoo: `TopKGate` (TopGate.py), `HashGate`,
 `KTop1Gate` (ktop1_layer.py), `BalanceAssignmentGate` (BASE layer, auction),
 `SAMGate` (sam_layer.py).
 
-TPU design: GShard-style dense dispatch/combine einsums (ops/moe_ops.py)
-instead of scatter kernels; expert weights are stacked [E, ...] and sharded
-over the 'ep' mesh axis, dispatched tokens constrained to P('ep', ...), and
-XLA's SPMD partitioner materializes the all_to_all exactly where the
-reference called alltoall_op (gpu_ops/AllToAll.py).  Gates produce
-(combine_weights [T,k], expert_idx [T,k], aux_loss).
+TPU design: index-based gather dispatch/combine by default (Pallas
+routed_gather on TPU — O(T·k·D), the LayoutTransform.cu analog), with the
+GShard-style dense dispatch/combine einsums kept as `dispatch_impl=
+'einsum'` (simple, but O(T²·D) — only for small T / cross-checking);
+expert weights are stacked [E, ...] and sharded over the 'ep' mesh axis,
+dispatched tokens constrained to P('ep', ...), and XLA's SPMD partitioner
+materializes the all_to_all exactly where the reference called alltoall_op
+(gpu_ops/AllToAll.py).  Gates produce (combine_weights [T,k],
+expert_idx [T,k], aux_loss).
 """
 
 from __future__ import annotations
@@ -25,8 +28,9 @@ from hetu_tpu import init as initializers
 from hetu_tpu import ops
 from hetu_tpu.layers.base import Module
 from hetu_tpu.ops.moe_ops import (
-    balance_assignment, layout_transform, make_dispatch_combine,
-    reverse_layout_transform, top_k_idx_gate,
+    balance_assignment, gather_combine, gather_dispatch, layout_transform,
+    make_dispatch_combine, make_slot_routing, reverse_layout_transform,
+    top_k_idx_gate,
 )
 
 
@@ -35,9 +39,14 @@ class TopKGate(Module):
     (reference layers/TopGate.py)."""
 
     def __init__(self, hidden_size: int, num_experts: int, k: int = 2,
-                 aux_weight: float = 1e-2):
+                 aux_weight: float = 1e-2, impl: str = "auto"):
+        if impl not in ("auto", "xla", "pallas"):
+            raise ValueError(f"impl {impl!r}: 'auto', 'xla' or 'pallas'")
         self.hidden_size, self.num_experts, self.k = hidden_size, num_experts, k
         self.aux_weight = aux_weight
+        self.impl = impl  # 'auto': fused Pallas top-k+softmax on TPU when
+        # the token count tiles (single-device hot path); 'xla' is required
+        # under SPMD sharding (the partitioner can't split a pallas_call)
         self.w_init = initializers.xavier_uniform()
 
     def init(self, key):
@@ -45,11 +54,24 @@ class TopKGate(Module):
             key, (self.hidden_size, self.num_experts), jnp.float32)},
             "state": {}}
 
-    def apply(self, variables, tokens, *, train: bool = False, rng=None):
+    def apply(self, variables, tokens, *, train: bool = False, rng=None,
+              force_xla: bool = False):
         logits = ops.linear(tokens.astype(jnp.float32),
                             variables["params"]["gate_w"])
         probs = jax.nn.softmax(logits, axis=-1)
-        gates, idx = top_k_idx_gate(logits, self.k)
+        T = logits.shape[0]
+        bt = next((b for b in (256, 128, 64, 32, 16, 8) if T % b == 0),
+                  None)
+        use_pallas = not force_xla and bt is not None and self.impl != "xla"
+        if self.impl == "pallas" and bt is None:
+            raise ValueError(
+                f"impl='pallas' needs a token count divisible by a "
+                f"power-of-two block >= 8; got T={T}")
+        if use_pallas:
+            from hetu_tpu.ops.pallas_kernels import topk_gating
+            gates, idx = topk_gating(logits, self.k, block_tokens=bt)
+        else:
+            gates, idx = top_k_idx_gate(logits, self.k)
         # GShard aux: E * sum_e (mean gate prob_e * mean dispatch frac_e)
         me = jnp.mean(probs, axis=0)
         oh = jax.nn.one_hot(idx[:, 0], self.num_experts)
@@ -195,12 +217,17 @@ class MoELayer(Module):
     """
 
     def __init__(self, gate: Module, experts: Expert, *,
-                 capacity_factor: float = 1.25, mesh=None, ep_axis: str = "ep"):
+                 capacity_factor: float = 1.25, mesh=None, ep_axis: str = "ep",
+                 dispatch_impl: str = "gather"):
+        if dispatch_impl not in ("gather", "einsum"):
+            raise ValueError(f"dispatch_impl {dispatch_impl!r}: "
+                             "'gather' or 'einsum'")
         self.gate = gate
         self.experts = experts
         self.capacity_factor = capacity_factor
         self.mesh = mesh
         self.ep_axis = ep_axis
+        self.dispatch_impl = dispatch_impl
 
     def init(self, key):
         kg, ke = jax.random.split(key)
@@ -217,9 +244,17 @@ class MoELayer(Module):
             x, NamedSharding(self.mesh, P(*spec)))
 
     def apply(self, variables, x, *, gate_input=None, train: bool = False,
-              rng=None):
+              rng=None, return_metrics: bool = False):
         """x: [B, S, D] or [T, D]. gate_input: alternative gate features
-        (e.g. token ids for HashGate)."""
+        (e.g. token ids for HashGate).
+
+        With ``return_metrics`` the first element becomes
+        ``(out, aux, metrics)`` where metrics carries the capacity-overflow
+        counter (``dropped_frac``: fraction of (token, choice) routes
+        silently dropped — the reference drops them silently too, but on
+        TPU the capacity is static so surfacing it is the only way to see
+        an undersized capacity_factor).
+        """
         p = variables["params"]
         orig_shape = x.shape
         D = x.shape[-1]
@@ -230,14 +265,38 @@ class MoELayer(Module):
         capacity = max(1, int(self.capacity_factor * T * k_choices / E))
 
         gi = gate_input.reshape(-1) if gate_input is not None else tokens
+        gate_kw = {}
+        if self.mesh is not None and hasattr(self.gate, "impl"):
+            gate_kw["force_xla"] = True  # SPMD can't split a pallas_call
         (gates, idx, aux), _ = self.gate.apply(
-            {"params": p["gate"], "state": {}}, gi, train=train, rng=rng)
+            {"params": p["gate"], "state": {}}, gi, train=train, rng=rng,
+            **gate_kw)
 
-        disp, comb = make_dispatch_combine(gates, idx, E, capacity)
-        xe = layout_transform(tokens, disp)          # [E, C, D]
+        # under SPMD (mesh given) the gathers must stay XLA ops — the
+        # partitioner can shard a gather but not a pallas_call; the Pallas
+        # kernels serve the single-device hot path (interpret=None auto)
+        kern = {"interpret": True} if self.mesh is not None else {}
+        if self.dispatch_impl == "gather":
+            slot_token, token_slot, n_dropped = make_slot_routing(
+                gates, idx, E, capacity)
+            xe = gather_dispatch(tokens, slot_token, E, capacity,
+                                 **kern)             # [E, C, D]
+        else:
+            disp, comb = make_dispatch_combine(gates, idx, E, capacity)
+            n_dropped = (jnp.asarray(T * k_choices, jnp.int32)
+                         - jnp.sum(disp).astype(jnp.int32))
+            xe = layout_transform(tokens, disp)      # [E, C, D]
         xe = self._constrain(xe, self.ep_axis)       # A2A insertion point
         ye, _ = self.experts.apply({"params": p["experts"], "state": {}}, xe,
                                    train=train)
         ye = self._constrain(ye, self.ep_axis)       # reverse A2A
-        out = reverse_layout_transform(ye, comb)     # [T, D]
-        return (out.reshape(orig_shape), aux), {}
+        if self.dispatch_impl == "gather":
+            out = gather_combine(ye, token_slot, gates, **kern)
+        else:
+            out = reverse_layout_transform(ye, comb)  # [T, D]
+        out = out.reshape(orig_shape)
+        if return_metrics:
+            metrics = {"dropped_frac":
+                       n_dropped.astype(jnp.float32) / (T * k_choices)}
+            return (out, aux, metrics), {}
+        return (out, aux), {}

@@ -35,6 +35,11 @@ class GPTConfig:
     # reference's recompute/checkpoint knobs (Galvatron's ckpt flag)
     remat_policy: str = "full"  # 'full' = save only layer inputs;
     # 'dots' = also save matmul outputs (recompute elementwise only)
+    fused_ce: bool = True  # lm_loss via ops.lm_head_cross_entropy: head
+    # matmul fused into a chunked exact-LSE CE so [B*S, V] f32 logits never
+    # materialize (the unfused path is the reference's
+    # Linear→SoftmaxCrossEntropySparse composition)
+    ce_row_chunk: int = 2048
 
 
 class GPTModel(Module):
@@ -60,8 +65,9 @@ class GPTModel(Module):
         }
         return {"params": params, "state": {}}
 
-    def apply(self, variables, input_ids, *, train: bool = False, rng=None):
-        """Returns (logits [B,S,V], {})."""
+    def hidden_states(self, variables, input_ids, *, train: bool = False,
+                      rng=None):
+        """Final pre-head hidden states ``[B, S, H]`` (post final LN)."""
         p = variables["params"]
         c = self.c
         b, s = input_ids.shape
@@ -85,20 +91,43 @@ class GPTModel(Module):
         keys = (jax.random.split(rng, c.num_layers) if rng is not None
                 else jnp.zeros((c.num_layers, 2), jnp.uint32))
         h, _ = jax.lax.scan(layer, h, (p["blocks"], keys))
-        h = ops.layer_norm(h, p["ln_f_scale"], p["ln_f_bias"])
+        return ops.layer_norm(h, p["ln_f_scale"], p["ln_f_bias"])
+
+    def apply(self, variables, input_ids, *, train: bool = False, rng=None):
+        """Returns (logits [B,S,V], {})."""
+        p = variables["params"]
+        c = self.c
+        h = self.hidden_states(variables, input_ids, train=train, rng=rng)
         # tied LM head in the compute dtype: an f32 matmul would skip the
         # MXU bf16 path; CE upcasts to f32 for the reduction
         logits = ops.linear(h, p["tok_emb"].T.astype(c.dtype))
         return logits, {}
 
     def lm_loss_fn(self):
-        """Next-token LM loss; batch = (input_ids,) or (input_ids, labels)."""
+        """Next-token LM loss; batch = (input_ids,) or (input_ids, labels).
+
+        With ``config.fused_ce`` the head matmul + CE run through
+        ``ops.lm_head_cross_entropy`` (chunked exact-LSE; logits never
+        materialize); otherwise the reference-shaped unfused composition.
+        """
         def fn(params, model_state, batch, rng, train):
             ids = batch[0] if isinstance(batch, (tuple, list)) else batch
-            logits, _ = self.apply({"params": params, "state": {}}, ids,
-                                   train=train, rng=rng)
-            loss = jnp.mean(ops.softmax_cross_entropy_sparse(
-                logits[:, :-1], ids[:, 1:]))
+            c = self.c
+            if c.fused_ce:
+                h = self.hidden_states({"params": params, "state": {}}, ids,
+                                       train=train, rng=rng)
+                loss = ops.lm_head_cross_entropy(
+                    h[:, :-1], params["tok_emb"], ids[:, 1:],
+                    row_chunk=c.ce_row_chunk)
+            else:
+                logits, _ = self.apply({"params": params, "state": {}}, ids,
+                                       train=train, rng=rng)
+                per = ops.softmax_cross_entropy_sparse(
+                    logits[:, :-1], ids[:, 1:])
+                # normalize by non-ignored rows, matching the fused path
+                # (identical when no label is ignored_index, as here)
+                n_valid = jnp.sum(ids[:, 1:] != -1)
+                loss = jnp.sum(per) / jnp.maximum(n_valid, 1)
             return loss, ({}, model_state)
         return fn
 

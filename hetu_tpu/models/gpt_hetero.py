@@ -64,7 +64,8 @@ class HeteroGPT(GPTModel):
             params[f"layer{i}"] = self.block.init(ks[2 + i])["params"]
         return {"params": params, "state": {}}
 
-    def apply(self, variables, input_ids, *, train: bool = False, rng=None):
+    def hidden_states(self, variables, input_ids, *, train: bool = False,
+                      rng=None):
         p = variables["params"]
         c = self.c
         b, s = input_ids.shape
@@ -79,9 +80,12 @@ class HeteroGPT(GPTModel):
                                     h, train=train,
                                     rng=None if rng is None else
                                     jax.random.fold_in(rng, i))
-        h = ops.layer_norm(h.astype(jnp.float32), p["ln_f_scale"],
-                           p["ln_f_bias"])
-        return ops.linear(h, p["tok_emb"].T), {}
+        return ops.layer_norm(h.astype(jnp.float32), p["ln_f_scale"],
+                              p["ln_f_bias"])
+
+    def apply(self, variables, input_ids, *, train: bool = False, rng=None):
+        h = self.hidden_states(variables, input_ids, train=train, rng=rng)
+        return ops.linear(h, variables["params"]["tok_emb"].T), {}
 
 
 _LAYER_RE = re.compile(r"\['layer(\d+)'\]")

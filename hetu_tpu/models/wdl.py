@@ -57,3 +57,76 @@ class WideDeep(Module):
         shared builder in ctr_common)."""
         from hetu_tpu.models.ctr_common import make_hybrid_step
         return make_hybrid_step(self, optimizer, n_sparse_inputs=1)
+
+
+class WideDeepDevice(Module):
+    """Device-resident Wide&Deep: the embedding table lives in HBM.
+
+    The TPU-idiomatic counterpart of the reference's PS/Hybrid CTR configs
+    for tables that FIT on-chip (Criteo-Kaggle's ~33M x 16 f32 is ~2.1 GB
+    against 16 GB HBM on v5e): no host tier, the lookup runs the Pallas
+    scalar-prefetch gather (``Embedding(impl='auto')``), and the update is
+    sparse — row gradients become ``IndexedSlices`` applied only to touched
+    rows (the reference's OptimizerOp *_sparse kernels), never a dense
+    [V, D] gradient.  The PS classes remain the path for tables bigger
+    than HBM.
+    """
+
+    def __init__(self, vocab_size: int, num_sparse_fields: int, emb_dim: int,
+                 dense_dim: int, hidden=(256, 256), emb_impl: str = "auto"):
+        from hetu_tpu import layers
+        self.vocab_size = vocab_size
+        self.emb = layers.Embedding(vocab_size, emb_dim, impl=emb_impl)
+        self.dense_net = WideDeep(num_sparse_fields, emb_dim, dense_dim,
+                                  hidden)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        d = self.dense_net.init(k1)
+        e = self.emb.init(k2)
+        return {"params": {"emb": e["params"], "net": d["params"]},
+                "state": {"net": d["state"]}}
+
+    def apply(self, variables, dense_x, sparse_ids, *, train: bool = False,
+              rng=None):
+        """dense_x [B, dense_dim]; sparse_ids [B, fields] int32 → logit [B]."""
+        p, s = variables["params"], variables["state"]
+        rows, _ = self.emb.apply({"params": p["emb"], "state": {}},
+                                 sparse_ids)
+        return self.dense_net.apply({"params": p["net"], "state": s["net"]},
+                                    dense_x, rows, train=train, rng=rng)
+
+    def sparse_step_fn(self, optimizer, *, jit: bool = True):
+        """Jitted full train step with a SPARSE table update.
+
+        Grads are taken wrt the gathered rows (not the table), converted to
+        ``IndexedSlices``, and the optimizer's ``apply_indexed`` rule
+        touches only those rows — step cost is O(B·fields·D), independent
+        of vocab size.
+        """
+        from hetu_tpu.ops.embedding import IndexedSlices
+
+        def step(params, opt_state, model_state, dense_x, sparse_ids,
+                 labels):
+            rows, _ = self.emb.apply(
+                {"params": params["emb"], "state": {}}, sparse_ids)
+
+            def loss_fn(net_params, rows):
+                logit, new_state = self.dense_net.apply(
+                    {"params": net_params, "state": model_state["net"]},
+                    dense_x, rows, train=True)
+                loss = jnp.mean(
+                    ops.binary_cross_entropy_with_logits(logit, labels))
+                return loss, (logit, new_state)
+
+            (loss, (logit, new_state)), (g_net, g_rows) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(params["net"], rows)
+            d = g_rows.shape[-1]
+            g_emb = {"weight": IndexedSlices(
+                sparse_ids.reshape(-1), g_rows.reshape(-1, d),
+                (self.vocab_size, d))}
+            new_params, opt_state = optimizer.update(
+                {"emb": g_emb, "net": g_net}, opt_state, params)
+            return (new_params, opt_state, {"net": new_state}, loss, logit)
+
+        return jax.jit(step, donate_argnums=(0, 1)) if jit else step

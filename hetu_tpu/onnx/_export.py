@@ -279,10 +279,17 @@ def _emit_gather(ctx, eqn, ins, outs):
         want = 1 if d == axis else operand.shape[d]
         if s != want:
             raise ValueError("ONNX export: partial-slice gather")
-    # indices carry a trailing length-1 coordinate dim: squeeze it
+    # indices usually carry a trailing length-1 coordinate dim: squeeze it.
+    # Decide by rank arithmetic, not shape[-1]==1 — output rank is
+    # batch-dims + offset-dims, so a coordinate dim is present exactly when
+    # out.ndim == (idx.ndim - 1) + len(offset_dims); a data dim that merely
+    # happens to be size 1 fails this and must NOT be squeezed.
     idx = eqn.invars[1].aval
+    out_rank = eqn.outvars[0].aval.ndim
+    has_coord_dim = (idx.shape and idx.shape[-1] == 1
+                     and out_rank == (idx.ndim - 1) + len(dn.offset_dims))
     idx_in = ins[1]
-    if idx.shape and idx.shape[-1] == 1:
+    if has_coord_dim:
         ax = ctx.init_tensor(np.asarray([idx.ndim - 1], np.int64), "axes")
         mid = ctx.fresh("sq")
         ctx.emit("Squeeze", [idx_in, ax], [mid])

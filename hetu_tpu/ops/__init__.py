@@ -32,6 +32,7 @@ from hetu_tpu.ops.losses import (
     binary_cross_entropy, binary_cross_entropy_with_logits,
     cross_entropy, cross_entropy_sparse,
     softmax_cross_entropy, softmax_cross_entropy_sparse, nll_loss,
+    lm_head_cross_entropy,
 )
 from hetu_tpu.ops.shape import (
     reshape, transpose, concat, concatenate, split, slice_, slice_assign,
@@ -56,7 +57,7 @@ from hetu_tpu.ops.quantize import (
 )
 from hetu_tpu.ops.moe_ops import (
     top_k_idx_gate, layout_transform, reverse_layout_transform,
-    balance_assignment,
+    balance_assignment, make_slot_routing, gather_dispatch, gather_combine,
 )
 from hetu_tpu.ops.attention import (
     attention, causal_attention,
@@ -69,4 +70,5 @@ from hetu_tpu.ops.pallas_kernels import (
     embedding_gather as pallas_embedding_gather,
     embedding_scatter_add as pallas_embedding_scatter_add,
     topk_gating as pallas_topk_gating,
+    routed_gather as pallas_routed_gather,
 )

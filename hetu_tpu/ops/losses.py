@@ -57,6 +57,70 @@ def softmax_cross_entropy_sparse(logits, label, ignored_index: int = -1):
     return jnp.where(label == ignored_index, 0.0, -picked)
 
 
+def lm_head_cross_entropy(h, w_emb, labels, *, ignored_index: int = -1,
+                          row_chunk: int = 2048):
+    """Fused LM-head + softmax-CE that never materializes ``[N, V]`` logits.
+
+    Computes ``mean(CE(h @ w_emb.T, labels))`` over non-ignored rows in
+    O(row_chunk * V) memory: a ``lax.scan`` over row chunks where each chunk
+    runs the head matmul on the MXU, reduces straight to (LSE, picked-logit)
+    in f32, and — via ``jax.checkpoint`` — recomputes its logits in the
+    backward instead of saving them.  Exact log-sum-exp, no approximation.
+
+    The reference has only the unfused pair (``gpu_ops/Linear.py`` into
+    ``gpu_ops/SoftmaxCrossEntropySparse.py``) which materializes the full
+    logits tensor both ways; at GPT vocab sizes the f32 logits are the
+    single largest HBM tensor in the step and this beats it the same way
+    the fused flash kernel beats composed attention.  Cost: one extra head
+    matmul (the backward recompute), bought back many times over in HBM
+    traffic at TPU arithmetic intensities.
+
+    Args:
+      h: ``[..., H]`` final hidden states (any float dtype; matmul runs in
+        ``h.dtype`` so bf16 stays on the MXU bf16 path).
+      w_emb: ``[V, H]`` (tied) embedding / LM-head weight.
+      labels: ``[...]`` int targets aligned with ``h``'s leading dims.
+      ignored_index: rows with this label contribute nothing.
+      row_chunk: rows per scan step; the peak live logits buffer is
+        ``row_chunk * V`` f32.
+
+    Returns: scalar mean loss (f32) over non-ignored rows.
+    """
+    hs = h.reshape(-1, h.shape[-1])
+    ys = labels.reshape(-1).astype(jnp.int32)
+    n = hs.shape[0]
+    pad = (-n) % row_chunk
+    if pad:
+        hs = jnp.concatenate([hs, jnp.zeros((pad, hs.shape[1]), hs.dtype)])
+        ys = jnp.concatenate(
+            [ys, jnp.full((pad,), ignored_index, jnp.int32)])
+    n_chunks = hs.shape[0] // row_chunk
+    hs = hs.reshape(n_chunks, row_chunk, -1)
+    ys = ys.reshape(n_chunks, row_chunk)
+    w_t = w_emb.T.astype(h.dtype)
+
+    @jax.checkpoint
+    def chunk(h_c, y_c):
+        logits = (h_c @ w_t).astype(jnp.float32)  # [C, V] — chunk-local
+        m = jnp.max(logits, axis=-1)
+        lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+        picked = jnp.take_along_axis(
+            logits, jnp.maximum(y_c, 0)[:, None], axis=-1)[:, 0]
+        valid = y_c != ignored_index
+        loss = jnp.where(valid, lse - picked, 0.0)
+        return jnp.sum(loss), jnp.sum(valid)
+
+    def body(carry, xs):
+        tot, cnt = carry
+        s, c = chunk(*xs)
+        return (tot + s, cnt + c), None
+
+    (total, count), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (hs, ys))
+    return total / jnp.maximum(count, 1).astype(jnp.float32)
+
+
 def nll_loss(logp, label):
     """Negative log-likelihood on log-probabilities (gpu_ops/NllLoss.py)."""
     picked = jnp.take_along_axis(

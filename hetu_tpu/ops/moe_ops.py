@@ -28,6 +28,23 @@ def top_k_idx_gate(logits, k: int):
     return gates, idx
 
 
+def _capacity_positions(expert_idx, num_experts: int, capacity: int):
+    """Shared in-order capacity assignment: position of each (token,
+    choice) within its chosen expert's queue — earlier tokens and lower
+    choice index first, matching the reference's LayoutTransform.cu index
+    computation.  Both routing builders (dense-mask and index-based) call
+    this so their routing decisions agree bit-for-bit.
+
+    Returns (one_hot [T,k,E] int32, pos [T,k], within_capacity [T,k] bool).
+    """
+    T, k = expert_idx.shape
+    oh = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)
+    flat = oh.reshape(T * k, num_experts)
+    pos_in_expert = jnp.cumsum(flat, axis=0) - flat          # [T*k, E]
+    pos = jnp.sum(pos_in_expert.reshape(T, k, num_experts) * oh, axis=-1)
+    return oh, pos, pos < capacity
+
+
 def make_dispatch_combine(gates, expert_idx, num_experts: int, capacity: int):
     """Build dispatch/combine tensors from top-k gate decisions.
 
@@ -39,19 +56,61 @@ def make_dispatch_combine(gates, expert_idx, num_experts: int, capacity: int):
     (src/ops/LayoutTransform.cu) but as dense masks for the MXU.
     """
     T, k = gates.shape
-    # position of each (token, choice) within its expert's queue
-    oh = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)  # [T,k,E]
-    # priority: earlier tokens and lower choice index first (matches the
-    # reference's in-order capacity assignment)
-    flat = oh.reshape(T * k, num_experts)
-    pos_in_expert = jnp.cumsum(flat, axis=0) - flat          # [T*k, E]
-    pos = jnp.sum(pos_in_expert.reshape(T, k, num_experts) * oh, axis=-1)  # [T,k]
-    within_cap = pos < capacity
+    oh, pos, within_cap = _capacity_positions(expert_idx, num_experts,
+                                              capacity)
     slot_oh = jax.nn.one_hot(jnp.where(within_cap, pos, capacity),
                              capacity + 1, dtype=gates.dtype)[..., :capacity]
     disp = jnp.einsum("tke,tkc->tec", oh.astype(gates.dtype), slot_oh)
     comb = jnp.einsum("tk,tke,tkc->tec", gates, oh.astype(gates.dtype), slot_oh)
     return disp, comb
+
+
+def make_slot_routing(gates, expert_idx, num_experts: int, capacity: int):
+    """Index-based routing tables (the O(T·k) alternative to the dense
+    [T, E, C] masks of :func:`make_dispatch_combine`, whose einsum
+    dispatch costs O(T²·D) at MoE scale).
+
+    Same in-order capacity assignment as the reference's
+    LayoutTransform.cu index computation, but kept as indices:
+      slot_token [E*C] — which token fills each expert slot (-1 = empty)
+      token_slot [T,k] — which flat slot each (token, choice) landed in
+                         (-1 = dropped by capacity)
+      n_dropped  []    — how many (token, choice) routes overflowed
+    """
+    T, k = gates.shape
+    _, pos, within = _capacity_positions(expert_idx, num_experts, capacity)
+    token_slot = jnp.where(within, expert_idx * capacity + pos, -1)
+    tok_ids = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[:, None],
+                               (T, k))
+    slot_token = jnp.full((num_experts * capacity,), -1, jnp.int32).at[
+        jnp.where(within, token_slot, num_experts * capacity)
+    ].set(tok_ids, mode="drop")
+    n_dropped = T * k - jnp.sum(within.astype(jnp.int32))
+    return slot_token, token_slot, n_dropped
+
+
+def gather_dispatch(tokens, slot_token, num_experts: int, capacity: int,
+                    *, interpret=None):
+    """tokens [T, D] → expert-major [E, C, D] by row gather (empty slots
+    zero).  Pallas routed_gather on TPU; replaces the einsum dispatch's
+    O(T·E·C·D) flops with O(E·C·D) bytes."""
+    from hetu_tpu.ops.pallas_kernels import routed_gather
+    rows = routed_gather(tokens, slot_token, interpret=interpret)
+    return rows.reshape(num_experts, capacity, tokens.shape[-1])
+
+
+def gather_combine(expert_out, token_slot, gates, *, interpret=None):
+    """[E, C, D] expert outputs → [T, D] token outputs, gate-weighted;
+    dropped routes contribute zero (capacity-overflow semantics of the
+    reference's ReverseLayoutTransform)."""
+    from hetu_tpu.ops.pallas_kernels import routed_gather
+    E, C, D = expert_out.shape
+    T, k = token_slot.shape
+    flat = expert_out.reshape(E * C, D)
+    picked = routed_gather(flat, token_slot.reshape(-1),
+                           interpret=interpret)          # [T*k, D]
+    picked = picked.reshape(T, k, D)
+    return jnp.sum(gates[..., None].astype(picked.dtype) * picked, axis=1)
 
 
 def layout_transform(tokens, dispatch):

@@ -124,6 +124,52 @@ def embedding_scatter_add(grads, ids, num_rows: int, *, interpret=None):
     return out[:num_rows]
 
 
+# ------------------------------------------------------- routed gather op
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _routed_gather(table, ids, interpret):
+    if interpret:  # CPU/tests: plain XLA — faster than interpret-mode pallas
+        valid = (ids >= 0) & (ids < table.shape[0])
+        rows = jnp.take(table, jnp.clip(ids, 0, table.shape[0] - 1), axis=0)
+        return jnp.where(valid[:, None], rows, 0)
+    return embedding_gather(table, ids, interpret=False)
+
+
+def _routed_gather_fwd(table, ids, interpret):
+    return _routed_gather(table, ids, interpret), (ids, table.shape[0])
+
+
+def _routed_gather_bwd(interpret, res, g):
+    ids, num_rows = res
+    if interpret:
+        valid = (ids >= 0) & (ids < num_rows)
+        g = jnp.where(valid[:, None], g, 0)
+        dt = jnp.zeros((num_rows, g.shape[-1]), g.dtype).at[
+            jnp.clip(ids, 0, num_rows - 1)].add(g)
+    else:
+        dt = embedding_scatter_add(g, ids, num_rows, interpret=False)
+    return dt, None
+
+
+_routed_gather.defvjp(_routed_gather_fwd, _routed_gather_bwd)
+
+
+def routed_gather(table, ids, *, interpret=None):
+    """Differentiable row gather with -1/out-of-range → zero-row semantics.
+
+    The gather/scatter-add kernels above bound into one autodiff op:
+    forward pulls ``table[ids]`` (invalid ids give zero rows), backward
+    scatter-adds the cotangent rows back (duplicates accumulate, invalid
+    ids drop) — the vjp-transpose contract ``test_scatter_is_gather_
+    transpose`` pins.  On TPU both directions run the Pallas kernels
+    (scalar-prefetch DMA streaming, EmbeddingLookUp.cu analog); elsewhere
+    an equivalent XLA path.  This is the building block the MoE
+    gather-dispatch and device-resident embedding layers route through.
+    """
+    ids = ids.astype(jnp.int32)
+    return _routed_gather(table, ids, _auto_interpret(interpret))
+
+
 # ---------------------------------------------------------------- top-k
 
 def _topk_kernel(logits_ref, vals_ref, idx_ref, *, k: int, experts: int):
@@ -140,6 +186,33 @@ def _topk_kernel(logits_ref, vals_ref, idx_ref, *, k: int, experts: int):
         x = jnp.where(iota == pos[:, None], -jnp.inf, x)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _topk_gating(logits, k, block_tokens, interpret):
+    return _topk_gating_impl(logits, k, block_tokens, interpret)
+
+
+def _topk_gating_fwd(logits, k, block_tokens, interpret):
+    gates, idx = _topk_gating_impl(logits, k, block_tokens, interpret)
+    return (gates, idx), (gates, idx, logits.shape[1])
+
+
+def _topk_gating_bwd(k, block_tokens, interpret, res, ct):
+    """softmax-over-the-chosen-k vjp, scattered back into [T, E]: the same
+    gradient lax.top_k + softmax would produce (idx is non-differentiable,
+    selection is piecewise-constant)."""
+    gates, idx, E = res
+    g_gates = ct[0]
+    inner = jnp.sum(g_gates * gates, axis=-1, keepdims=True)
+    dvals = (gates * (g_gates - inner)).astype(gates.dtype)
+    T = gates.shape[0]
+    dlogits = jnp.zeros((T, E), dvals.dtype).at[
+        jnp.arange(T)[:, None], idx].add(dvals)
+    return (dlogits,)
+
+
+_topk_gating.defvjp(_topk_gating_fwd, _topk_gating_bwd)
+
+
 def topk_gating(logits, k: int, *, block_tokens: int = 256,
                 interpret=None):
     """logits [T, E] -> (gates [T, k] softmaxed over the k, idx [T, k]).
@@ -147,8 +220,15 @@ def topk_gating(logits, k: int, *, block_tokens: int = 256,
     The MoE gate's top-k + softmax fused in VMEM (TopKIdx.cu analog):
     k repeated max/mask passes beat a full sort for the k << E regime.
     Matches ops.top_k_idx_gate (ties resolved to the lowest index,
-    lax.top_k's order)."""
+    lax.top_k's order) — including its gradient, via a custom vjp.
+    """
     interpret = _auto_interpret(interpret)
+    return _topk_gating(logits, int(k), int(min(block_tokens,
+                                                logits.shape[0])),
+                        bool(interpret))
+
+
+def _topk_gating_impl(logits, k, block_tokens, interpret):
     T, E = logits.shape
     if k > E:
         raise ValueError(f"top-{k} of only {E} experts (lax.top_k would "

@@ -44,6 +44,13 @@ class VanMailbox:
     the van server applies one connection's requests in order, so the
     reader observing seq implies the payload is complete.  A fresh `seq`
     per message makes the channel reusable (ping-pong for fwd/bwd).
+
+    At most ONE message may be outstanding per channel: there is no reader
+    ack, so a second `put` can overwrite the payload between the reader's
+    flag poll and its (separate) payload pull, tearing the data.  Callers
+    must externally order put(seq=n+1) after the consumer of seq=n has
+    returned (the pipeline schedules here use one channel per microbatch
+    or strict ping-pong, which satisfies this).
     """
 
     def __init__(self, host: str, port: int, channel_id: int,

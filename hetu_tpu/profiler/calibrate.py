@@ -49,6 +49,10 @@ def fit_ici_bandwidth(cprof: CollectiveProfiler, axis: str, n_devices: int,
 
     Ring allreduce moves 2*(n-1)/n * S bytes over the bottleneck link, so
     bw_eff = wire_bytes_delta / time_delta; the intercept is latency."""
+    if n_devices < 2:
+        raise ValueError(
+            f"fit_ici_bandwidth needs a multi-device axis; axis {axis!r} has "
+            f"{n_devices} device(s) (no wire traffic to fit)")
     s1, s2 = sizes
     t1 = cprof.allreduce_time(s1, axis)
     t2 = cprof.allreduce_time(s2, axis)

@@ -70,6 +70,12 @@ def detect_chip(timeout_s: float = 15.0) -> ChipSpec:
     t.start()
     t.join(timeout_s)
     if "d" not in found:
+        import logging
+        logging.getLogger(__name__).warning(
+            "detect_chip: backend probe timed out after %ss; defaulting to "
+            "the v5e spec — absolute cost estimates reflect a TPU even if "
+            "this host is not one (relative plan rankings are unaffected)",
+            timeout_s)
         _DETECTED["spec"] = CHIPS["v5e"]  # offline default: bench target
         return _DETECTED["spec"]
     d = found["d"]

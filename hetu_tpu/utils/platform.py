@@ -25,12 +25,14 @@ _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
 def device_watchdog(timeout_s: float = 180.0, *, exit_code: int = 3,
-                    label: str = "device backend"):
+                    label: str = "device backend", exit_on_fail: bool = True):
     """Touch the backend under a timeout; exit ``exit_code`` fast on a hang.
 
     Returns the device list on success.  A dead tunnel otherwise hangs the
     process until the driver's own timeout fires (rc=124) — exiting nonzero
-    quickly is strictly better for any batch runner.
+    quickly is strictly better for any batch runner.  With
+    ``exit_on_fail=False`` a failure returns ``None`` instead, for callers
+    with their own degradation path (:func:`wait_for_devices`).
     """
     import sys
 
@@ -51,8 +53,68 @@ def device_watchdog(timeout_s: float = 180.0, *, exit_code: int = 3,
         msg = (f"{label} error: {found['err']!r}" if "err" in found
                else f"{label} unreachable within {timeout_s}s — tunnel down?")
         print(msg, file=sys.stderr, flush=True)
-        os._exit(exit_code)
+        if exit_on_fail:
+            os._exit(exit_code)
+        return None
     return found["devs"]
+
+
+def wait_for_devices(deadline_s: float = 600.0, *,
+                     probe_timeout_s: float = 90.0, poll_s: float = 5.0,
+                     label: str = "device backend"):
+    """Poll for a live backend with subprocess probes, then bind in-process.
+
+    :func:`device_watchdog` is right for a fail-fast gate but wrong for a
+    once-per-round benchmark: a single tunnel blip at capture time wastes the
+    whole round's perf evidence.  This waits up to ``deadline_s`` for the
+    backend to answer.  Probes run in SUBPROCESSES because a hung in-process
+    ``jax.devices()`` wedges backend-init state for every later attempt in
+    the same interpreter; a killed subprocess leaves this process clean.
+
+    Returns the in-process device list on success, ``None`` if the deadline
+    expires without a live backend (caller decides how to degrade).
+    """
+    import subprocess
+    import sys
+    import time
+
+    env = os.environ.copy()
+    if env.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # the tunnel plugin's sitecustomize blocks at interpreter start when
+        # the tunnel is down, even though the probe only wants CPU — drop the
+        # plugin's site dir so a CPU probe cannot hang on a dead tunnel
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p)
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(len(jax.devices()))"],
+                capture_output=True, timeout=probe_timeout_s, text=True,
+                env=env)
+            ok = r.returncode == 0 and r.stdout.strip().isdigit()
+        except subprocess.TimeoutExpired:
+            ok = False
+        if ok:
+            # the tunnel answers; bind this process's backend (still under a
+            # watchdog in case it dropped again between probe and bind —
+            # a bind failure degrades to None, never a hard exit, so the
+            # caller's own fallback still runs)
+            left = max(probe_timeout_s, deadline_s - (time.monotonic() - start))
+            devs = device_watchdog(left, label=label, exit_on_fail=False)
+            if devs is not None:
+                return devs
+            # bind failed after a good probe: fall through to retry/deadline
+        waited = time.monotonic() - start
+        if waited >= deadline_s:
+            print(f"{label} unreachable after {attempt} probes over "
+                  f"{waited:.0f}s — tunnel down?", file=sys.stderr, flush=True)
+            return None
+        time.sleep(poll_s)
 
 
 def default_backend_is_tpu() -> bool:

@@ -1,0 +1,115 @@
+"""Bench capture resilience: retry loop + stale last-known-good fallback.
+
+The driver runs ``bench.py`` exactly once per round over a tunneled TPU; two
+rounds of perf evidence were lost to single-probe watchdog exits when the
+tunnel blipped at capture time.  These tests pin the recovery contract:
+``wait_for_devices`` polls with subprocess probes (a hung in-process
+``jax.devices()`` would wedge retries), and a dead backend degrades to an
+honestly-labeled stale record instead of an error when one exists.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_wait_for_devices_returns_promptly_on_live_backend():
+    from hetu_tpu.utils.platform import wait_for_devices
+
+    t0 = time.monotonic()
+    devs = wait_for_devices(deadline_s=120.0, probe_timeout_s=60.0)
+    assert devs is not None and len(devs) >= 1
+    assert time.monotonic() - t0 < 60.0
+
+
+def test_wait_for_devices_gives_up_after_deadline():
+    from hetu_tpu.utils import platform as plat
+
+    calls = []
+
+    def fake_run(*a, **k):
+        calls.append(time.monotonic())
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=0.01)
+
+    orig = subprocess.run
+    subprocess.run = fake_run
+    try:
+        t0 = time.monotonic()
+        devs = plat.wait_for_devices(deadline_s=0.5, probe_timeout_s=0.1,
+                                     poll_s=0.1)
+    finally:
+        subprocess.run = orig
+    assert devs is None
+    assert len(calls) >= 2  # actually retried, not a single probe
+    assert time.monotonic() - t0 < 30.0
+
+
+def _run_bench_snippet(code, cwd):
+    env = {"PATH": "/usr/bin:/bin", "PYTHONPATH": str(REPO),
+           "JAX_PLATFORMS": "cpu", "HOME": "/root",
+           "HETU_BENCH_ALLOW_CPU_LKG": "1"}
+    return subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, env=env, cwd=str(cwd), timeout=120)
+
+
+def test_stale_lkg_emitted_with_labels(tmp_path):
+    lkg = {"gpt2s_bf16_train_mfu_1chip": {
+        "metric": "gpt2s_bf16_train_mfu_1chip", "value": 0.254,
+        "unit": "model_flops_utilization", "vs_baseline": 0.726,
+        "extra": {"tokens_per_s": 58600.0},
+        "measured_unix": time.time() - 7200}}
+    lkg_file = tmp_path / ".bench_lkg.json"
+    lkg_file.write_text(json.dumps(lkg))
+    r = _run_bench_snippet(
+        "import bench\n"
+        f"bench._LKG_PATH = __import__('pathlib').Path({str(lkg_file)!r})\n"
+        "bench._emit_stale_or_die('gpt2s_bf16_train_mfu_1chip')\n", tmp_path)
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout)
+    assert rec["value"] == 0.254
+    assert rec["extra"]["stale"] is True
+    assert 1.5 < rec["extra"]["stale_age_hours"] < 3.0
+    assert "last-known-good" in rec["extra"]["stale_reason"]
+
+
+def test_no_lkg_exits_nonzero(tmp_path):
+    lkg_file = tmp_path / ".bench_lkg.json"  # absent
+    r = _run_bench_snippet(
+        "import bench\n"
+        f"bench._LKG_PATH = __import__('pathlib').Path({str(lkg_file)!r})\n"
+        "bench._emit_stale_or_die('gpt2s_bf16_train_mfu_1chip')\n", tmp_path)
+    assert r.returncode == 3
+    assert r.stdout.strip() == ""
+
+
+def test_lkg_for_other_metric_is_not_emitted(tmp_path):
+    """Only a record for the SAME metric is an honest fallback: a GPT LKG
+    must not satisfy a resnet bench run."""
+    lkg_file = tmp_path / ".bench_lkg.json"
+    lkg_file.write_text(json.dumps({"gpt2s_bf16_train_mfu_1chip": {
+        "metric": "gpt2s_bf16_train_mfu_1chip", "value": 0.3, "unit": "u",
+        "vs_baseline": 1.0, "measured_unix": time.time()}}))
+    r = _run_bench_snippet(
+        "import bench\n"
+        f"bench._LKG_PATH = __import__('pathlib').Path({str(lkg_file)!r})\n"
+        "bench._emit_stale_or_die("
+        "'resnet18_cifar10_train_samples_per_sec_per_chip')\n", tmp_path)
+    assert r.returncode == 3
+    assert r.stdout.strip() == ""
+
+
+def test_emit_persists_lkg(tmp_path):
+    lkg_file = tmp_path / ".bench_lkg.json"
+    r = _run_bench_snippet(
+        "import bench\n"
+        f"bench._LKG_PATH = __import__('pathlib').Path({str(lkg_file)!r})\n"
+        "bench._emit({'metric': 'm', 'value': 1.0, 'unit': 'u',"
+        " 'vs_baseline': 1.0})\n", tmp_path)
+    assert r.returncode == 0, r.stderr
+    saved = json.loads(lkg_file.read_text())
+    assert saved["m"]["value"] == 1.0
+    assert saved["m"]["measured_unix"] > 0

@@ -95,3 +95,57 @@ def test_gpt_tiny_lm_step():
     lb, _ = model.apply(v, jnp.asarray(ids2))
     np.testing.assert_allclose(np.asarray(la[:, :-1]), np.asarray(lb[:, :-1]),
                                atol=1e-5)
+
+
+def test_gpt_fused_ce_matches_unfused():
+    """fused_ce (chunked lm_head_cross_entropy) == Linear→SoftmaxCE-sparse
+    composition: loss value and every grad leaf, incl. the tied embedding
+    (which takes grads from both the lookup and the recomputed head)."""
+    cfg = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+               ffn_size=64, max_position=64, dropout_rate=0.0)
+    m_fused = models.GPTModel(models.GPTConfig(**cfg, fused_ce=True,
+                                               ce_row_chunk=16))
+    m_ref = models.GPTModel(models.GPTConfig(**cfg, fused_ce=False))
+    v = m_fused.init(jax.random.PRNGKey(1))
+    ids = jnp.asarray(
+        np.random.default_rng(1).integers(0, 97, (3, 33)), jnp.int32)
+
+    def loss(model, p):
+        return model.lm_loss_fn()(p, {}, (ids,), None, False)[0]
+
+    lf, gf = jax.value_and_grad(lambda p: loss(m_fused, p))(v["params"])
+    lr, gr = jax.value_and_grad(lambda p: loss(m_ref, p))(v["params"])
+    np.testing.assert_allclose(float(lf), float(lr), rtol=1e-5)
+    for kf, kr in zip(jax.tree_util.tree_leaves(gf),
+                      jax.tree_util.tree_leaves(gr)):
+        np.testing.assert_allclose(np.asarray(kf), np.asarray(kr),
+                                   rtol=2e-4, atol=1e-5)
+
+
+def test_lm_head_ce_op_direct():
+    """lm_head_cross_entropy == mean softmax_cross_entropy_sparse on the
+    materialized logits, for ragged N (padding rows masked) and ignored
+    labels; grads wrt h and w match too."""
+    from hetu_tpu import ops
+    g = np.random.default_rng(2)
+    N, H, V = 37, 16, 53  # N not a multiple of row_chunk
+    h = jnp.asarray(g.standard_normal((N, H)), jnp.float32)
+    w = jnp.asarray(g.standard_normal((V, H)) * 0.2, jnp.float32)
+    y = g.integers(0, V, N).astype(np.int32)
+    y[5] = -1; y[20] = -1  # ignored
+    y = jnp.asarray(y)
+
+    def ref(h, w):
+        per = ops.softmax_cross_entropy_sparse(h @ w.T, y)
+        return jnp.sum(per) / jnp.sum(y != -1)
+
+    def fused(h, w):
+        return ops.lm_head_cross_entropy(h, w, y, row_chunk=8)
+
+    lr, (ghr, gwr) = jax.value_and_grad(ref, argnums=(0, 1))(h, w)
+    lf, (ghf, gwf) = jax.value_and_grad(fused, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(float(lf), float(lr), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ghf), np.asarray(ghr),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gwf), np.asarray(gwr),
+                               rtol=1e-5, atol=1e-6)
