@@ -81,6 +81,7 @@ def calibrate_simulator(mesh=None, *, chip: Optional[ChipSpec] = None,
     report["mxu_util_fit"] = mxu
     fitted = dataclasses.replace(chip, mxu_util=mxu)
 
+    axis_rates = {}
     if mesh is not None:
         axes = list(axes) if axes is not None else \
             [a for a in mesh.axis_names if mesh.shape[a] > 1]
@@ -89,13 +90,16 @@ def calibrate_simulator(mesh=None, *, chip: Optional[ChipSpec] = None,
         for ax in axes:
             bw, lat = fit_ici_bandwidth(cprof, ax, mesh.shape[ax])
             bws[ax] = {"bw_bytes_per_s": bw, "latency_s": lat}
+            axis_rates[ax] = (bw, lat)
         report["ici_fit"] = bws
         if bws:
-            # the simulator prices one interconnect tier; use the slowest
-            # fitted axis (conservative for plan feasibility)
+            # chip-level fallback rate for roles without a fitted axis:
+            # the slowest fitted axis (conservative for plan feasibility);
+            # fitted axes themselves keep their OWN rate via axis_rates —
+            # multi-tier pricing, not worst-axis folding
             worst = min(b["bw_bytes_per_s"] for b in bws.values())
             fitted = dataclasses.replace(fitted, ici_bw=worst, ici_util=1.0)
-    return Simulator(fitted), report
+    return Simulator(fitted, axis_rates=axis_rates), report
 
 
 def layer_spec_from_measurement(name: str, fwd_fn, args, *,

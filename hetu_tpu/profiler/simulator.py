@@ -18,10 +18,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
-from hetu_tpu.profiler.cost_model import (
-    ChipSpec, allgather_time, allreduce_time, alltoall_time, detect_chip,
-    matmul_time, p2p_time,
-)
+from hetu_tpu.profiler.cost_model import ChipSpec, detect_chip, p2p_time
 
 
 @dataclass
@@ -62,11 +59,64 @@ class LayerSpec:
 
 class Simulator:
     def __init__(self, chip: Optional[ChipSpec] = None, *,
-                 calibration: Optional[float] = None):
+                 calibration: Optional[float] = None,
+                 axis_rates: Optional[Dict[str, tuple]] = None,
+                 axis_of: Optional[Dict[str, str]] = None):
         """calibration: measured/predicted ratio from one real matmul
-        (OpProfiler.time_matmul vs cost_model.matmul_time)."""
+        (OpProfiler.time_matmul vs cost_model.matmul_time).
+
+        Multi-tier interconnect pricing (reference per-device-subset
+        fidelity, python/hetu/profiler.py:502-608): ``axis_rates`` maps a
+        MESH AXIS name to its fitted ``(bytes_per_s, latency_s)`` —
+        typically from ``calibrate.fit_ici_bandwidth`` per axis — and
+        ``axis_of`` maps each parallelism ROLE ('dp'/'tp'/'sp'/'ep') to
+        the mesh axis that carries it.  A collective then rides ITS axis's
+        rate: tp-on-a-fast-ICI-axis with dp-on-a-slow-DCN-axis is priced
+        differently from the inverse, so searchers rank hierarchical
+        layouts correctly instead of folding every axis to the worst rate.
+        Roles without a fitted axis fall back to the chip's ici numbers.
+        """
         self.chip = chip or detect_chip()
         self.cal = calibration or 1.0
+        self.axis_rates = dict(axis_rates or {})
+        self.axis_of = dict(axis_of or {})
+
+    # ---- per-role interconnect rates ----
+    def _rate(self, role: str) -> tuple:
+        """(bytes/s, latency) of the mesh axis carrying ``role``."""
+        ax = self.axis_of.get(role, role)
+        if ax in self.axis_rates:
+            return self.axis_rates[ax]
+        return (self.chip.ici_bw * self.chip.ici_util, 5e-6)
+
+    def _allreduce(self, nbytes: float, n: int, role: str) -> float:
+        if n <= 1:
+            return 0.0
+        bw, lat = self._rate(role)
+        return 2.0 * (n - 1) / n * nbytes / bw + lat
+
+    def _allgather(self, nbytes: float, n: int, role: str) -> float:
+        if n <= 1:
+            return 0.0
+        bw, lat = self._rate(role)
+        return (n - 1) / n * nbytes / bw + lat
+
+    def _alltoall(self, nbytes: float, n: int, role: str) -> float:
+        if n <= 1:
+            return 0.0
+        bw, lat = self._rate(role)
+        return (n - 1) / n * nbytes / bw + lat
+
+    def hier_alltoall_time(self, nbytes: float, n_local: int,
+                           n_groups: int, *, local_role: str = "ep",
+                           cross_role: str = "dp") -> float:
+        """Two-leg hierarchical A2A (parallel/collectives.py
+        hierarchical_all_to_all): an intra-group a2a on the fast axis,
+        then a cross-group a2a moving 1/n_local of the data per device on
+        the slow axis — priced per leg on each leg's own rate."""
+        t = self._alltoall(nbytes, n_local, local_role)
+        t += self._alltoall(nbytes / max(n_local, 1), n_groups, cross_role)
+        return t
 
     # ---- per-layer ----
     def layer_time(self, layer: LayerSpec, opt: ShardOption, dp: int,
@@ -80,13 +130,13 @@ class Simulator:
             if opt.dp_type == "sdp":
                 # FSDP: allgather params fwd + bwd, reduce_scatter grads —
                 # ~1.5x the allreduce wire bytes (ring AR = AG + RS)
-                t += 1.5 * allreduce_time(self.chip, layer.param_bytes, dp)
+                t += 1.5 * self._allreduce(layer.param_bytes, dp, "dp")
             else:
                 # 'dp' and 'zero1' both move allreduce-equivalent bytes
                 # (zero1 = reduce_scatter grads + allgather updated params)
-                t += allreduce_time(self.chip, layer.param_bytes, dp)
+                t += self._allreduce(layer.param_bytes, dp, "dp")
         if opt.kind == "tp_row" and opt.tp > 1:
-            t += allreduce_time(self.chip, layer.act_bytes / dp, opt.tp)
+            t += self._allreduce(layer.act_bytes / dp, opt.tp, "tp")
         if opt.kind == "tp_col" and opt.tp > 1:
             # activations stay split; cost shows up at reshard time
             pass
@@ -102,11 +152,11 @@ class Simulator:
                 prev.tp == nxt.tp:
             return 0.0  # Megatron pairing: split output feeds split input
         if prev.kind == "tp_col":
-            return allgather_time(self.chip, per_dp, prev.tp)
+            return self._allgather(per_dp, prev.tp, "tp")
         if nxt.kind in ("tp_col", "tp_row") and nxt.tp > 1:
             return 0.0  # replicated → split is a local slice
         if prev.kind == "seq" or nxt.kind == "seq":
-            return alltoall_time(self.chip, per_dp, max(prev.tp, nxt.tp))
+            return self._alltoall(per_dp, max(prev.tp, nxt.tp), "sp")
         return 0.0
 
     # ---- whole-chain ----

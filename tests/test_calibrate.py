@@ -93,3 +93,91 @@ def test_layer_spec_from_measurement_roundtrips(tmp_path):
     from hetu_tpu.profiler import ShardOption
     t_sim = sim.layer_time(spec, ShardOption("dp"), dp=1, train=False)
     assert t_sim == pytest.approx(t_meas, rel=1e-6)
+
+
+def test_multi_tier_axis_rates_price_roles_differently():
+    """tp-on-fast-axis/dp-on-slow-axis must cost less than the inverse for
+    a tp_row layer whose activation psum rides tp while grads ride dp —
+    per-axis pricing, not worst-axis folding (reference per-subset cost:
+    python/hetu/profiler.py:502-608)."""
+    from hetu_tpu.profiler.cost_model import CHIPS
+    from hetu_tpu.profiler.simulator import LayerSpec, ShardOption, Simulator
+
+    rates = {"ici": (100e9, 1e-6), "dcn": (2e9, 50e-6)}
+    layer = LayerSpec("ffn", flops=1e12, param_bytes=4e6,
+                      act_bytes=512e6, options=[])
+    opt = ShardOption("tp_row", tp=4)
+
+    sim_good = Simulator(CHIPS["v5e"], axis_rates=rates,
+                         axis_of={"tp": "ici", "dp": "dcn"})
+    sim_bad = Simulator(CHIPS["v5e"], axis_rates=rates,
+                        axis_of={"tp": "dcn", "dp": "ici"})
+    t_good = sim_good.layer_time(layer, opt, dp=2)
+    t_bad = sim_bad.layer_time(layer, opt, dp=2)
+    # act psum (128 MB over tp) dominates the small grad allreduce: putting
+    # tp on the fast tier must win by a wide margin
+    assert t_good < t_bad / 5, (t_good, t_bad)
+
+
+def test_searched_plan_flips_with_axis_assignment():
+    """OptCNN must pick tp when the tp axis is fast and pure dp when the
+    tp axis is slow — the searched plan reacts to tier assignment."""
+    from hetu_tpu.parallel.strategies.search import OptCNNSearching
+    from hetu_tpu.profiler.cost_model import CHIPS
+    from hetu_tpu.profiler.simulator import LayerSpec, ShardOption, Simulator
+
+    opts = [ShardOption("dp"), ShardOption("tp_row", tp=4)]
+    # compute-heavy layer: tp=4 quarters the compute, but its act psum is
+    # sizeable — worth it only on a fast tp tier
+    layers = [LayerSpec(f"l{i}", flops=2e12, param_bytes=1e6,
+                        act_bytes=256e6, options=list(opts))
+              for i in range(3)]
+    rates = {"fast": (100e9, 1e-6), "slow": (1.5e9, 50e-6)}
+
+    plan_fast = OptCNNSearching(
+        Simulator(CHIPS["v5e"], axis_rates=rates,
+                  axis_of={"tp": "fast", "dp": "slow"}),
+        dp=2).search(layers)
+    plan_slow = OptCNNSearching(
+        Simulator(CHIPS["v5e"], axis_rates=rates,
+                  axis_of={"tp": "slow", "dp": "fast"}),
+        dp=2).search(layers)
+    kinds_fast = [o.kind for o in plan_fast.layer_options]
+    kinds_slow = [o.kind for o in plan_slow.layer_options]
+    assert all(k == "tp_row" for k in kinds_fast), kinds_fast
+    assert all(k == "dp" for k in kinds_slow), kinds_slow
+
+
+def test_hier_alltoall_prices_both_legs():
+    """hierarchical A2A = intra-group leg on the local axis rate + 1/n_local
+    of the bytes on the cross axis rate (parallel/collectives.py
+    hierarchical_all_to_all two-phase layout)."""
+    from hetu_tpu.profiler.cost_model import CHIPS
+    from hetu_tpu.profiler.simulator import Simulator
+
+    rates = {"ici": (100e9, 0.0), "dcn": (10e9, 0.0)}
+    sim = Simulator(CHIPS["v5e"], axis_rates=rates,
+                    axis_of={"ep": "ici", "dp": "dcn"})
+    nbytes, n_local, n_groups = 64e6, 4, 8
+    t = sim.hier_alltoall_time(nbytes, n_local, n_groups,
+                               local_role="ep", cross_role="dp")
+    want_local = (n_local - 1) / n_local * nbytes / 100e9
+    want_cross = (n_groups - 1) / n_groups * (nbytes / n_local) / 10e9
+    assert abs(t - (want_local + want_cross)) < 1e-9, t
+    # flat a2a over the slow tier for ALL bytes must cost more
+    t_flat = sim._alltoall(nbytes, n_local * n_groups, "dp")
+    assert t < t_flat
+
+
+def test_calibrated_simulator_carries_per_axis_rates():
+    """calibrate_simulator must hand the per-axis fits to the Simulator
+    (not fold them away) so searchers see tiered rates."""
+    import hetu_tpu as ht
+    from hetu_tpu.profiler.calibrate import calibrate_simulator
+
+    mesh = ht.make_mesh(dp=2, tp=4)
+    sim, report = calibrate_simulator(mesh)
+    assert set(sim.axis_rates) == {"dp", "tp"}
+    for ax, (bw, lat) in sim.axis_rates.items():
+        assert bw > 0 and lat >= 0
+        assert report["ici_fit"][ax]["bw_bytes_per_s"] == bw
