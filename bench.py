@@ -87,6 +87,8 @@ def _emit_stale_or_die(metric_hint, exit_code=3):
         sys.exit(exit_code)
     rec = dict(rec)
     age_h = (time.time() - rec.pop("measured_unix", time.time())) / 3600.0
+    rec["stale"] = True  # top-level: consumers parsing only metric/value
+    # must still see this is not a live measurement (ADVICE r3)
     extra = dict(rec.get("extra") or {})
     extra.update({"stale": True, "stale_age_hours": round(age_h, 2),
                   "stale_reason": "device backend unreachable at capture; "

@@ -63,10 +63,23 @@ class TopKGate(Module):
         bt = next((b for b in (256, 128, 64, 32, 16, 8) if T % b == 0),
                   None)
         use_pallas = not force_xla and bt is not None and self.impl != "xla"
-        if self.impl == "pallas" and bt is None:
+        if self.impl == "pallas" and bt is None and not force_xla:
+            # under force_xla the kernel was never going to run, so the
+            # divisibility contract doesn't apply — the warning below covers
             raise ValueError(
                 f"impl='pallas' needs a token count divisible by a "
                 f"power-of-two block >= 8; got T={T}")
+        if self.impl == "pallas" and force_xla:
+            # SPMD (meshed MoELayer) forces XLA because the partitioner
+            # cannot split a pallas_call — an explicit 'pallas' request
+            # cannot be honored there, and silence would contradict the
+            # shape error above.  Warn rather than raise: the XLA path is
+            # numerically identical (same vjp), only the fusion differs.
+            import warnings
+            warnings.warn(
+                "TopKGate(impl='pallas') runs the XLA gate under SPMD "
+                "sharding (pallas_call is not partitionable); use "
+                "impl='auto' to silence this", stacklevel=2)
         if use_pallas:
             from hetu_tpu.ops.pallas_kernels import topk_gating
             gates, idx = topk_gating(logits, self.k, block_tokens=bt)

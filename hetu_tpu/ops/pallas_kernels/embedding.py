@@ -221,11 +221,18 @@ def topk_gating(logits, k: int, *, block_tokens: int = 256,
     k repeated max/mask passes beat a full sort for the k << E regime.
     Matches ops.top_k_idx_gate (ties resolved to the lowest index,
     lax.top_k's order) — including its gradient, via a custom vjp.
+
+    ``interpret``: None auto-selects (compiled kernel on TPU, plain-XLA
+    fallback elsewhere); True is the XLA fallback (interpret-mode pallas is
+    orders of magnitude slower at large T); the string ``"kernel"`` forces
+    the pallas kernel in interpret mode — the tests' oracle path, so the
+    kernel body keeps CPU coverage.
     """
-    interpret = _auto_interpret(interpret)
+    if interpret != "kernel":
+        interpret = bool(_auto_interpret(interpret))
     return _topk_gating(logits, int(k), int(min(block_tokens,
                                                 logits.shape[0])),
-                        bool(interpret))
+                        interpret)
 
 
 def _topk_gating_impl(logits, k, block_tokens, interpret):
@@ -235,7 +242,21 @@ def _topk_gating_impl(logits, k, block_tokens, interpret):
                          "reject this too)")
     bt = min(block_tokens, T)
     if T % bt:
+        # validated on every path so callers see the same contract whether
+        # or not the kernel actually runs (interpret falls back to XLA)
         raise ValueError(f"tokens {T} not divisible by block {bt}")
+    if interpret is True:
+        # CPU/tests: plain XLA beats interpret-mode pallas by orders of
+        # magnitude at large T; identical values/ties/grad (same vjp wraps
+        # both paths).  Mirrors _routed_gather's interpret special-case.
+        # interpret == "kernel" instead runs the pallas body in interpret
+        # mode (tests' oracle path keeping the kernel covered on CPU).
+        vals, idx = jax.lax.top_k(logits, k)
+        # f32 softmax like the kernel path (which accumulates f32 vals),
+        # so CPU-validated gate values match TPU bit-for-bit policy
+        return (jax.nn.softmax(vals.astype(jnp.float32), axis=-1)
+                .astype(logits.dtype), idx)
+    interpret = interpret == "kernel"  # pallas_call wants a bool
     kernel = functools.partial(_topk_kernel, k=k, experts=E)
     vals, idx = pl.pallas_call(
         kernel,

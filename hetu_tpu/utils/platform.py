@@ -82,10 +82,23 @@ def wait_for_devices(deadline_s: float = 600.0, *,
     if env.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         # the tunnel plugin's sitecustomize blocks at interpreter start when
         # the tunnel is down, even though the probe only wants CPU — drop the
-        # plugin's site dir so a CPU probe cannot hang on a dead tunnel
+        # plugin's site dir so a CPU probe cannot hang on a dead tunnel.
+        # HETU_TUNNEL_SITE overrides; the default matches only a path
+        # *component* named for the plugin, not any substring (a user dir
+        # like .../taxonomy must survive).
+        plug = os.environ.get("HETU_TUNNEL_SITE")
+
+        def _is_plugin_dir(p):
+            if plug:
+                return os.path.abspath(p) == os.path.abspath(plug)
+            # a component NAMED for the plugin (.axon_site, axon, axon-*);
+            # 'taxonomy' has no component whose name starts with 'axon'
+            return any(part.lstrip(".").startswith("axon")
+                       for part in p.split(os.sep))
+
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if p and "axon" not in p)
+            if p and not _is_plugin_dir(p))
     start = time.monotonic()
     attempt = 0
     while True:

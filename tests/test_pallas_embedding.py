@@ -62,16 +62,20 @@ def test_scatter_is_gather_transpose():
 def test_topk_gating_matches_lax(k):
     rng = np.random.default_rng(3)
     logits = jnp.asarray(rng.standard_normal((512, 16)), jnp.float32)
-    gates, idx = topk_gating(logits, k, interpret=True)
+    gates, idx = topk_gating(logits, k, interpret="kernel")
     want_g, want_i = ops.top_k_idx_gate(logits, k)
     np.testing.assert_array_equal(np.asarray(idx), np.asarray(want_i))
     np.testing.assert_allclose(np.asarray(gates), np.asarray(want_g),
                                rtol=1e-5)
+    # the large-T XLA fallback (interpret=True) must agree with the kernel
+    xg, xi = topk_gating(logits, k, interpret=True)
+    np.testing.assert_array_equal(np.asarray(xi), np.asarray(idx))
+    np.testing.assert_allclose(np.asarray(xg), np.asarray(gates), rtol=1e-5)
 
 
 def test_topk_gating_ties_resolve_low_index():
     logits = jnp.asarray([[1.0, 5.0, 5.0, 0.0]], jnp.float32)
-    _, idx = topk_gating(logits, 2, block_tokens=1, interpret=True)
+    _, idx = topk_gating(logits, 2, block_tokens=1, interpret="kernel")
     assert idx.tolist() == [[1, 2]]
 
 
@@ -87,7 +91,7 @@ def test_topk_gating_grad_matches_lax():
     g_out = jnp.asarray(rng.standard_normal((32, 3)), jnp.float32)
 
     def f_pallas(x):
-        gates, _ = topk_gating(x, 3, interpret=True)
+        gates, _ = topk_gating(x, 3, interpret="kernel")
         return jnp.sum(gates * g_out)
 
     def f_lax(x):

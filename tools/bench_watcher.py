@@ -1,0 +1,118 @@
+"""Round-long TPU tunnel watcher: capture the bench matrix at first light.
+
+VERDICT r3 #1: three rounds of perf work produced zero measured TPU numbers
+because the tunnel was only probed at official bench time.  This watcher
+runs in the background from the *start* of the round, probes the backend in
+subprocesses (a hung in-process ``jax.devices()`` wedges the interpreter —
+see utils/platform.wait_for_devices), and the moment the tunnel answers it
+runs every bench command that has not yet produced a fresh measurement this
+run.  bench.py itself persists each success as last-known-good in
+``.bench_lkg.json``, so even if the tunnel dies again before the driver's
+official capture, ``_emit_stale_or_die`` has an honest number to re-emit.
+
+Exit: when all bench commands have succeeded, or after ``--deadline-s``.
+Log: ``.bench_watch.log`` next to this file's repo root.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+LOG = REPO / ".bench_watch.log"
+CMDS = ["gpt", "resnet", "ctr", "moe"]
+
+PROBE_TIMEOUT_S = 75.0
+POLL_S = 60.0
+BENCH_TIMEOUT_S = 1800.0  # first compile over a tunnel is slow
+
+
+def log(msg: str) -> None:
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    with LOG.open("a") as f:
+        f.write(line + "\n")
+
+
+def probe_tpu() -> bool:
+    """Subprocess probe: does the default backend answer, and is it a TPU?"""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, timeout=PROBE_TIMEOUT_S, text=True)
+        return r.returncode == 0 and r.stdout.strip() == "tpu"
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_bench(cmd: str) -> bool:
+    """One bench command; success = rc0 + parseable non-stale JSON line."""
+    log(f"bench {cmd}: starting")
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run(
+            [sys.executable, str(REPO / "bench.py"), cmd],
+            capture_output=True, timeout=BENCH_TIMEOUT_S, text=True,
+            cwd=str(REPO))
+    except subprocess.TimeoutExpired:
+        log(f"bench {cmd}: TIMEOUT after {BENCH_TIMEOUT_S}s")
+        return False
+    dt = time.monotonic() - t0
+    line = (r.stdout.strip().splitlines() or [""])[-1]
+    try:
+        rec = json.loads(line)
+        stale = bool(rec.get("stale") or (rec.get("extra") or {}).get("stale"))
+    except Exception:
+        rec, stale = None, True
+    if r.returncode == 0 and rec is not None and not stale:
+        log(f"bench {cmd}: OK in {dt:.0f}s -> {line}")
+        return True
+    log(f"bench {cmd}: FAIL rc={r.returncode} in {dt:.0f}s "
+        f"stderr_tail={r.stderr.strip()[-300:]!r}")
+    return False
+
+
+def main() -> None:
+    deadline_s = float(sys.argv[sys.argv.index("--deadline-s") + 1]) \
+        if "--deadline-s" in sys.argv else 11.0 * 3600
+    start = time.monotonic()
+    done: set[str] = set()
+    fails: dict[str, int] = {}
+    MAX_FAILS = 3  # a bench failing repeatedly while the tunnel is up is a
+    # deterministic bug, not a blip — don't burn tunnel time on it forever
+    log(f"watcher up (pid {os.getpid()}), cmds={CMDS}, "
+        f"deadline={deadline_s / 3600:.1f}h")
+    while time.monotonic() - start < deadline_s:
+        if probe_tpu():
+            log("tunnel UP — running pending benches")
+            for cmd in CMDS:
+                if cmd in done or fails.get(cmd, 0) >= MAX_FAILS:
+                    continue
+                if run_bench(cmd):
+                    done.add(cmd)
+                elif not probe_tpu():
+                    log("tunnel dropped mid-matrix; back to polling")
+                    break
+                else:
+                    fails[cmd] = fails.get(cmd, 0) + 1
+                    if fails[cmd] >= MAX_FAILS:
+                        log(f"bench {cmd}: giving up after {MAX_FAILS} "
+                            "failures with a live tunnel")
+            pending = [c for c in CMDS
+                       if c not in done and fails.get(c, 0) < MAX_FAILS]
+            if not pending:
+                log(f"done={sorted(done)} given_up="
+                    f"{sorted(set(CMDS) - done)} — watcher exiting")
+                return
+        time.sleep(POLL_S)
+    log(f"deadline reached with {sorted(done)} captured — exiting")
+
+
+if __name__ == "__main__":
+    main()
