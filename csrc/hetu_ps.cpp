@@ -283,6 +283,35 @@ int ps_sparse_push(int id, const int64_t* idx, const float* grads,
   return 0;
 }
 
+// Version-bounded sync pull (HET kSyncEmbedding server handler,
+// ps-lite/include/ps/psf/cachetable.h:24-40): the worker sends each key's
+// cached version (UINT64_MAX = "not cached, always send"); the server
+// returns only rows whose version exceeds cached_version + bound.
+// Outputs: sel_out[m] = positions into the request batch, vers_out[m] =
+// server versions, rows_out[m*dim] = row values.  Returns m (#sent) or <0.
+int64_t ps_sync_pull(int id, const int64_t* idx, const uint64_t* cached_ver,
+                     int64_t n, uint64_t bound, uint32_t* sel_out,
+                     uint64_t* vers_out, float* rows_out) {
+  Table* t = get_table(id);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  int64_t m = 0;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t r = idx[i];
+    if (r < 0 || r >= t->rows) continue;  // never sent: workers zero-fill
+    uint64_t cv = cached_ver[i];
+    bool send = cv == UINT64_MAX ||
+                t->version[r] > cv + bound;  // bound: staleness tolerance
+    if (!send) continue;
+    sel_out[m] = (uint32_t)i;
+    vers_out[m] = t->version[r];
+    std::memcpy(rows_out + m * t->dim, t->data.data() + r * t->dim,
+                t->dim * sizeof(float));
+    m++;
+  }
+  return m;
+}
+
 int ps_sparse_push_pull(int id, const int64_t* idx, const float* grads,
                         int64_t n, float* out) {
   int rc = ps_sparse_push(id, idx, grads, n);

@@ -55,6 +55,19 @@ int ps_van_sparse_push_id(int fd, int id, const int64_t* idx,
                           uint64_t req);
 int ps_van_table_save(int fd, int id, const char* path);
 int ps_van_table_load(int fd, int id, const char* path);
+int64_t ps_van_sync_pull(int fd, int id, const int64_t* keys,
+                         const uint64_t* cached_vers, int64_t ns,
+                         uint64_t bound, int64_t dim, uint32_t* sel_out,
+                         uint64_t* vers_out, float* rows_out);
+int64_t ps_van_push_sync(int fd, int id, const int64_t* push_keys,
+                         const float* push_grads, int64_t np,
+                         const int64_t* sync_keys,
+                         const uint64_t* cached_vers, int64_t ns,
+                         uint64_t bound, int64_t dim, uint64_t req,
+                         uint32_t* sel_out, uint64_t* vers_out,
+                         float* rows_out);
+int ps_van_sched_map(int fd, int max_n, int32_t* ranks, uint8_t* alive,
+                     int32_t* ports, char* hosts64);
 }
 
 namespace {
@@ -84,6 +97,11 @@ struct Group {
   float lr = 0, mom = 0, eps = 0, b1 = 0, b2 = 0;
   int retry_max = 3;
   int retry_backoff_ms = 100;
+  // scheduler endpoint, when the group was built via ps_group_create_sched:
+  // a shard whose direct reconnect fails re-resolves its CURRENT endpoint
+  // from the scheduler (postoffice rejoin-at-new-address)
+  std::string sched_host;
+  int sched_port = 0;
   std::vector<std::unique_ptr<Shard>> shards;
   std::atomic<uint64_t> recovered{0};
   std::atomic<bool> hb_running{false};
@@ -139,8 +157,33 @@ int create_shard_table(Group* g, Shard* s, int shard_idx) {
   return 0;
 }
 
+// Resolve shard `rank`'s current endpoint from the group's scheduler.
+// Returns true (and updates host/port) only for a LIVE rank whose endpoint
+// differs from what we have — a dead entry would just re-fail.
+bool resolve_from_sched(Group* g, int rank, std::string* host, int* port) {
+  if (g->sched_port <= 0) return false;
+  int fd = ps_van_connect(g->sched_host.c_str(), g->sched_port);
+  if (fd < 0) return false;
+  constexpr int kMax = 64;
+  int32_t ranks[kMax]; uint8_t alive[kMax]; int32_t ports[kMax];
+  char hosts[kMax * 64];
+  int n = ps_van_sched_map(fd, kMax, ranks, alive, ports, hosts);
+  ps_van_close(fd);
+  for (int i = 0; i < n; ++i) {
+    if (ranks[i] != rank || !alive[i]) continue;
+    std::string h(hosts + i * 64);
+    if (h == *host && ports[i] == *port) return false;  // nothing new
+    *host = h;
+    *port = ports[i];
+    return true;
+  }
+  return false;
+}
+
 // Run `op(fd)` against one shard with the resender-style reliability loop:
-//   transport error / desync -> reconnect, retry
+//   transport error / desync -> reconnect (re-resolving the endpoint from
+//                               the scheduler if direct reconnect fails),
+//                               retry
 //   "no such table"          -> server restarted blank: re-create, retry
 // Caller must NOT hold s->mu.
 template <typename Op>
@@ -154,6 +197,8 @@ int shard_call(Group* g, Shard* s, int shard_idx, Op op) {
       std::this_thread::sleep_for(
           std::chrono::milliseconds(g->retry_backoff_ms * (attempt + 1)));
       int fd = ps_van_connect(s->host.c_str(), s->port);
+      if (fd < 0 && resolve_from_sched(g, shard_idx, &s->host, &s->port))
+        fd = ps_van_connect(s->host.c_str(), s->port);  // rejoined elsewhere
       if (fd < 0) { rc = kTransportErr; continue; }
       s->fd = fd;
       s->alive = true;
@@ -228,6 +273,8 @@ void heartbeat_loop(Group* g, int hb_ms) {
       if (s->fd >= 0) { ps_van_close(s->fd); s->fd = -1; }
       s->alive = false;
       int fd = ps_van_connect(s->host.c_str(), s->port);
+      if (fd < 0 && resolve_from_sched(g, (int)i, &s->host, &s->port))
+        fd = ps_van_connect(s->host.c_str(), s->port);
       if (fd >= 0) { s->fd = fd; s->alive = true; }
     }
     for (int slept = 0; slept < hb_ms && g->hb_running.load(); slept += 50)
@@ -242,12 +289,21 @@ extern "C" {
 // endpoints: "host:port,host:port,..." — one logical table of `rows` keys
 // range-partitioned over them.  hb_ms > 0 starts a heartbeat thread.
 // Returns a group handle (> 0) or a negative error.
-int ps_group_create(const char* endpoints, int table_id, int64_t rows,
-                    int64_t dim, int init_kind, double a, double b,
-                    uint64_t seed, double connect_timeout_s, int hb_ms) {
+static int group_create_impl(const char* endpoints, int table_id,
+                             int64_t rows, int64_t dim, int init_kind,
+                             double a, double b, uint64_t seed,
+                             double connect_timeout_s, int hb_ms,
+                             const char* sched_host, int sched_port) {
   if (!endpoints || rows <= 0 || dim <= 0) return -3;
   auto g = std::make_unique<Group>();
   g->table_id = table_id;
+  // sched fields BEFORE the heartbeat thread exists: heartbeat_loop /
+  // shard_call read them unsynchronized, which is only safe because they
+  // are immutable once the group is visible
+  if (sched_host && sched_port > 0) {
+    g->sched_host = sched_host;
+    g->sched_port = sched_port;
+  }
   g->rows = rows; g->dim = dim;
   g->init_kind = init_kind; g->init_a = a; g->init_b = b; g->seed = seed;
   // parse "h:p,h:p"
@@ -305,6 +361,13 @@ int ps_group_create(const char* endpoints, int table_id, int64_t rows,
     gp->hb_thread = std::thread(heartbeat_loop, gp, hb_ms);
   }
   return gid;
+}
+
+int ps_group_create(const char* endpoints, int table_id, int64_t rows,
+                    int64_t dim, int init_kind, double a, double b,
+                    uint64_t seed, double connect_timeout_s, int hb_ms) {
+  return group_create_impl(endpoints, table_id, rows, dim, init_kind, a, b,
+                           seed, connect_timeout_s, hb_ms, nullptr, 0);
 }
 
 int ps_group_set_optimizer(int gid, int kind, float lr, float mom, float eps,
@@ -462,6 +525,176 @@ int ps_group_save(int gid, const char* path) {
 
 int ps_group_load(int gid, const char* path) {
   return group_file_op(gid, path, false);
+}
+
+// Build a group by resolving `n_servers` ranks (0..n-1) from a scheduler
+// instead of a static endpoint list (postoffice.cc node management): polls
+// the map until all ranks are alive or the timeout expires.  The group
+// remembers the scheduler so shards can re-resolve after a server rejoins
+// at a different address/port.
+int ps_group_create_sched(const char* sched_host, int sched_port,
+                          int n_servers, int table_id, int64_t rows,
+                          int64_t dim, int init_kind, double a, double b,
+                          uint64_t seed, double connect_timeout_s,
+                          int hb_ms) {
+  if (!sched_host || sched_port <= 0 || n_servers <= 0 || n_servers > 64)
+    return -3;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(connect_timeout_s);
+  constexpr int kMax = 64;
+  int32_t ranks[kMax]; uint8_t alive[kMax]; int32_t ports[kMax];
+  char hosts[kMax * 64];
+  std::string endpoints;
+  while (true) {
+    int fd = ps_van_connect(sched_host, sched_port);
+    int n = fd >= 0 ? ps_van_sched_map(fd, kMax, ranks, alive, ports, hosts)
+                    : -1;
+    if (fd >= 0) ps_van_close(fd);
+    // need ranks 0..n_servers-1 all alive; map order is rank order
+    std::vector<std::pair<std::string, int>> eps(n_servers);
+    int found = 0;
+    for (int i = 0; i < n; ++i) {
+      if (ranks[i] < 0 || ranks[i] >= n_servers || !alive[i]) continue;
+      eps[ranks[i]] = {std::string(hosts + i * 64), ports[i]};
+      found++;
+    }
+    if (found == n_servers) {
+      endpoints.clear();
+      for (int i = 0; i < n_servers; ++i) {
+        if (i) endpoints += ',';
+        endpoints += eps[i].first + ':' + std::to_string(eps[i].second);
+      }
+      break;
+    }
+    if (std::chrono::steady_clock::now() > deadline) return -4;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+  double left = std::chrono::duration<double>(
+                    deadline - std::chrono::steady_clock::now()).count();
+  return group_create_impl(endpoints.c_str(), table_id, rows, dim,
+                           init_kind, a, b, seed, left > 1.0 ? left : 1.0,
+                           hb_ms, sched_host, sched_port);
+}
+
+int64_t ps_group_rows(int gid) {
+  GroupRef ref(gid);
+  return ref.g ? ref.g->rows : -1;
+}
+
+int64_t ps_group_dim(int gid) {
+  GroupRef ref(gid);
+  return ref.g ? ref.g->dim : -1;
+}
+
+// Reserve a contiguous block of push request-ids for a caller that needs
+// them stable ACROSS calls (the remote cache's resender-style outstanding
+// buffer): a failed multi-shard push retried later with the SAME req_base
+// is deduped by the servers that already applied it, instead of being
+// double-applied under a fresh id.
+uint64_t ps_group_alloc_reqs(int n) {
+  uint64_t base = next_req_id();
+  for (int i = 1; i < n; ++i) next_req_id();
+  return base;
+}
+
+// Version-bounded sync over the partitioned group: slice the (key, cached
+// version) batch per shard, one OP_PUSH_SYNC per shard (push half optional),
+// merge responses back to caller positions.  Out-of-range keys are never
+// returned (caller zero-fills).  req_base != 0 pins shard i's push request
+// id to req_base + i (see ps_group_alloc_reqs); 0 auto-generates per call.
+// shard_rcs (nullable, size >= shard count) receives each shard's own rc so
+// a caller can tell WHICH shards applied their push half on partial
+// failure.  Returns total rows sent, or < 0.
+int64_t ps_group_push_sync_req(int gid, const int64_t* push_keys,
+                               const float* push_grads, int64_t np,
+                               const int64_t* sync_keys,
+                               const uint64_t* sync_vers, int64_t ns,
+                               uint64_t bound, uint64_t req_base,
+                               uint32_t* sel_out, uint64_t* vers_out,
+                               float* rows_out, int32_t* shard_rcs) {
+  GroupRef ref(gid);
+  Group* g = ref.g;
+  if (!g) return -1;
+  int nsh = (int)g->shards.size();
+  std::vector<std::vector<int64_t>> pk(nsh), sk(nsh), spos(nsh);
+  std::vector<std::vector<float>> pg(nsh);
+  std::vector<std::vector<uint64_t>> sv(nsh);
+  for (int64_t i = 0; i < np; ++i) {
+    int64_t k = push_keys[i];
+    if (k < 0 || k >= g->rows) continue;
+    int s = shard_of(g, k);
+    pk[s].push_back(k - g->shards[s]->start);
+    pg[s].insert(pg[s].end(), push_grads + i * g->dim,
+                 push_grads + (i + 1) * g->dim);
+  }
+  for (int64_t i = 0; i < ns; ++i) {
+    int64_t k = sync_keys[i];
+    if (k < 0 || k >= g->rows) continue;
+    int s = shard_of(g, k);
+    sk[s].push_back(k - g->shards[s]->start);
+    sv[s].push_back(sync_vers[i]);
+    spos[s].push_back(i);
+  }
+  std::vector<int> nonempty;
+  for (int i = 0; i < nsh; ++i)
+    if (!pk[i].empty() || !sk[i].empty()) nonempty.push_back(i);
+  std::vector<std::vector<uint32_t>> ssel(nsh);
+  std::vector<std::vector<uint64_t>> sver(nsh);
+  std::vector<std::vector<float>> srows(nsh);
+  std::vector<int64_t> sm(nsh, 0);
+  if (shard_rcs)
+    for (int i = 0; i < nsh; ++i) shard_rcs[i] = 0;
+  int rc = fan_out(nonempty, [&](int i) {
+    ssel[i].resize(sk[i].size());
+    sver[i].resize(sk[i].size());
+    srows[i].resize(sk[i].size() * g->dim);
+    // constant across retries (and, with req_base, across CALLS):
+    // exactly-once on the server
+    uint64_t req = req_base ? req_base + (uint64_t)i : next_req_id();
+    int src = shard_call(g, g->shards[i].get(), i, [&](int fd) {
+      int64_t m = ps_van_push_sync(
+          fd, g->table_id, pk[i].data(), pg[i].data(),
+          (int64_t)pk[i].size(), sk[i].data(), sv[i].data(),
+          (int64_t)sk[i].size(), bound, g->dim, req, ssel[i].data(),
+          sver[i].data(), srows[i].data());
+      if (m < 0) return (int)m;
+      sm[i] = m;
+      return 0;
+    });
+    if (shard_rcs) shard_rcs[i] = src;
+    return src;
+  });
+  if (rc != 0) return rc;
+  int64_t total = 0;
+  for (int i : nonempty) {
+    for (int64_t j = 0; j < sm[i]; ++j) {
+      sel_out[total] = (uint32_t)spos[i][ssel[i][j]];
+      vers_out[total] = sver[i][j];
+      std::memcpy(rows_out + total * g->dim, srows[i].data() + j * g->dim,
+                  g->dim * sizeof(float));
+      total++;
+    }
+  }
+  return total;
+}
+
+int64_t ps_group_push_sync(int gid, const int64_t* push_keys,
+                           const float* push_grads, int64_t np,
+                           const int64_t* sync_keys,
+                           const uint64_t* sync_vers, int64_t ns,
+                           uint64_t bound, uint32_t* sel_out,
+                           uint64_t* vers_out, float* rows_out) {
+  return ps_group_push_sync_req(gid, push_keys, push_grads, np, sync_keys,
+                                sync_vers, ns, bound, 0, sel_out, vers_out,
+                                rows_out, nullptr);
+}
+
+int64_t ps_group_sync_pull(int gid, const int64_t* keys,
+                           const uint64_t* vers, int64_t ns, uint64_t bound,
+                           uint32_t* sel_out, uint64_t* vers_out,
+                           float* rows_out) {
+  return ps_group_push_sync(gid, nullptr, nullptr, 0, keys, vers, ns, bound,
+                            sel_out, vers_out, rows_out);
 }
 
 uint64_t ps_group_alive_mask(int gid) {

@@ -1,0 +1,417 @@
+// Worker-side HET embedding cache over the multi-host van (remote tier).
+//
+// Reference: src/hetu_cache/include/hetu_client.h:19-31 (syncEmbedding /
+// pushEmbedding / pushSyncEmbedding — the VLDB'22 HET protocol) and
+// ps-lite/include/ps/psf/cachetable.h:24-55 (the kSyncEmbedding /
+// kPushSyncEmbedding wire PSFs).  The in-process cache in hetu_ps.cpp fronts
+// a local Table; THIS cache fronts a key-range-partitioned group of remote
+// van servers (hetu_ps_group.cpp), so the headline HET capability —
+// version-bounded worker caches over remote sharded tables — works across
+// hosts:
+//
+//   lookup(keys, bound):  cached rows whose version the server deems within
+//     `bound` are served locally with zero wire traffic; outdated/missing
+//     rows arrive via ONE fused OP_PUSH_SYNC round trip per shard that also
+//     flushes the pending gradients of evicted victims (pushSyncEmbedding).
+//   update(keys, grads):  accumulates gradients locally (dirty rows), with
+//     an optimistic first-order local apply so later cached lookups see
+//     fresh values (HET's bounded-divergence trick); uncached keys push
+//     straight through to the servers.
+//   flush():              pushes every dirty row's accumulated gradient and
+//     re-pulls exact server values.
+//
+// Eviction: LRU / LFU / LFUOpt (lazy-aging LFU), same scoring as the local
+// cache; dirty victims' pendings ride the next wire call, never dropped.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+int64_t ps_group_rows(int gid);
+int64_t ps_group_dim(int gid);
+int ps_group_n(int gid);
+int64_t ps_group_start(int gid, int i);
+uint64_t ps_group_alloc_reqs(int n);
+int64_t ps_group_push_sync_req(int gid, const int64_t* push_keys,
+                               const float* push_grads, int64_t np,
+                               const int64_t* sync_keys,
+                               const uint64_t* sync_vers, int64_t ns,
+                               uint64_t bound, uint64_t req_base,
+                               uint32_t* sel_out, uint64_t* vers_out,
+                               float* rows_out, int32_t* shard_rcs);
+}
+
+namespace {
+
+struct RCEntry {
+  std::vector<float> row;
+  std::vector<float> pending;  // accumulated local gradient (dirty)
+  uint64_t version = 0;
+  uint64_t freq = 0;
+  uint64_t last = 0;
+  bool dirty = false;
+};
+
+// A push batch whose outcome is unknown (some shard exhausted retries):
+// held with its ORIGINAL per-shard request-id base and re-sent verbatim
+// until every shard acks.  Shards that already applied it dedup on the id,
+// so retried batches are exactly-once (ps-lite resender semantics: same
+// message id until acked, never a fresh id for old payload).
+struct PendingPush {
+  std::vector<int64_t> keys;
+  std::vector<float> grads;
+  uint64_t req_base = 0;
+};
+
+struct RCache {
+  int gid = 0;
+  int64_t rows = 0, dim = 0, capacity = 0;
+  int policy = 0;  // 0 LRU, 1 LFU, 2 LFUOpt
+  float lr = 0.f;  // optimistic local apply rate (server optimizer's lr)
+  uint64_t tick = 0;
+  std::vector<int64_t> shard_starts;  // for per-shard failure stashing
+  std::unordered_map<int64_t, RCEntry> entries;
+  std::vector<PendingPush> outstanding;
+  std::mutex mu;
+
+  int shard_of(int64_t key) const {
+    int lo = 0, hi = (int)shard_starts.size() - 1;
+    while (lo < hi) {
+      int mid = (lo + hi + 1) / 2;
+      if (shard_starts[mid] <= key) lo = mid; else hi = mid - 1;
+    }
+    return lo;
+  }
+
+  uint64_t score(const RCEntry& e) const {
+    if (policy == 0) return e.last;
+    if (policy == 1) return e.freq;
+    uint64_t age =
+        (tick - e.last) / (uint64_t)std::max<int64_t>(capacity, 1);
+    return e.freq >> std::min<uint64_t>(age, 63);
+  }
+};
+
+std::mutex g_rcaches_mu;
+std::map<int, RCache*> g_rcaches;
+int g_next_rcache = 1;
+
+RCache* get_rcache(int cid) {
+  std::lock_guard<std::mutex> lk(g_rcaches_mu);
+  auto it = g_rcaches.find(cid);
+  return it == g_rcaches.end() ? nullptr : it->second;
+}
+
+// After a partially-failed push call, stash ONLY the failed shards' key
+// subsets (shards that answered rc==0 applied and acked their halves — a
+// full-batch stash would re-send acked halves whose req ids can age out of
+// the server's 4096-id dedup window during a long outage, double-applying
+// them).  Single-shard batches keep their shard's original req id
+// (req_base + shard), so retries stay exactly-once.  Caller holds c->mu.
+void stash_failed_shards(RCache* c, const std::vector<int64_t>& keys,
+                         const std::vector<float>& grads, uint64_t req_base,
+                         const std::vector<int32_t>& rcs) {
+  std::vector<std::vector<int64_t>> ks(rcs.size());
+  std::vector<std::vector<float>> gs(rcs.size());
+  for (size_t i = 0; i < keys.size(); ++i) {
+    int s = c->shard_of(keys[i]);
+    if ((size_t)s < rcs.size() && rcs[s] == 0) continue;  // acked: done
+    ks[s].push_back(keys[i]);
+    gs[s].insert(gs[s].end(), grads.data() + i * c->dim,
+                 grads.data() + (i + 1) * c->dim);
+  }
+  for (size_t s = 0; s < ks.size(); ++s)
+    if (!ks[s].empty())
+      c->outstanding.push_back(
+          {std::move(ks[s]), std::move(gs[s]), req_base});
+}
+
+// Fire a push-only wire call; on failure, stash only the failed shards'
+// subsets in `outstanding` under their stable req_base.  Caller holds
+// c->mu.
+int push_or_stash(RCache* c, std::vector<int64_t>&& keys,
+                  std::vector<float>&& grads, uint64_t req_base) {
+  if (keys.empty()) return 0;
+  if (req_base == 0) req_base = ps_group_alloc_reqs(64);
+  std::vector<int32_t> rcs(c->shard_starts.size(), 0);
+  int64_t rc = ps_group_push_sync_req(
+      c->gid, keys.data(), grads.data(), (int64_t)keys.size(), nullptr,
+      nullptr, 0, 0, req_base, nullptr, nullptr, nullptr, rcs.data());
+  if (rc >= 0) return 0;
+  stash_failed_shards(c, keys, grads, req_base, rcs);
+  return (int)rc;
+}
+
+// Re-send every outstanding batch verbatim (same req_base: deduped where
+// already applied).  Drops acked batches; keeps the rest (each batch is
+// single-shard, so whole-batch keep is precise).  Caller holds c->mu.
+// Returns 0 when the list drained.
+int retry_outstanding(RCache* c) {
+  int rc = 0;
+  std::vector<PendingPush> keep;
+  for (auto& b : c->outstanding) {
+    int64_t r = ps_group_push_sync_req(
+        c->gid, b.keys.data(), b.grads.data(), (int64_t)b.keys.size(),
+        nullptr, nullptr, 0, 0, b.req_base, nullptr, nullptr, nullptr,
+        nullptr);
+    if (r < 0) {
+      rc = (int)r;
+      keep.push_back(std::move(b));
+    }
+  }
+  c->outstanding = std::move(keep);
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+int ps_rcache_create(int gid, int64_t capacity, int policy, float lr) {
+  int64_t rows = ps_group_rows(gid), dim = ps_group_dim(gid);
+  int nsh = ps_group_n(gid);
+  if (rows <= 0 || dim <= 0 || capacity <= 0 || nsh <= 0) return -1;
+  auto* c = new RCache();
+  c->gid = gid;
+  c->rows = rows;
+  c->dim = dim;
+  c->capacity = capacity;
+  c->policy = policy;
+  c->lr = lr;
+  c->shard_starts.resize(nsh);
+  for (int i = 0; i < nsh; ++i) c->shard_starts[i] = ps_group_start(gid, i);
+  std::lock_guard<std::mutex> lk(g_rcaches_mu);
+  int cid = g_next_rcache++;
+  g_rcaches[cid] = c;
+  return cid;
+}
+
+// Cached embedding lookup with bounded staleness (syncEmbedding).  One
+// fused push+sync wire call refreshes outdated/missing rows AND flushes
+// evicted dirty rows.  Returns #rows actually pulled from servers, or < 0.
+int64_t ps_rcache_lookup(int cid, const int64_t* idx, int64_t n,
+                         uint64_t bound, float* out) {
+  RCache* c = get_rcache(cid);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> lk(c->mu);
+  c->tick++;
+  // unique in-range keys, first-occurrence order
+  std::vector<int64_t> uniq;
+  uniq.reserve(n);
+  {
+    std::unordered_map<int64_t, char> seen;
+    seen.reserve(n * 2);
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t k = idx[i];
+      if (k < 0 || k >= c->rows) continue;
+      if (seen.emplace(k, 1).second) uniq.push_back(k);
+    }
+  }
+  int64_t nu = (int64_t)uniq.size();
+  // sync half: every unique key, with its cached version (missing = MAX)
+  std::vector<uint64_t> vers(nu);
+  int64_t new_keys = 0;
+  for (int64_t i = 0; i < nu; ++i) {
+    auto it = c->entries.find(uniq[i]);
+    vers[i] = it == c->entries.end() ? UINT64_MAX : it->second.version;
+    if (it == c->entries.end()) new_keys++;
+  }
+  // eviction planning: victims among entries NOT in this batch, chosen
+  // before the wire call so their dirty pendings ride the push half;
+  // erased only after the call succeeds (a failed push must not lose them)
+  std::vector<int64_t> victims;
+  int64_t excess =
+      (int64_t)c->entries.size() + new_keys - c->capacity;
+  if (excess > 0) {
+    std::unordered_map<int64_t, char> inbatch;
+    inbatch.reserve(nu * 2);
+    for (int64_t k : uniq) inbatch.emplace(k, 1);
+    std::vector<std::pair<uint64_t, int64_t>> scored;
+    scored.reserve(c->entries.size());
+    for (auto& kv : c->entries)
+      if (!inbatch.count(kv.first))
+        scored.emplace_back(c->score(kv.second), kv.first);
+    int64_t nv = std::min<int64_t>(excess, (int64_t)scored.size());
+    std::nth_element(scored.begin(), scored.begin() + nv, scored.end());
+    for (int64_t i = 0; i < nv; ++i) victims.push_back(scored[i].second);
+  }
+  retry_outstanding(c);  // best-effort drain of earlier failed pushes
+  std::vector<int64_t> push_keys;
+  std::vector<float> push_grads;
+  for (int64_t v : victims) {
+    RCEntry& e = c->entries[v];
+    if (!e.dirty) continue;
+    push_keys.push_back(v);
+    push_grads.insert(push_grads.end(), e.pending.begin(), e.pending.end());
+  }
+  std::vector<uint32_t> sel(nu);
+  std::vector<uint64_t> vout(nu);
+  std::vector<float> rout(nu * c->dim);
+  uint64_t req_base = push_keys.empty() ? 0 : ps_group_alloc_reqs(64);
+  std::vector<int32_t> rcs(c->shard_starts.size(), 0);
+  int64_t m = ps_group_push_sync_req(
+      c->gid, push_keys.data(), push_grads.data(),
+      (int64_t)push_keys.size(), uniq.data(), vers.data(), nu, bound,
+      req_base, sel.data(), vout.data(), rout.data(), rcs.data());
+  if (m < 0) {
+    // some shard may ALREADY have applied its push half: hand the FAILED
+    // shards' subsets to `outstanding` under their original req ids
+    // (retries dedup, never double-apply) and release the victims' dirty
+    // state — acked shards' halves are done, failed ones now live in the
+    // outstanding buffer
+    if (!push_keys.empty()) {
+      for (int64_t v : push_keys) {
+        auto it = c->entries.find(v);
+        if (it != c->entries.end()) {
+          it->second.dirty = false;
+          std::fill(it->second.pending.begin(), it->second.pending.end(),
+                    0.f);
+        }
+      }
+      stash_failed_shards(c, push_keys, push_grads, req_base, rcs);
+    }
+    return m;
+  }
+  for (int64_t v : victims) c->entries.erase(v);
+  // apply refreshed rows
+  for (int64_t j = 0; j < m; ++j) {
+    int64_t key = uniq[sel[j]];
+    RCEntry& e = c->entries[key];
+    e.row.assign(rout.data() + j * c->dim, rout.data() + (j + 1) * c->dim);
+    e.version = vout[j];
+    if (e.dirty) {
+      // row was outdated on the server while carrying local pending: keep
+      // the pending for a later flush, but replay it on the fresh copy so
+      // local reads still see our own updates (bounded divergence)
+      for (int64_t d = 0; d < c->dim; ++d)
+        e.row[d] -= c->lr * e.pending[d];
+    } else {
+      e.pending.assign(c->dim, 0.f);
+    }
+  }
+  // serve the batch from cache
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t k = idx[i];
+    if (k < 0 || k >= c->rows) {
+      std::memset(out + i * c->dim, 0, c->dim * sizeof(float));
+      continue;
+    }
+    RCEntry& e = c->entries[k];
+    e.freq++;
+    e.last = c->tick;
+    std::memcpy(out + i * c->dim, e.row.data(), c->dim * sizeof(float));
+  }
+  return m;
+}
+
+// Accumulate gradients into cached rows (pushEmbedding with lazy flush);
+// uncached keys are pushed straight to the servers in one batched call.
+int ps_rcache_update(int cid, const int64_t* idx, const float* grads,
+                     int64_t n) {
+  RCache* c = get_rcache(cid);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> lk(c->mu);
+  std::vector<int64_t> through_keys;
+  std::vector<float> through_grads;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t k = idx[i];
+    if (k < 0 || k >= c->rows) continue;
+    auto it = c->entries.find(k);
+    const float* g = grads + i * c->dim;
+    if (it == c->entries.end()) {
+      through_keys.push_back(k);
+      through_grads.insert(through_grads.end(), g, g + c->dim);
+      continue;
+    }
+    RCEntry& e = it->second;
+    if (e.pending.empty()) e.pending.assign(c->dim, 0.f);
+    for (int64_t d = 0; d < c->dim; ++d) e.pending[d] += g[d];
+    e.dirty = true;
+    for (int64_t d = 0; d < c->dim; ++d) e.row[d] -= c->lr * g[d];
+  }
+  // uncached keys go straight through — via the outstanding machinery so a
+  // transport failure can never double-apply them on a later retry
+  return push_or_stash(c, std::move(through_keys), std::move(through_grads),
+                       0);
+}
+
+// Push every dirty row's accumulated gradient, then re-pull exact server
+// values for those rows (one fused wire call; versions refreshed).
+int ps_rcache_flush(int cid) {
+  RCache* c = get_rcache(cid);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> lk(c->mu);
+  int out_rc = retry_outstanding(c);  // earlier failed pushes first
+  std::vector<int64_t> keys;
+  std::vector<float> grads;
+  std::vector<uint64_t> maxv;
+  for (auto& kv : c->entries) {
+    if (!kv.second.dirty) continue;
+    keys.push_back(kv.first);
+    grads.insert(grads.end(), kv.second.pending.begin(),
+                 kv.second.pending.end());
+  }
+  if (keys.empty()) return out_rc;
+  int64_t nk = (int64_t)keys.size();
+  maxv.assign(nk, UINT64_MAX);  // "not cached": always send fresh values
+  std::vector<uint32_t> sel(nk);
+  std::vector<uint64_t> vout(nk);
+  std::vector<float> rout(nk * c->dim);
+  uint64_t req_base = ps_group_alloc_reqs(64);
+  std::vector<int32_t> rcs(c->shard_starts.size(), 0);
+  int64_t m = ps_group_push_sync_req(c->gid, keys.data(), grads.data(), nk,
+                                     keys.data(), maxv.data(), nk, 0,
+                                     req_base, sel.data(), vout.data(),
+                                     rout.data(), rcs.data());
+  if (m < 0) {
+    // outcome unknown on >= 1 shard: hand the FAILED shards' subsets to
+    // `outstanding` (same req ids on retry = exactly-once) and mark
+    // entries clean — their optimistic local values stand in until a
+    // later sync refreshes them
+    for (auto& kv : c->entries) {
+      if (!kv.second.dirty) continue;
+      kv.second.dirty = false;
+      std::fill(kv.second.pending.begin(), kv.second.pending.end(), 0.f);
+    }
+    stash_failed_shards(c, keys, grads, req_base, rcs);
+    return (int)m;
+  }
+  for (int64_t j = 0; j < m; ++j) {
+    RCEntry& e = c->entries[keys[sel[j]]];
+    e.row.assign(rout.data() + j * c->dim, rout.data() + (j + 1) * c->dim);
+    e.version = vout[j];
+    e.dirty = false;
+    e.pending.assign(c->dim, 0.f);
+  }
+  return out_rc;
+}
+
+int64_t ps_rcache_size(int cid) {
+  RCache* c = get_rcache(cid);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> lk(c->mu);
+  return (int64_t)c->entries.size();
+}
+
+void ps_rcache_close(int cid) {
+  RCache* c = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_rcaches_mu);
+    auto it = g_rcaches.find(cid);
+    if (it == g_rcaches.end()) return;
+    c = it->second;
+    g_rcaches.erase(it);
+  }
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    retry_outstanding(c);  // last best-effort drain of unacked pushes
+  }
+  delete c;
+}
+
+}  // extern "C"

@@ -22,9 +22,11 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <string>
 #include <deque>
 #include <map>
 #include <memory>
@@ -50,6 +52,14 @@ int ps_sparse_push(int id, const int64_t* idx, const float* grads, int64_t n);
 int ps_sparse_set(int id, const int64_t* idx, const float* vals, int64_t n);
 int ps_table_save(int id, const char* path);
 int ps_table_load(int id, const char* path);
+int64_t ps_sync_pull(int id, const int64_t* idx, const uint64_t* cached_ver,
+                     int64_t n, uint64_t bound, uint32_t* sel_out,
+                     uint64_t* vers_out, float* rows_out);
+int ps_ssp_init(int ssp_id, int nworkers, int staleness);
+int ps_ssp_clock_and_wait(int ssp_id, int worker, int timeout_ms);
+int64_t ps_ssp_get_clock(int ssp_id, int worker);
+uint64_t ps_preduce_get_partner(int pool_id, int worker, int max_group,
+                                int wait_ms);
 }
 
 namespace {
@@ -62,6 +72,17 @@ enum VanOp : uint8_t {
   // reconnect-and-resend retry is exactly-once (ps-lite resender.h dedups
   // by message id the same way); non-idempotent ops only
   OP_DENSE_PUSH_ID = 11, OP_SPARSE_PUSH_ID = 12,
+  // HET cache tier on the wire (reference kSyncEmbedding/kPushSyncEmbedding,
+  // ps-lite/include/ps/psf/cachetable.h:24-55): version-bounded sync pull
+  // and the fused push+sync that flushes evicted rows and refreshes
+  // outdated ones in a single round trip
+  OP_SYNC_PULL = 13, OP_PUSH_SYNC = 14,
+  // SSP clocks + partial-reduce matchmaking as wire ops (reference ssp.h /
+  // preduce.h PSFs) — multi-host workers share one server-side controller
+  OP_SSP_INIT = 15, OP_SSP_CLOCK = 16, OP_SSP_GET = 17, OP_PREDUCE = 18,
+  // scheduler / node-management role (reference ps-lite/src/postoffice.cc):
+  // dynamic server registration, liveness via beats, endpoint-map queries
+  OP_SCHED_REGISTER = 19, OP_SCHED_MAP = 20, OP_SCHED_BEAT = 21,
 };
 
 // Per-table bounded set of recently applied push request-ids.  A repeated
@@ -116,6 +137,82 @@ class DedupSet {
 };
 DedupSet g_push_dedup;
 
+// ------------------------------------------------------------- scheduler
+// Node-management state (postoffice.cc analog).  Any van server can act as
+// the scheduler: servers OP_SCHED_REGISTER themselves (host taken from the
+// connection's peer address so servers need not know their external IP),
+// beat periodically, and workers OP_SCHED_MAP to resolve the current
+// rank -> endpoint map.  A rank is alive while its last beat is within
+// kSchedTtlMs; a server re-registering an existing rank (rejoin, possibly
+// at a NEW address/port) simply overwrites the slot.
+struct SchedEntry {
+  std::string host;
+  int port = 0;
+  int64_t last_beat_ms = 0;
+  bool ever = false;
+};
+struct Sched {
+  std::mutex mu;
+  std::vector<SchedEntry> entries;
+};
+Sched g_sched;
+constexpr int64_t kSchedTtlMs = 5000;
+
+int64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string peer_host(int fd) {
+  sockaddr_in addr{};
+  socklen_t alen = sizeof(addr);
+  if (getpeername(fd, (sockaddr*)&addr, &alen) != 0) return "127.0.0.1";
+  char buf[INET_ADDRSTRLEN] = {0};
+  inet_ntop(AF_INET, &addr.sin_addr, buf, sizeof(buf));
+  return buf;
+}
+
+// Ranks are bounded like group shards (alive masks are u64): a
+// wire-supplied hint must never size the entries vector unchecked.
+constexpr int kSchedMaxRanks = 64;
+
+// register/beat shared body: claim/refresh `rank` (or assign one), record
+// host:port + beat time.  Returns the rank, or -3 on an invalid hint / -6
+// when all rank slots are taken.
+int sched_register_locked(const std::string& host, int rank_hint, int port) {
+  auto& es = g_sched.entries;
+  if (rank_hint >= kSchedMaxRanks) return -3;  // wire-supplied: validate
+  int rank = rank_hint;
+  if (rank < 0) {
+    // first reusable slot: never-registered, or dead past TTL at the SAME
+    // host:port (that server restarted without its rank memory).  A rank
+    // merely TTL-stale at a different endpoint is NOT reusable — a new
+    // server must not steal a stalled server's rank (the stalled one's
+    // next beat would flap the slot and misroute shard traffic).
+    int64_t now = now_ms();
+    rank = -1;
+    for (size_t i = 0; i < es.size(); ++i) {
+      bool dead_same_ep = now - es[i].last_beat_ms > kSchedTtlMs &&
+                          es[i].host == host && es[i].port == port;
+      if (!es[i].ever || dead_same_ep) {
+        rank = (int)i;
+        break;
+      }
+    }
+    if (rank < 0) {
+      if (es.size() >= (size_t)kSchedMaxRanks) return -6;
+      rank = (int)es.size();
+    }
+  }
+  if ((size_t)rank >= es.size()) es.resize(rank + 1);
+  es[rank].host = host;
+  es[rank].port = port;
+  es[rank].last_beat_ms = now_ms();
+  es[rank].ever = true;
+  return rank;
+}
+
 bool read_all(int fd, void* buf, size_t n) {
   auto* p = (char*)buf;
   while (n) {
@@ -168,7 +265,8 @@ void handle_conn(int fd) {
     // minimum fixed-header bytes per op AFTER the op byte: reject short
     // frames BEFORE any rd<> touches the body (overread-proof)
     static const uint32_t kMinBody[] = {
-        0, 48, 28, 4, 4, 13, 12, 12, 8, 8, 0, 12, 20};
+        0, 48, 28, 4, 4, 13, 12, 12, 8, 8, 0, 12, 20,
+        20, 36, 12, 12, 8, 16, 8, 0, 8};
     if (op < sizeof(kMinBody) / sizeof(uint32_t) &&
         blen < 1 + kMinBody[op]) {
       send_resp(fd, -3, nullptr, 0);
@@ -316,6 +414,145 @@ void handle_conn(int fd) {
         int rc = op == OP_SAVE ? ps_table_save(id, path.c_str())
                                : ps_table_load(id, path.c_str());
         send_resp(fd, rc, nullptr, 0);
+        break;
+      }
+      case OP_SYNC_PULL: case OP_PUSH_SYNC: {
+        // SYNC_PULL:  [i32 id][i64 ns][u64 bound]
+        //             [i64 sync_keys x ns][u64 cached_vers x ns]
+        // PUSH_SYNC:  [i32 id][u64 req][i64 np][i64 ns][u64 bound]
+        //             [i64 push_keys x np][f32 push_grads x np*dim]
+        //             [i64 sync_keys x ns][u64 cached_vers x ns]
+        // resp: [i64 m][u32 sel x m][u64 vers x m][f32 rows x m*dim]
+        // The push half is exactly-once via the request-id dedup (the sync
+        // half is idempotent, so a duplicate still answers the sync).
+        int id = rd<int32_t>(p);
+        uint64_t req = 0;
+        int64_t np = 0;
+        bool is_push = op == OP_PUSH_SYNC;
+        if (is_push) {
+          req = rd<uint64_t>(p);
+          np = rd<int64_t>(p);
+        }
+        int64_t ns = rd<int64_t>(p);
+        uint64_t bound = rd<uint64_t>(p);
+        int64_t dim = ps_table_dim(id);
+        if (dim <= 0) { send_resp(fd, -1, nullptr, 0); break; }
+        int64_t have = body.data() + blen - p;
+        int64_t push_bytes = np * (int64_t)(sizeof(int64_t) +
+                                            dim * sizeof(float));
+        int64_t sync_bytes = ns * (int64_t)(sizeof(int64_t) +
+                                            sizeof(uint64_t));
+        int64_t resp_bytes = 8 + ns * (int64_t)(4 + 8 + dim * sizeof(float));
+        if (np < 0 || ns < 0 || np > (1 << 24) || ns > (1 << 24) ||
+            have < push_bytes + sync_bytes ||
+            resp_bytes > (int64_t)(1u << 30)) {
+          send_resp(fd, -3, nullptr, 0); break;
+        }
+        const auto* push_keys = (const int64_t*)p;
+        const auto* push_grads = (const float*)(p + np * sizeof(int64_t));
+        const char* q = p + push_bytes;
+        const auto* sync_keys = (const int64_t*)q;
+        const auto* sync_vers = (const uint64_t*)(q + ns * sizeof(int64_t));
+        int rc = 0;
+        if (is_push && np > 0) {
+          if (g_push_dedup.begin(id, req) == DedupSet::NEW) {
+            rc = ps_sparse_push(id, push_keys, push_grads, np);
+            g_push_dedup.finish(id, req, rc == 0);
+          }  // duplicate: push already applied — answer the sync only
+        }
+        if (rc != 0) { send_resp(fd, rc, nullptr, 0); break; }
+        std::vector<uint32_t> sel(ns);
+        vbuf.resize(ns);
+        fbuf.resize(ns * dim);
+        int64_t m = ps_sync_pull(id, sync_keys, sync_vers, ns, bound,
+                                 sel.data(), vbuf.data(), fbuf.data());
+        if (m < 0) { send_resp(fd, (int32_t)m, nullptr, 0); break; }
+        uint32_t plen = (uint32_t)(8 + m * (4 + 8 + dim * sizeof(float)));
+        uint32_t blen2 = 4 + plen;
+        int32_t rc32 = 0;
+        if (!write_all(fd, &blen2, 4) || !write_all(fd, &rc32, 4) ||
+            !write_all(fd, &m, 8) ||
+            !write_all(fd, sel.data(), m * 4) ||
+            !write_all(fd, vbuf.data(), m * 8) ||
+            !write_all(fd, fbuf.data(), m * dim * sizeof(float))) {
+          ::close(fd); return;
+        }
+        break;
+      }
+      case OP_SSP_INIT: {
+        int sid = rd<int32_t>(p);
+        int nworkers = rd<int32_t>(p), staleness = rd<int32_t>(p);
+        send_resp(fd, ps_ssp_init(sid, nworkers, staleness), nullptr, 0);
+        break;
+      }
+      case OP_SSP_CLOCK: {
+        // blocks this connection's handler thread while the worker is too
+        // far ahead — thread-per-connection makes that safe
+        int sid = rd<int32_t>(p);
+        int worker = rd<int32_t>(p), timeout_ms = rd<int32_t>(p);
+        send_resp(fd, ps_ssp_clock_and_wait(sid, worker, timeout_ms),
+                  nullptr, 0);
+        break;
+      }
+      case OP_SSP_GET: {
+        int sid = rd<int32_t>(p);
+        int worker = rd<int32_t>(p);
+        int64_t clk = ps_ssp_get_clock(sid, worker);
+        send_resp(fd, clk < 0 ? (int32_t)clk : 0, &clk,
+                  clk < 0 ? 0 : sizeof(clk));
+        break;
+      }
+      case OP_PREDUCE: {
+        int pool = rd<int32_t>(p), worker = rd<int32_t>(p);
+        int max_group = rd<int32_t>(p), wait_ms = rd<int32_t>(p);
+        uint64_t mask = ps_preduce_get_partner(pool, worker, max_group,
+                                               wait_ms);
+        send_resp(fd, 0, &mask, sizeof(mask));
+        break;
+      }
+      case OP_SCHED_REGISTER: case OP_SCHED_BEAT: {
+        int rank_hint = rd<int32_t>(p);
+        int port = rd<int32_t>(p);
+        if (port <= 0 || port > 65535) {
+          send_resp(fd, -3, nullptr, 0);
+          break;
+        }
+        std::string host = peer_host(fd);
+        int32_t rank;
+        {
+          std::lock_guard<std::mutex> lk(g_sched.mu);
+          rank = sched_register_locked(host, rank_hint, port);
+        }
+        if (rank < 0) {
+          send_resp(fd, rank, nullptr, 0);
+          break;
+        }
+        send_resp(fd, 0, &rank, sizeof(rank));
+        break;
+      }
+      case OP_SCHED_MAP: {
+        // resp: [i32 n] then per rank [i32 rank][u8 alive][i32 port]
+        //       [u8 hlen][host bytes]
+        std::vector<char> pay;
+        {
+          std::lock_guard<std::mutex> lk(g_sched.mu);
+          int64_t now = now_ms();
+          int32_t n = (int32_t)g_sched.entries.size();
+          pay.reserve(8 + n * 32);
+          pay.insert(pay.end(), (char*)&n, (char*)&n + 4);
+          for (int32_t i = 0; i < n; ++i) {
+            const auto& e = g_sched.entries[i];
+            uint8_t alive = e.ever && now - e.last_beat_ms <= kSchedTtlMs;
+            int32_t port = e.port;
+            uint8_t hlen = (uint8_t)std::min<size_t>(e.host.size(), 255);
+            pay.insert(pay.end(), (char*)&i, (char*)&i + 4);
+            pay.push_back((char)alive);
+            pay.insert(pay.end(), (char*)&port, (char*)&port + 4);
+            pay.push_back((char)hlen);
+            pay.insert(pay.end(), e.host.data(), e.host.data() + hlen);
+          }
+        }
+        send_resp(fd, 0, pay.data(), (uint32_t)pay.size());
         break;
       }
       default:
@@ -585,6 +822,249 @@ int ps_van_table_save(int fd, int id, const char* path) {
 
 int ps_van_table_load(int fd, int id, const char* path) {
   return van_file_op(OP_LOAD, fd, id, path);
+}
+
+// ---- HET cache tier wire ops (kSyncEmbedding / kPushSyncEmbedding) ----
+
+// Shared response decode for sync_pull / push_sync: payload is
+// [i64 m][u32 sel x m][u64 vers x m][f32 rows x m*dim]; returns m or <0.
+static int64_t decode_sync_resp(const std::vector<char>& pay, int64_t ns,
+                                int64_t dim, uint32_t* sel_out,
+                                uint64_t* vers_out, float* rows_out) {
+  if (pay.size() < 8) return -5;
+  int64_t m;
+  std::memcpy(&m, pay.data(), 8);
+  if (m < 0 || m > ns ||
+      (int64_t)pay.size() != 8 + m * (int64_t)(4 + 8 + dim * sizeof(float)))
+    return -5;
+  if (m == 0) return 0;  // out pointers may be null for push-only calls
+  const char* q = pay.data() + 8;
+  std::memcpy(sel_out, q, m * 4); q += m * 4;
+  std::memcpy(vers_out, q, m * 8); q += m * 8;
+  std::memcpy(rows_out, q, m * dim * sizeof(float));
+  return m;
+}
+
+int64_t ps_van_sync_pull(int fd, int id, const int64_t* keys,
+                         const uint64_t* cached_vers, int64_t ns,
+                         uint64_t bound, int64_t dim, uint32_t* sel_out,
+                         uint64_t* vers_out, float* rows_out) {
+  std::vector<char> b{(char)OP_SYNC_PULL}, pay;
+  put<int32_t>(b, id); put<int64_t>(b, ns); put<uint64_t>(b, bound);
+  size_t o = b.size();
+  b.resize(o + ns * (sizeof(int64_t) + sizeof(uint64_t)));
+  std::memcpy(b.data() + o, keys, ns * sizeof(int64_t));
+  std::memcpy(b.data() + o + ns * sizeof(int64_t), cached_vers,
+              ns * sizeof(uint64_t));
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
+  return decode_sync_resp(pay, ns, dim, sel_out, vers_out, rows_out);
+}
+
+int64_t ps_van_push_sync(int fd, int id, const int64_t* push_keys,
+                         const float* push_grads, int64_t np,
+                         const int64_t* sync_keys,
+                         const uint64_t* cached_vers, int64_t ns,
+                         uint64_t bound, int64_t dim, uint64_t req,
+                         uint32_t* sel_out, uint64_t* vers_out,
+                         float* rows_out) {
+  std::vector<char> b{(char)OP_PUSH_SYNC}, pay;
+  put<int32_t>(b, id); put<uint64_t>(b, req);
+  put<int64_t>(b, np); put<int64_t>(b, ns); put<uint64_t>(b, bound);
+  size_t o = b.size();
+  size_t push_bytes = np * (sizeof(int64_t) + dim * sizeof(float));
+  b.resize(o + push_bytes + ns * (sizeof(int64_t) + sizeof(uint64_t)));
+  std::memcpy(b.data() + o, push_keys, np * sizeof(int64_t));
+  std::memcpy(b.data() + o + np * sizeof(int64_t), push_grads,
+              np * dim * sizeof(float));
+  char* q = b.data() + o + push_bytes;
+  std::memcpy(q, sync_keys, ns * sizeof(int64_t));
+  std::memcpy(q + ns * sizeof(int64_t), cached_vers, ns * sizeof(uint64_t));
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
+  return decode_sync_resp(pay, ns, dim, sel_out, vers_out, rows_out);
+}
+
+// ---- SSP / preduce wire ops ----
+
+int ps_van_ssp_init(int fd, int ssp_id, int nworkers, int staleness) {
+  std::vector<char> b{(char)OP_SSP_INIT}, pay;
+  put<int32_t>(b, ssp_id); put<int32_t>(b, nworkers);
+  put<int32_t>(b, staleness);
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
+}
+
+int ps_van_ssp_clock(int fd, int ssp_id, int worker, int timeout_ms) {
+  std::vector<char> b{(char)OP_SSP_CLOCK}, pay;
+  put<int32_t>(b, ssp_id); put<int32_t>(b, worker);
+  put<int32_t>(b, timeout_ms);
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
+}
+
+int64_t ps_van_ssp_get(int fd, int ssp_id, int worker) {
+  std::vector<char> b{(char)OP_SSP_GET}, pay;
+  put<int32_t>(b, ssp_id); put<int32_t>(b, worker);
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
+  if (pay.size() != 8) return -5;
+  int64_t clk;
+  std::memcpy(&clk, pay.data(), 8);
+  return clk;
+}
+
+uint64_t ps_van_preduce(int fd, int pool, int worker, int max_group,
+                        int wait_ms) {
+  std::vector<char> b{(char)OP_PREDUCE}, pay;
+  put<int32_t>(b, pool); put<int32_t>(b, worker);
+  put<int32_t>(b, max_group); put<int32_t>(b, wait_ms);
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay) || rc != 0 || pay.size() != 8) return 0;
+  uint64_t mask;
+  std::memcpy(&mask, pay.data(), 8);
+  return mask;
+}
+
+// ---- scheduler wire ops (postoffice.cc analog) ----
+
+// Register/beat: returns assigned rank (>= 0) or a negative error.
+int ps_van_sched_register(int fd, int rank_hint, int advertised_port,
+                          int beat) {
+  std::vector<char> b{(char)(beat ? OP_SCHED_BEAT : OP_SCHED_REGISTER)}, pay;
+  put<int32_t>(b, rank_hint); put<int32_t>(b, advertised_port);
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
+  if (pay.size() != 4) return -5;
+  int32_t rank;
+  std::memcpy(&rank, pay.data(), 4);
+  return rank;
+}
+
+// Server-side registration loop: spawn a thread that registers this van
+// with the scheduler and beats every `interval_ms`, re-connecting and
+// re-registering (same rank) after any transport failure — the rejoin path
+// of postoffice node management.  Returns a handle (> 0) once the FIRST
+// registration succeeded (so the caller knows its rank), or < 0.
+namespace {
+struct BeatLoop {
+  std::atomic<bool> running{true};
+  std::atomic<int> rank{-1};
+  std::thread th;
+};
+std::mutex g_beats_mu;
+std::map<int, BeatLoop*> g_beats;
+int g_next_beat = 1;
+}  // namespace
+
+int ps_sched_beat_start(const char* sched_host, int sched_port,
+                        int rank_hint, int advertised_port, int interval_ms,
+                        double first_timeout_s) {
+  std::string host(sched_host);
+  // first registration synchronously, so the caller learns its rank
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(first_timeout_s);
+  int fd = -1, rank = -1;
+  while (rank < 0) {
+    if (fd < 0) fd = ps_van_connect(host.c_str(), sched_port);
+    if (fd >= 0) {
+      rank = ps_van_sched_register(fd, rank_hint, advertised_port, 0);
+      if (rank < 0) { ps_van_close(fd); fd = -1; }
+    }
+    if (rank < 0) {
+      if (std::chrono::steady_clock::now() > deadline) return -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+  }
+  auto* bl = new BeatLoop();
+  bl->rank = rank;
+  int handle;
+  {
+    std::lock_guard<std::mutex> lk(g_beats_mu);
+    handle = g_next_beat++;
+    g_beats[handle] = bl;
+  }
+  bl->th = std::thread([bl, host, sched_port, advertised_port, interval_ms,
+                        fd]() mutable {
+    while (bl->running.load()) {
+      for (int slept = 0; slept < interval_ms && bl->running.load();
+           slept += 50)
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      if (!bl->running.load()) break;
+      int r = fd >= 0 ? ps_van_sched_register(fd, bl->rank.load(),
+                                              advertised_port, 1)
+                      : kTransportErr;
+      if (r < 0) {  // scheduler unreachable: reconnect + re-register
+        if (fd >= 0) { ps_van_close(fd); fd = -1; }
+        fd = ps_van_connect(host.c_str(), sched_port);
+        if (fd >= 0)
+          ps_van_sched_register(fd, bl->rank.load(), advertised_port, 0);
+      }
+    }
+    if (fd >= 0) ps_van_close(fd);
+  });
+  return handle;
+}
+
+int ps_sched_beat_rank(int handle) {
+  std::lock_guard<std::mutex> lk(g_beats_mu);
+  auto it = g_beats.find(handle);
+  return it == g_beats.end() ? -1 : it->second->rank.load();
+}
+
+void ps_sched_beat_stop(int handle) {
+  BeatLoop* bl = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_beats_mu);
+    auto it = g_beats.find(handle);
+    if (it == g_beats.end()) return;
+    bl = it->second;
+    g_beats.erase(it);
+  }
+  bl->running = false;
+  if (bl->th.joinable()) bl->th.join();
+  delete bl;
+}
+
+// Query the endpoint map into caller-provided arrays (hosts are 64-byte
+// NUL-terminated slots).  Returns the number of ranks, or < 0.
+int ps_van_sched_map(int fd, int max_n, int32_t* ranks, uint8_t* alive,
+                     int32_t* ports, char* hosts64) {
+  std::vector<char> b{(char)OP_SCHED_MAP}, pay;
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
+  if (pay.size() < 4) return -5;
+  const char* p = pay.data();
+  const char* end = pay.data() + pay.size();
+  int32_t n;
+  std::memcpy(&n, p, 4); p += 4;
+  if (n < 0) return -5;
+  int out = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    if (end - p < 10) return -5;
+    int32_t rank; uint8_t al; int32_t port; uint8_t hlen;
+    std::memcpy(&rank, p, 4); p += 4;
+    al = (uint8_t)*p++;
+    std::memcpy(&port, p, 4); p += 4;
+    hlen = (uint8_t)*p++;
+    if (end - p < hlen) return -5;
+    if (out < max_n) {
+      ranks[out] = rank;
+      alive[out] = al;
+      ports[out] = port;
+      size_t cp = std::min<size_t>(hlen, 63);
+      std::memcpy(hosts64 + out * 64, p, cp);
+      hosts64[out * 64 + cp] = 0;
+      out++;
+    }
+    p += hlen;
+  }
+  return out;
 }
 
 }  // extern "C"

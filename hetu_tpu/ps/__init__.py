@@ -3,3 +3,7 @@ from hetu_tpu.ps.client import (
     PSTable, CacheSparseTable, SSPController, PartialReduce,
 )
 from hetu_tpu.ps.embedding import PSEmbedding
+from hetu_tpu.ps.van import (
+    RemotePSTable, PartitionedPSTable, RemoteCacheTable, RemoteSSP,
+    RemotePReduce, serve, serve_and_register, scheduler_map,
+)

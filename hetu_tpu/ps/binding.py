@@ -16,7 +16,7 @@ from pathlib import Path
 _HERE = Path(__file__).resolve().parent
 _CSRC = _HERE.parent.parent / "csrc"
 _SRCS = [_CSRC / "hetu_ps.cpp", _CSRC / "hetu_ps_van.cpp",
-         _CSRC / "hetu_ps_group.cpp"]
+         _CSRC / "hetu_ps_group.cpp", _CSRC / "hetu_ps_rcache.cpp"]
 _BUILD = _HERE / "_build"
 _SO = _BUILD / "libhetu_ps.so"
 
@@ -50,6 +50,9 @@ def _load():
         i64p = c.POINTER(c.c_int64)
         f32p = c.POINTER(c.c_float)
         u64p = c.POINTER(c.c_uint64)
+        u32p = c.POINTER(c.c_uint32)
+        i32p = c.POINTER(c.c_int32)
+        u8p = c.POINTER(c.c_uint8)
         sigs = {
             "ps_table_create": ([c.c_int, c.c_int64, c.c_int64, c.c_int,
                                  c.c_double, c.c_double, c.c_uint64], c.c_int),
@@ -133,6 +136,50 @@ def _load():
             "ps_group_alive_mask": ([c.c_int], c.c_uint64),
             "ps_group_recovered": ([c.c_int], c.c_uint64),
             "ps_group_close": ([c.c_int], None),
+            # HET cache tier on the wire + scheduler role (round 4)
+            "ps_sync_pull": ([c.c_int, i64p, u64p, c.c_int64, c.c_uint64,
+                              u32p, u64p, f32p], c.c_int64),
+            "ps_van_sync_pull": ([c.c_int, c.c_int, i64p, u64p, c.c_int64,
+                                  c.c_uint64, c.c_int64, u32p, u64p, f32p],
+                                 c.c_int64),
+            "ps_van_push_sync": ([c.c_int, c.c_int, i64p, f32p, c.c_int64,
+                                  i64p, u64p, c.c_int64, c.c_uint64,
+                                  c.c_int64, c.c_uint64, u32p, u64p, f32p],
+                                 c.c_int64),
+            "ps_van_ssp_init": ([c.c_int, c.c_int, c.c_int, c.c_int],
+                                c.c_int),
+            "ps_van_ssp_clock": ([c.c_int, c.c_int, c.c_int, c.c_int],
+                                 c.c_int),
+            "ps_van_ssp_get": ([c.c_int, c.c_int, c.c_int], c.c_int64),
+            "ps_van_preduce": ([c.c_int, c.c_int, c.c_int, c.c_int,
+                                c.c_int], c.c_uint64),
+            "ps_van_sched_register": ([c.c_int, c.c_int, c.c_int, c.c_int],
+                                      c.c_int),
+            "ps_van_sched_map": ([c.c_int, c.c_int, i32p, u8p, i32p,
+                                  c.c_char_p], c.c_int),
+            "ps_sched_beat_start": ([c.c_char_p, c.c_int, c.c_int, c.c_int,
+                                     c.c_int, c.c_double], c.c_int),
+            "ps_sched_beat_rank": ([c.c_int], c.c_int),
+            "ps_sched_beat_stop": ([c.c_int], None),
+            "ps_group_create_sched": ([c.c_char_p, c.c_int, c.c_int, c.c_int,
+                                       c.c_int64, c.c_int64, c.c_int,
+                                       c.c_double, c.c_double, c.c_uint64,
+                                       c.c_double, c.c_int], c.c_int),
+            "ps_group_rows": ([c.c_int], c.c_int64),
+            "ps_group_dim": ([c.c_int], c.c_int64),
+            "ps_group_sync_pull": ([c.c_int, i64p, u64p, c.c_int64,
+                                    c.c_uint64, u32p, u64p, f32p], c.c_int64),
+            "ps_group_push_sync": ([c.c_int, i64p, f32p, c.c_int64, i64p,
+                                    u64p, c.c_int64, c.c_uint64, u32p, u64p,
+                                    f32p], c.c_int64),
+            "ps_rcache_create": ([c.c_int, c.c_int64, c.c_int, c.c_float],
+                                 c.c_int),
+            "ps_rcache_lookup": ([c.c_int, i64p, c.c_int64, c.c_uint64,
+                                  f32p], c.c_int64),
+            "ps_rcache_update": ([c.c_int, i64p, f32p, c.c_int64], c.c_int),
+            "ps_rcache_flush": ([c.c_int], c.c_int),
+            "ps_rcache_size": ([c.c_int], c.c_int64),
+            "ps_rcache_close": ([c.c_int], None),
         }
         for name, (argtypes, restype) in sigs.items():
             fn = getattr(lib, name)

@@ -26,6 +26,22 @@ def _fresh_remote_id() -> int:
     return (1 << 24) + int.from_bytes(os.urandom(3), "little")
 
 
+def _connect_with_deadline(host: str, port: int, timeout_s: float) -> int:
+    """Poll ``ps_van_connect`` until it succeeds or the deadline expires;
+    shared by every van client constructor."""
+    deadline = time.time() + timeout_s
+    fd = lib.ps_van_connect(host.encode(), port)
+    while fd < 0:
+        if time.time() > deadline:
+            raise ConnectionError(f"cannot reach PS van {host}:{port}")
+        time.sleep(0.05)
+        fd = lib.ps_van_connect(host.encode(), port)
+    return fd
+
+
+_beat_handles: list[int] = []
+
+
 def serve(port: int = 0) -> int:
     """Start the in-process van server; returns the bound port."""
     bound = lib.ps_van_start(port)
@@ -35,7 +51,63 @@ def serve(port: int = 0) -> int:
 
 
 def stop() -> None:
+    # stop beat threads FIRST: a beat outliving the van would keep
+    # advertising a dead endpoint as alive in the scheduler map
+    while _beat_handles:
+        lib.ps_sched_beat_stop(_beat_handles.pop())
     lib.ps_van_stop()
+
+
+def serve_and_register(sched_host: str, sched_port: int, *,
+                       port: int = 0, rank_hint: int = -1,
+                       beat_ms: int = 1000,
+                       register_timeout_s: float = 10.0) -> tuple[int, int]:
+    """Start a van server AND register it with the scheduler.
+
+    The postoffice server role (reference ps-lite/src/postoffice.cc:1-222):
+    the scheduler assigns this server a rank (or honors ``rank_hint`` — the
+    rejoin path, valid even when the server comes back on a DIFFERENT
+    port/host) and learns its endpoint from the registration connection's
+    peer address.  A native beat thread keeps the registration live; it is
+    stopped by :func:`stop` so a shut-down server stops advertising itself.
+
+    Returns ``(bound_port, rank)``.
+    """
+    bound = serve(port)
+    h = lib.ps_sched_beat_start(sched_host.encode(), sched_port, rank_hint,
+                                bound, beat_ms, register_timeout_s)
+    if h <= 0:
+        stop()
+        raise ConnectionError(
+            f"cannot register with scheduler {sched_host}:{sched_port}")
+    _beat_handles.append(h)
+    rank = int(lib.ps_sched_beat_rank(h))
+    return bound, rank
+
+
+def scheduler_map(host: str, port: int) -> list[dict]:
+    """Query a scheduler's endpoint map: [{rank, alive, host, port}, ...]."""
+    import ctypes as c
+    fd = lib.ps_van_connect(host.encode(), port)
+    if fd < 0:
+        raise ConnectionError(f"cannot reach scheduler {host}:{port}")
+    try:
+        kmax = 64
+        ranks = (c.c_int32 * kmax)()
+        alive = (c.c_uint8 * kmax)()
+        ports = (c.c_int32 * kmax)()
+        hosts = c.create_string_buffer(kmax * 64)
+        n = lib.ps_van_sched_map(
+            fd, kmax, c.cast(ranks, c.POINTER(c.c_int32)),
+            c.cast(alive, c.POINTER(c.c_uint8)),
+            c.cast(ports, c.POINTER(c.c_int32)), hosts)
+        if n < 0:
+            raise RuntimeError(f"scheduler map query failed rc={n}")
+        return [{"rank": int(ranks[i]), "alive": bool(alive[i]),
+                 "host": hosts.raw[i * 64:(i + 1) * 64].split(b"\0")[0]
+                 .decode(), "port": int(ports[i])} for i in range(n)]
+    finally:
+        lib.ps_van_close(fd)
 
 
 class RemotePSTable:
@@ -51,14 +123,7 @@ class RemotePSTable:
                  connect_timeout_s: float = 10.0):
         from hetu_tpu.ps.client import _INIT_KINDS, _OPT_KINDS
         self.rows, self.dim = rows, dim
-        deadline = time.time() + connect_timeout_s
-        self.fd = -1
-        while self.fd < 0:
-            self.fd = lib.ps_van_connect(host.encode(), port)
-            if self.fd < 0 and time.time() > deadline:
-                raise ConnectionError(f"cannot reach PS van {host}:{port}")
-            if self.fd < 0:
-                time.sleep(0.05)
+        self.fd = _connect_with_deadline(host, port, connect_timeout_s)
         self.id = table_id if table_id is not None else _fresh_remote_id()
         if create:
             try:
@@ -146,7 +211,7 @@ class PartitionedPSTable:
                  beta1: float = 0.9, beta2: float = 0.999,
                  connect_timeout_s: float = 10.0,
                  heartbeat_ms: int = 0):
-        from hetu_tpu.ps.client import _INIT_KINDS, _OPT_KINDS
+        from hetu_tpu.ps.client import _INIT_KINDS
         if not isinstance(endpoints, str):
             endpoints = ",".join(f"{h}:{p}" for h, p in endpoints)
         self.rows, self.dim = rows, dim
@@ -157,7 +222,12 @@ class PartitionedPSTable:
         if gid <= 0:
             raise ConnectionError(
                 f"cannot establish PS group over {endpoints} (rc={gid})")
+        self._finish_init(gid, optimizer, lr, momentum, eps, beta1, beta2)
+
+    def _finish_init(self, gid, optimizer, lr, momentum, eps, beta1, beta2):
+        from hetu_tpu.ps.client import _OPT_KINDS
         self.gid = gid
+        self.lr = lr
         try:
             _check(lib.ps_group_set_optimizer(
                 gid, _OPT_KINDS[optimizer], lr, momentum, eps, beta1, beta2),
@@ -167,6 +237,38 @@ class PartitionedPSTable:
             self.gid = 0
             lib.ps_group_close(gid)
             raise
+
+    @classmethod
+    def from_scheduler(cls, sched_host: str, sched_port: int,
+                       n_servers: int, rows: int, dim: int, *,
+                       table_id: Optional[int] = None,
+                       init: str = "normal", init_a: float = 0.0,
+                       init_b: float = 0.01, seed: int = 0,
+                       optimizer: str = "sgd", lr: float = 0.01,
+                       momentum: float = 0.9, eps: float = 1e-7,
+                       beta1: float = 0.9, beta2: float = 0.999,
+                       connect_timeout_s: float = 10.0,
+                       heartbeat_ms: int = 0) -> "PartitionedPSTable":
+        """Resolve the server endpoints from a scheduler instead of a static
+        list (reference postoffice.cc node management).  Waits until ranks
+        0..n_servers-1 are all alive; the resulting group re-resolves a
+        shard's endpoint from the scheduler whenever a direct reconnect
+        fails, so a server may rejoin at a different address/port with no
+        client reconfiguration."""
+        from hetu_tpu.ps.client import _INIT_KINDS
+        self = cls.__new__(cls)
+        self.rows, self.dim = rows, dim
+        self.id = table_id if table_id is not None else _fresh_remote_id()
+        gid = lib.ps_group_create_sched(
+            sched_host.encode(), sched_port, n_servers, self.id, rows, dim,
+            _INIT_KINDS[init], init_a, init_b, seed, connect_timeout_s,
+            heartbeat_ms)
+        if gid <= 0:
+            raise ConnectionError(
+                f"cannot establish PS group via scheduler "
+                f"{sched_host}:{sched_port} (rc={gid})")
+        self._finish_init(gid, optimizer, lr, momentum, eps, beta1, beta2)
+        return self
 
     @property
     def n_servers(self) -> int:
@@ -217,6 +319,30 @@ class PartitionedPSTable:
         _check(lib.ps_group_dense_push(self.gid, _f32p(g)),
                "group_dense_push")
 
+    def sync_pull(self, indices, cached_versions, bound: int = 0):
+        """Version-bounded sync (HET kSyncEmbedding over the wire): returns
+        ``(positions, versions, rows)`` for only the requested rows whose
+        server version exceeds ``cached_versions + bound``
+        (``np.uint64(-1)`` = "not cached, always send")."""
+        import ctypes as c
+        idx = _as_idx(indices)
+        vers = np.ascontiguousarray(cached_versions, np.uint64).reshape(-1)
+        if vers.shape[0] != idx.shape[0]:
+            raise ValueError("cached_versions must match indices length")
+        n = idx.shape[0]
+        sel = np.empty(n, np.uint32)
+        vout = np.empty(n, np.uint64)
+        rout = np.empty((n, self.dim), np.float32)
+        m = lib.ps_group_sync_pull(
+            self.gid, _i64p(idx), vers.ctypes.data_as(
+                c.POINTER(c.c_uint64)), n, bound,
+            sel.ctypes.data_as(c.POINTER(c.c_uint32)),
+            vout.ctypes.data_as(c.POINTER(c.c_uint64)), _f32p(rout))
+        if m < 0:
+            raise RuntimeError(f"hetu_ps group_sync_pull failed rc={m}")
+        m = int(m)
+        return sel[:m].copy(), vout[:m].copy(), rout[:m].copy()
+
     def save(self, path) -> None:
         """Each server saves `<path>.shard<i>` on its own host."""
         _check(lib.ps_group_save(self.gid, str(path).encode()), "group_save")
@@ -228,3 +354,128 @@ class PartitionedPSTable:
         if getattr(self, "gid", 0) > 0:
             lib.ps_group_close(self.gid)
             self.gid = 0
+
+
+class RemoteCacheTable:
+    """Worker-side HET cache over a remote (partitioned) table — the
+    multi-host cache tier (reference src/hetu_cache/include/
+    hetu_client.h:19-31 syncEmbedding/pushEmbedding/pushSyncEmbedding;
+    csrc/hetu_ps_rcache.cpp).
+
+    Same surface as the in-process ``CacheSparseTable`` so models swap
+    between the local and remote tiers freely; here misses/outdated rows
+    cross the wire in one fused push+sync round trip per shard.
+    """
+
+    def __init__(self, table: PartitionedPSTable, capacity: int,
+                 policy: str = "lfuopt", *, pull_bound: int = 0):
+        from hetu_tpu.ps.client import _POLICIES
+        self.table = table
+        self.dim = table.dim
+        self.pull_bound = pull_bound
+        cid = lib.ps_rcache_create(table.gid, capacity, _POLICIES[policy],
+                                   getattr(table, "lr", 0.01))
+        if cid <= 0:
+            raise RuntimeError(f"hetu_ps rcache_create failed rc={cid}")
+        self.id = cid
+        self.misses = 0
+        self.lookups = 0
+
+    def embedding_lookup(self, indices) -> np.ndarray:
+        idx = np.ascontiguousarray(indices, np.int64)
+        flat = idx.reshape(-1)
+        out = np.empty((flat.shape[0], self.dim), np.float32)
+        m = lib.ps_rcache_lookup(self.id, _i64p(flat), flat.shape[0],
+                                 self.pull_bound, _f32p(out))
+        if m < 0:
+            raise RuntimeError(f"hetu_ps rcache_lookup failed rc={m}")
+        self.misses += int(m)
+        self.lookups += flat.shape[0]
+        return out.reshape(*idx.shape, self.dim)
+
+    def embedding_update(self, indices, grads) -> None:
+        idx = _as_idx(indices)
+        g = _as_mat(grads, idx.shape[0], self.dim)
+        _check(lib.ps_rcache_update(self.id, _i64p(idx), _f32p(g),
+                                    idx.shape[0]), "rcache_update")
+
+    def flush(self) -> None:
+        _check(lib.ps_rcache_flush(self.id), "rcache_flush")
+
+    @property
+    def size(self) -> int:
+        return int(lib.ps_rcache_size(self.id))
+
+    @property
+    def hit_rate(self) -> float:
+        return 1.0 - self.misses / max(self.lookups, 1)
+
+    def close(self) -> None:
+        if getattr(self, "id", 0) > 0:
+            lib.ps_rcache_close(self.id)
+            self.id = 0
+
+
+class RemoteSSP:
+    """SSP clocks against a remote van server (reference ssp.h PSFs over
+    the wire): multi-host workers share one server-side clock table."""
+
+    def __init__(self, host: str, port: int, ssp_id: int, n_workers: int,
+                 staleness: int, *, create: bool = True,
+                 connect_timeout_s: float = 10.0):
+        self.fd = _connect_with_deadline(host, port, connect_timeout_s)
+        self.id = ssp_id
+        self.n_workers = n_workers
+        if create:
+            rc = lib.ps_van_ssp_init(self.fd, ssp_id, n_workers, staleness)
+            if rc not in (0, -2):  # -2: another worker initialized it first
+                self.close()
+                raise RuntimeError(f"remote ssp_init failed rc={rc}")
+
+    def clock_and_wait(self, worker: int, timeout_ms: int = 10_000) -> bool:
+        rc = lib.ps_van_ssp_clock(self.fd, self.id, worker, timeout_ms)
+        if rc < 0:
+            raise RuntimeError(f"remote ssp_clock failed rc={rc}")
+        return rc == 0
+
+    def clock(self, worker: int) -> int:
+        clk = int(lib.ps_van_ssp_get(self.fd, self.id, worker))
+        if clk < 0:
+            raise RuntimeError(f"remote ssp_get failed rc={clk}")
+        return clk
+
+    def close(self) -> None:
+        if self.fd >= 0:
+            lib.ps_van_close(self.fd)
+            self.fd = -1
+
+
+class RemotePReduce:
+    """Partial-reduce matchmaking against a remote van server (reference
+    preduce.h kPReduceGetPartner over the wire)."""
+
+    def __init__(self, host: str, port: int, pool_id: int,
+                 max_group: int = 8, wait_ms: int = 100,
+                 connect_timeout_s: float = 10.0):
+        self.fd = _connect_with_deadline(host, port, connect_timeout_s)
+        self.id = pool_id
+        self.max_group = max_group
+        self.wait_ms = wait_ms
+
+    def get_partner(self, worker: int) -> list[int]:
+        if not 0 <= worker < 64:
+            raise ValueError("worker id must be in [0, 64) for mask encoding")
+        mask = int(lib.ps_van_preduce(self.fd, self.id, worker,
+                                      self.max_group, self.wait_ms))
+        if mask == 0:
+            # a formed group always contains the announcing worker, so a
+            # zero mask can only mean transport failure or a server error —
+            # surface it (siblings RemoteSSP/RemotePSTable raise likewise)
+            raise RuntimeError("remote preduce matchmaking failed "
+                               "(van unreachable or server error)")
+        return [i for i in range(64) if mask & (1 << i)]
+
+    def close(self) -> None:
+        if self.fd >= 0:
+            lib.ps_van_close(self.fd)
+            self.fd = -1

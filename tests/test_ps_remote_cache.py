@@ -1,0 +1,239 @@
+"""Multi-host HET cache tier: worker-side version-bounded caches over
+REMOTE sharded tables.
+
+Reference analogs: src/hetu_cache/include/hetu_client.h:19-31
+(syncEmbedding / pushEmbedding / pushSyncEmbedding),
+ps-lite/include/ps/psf/cachetable.h:24-55 (kSyncEmbedding /
+kPushSyncEmbedding wire PSFs), tests/hetu_cache/hetu_cache_test.py (the
+randomized lookup/update-vs-mirror pattern).  Exercised through
+csrc/hetu_ps_rcache.cpp over the van (OP_SYNC_PULL / OP_PUSH_SYNC).
+"""
+
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from hetu_tpu.ps import available
+
+if not available():  # pragma: no cover
+    pytest.skip("native PS lib unavailable", allow_module_level=True)
+
+from hetu_tpu.ps import van
+
+REPO = Path(__file__).resolve().parent.parent
+
+SERVER_SRC = """
+import sys, time
+sys.path.insert(0, {repo!r})
+from hetu_tpu.ps import van
+port = van.serve({port})
+print("READY", port, flush=True)
+time.sleep(600)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_server(tmp_path, port: int, tag: str) -> subprocess.Popen:
+    script = tmp_path / f"server_{tag}.py"
+    script.write_text(SERVER_SRC.format(repo=str(REPO), port=port))
+    proc = subprocess.Popen([sys.executable, str(script)],
+                            stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    assert line.startswith("READY"), line
+    return proc
+
+
+@pytest.fixture
+def two_servers(tmp_path):
+    ports = [_free_port(), _free_port()]
+    procs = [_spawn_server(tmp_path, p, f"s{i}")
+             for i, p in enumerate(ports)]
+    yield ports, procs
+    for p in procs:
+        p.kill()
+        p.wait()
+
+
+def test_sync_pull_version_bound_semantics(two_servers):
+    """The kSyncEmbedding wire contract: only rows whose server version
+    exceeds cached_version + bound come back; UINT64_MAX means 'always
+    send'."""
+    ports, _ = two_servers
+    eps = [("127.0.0.1", p) for p in ports]
+    t = van.PartitionedPSTable(eps, rows=10, dim=2, init="zeros",
+                               optimizer="sgd", lr=1.0)
+    NOT_CACHED = np.uint64(0xFFFFFFFFFFFFFFFF)
+    # fresh table: all versions 0; "not cached" rows always arrive
+    sel, vers, rows = t.sync_pull([1, 6], [NOT_CACHED, NOT_CACHED])
+    assert sorted(sel.tolist()) == [0, 1]
+    np.testing.assert_array_equal(vers, 0)
+    np.testing.assert_allclose(rows, 0.0)
+    # cached at version 0, no updates since: nothing to send
+    sel, _, _ = t.sync_pull([1, 6], [0, 0], bound=0)
+    assert sel.size == 0
+    # one update bumps the version past the bound=0 check on both shards
+    t.sparse_push([1, 6], np.ones((2, 2), np.float32))
+    sel, vers, rows = t.sync_pull([1, 6], [0, 0], bound=0)
+    assert sorted(sel.tolist()) == [0, 1]
+    np.testing.assert_array_equal(vers, 1)
+    np.testing.assert_allclose(rows, -1.0)  # sgd lr=1 on ones
+    # bound=1 tolerates exactly that staleness: nothing to send
+    sel, _, _ = t.sync_pull([1, 6], [0, 0], bound=1)
+    assert sel.size == 0
+    t.close()
+
+
+def test_remote_cache_matches_mirror_single_worker(two_servers):
+    """Randomized lookup/update against a remote 2-server group vs a numpy
+    mirror (the reference hetu_cache_test.py pattern).  SGD makes the
+    optimistic local apply exact, so bound=0 lookups equal the mirror at
+    every step."""
+    ports, _ = two_servers
+    eps = [("127.0.0.1", p) for p in ports]
+    ROWS, DIM, LR = 64, 4, 0.5
+    t = van.PartitionedPSTable(eps, rows=ROWS, dim=DIM, init="zeros",
+                               optimizer="sgd", lr=LR)
+    cache = van.RemoteCacheTable(t, capacity=16, policy="lfuopt",
+                                 pull_bound=0)
+    mirror = np.zeros((ROWS, DIM), np.float32)
+    rng = np.random.default_rng(7)
+    for _ in range(30):
+        idx = rng.integers(0, ROWS, 8)
+        got = cache.embedding_lookup(idx)
+        np.testing.assert_allclose(got, mirror[idx], rtol=1e-5, atol=1e-6)
+        g = rng.standard_normal((8, DIM)).astype(np.float32)
+        cache.embedding_update(idx, g)
+        # mirror applies aggregated-by-row sgd, matching the server/cache
+        for k in np.unique(idx):
+            mirror[k] -= LR * g[idx == k].sum(axis=0)
+    assert cache.size <= 16  # capacity respected
+    assert cache.hit_rate > 0.1  # the cache actually caches
+    cache.flush()
+    np.testing.assert_allclose(t.sparse_pull(np.arange(ROWS)), mirror,
+                               rtol=1e-5, atol=1e-6)
+    cache.close()
+    t.close()
+
+
+def test_remote_cache_bounded_staleness_two_workers(two_servers, tmp_path):
+    """2 servers + 2 worker PROCESSES, each with its own worker-side cache
+    (the full HET multi-host topology).  Each worker updates a disjoint key
+    half with deterministic gradients and looks up ALL keys under a
+    staleness bound; after both flush, the servers hold exactly the
+    combined mirror."""
+    ports, _ = two_servers
+    eps = ",".join(f"127.0.0.1:{p}" for p in ports)
+    ROWS, DIM, LR, STEPS = 32, 2, 1.0, 12
+    worker = tmp_path / "cache_worker.py"
+    worker.write_text(f"""
+import sys
+sys.path.insert(0, {str(REPO)!r})
+import numpy as np
+from hetu_tpu.ps import van
+
+wid = int(sys.argv[1])
+t = van.PartitionedPSTable({eps!r}, rows={ROWS}, dim={DIM}, init="zeros",
+                           optimizer="sgd", lr={LR}, table_id=888)
+cache = van.RemoteCacheTable(t, capacity=12, policy="lru", pull_bound=2)
+own = np.arange(wid * {ROWS}//2, (wid + 1) * {ROWS}//2)
+for step in range({STEPS}):
+    allk = np.arange({ROWS})
+    vals = cache.embedding_lookup(allk)   # bounded-staleness read of all
+    assert vals.shape == ({ROWS}, {DIM})
+    # deterministic grad: g[k, :] = (k % 3 + 1) each step, own keys only
+    g = ((own % 3 + 1).astype(np.float32))[:, None].repeat({DIM}, 1)
+    cache.embedding_update(own, g)
+cache.flush()
+# exact check on OWN rows after flush (SGD: server == local mirror)
+final = cache.embedding_lookup(own)
+want = -{LR} * {STEPS} * ((own % 3 + 1).astype(np.float32))[:, None]
+np.testing.assert_allclose(final, want.repeat({DIM}, 1), rtol=1e-5)
+assert cache.hit_rate > 0.0
+cache.close(); t.close()
+print("OK", flush=True)
+""")
+    procs = [subprocess.Popen([sys.executable, str(worker), str(i)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for i in range(2)]
+    for p in procs:
+        out, err = p.communicate(timeout=180)
+        assert p.returncode == 0 and "OK" in out, err[-2000:]
+    # combined mirror: every key got STEPS * (k%3+1) total gradient
+    t = van.PartitionedPSTable(eps, rows=ROWS, dim=DIM, init="zeros",
+                               optimizer="sgd", lr=LR, table_id=888)
+    allk = np.arange(ROWS)
+    want = (-LR * STEPS * (allk % 3 + 1).astype(np.float32))[:, None]
+    np.testing.assert_allclose(t.sparse_pull(allk), want.repeat(DIM, 1),
+                               rtol=1e-5)
+    t.close()
+
+
+def test_failed_push_retries_exactly_once(two_servers, tmp_path):
+    """A push whose shard is down is stashed and re-sent with its ORIGINAL
+    request ids (ps-lite resender semantics): after the server comes back,
+    the gradient lands exactly once — never doubled, never dropped."""
+    ports, procs = two_servers
+    eps = [("127.0.0.1", p) for p in ports]
+    ROWS, DIM, LR = 10, 2, 1.0
+    t = van.PartitionedPSTable(eps, rows=ROWS, dim=DIM, init="zeros",
+                               optimizer="sgd", lr=LR)
+    cache = van.RemoteCacheTable(t, capacity=4, policy="lru", pull_bound=0)
+    # key 7 lives on shard 1 (rows 5..9); kill that server
+    procs[1].kill()
+    procs[1].wait()
+    # uncached update -> through-push -> shard down -> stashed, raises
+    with pytest.raises(RuntimeError):
+        cache.embedding_update([7], np.ones((1, DIM), np.float32))
+    # restart the server blank on the same port; flush drains the stash
+    procs[1] = _spawn_server(tmp_path, ports[1], "s1b")
+    deadline = time.time() + 20
+    ok = False
+    while time.time() < deadline:
+        try:
+            cache.flush()
+            ok = True
+            break
+        except RuntimeError:
+            time.sleep(0.2)
+    assert ok, "outstanding push never drained after restart"
+    np.testing.assert_allclose(t.sparse_pull([7]), -1.0)  # exactly once
+    # a second flush must NOT re-apply it
+    cache.flush()
+    np.testing.assert_allclose(t.sparse_pull([7]), -1.0)
+    cache.close()
+    t.close()
+
+
+def test_remote_cache_eviction_pushes_dirty_victims(two_servers):
+    """Evicted dirty rows must flush their pending gradients (the push half
+    of pushSyncEmbedding), never drop them."""
+    ports, _ = two_servers
+    eps = [("127.0.0.1", p) for p in ports]
+    ROWS, DIM, LR, CAP = 40, 2, 1.0, 4
+    t = van.PartitionedPSTable(eps, rows=ROWS, dim=DIM, init="zeros",
+                               optimizer="sgd", lr=LR)
+    cache = van.RemoteCacheTable(t, capacity=CAP, policy="lru",
+                                 pull_bound=0)
+    # touch + dirty rows 0..3, then touch 4..7 to force eviction of all four
+    first = np.arange(4)
+    cache.embedding_lookup(first)
+    cache.embedding_update(first, np.ones((4, DIM), np.float32))
+    cache.embedding_lookup(np.arange(4, 8))
+    assert cache.size <= CAP
+    # victims' pendings reached the servers despite never flushing
+    np.testing.assert_allclose(t.sparse_pull(first), -1.0)
+    cache.close()
+    t.close()

@@ -1,0 +1,195 @@
+"""PS scheduler role: dynamic registration, liveness, endpoint-map
+resolution, and rejoin-at-a-NEW-address recovery.
+
+Reference analogs: ps-lite/src/postoffice.cc:1-222 (node management: rank
+assignment, heartbeats, rejoin) exercised through the van's
+OP_SCHED_REGISTER/OP_SCHED_MAP/OP_SCHED_BEAT ops (csrc/hetu_ps_van.cpp) and
+the scheduler-resolving group layer (csrc/hetu_ps_group.cpp
+ps_group_create_sched + resolve_from_sched).
+"""
+
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from hetu_tpu.ps import available
+
+if not available():  # pragma: no cover
+    pytest.skip("native PS lib unavailable", allow_module_level=True)
+
+from hetu_tpu.ps import van
+
+REPO = Path(__file__).resolve().parent.parent
+
+SCHED_SRC = """
+import sys, time
+sys.path.insert(0, {repo!r})
+from hetu_tpu.ps import van
+port = van.serve({port})
+print("READY", port, flush=True)
+time.sleep(600)
+"""
+
+# a server that REGISTERS with the scheduler instead of being listed
+# statically; port=0 lets the OS choose (the client must resolve it)
+SERVER_SRC = """
+import sys, time
+sys.path.insert(0, {repo!r})
+from hetu_tpu.ps import van
+port, rank = van.serve_and_register("127.0.0.1", {sched_port},
+                                    port={port}, rank_hint={rank_hint},
+                                    beat_ms=200)
+print("READY", port, rank, flush=True)
+time.sleep(600)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(tmp_path, tag: str, src: str, **fmt) -> subprocess.Popen:
+    script = tmp_path / f"{tag}.py"
+    script.write_text(src.format(repo=str(REPO), **fmt))
+    proc = subprocess.Popen([sys.executable, str(script)],
+                            stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    assert line.startswith("READY"), line
+    proc._ready = line.split()  # noqa: SLF001 - test-local stash
+    return proc
+
+
+@pytest.fixture
+def sched_and_servers(tmp_path):
+    sched_port = _free_port()
+    sched = _spawn(tmp_path, "sched", SCHED_SRC, port=sched_port)
+    servers = [_spawn(tmp_path, f"srv{i}", SERVER_SRC,
+                      sched_port=sched_port, port=0, rank_hint=-1)
+               for i in range(2)]
+    yield sched_port, servers, tmp_path
+    for p in [sched] + servers:
+        p.kill()
+        p.wait()
+
+
+def test_registration_assigns_ranks_and_map_lists_alive(sched_and_servers):
+    sched_port, servers, _ = sched_and_servers
+    ranks = sorted(int(p._ready[2]) for p in servers)
+    assert ranks == [0, 1]  # dynamic assignment, dense from 0
+    m = van.scheduler_map("127.0.0.1", sched_port)
+    assert len(m) == 2
+    assert all(e["alive"] for e in m)
+    assert sorted(e["rank"] for e in m) == [0, 1]
+    # advertised ports match what the servers actually bound
+    by_rank = {int(p._ready[2]): int(p._ready[1]) for p in servers}
+    for e in m:
+        assert e["port"] == by_rank[e["rank"]]
+
+
+def test_dead_server_goes_stale_in_map(sched_and_servers):
+    sched_port, servers, _ = sched_and_servers
+    dead_rank = int(servers[0]._ready[2])
+    servers[0].kill()
+    servers[0].wait()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        m = {e["rank"]: e for e in van.scheduler_map("127.0.0.1", sched_port)}
+        if not m[dead_rank]["alive"]:
+            break
+        time.sleep(0.3)
+    assert not m[dead_rank]["alive"], "dead server never marked stale"
+    other = 1 - dead_rank
+    assert m[other]["alive"]
+
+
+def test_group_via_scheduler_and_rejoin_at_new_port(sched_and_servers):
+    """The headline recovery contract: kill a server, restart it on a
+    DIFFERENT port (same rank), and the group recovers with NO client
+    reconfiguration — the shard re-resolves its endpoint from the
+    scheduler."""
+    sched_port, servers, tmp_path = sched_and_servers
+    t = van.PartitionedPSTable.from_scheduler(
+        "127.0.0.1", sched_port, 2, rows=10, dim=2, init="zeros",
+        optimizer="sgd", lr=1.0)
+    t.sparse_set(np.arange(10), np.ones((10, 2), np.float32))
+    np.testing.assert_allclose(t.sparse_pull(np.arange(10)), 1.0)
+
+    # find which subprocess serves rank 1 (owns global rows 5..9)
+    victim = next(p for p in servers if int(p._ready[2]) == 1)
+    victim.kill()
+    victim.wait()
+    with pytest.raises(RuntimeError):
+        t.sparse_pull([7])
+    np.testing.assert_allclose(t.sparse_pull([2]), 1.0)  # shard 0 fine
+
+    # rejoin on a NEW port with the same rank
+    new_port = _free_port()
+    servers.append(_spawn(tmp_path, "srv1b", SERVER_SRC,
+                          sched_port=sched_port, port=new_port, rank_hint=1))
+    assert int(servers[-1]._ready[1]) == new_port
+
+    deadline = time.time() + 20
+    got = None
+    while time.time() < deadline:
+        try:
+            got = t.sparse_pull([7])
+            break
+        except RuntimeError:
+            time.sleep(0.2)
+    assert got is not None, "group never recovered at the new endpoint"
+    np.testing.assert_allclose(got, 0.0)  # blank restart: fresh zeros
+    assert t.recovered >= 1
+    # writes flow to the new endpoint too
+    t.sparse_set([7], np.full((1, 2), 5.0, np.float32))
+    np.testing.assert_allclose(t.sparse_pull([7]), 5.0)
+    t.close()
+
+
+def test_remote_ssp_blocks_fast_worker(sched_and_servers):
+    """SSP clocks as a WIRE op: two clients of one van server share the
+    clock table; the fast worker times out while too far ahead and
+    proceeds once the slow one catches up (ssp_handler.h contract)."""
+    _, servers, _ = sched_and_servers
+    port = int(servers[0]._ready[1])
+    a = van.RemoteSSP("127.0.0.1", port, ssp_id=501, n_workers=2,
+                      staleness=1)
+    b = van.RemoteSSP("127.0.0.1", port, ssp_id=501, n_workers=2,
+                      staleness=1, create=True)  # -2 tolerated
+    assert a.clock_and_wait(0, timeout_ms=2000)   # w0 -> 1 (bound ok)
+    assert a.clock_and_wait(0, timeout_ms=200) is False  # w0 -> 2, ahead
+    assert b.clock_and_wait(1, timeout_ms=2000)   # w1 -> 1: gap now 1
+    assert a.clock(0) == 2 and b.clock(1) == 1
+    a.close()
+    b.close()
+
+
+def test_remote_preduce_forms_groups(sched_and_servers):
+    """Partial-reduce matchmaking as a wire op: two clients announcing
+    readiness are matched into one group mask."""
+    _, servers, _ = sched_and_servers
+    port = int(servers[0]._ready[1])
+    import threading
+    a = van.RemotePReduce("127.0.0.1", port, pool_id=601, max_group=2,
+                          wait_ms=5000)
+    b = van.RemotePReduce("127.0.0.1", port, pool_id=601, max_group=2,
+                          wait_ms=5000)
+    out = {}
+
+    def go(name, cli, wid):
+        out[name] = cli.get_partner(wid)
+
+    t1 = threading.Thread(target=go, args=("a", a, 0))
+    t2 = threading.Thread(target=go, args=("b", b, 3))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert out["a"] == out["b"] == [0, 3]
+    a.close()
+    b.close()
