@@ -177,12 +177,29 @@ std::string peer_host(int fd) {
 // wire-supplied hint must never size the entries vector unchecked.
 constexpr int kSchedMaxRanks = 64;
 
+// A beat from an endpoint that no longer owns its rank (another server
+// REGISTERed it since — takeover) is rejected with kRankLost; the beater
+// must stop advertising, which makes a duplicate-rank_hint misconfig
+// converge to one stable owner instead of flapping the slot between two
+// endpoints (each flap would misroute shard traffic to a blank table).
+constexpr int kRankLost = -7;
+
 // register/beat shared body: claim/refresh `rank` (or assign one), record
-// host:port + beat time.  Returns the rank, or -3 on an invalid hint / -6
-// when all rank slots are taken.
-int sched_register_locked(const std::string& host, int rank_hint, int port) {
+// host:port + beat time.  REGISTER with a rank_hint is an explicit claim
+// (the rejoin-at-new-address path) and may take over a live slot; BEAT
+// only refreshes a slot this endpoint still owns.  Returns the rank, or
+// -3 invalid hint / -6 slots full / kRankLost superseded beat.
+int sched_register_locked(const std::string& host, int rank_hint, int port,
+                          bool is_beat) {
   auto& es = g_sched.entries;
   if (rank_hint >= kSchedMaxRanks) return -3;  // wire-supplied: validate
+  if (is_beat) {
+    if (rank_hint < 0 || (size_t)rank_hint >= es.size()) return -3;
+    auto& e = es[rank_hint];
+    if (!e.ever || e.host != host || e.port != port) return kRankLost;
+    e.last_beat_ms = now_ms();
+    return rank_hint;
+  }
   int rank = rank_hint;
   if (rank < 0) {
     // first reusable slot: never-registered, or dead past TTL at the SAME
@@ -521,7 +538,8 @@ void handle_conn(int fd) {
         int32_t rank;
         {
           std::lock_guard<std::mutex> lk(g_sched.mu);
-          rank = sched_register_locked(host, rank_hint, port);
+          rank = sched_register_locked(host, rank_hint, port,
+                                       op == OP_SCHED_BEAT);
         }
         if (rank < 0) {
           send_resp(fd, rank, nullptr, 0);
@@ -998,6 +1016,13 @@ int ps_sched_beat_start(const char* sched_host, int sched_port,
       int r = fd >= 0 ? ps_van_sched_register(fd, bl->rank.load(),
                                               advertised_port, 1)
                       : kTransportErr;
+      if (r == -7) {
+        // kRankLost: another server took this rank over (explicit
+        // REGISTER wins).  Stop advertising — re-claiming would flap the
+        // slot and misroute clients between two live endpoints.
+        bl->rank = -7;
+        break;
+      }
       if (r < 0) {  // scheduler unreachable: reconnect + re-register
         if (fd >= 0) { ps_van_close(fd); fd = -1; }
         fd = ps_van_connect(host.c_str(), sched_port);

@@ -48,8 +48,20 @@ class HeteroGPT(GPTModel):
     layer loop differ.
     """
 
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, *,
+                 layer_remat: "tuple[bool, ...] | None" = None):
+        """``layer_remat``: per-transformer-layer activation-checkpoint
+        flags, normally taken from a searched Galvatron plan via
+        :func:`plan_block_remat` (reference per-layer ckpt flag,
+        tools/Galvatron/galvatron/core/hybrid_parallel_config.py:26-110).
+        The searcher prices remat per layer; this executes it, so the
+        memory the plan certified is the memory the compiled step uses."""
         super().__init__(config)
+        if layer_remat is not None and len(layer_remat) != config.num_layers:
+            raise ValueError(
+                f"layer_remat has {len(layer_remat)} flags for "
+                f"{config.num_layers} layers")
+        self.layer_remat = layer_remat
 
     def init(self, key):
         c = self.c
@@ -76,10 +88,17 @@ class HeteroGPT(GPTModel):
                             train=True)
         h = h.astype(c.dtype)
         for i in range(c.num_layers):
-            h, _ = self.block.apply({"params": p[f"layer{i}"], "state": {}},
-                                    h, train=train,
-                                    rng=None if rng is None else
-                                    jax.random.fold_in(rng, i))
+            lrng = None if rng is None else jax.random.fold_in(rng, i)
+
+            def block_fn(lp, hh, lr, _train=train):
+                return self.block.apply({"params": lp, "state": {}}, hh,
+                                        train=_train, rng=lr)[0]
+
+            if self.layer_remat is not None and self.layer_remat[i]:
+                # execute the plan's per-layer ckpt flag: activations of
+                # this layer are rematerialized in backward instead of held
+                block_fn = jax.checkpoint(block_fn)
+            h = block_fn(p[f"layer{i}"], h, lrng)
         return ops.layer_norm(h.astype(jnp.float32), p["ln_f_scale"],
                               p["ln_f_bias"])
 
@@ -89,6 +108,28 @@ class HeteroGPT(GPTModel):
 
 
 _LAYER_RE = re.compile(r"\['layer(\d+)'\]")
+
+
+def plan_block_remat(plan: Plan, num_layers: int) -> "tuple[bool, ...]":
+    """Fold a searched plan's per-LayerSpec remat flags into per-block
+    flags for :class:`HeteroGPT`.
+
+    The transformer_layer_specs chain is [embed, (attn_i, ffn_i)*, head];
+    a block checkpoints when the searcher flagged EITHER of its halves
+    (jax.checkpoint granularity is the block — the conservative rounding:
+    never less remat than the plan's memory certificate assumed).
+    Plans without remat metadata (non-Galvatron searchers) mean no remat.
+    """
+    flags = plan.meta.get("remat")
+    if not flags:
+        return tuple(False for _ in range(num_layers))
+    body = flags[1:-1]
+    if len(body) != 2 * num_layers:
+        raise ValueError(
+            f"plan has {len(body)} body remat flags for {num_layers} "
+            "transformer layers (expected attn+ffn per layer)")
+    return tuple(bool(body[2 * i] or body[2 * i + 1])
+                 for i in range(num_layers))
 
 
 def _add_dp_axis(spec: P, ndim: int) -> P:

@@ -130,3 +130,45 @@ class WideDeepDevice(Module):
             return (new_params, opt_state, {"net": new_state}, loss, logit)
 
         return jax.jit(step, donate_argnums=(0, 1)) if jit else step
+
+    def masked_step_fn(self, optimizer, *, jit: bool = True):
+        """Bucketed-padding train step (SURVEY §7 dynamic shapes).
+
+        Same update as :meth:`sparse_step_fn` but takes ``n_valid``: rows at
+        index >= n_valid are padding (dense zeros, ids == -1 per
+        data/bucketing.py) — their loss terms are masked out and their id
+        rows are dropped by the sparse optimizer (apply_indexed ignores
+        negative indices), so a padded batch steps IDENTICALLY to the
+        unpadded batch at its true size.  ``n_valid`` is traced (a scalar
+        input, not a static arg), so one compiled program serves every
+        occupancy of its bucket.
+        """
+        from hetu_tpu.ops.embedding import IndexedSlices
+
+        def step(params, opt_state, model_state, dense_x, sparse_ids,
+                 labels, n_valid):
+            B = dense_x.shape[0]
+            mask = (jnp.arange(B) < n_valid).astype(jnp.float32)
+            safe_ids = jnp.where(sparse_ids >= 0, sparse_ids, 0)
+            rows, _ = self.emb.apply(
+                {"params": params["emb"], "state": {}}, safe_ids)
+
+            def loss_fn(net_params, rows):
+                logit, new_state = self.dense_net.apply(
+                    {"params": net_params, "state": model_state["net"]},
+                    dense_x, rows, train=True)
+                per = ops.binary_cross_entropy_with_logits(logit, labels)
+                loss = jnp.sum(per * mask) / jnp.maximum(n_valid, 1)
+                return loss, (logit, new_state)
+
+            (loss, (logit, new_state)), (g_net, g_rows) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(params["net"], rows)
+            d = g_rows.shape[-1]
+            g_emb = {"weight": IndexedSlices(
+                sparse_ids.reshape(-1),  # padding keeps -1: dropped rows
+                g_rows.reshape(-1, d), (self.vocab_size, d))}
+            new_params, opt_state = optimizer.update(
+                {"emb": g_emb, "net": g_net}, opt_state, params)
+            return (new_params, opt_state, {"net": new_state}, loss, logit)
+
+        return jax.jit(step, donate_argnums=(0, 1)) if jit else step

@@ -232,15 +232,22 @@ class GPipeSearching:
 
 
 class PipeDreamSearching(GPipeSearching):
-    """PipeDream planner (reference pipedream.py:7): same stage partition,
-    1F1B steady-state cost = max-stage time (bubble amortized away), plus
-    weight-stash memory accounting in meta."""
+    """PipeDream planner (reference pipedream.py:7): same stage partition
+    and the SAME wall-clock price as GPipe — our 1F1B runtime is
+    SPMD-lockstep, so the bubble is masked compute under either schedule
+    and 1F1B's win is MEMORY (O(S) stashes vs O(M)), accounted in
+    meta['stash_bytes'].  The async steady state the reference's
+    pipedream_subexecutor approaches on independent devices is recorded as
+    meta['ideal_1f1b_time'] (a lower bound), never used for ranking."""
 
     def search(self, layers, options=None) -> Plan:
         plan = super().search(layers, options)
         stage_times = plan.meta["stage_times"]
-        steady = max(stage_times)  # per microbatch in steady state
-        plan.predicted_time = steady * self.M + sum(stage_times)
+        # predicted_time stays the parent's gpipe price — schedule='1f1b'
+        # is the identical lockstep formula (see Simulator.pipeline_time)
+        plan.meta["ideal_1f1b_time"] = self.sim.pipeline_time(
+            stage_times, self.M, layers[0].act_bytes,
+            schedule="ideal_1f1b")
         plan.meta["searcher"] = "pipedream"
         # weight stashing: a stage holds up to (S - stage_idx) weight versions
         S = len(stage_times)

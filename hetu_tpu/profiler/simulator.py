@@ -188,16 +188,37 @@ class Simulator:
                                    gspec.layers[p].act_bytes, dp)
         return t
 
-    # ---- pipeline (GPipe bubble model) ----
+    # ---- pipeline (bubble model per schedule) ----
     def pipeline_time(self, stage_times: Sequence[float],
-                      n_microbatches: int, act_bytes: float) -> float:
-        """max-stage * (M + S - 1)/M + p2p transfers (gpipe_subexecutor
-        schedule shape)."""
+                      n_microbatches: int, act_bytes: float,
+                      *, schedule: str = "gpipe") -> float:
+        """Wall-clock of a pipelined step.  ``stage_times``: FULL-batch
+        per-stage compute; per-microbatch stage time is stage_time / M.
+
+        schedule:
+          'gpipe' / '1f1b' — the SPMD lockstep executors
+            (parallel/pipeline.GPipe, parallel/pipedream.PipeDream1F1B):
+            every one of the (M + S - 1) ticks costs the max per-microbatch
+            stage time whether or not a stage holds real work (garbage
+            ticks are MASKED COMPUTE, not idle — all stages run in lockstep
+            between ppermutes), so both schedules pay the same
+            max_st * (M + S - 1) / M bubble.  1F1B buys MEMORY (O(S)
+            stashes vs GPipe's O(M)), not wall-clock.
+          'ideal_1f1b' — the asynchronous 1F1B steady state the reference's
+            pipedream_subexecutor approaches on independent devices:
+            fill sum(st)/M once, then (M-1) steady ticks of max(st)/M.
+            Lower bound; our lockstep runtimes do NOT achieve it.
+        """
         S = len(stage_times)
         M = max(n_microbatches, 1)
-        bubble = (max(stage_times) * (M + S - 1)) / M
+        if schedule in ("gpipe", "1f1b"):
+            compute = (max(stage_times) * (M + S - 1)) / M
+        elif schedule == "ideal_1f1b":
+            compute = sum(stage_times) / M + (M - 1) * max(stage_times) / M
+        else:
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
         xfer = (S - 1) * p2p_time(self.chip, act_bytes / M)
-        return bubble + xfer
+        return compute + xfer
 
     # ---- memory ----
     def layer_memory(self, layer: LayerSpec, opt: ShardOption, dp: int,

@@ -59,3 +59,101 @@ def test_wdl_hybrid_learns():
         losses.append(float(loss))
     assert losses[-1] < losses[0], (losses[0], losses[-1])
     assert emb.cache.hit_rate > 0  # cache tier active
+
+
+# ---- dynamic-shape bucketing (SURVEY §7; VERDICT r3 ask #7) ----
+
+def _wdl_fixture():
+    import jax
+    from hetu_tpu import optim
+    from hetu_tpu.models.wdl import WideDeepDevice
+
+    model = WideDeepDevice(vocab_size=1000, num_sparse_fields=5, emb_dim=4,
+                           dense_dim=8)
+    opt = optim.SGDOptimizer(0.1)
+    v = model.init(jax.random.PRNGKey(0))
+    ostate = opt.init_state(v["params"])
+    return model, opt, v, ostate
+
+
+def _batch(rng, n):
+    dx = rng.standard_normal((n, 8)).astype(np.float32)
+    ids = rng.integers(0, 1000, (n, 5)).astype(np.int32)
+    y = rng.integers(0, 2, n).astype(np.float32)
+    return dx, ids, y
+
+
+def test_bucketed_epoch_compiles_bounded_programs():
+    """A WDL epoch with varying batch sizes compiles at most
+    log2(max_batch)+1 distinct programs (asserted via the jit cache),
+    instead of one per distinct size."""
+    from hetu_tpu.data.bucketing import BucketedLoader
+
+    model, opt, v, ostate = _wdl_fixture()
+    step = model.masked_step_fn(opt, jit=True)
+    rng = np.random.default_rng(0)
+    sizes = [100, 64, 37, 128, 5, 128, 99, 12, 3, 77, 128, 50]
+    loader = BucketedLoader((_batch(rng, n) for n in sizes), max_batch=128)
+    params, mstate = v["params"], v["state"]
+    losses = []
+    for dx, ids, y, n_valid in loader:
+        params, ostate, mstate, loss, _ = step(
+            params, ostate, mstate, dx, ids, y, n_valid)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    n_programs = step._cache_size()
+    assert n_programs <= loader.max_distinct_shapes, (
+        n_programs, loader.max_distinct_shapes)
+    # the epoch saw 12 batches in 10 distinct sizes but compiled only one
+    # program per occupied bucket: {4, 8, 16, 64, 128}
+    assert n_programs == 5, n_programs
+
+
+def test_masked_step_equals_exact_step():
+    """A padded batch must step IDENTICALLY to the unpadded batch at its
+    true size: padding rows contribute no loss, no embedding-row updates,
+    and no optimizer-slot updates."""
+    from hetu_tpu.data.bucketing import pad_batch, pow2_bucket
+
+    model, opt, v, ostate = _wdl_fixture()
+    import jax
+    rng = np.random.default_rng(1)
+    dx, ids, y = _batch(rng, 37)
+
+    exact = model.sparse_step_fn(opt, jit=False)
+    p1, o1, m1, loss1, _ = exact(v["params"], ostate, v["state"], dx, ids, y)
+
+    bucket = pow2_bucket(37, 128)
+    assert bucket == 64
+    (pdx, pids, py), n_valid = pad_batch([dx, ids, y], bucket)
+    assert n_valid == 37 and (pids[37:] == -1).all()
+    masked = model.masked_step_fn(opt, jit=False)
+    v2 = model.init(jax.random.PRNGKey(0))
+    o2 = opt.init_state(v2["params"])
+    p2, o2, m2, loss2, _ = masked(v2["params"], o2, v2["state"], pdx, pids,
+                                  py, n_valid)
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6,
+                                   atol=1e-7)
+
+
+def test_bucketing_utilities():
+    from hetu_tpu.data.bucketing import BucketedLoader, pad_batch, pow2_bucket
+
+    assert pow2_bucket(1, 128) == 1
+    assert pow2_bucket(65, 128) == 128
+    assert pow2_bucket(128, 128) == 128
+    with pytest.raises(ValueError, match="exceeds"):
+        pow2_bucket(129, 128)
+    with pytest.raises(ValueError, match="positive"):
+        pow2_bucket(0, 128)
+    arrs, n = pad_batch([np.zeros((3, 2), np.float32),
+                         np.ones((3,), np.int64)], 8)
+    assert n == 3 and arrs[0].shape == (8, 2) and arrs[1].shape == (8,)
+    assert (arrs[1][3:] == -1).all() and (arrs[0][3:] == 0).all()
+    assert BucketedLoader([], 1024).max_distinct_shapes == 11
+    # non-power-of-two max: the cap itself is one extra distinct shape
+    assert BucketedLoader([], 100).max_distinct_shapes == 8
+    assert pow2_bucket(65, 100) == 100

@@ -154,6 +154,30 @@ def test_group_via_scheduler_and_rejoin_at_new_port(sched_and_servers):
     t.close()
 
 
+def test_rank_takeover_converges_no_flap(sched_and_servers):
+    """An explicit REGISTER with a live rank's hint takes the slot over
+    (rejoin semantics); the superseded server's next BEAT gets kRankLost
+    and stops advertising — the map converges to ONE stable owner instead
+    of flapping between two endpoints (review finding r4)."""
+    sched_port, servers, tmp_path = sched_and_servers
+    old = next(p for p in servers if int(p._ready[2]) == 0)
+    new_port = _free_port()
+    servers.append(_spawn(tmp_path, "srv0b", SERVER_SRC,
+                          sched_port=sched_port, port=new_port, rank_hint=0))
+    # old server (beat_ms=200) must observe kRankLost and go silent;
+    # after several beat intervals the map must STABLY show the new owner
+    time.sleep(1.5)
+    seen = set()
+    for _ in range(4):
+        m = {e["rank"]: e for e in van.scheduler_map("127.0.0.1",
+                                                     sched_port)}
+        seen.add(m[0]["port"])
+        time.sleep(0.3)
+    assert seen == {new_port}, (seen, new_port)
+    assert m[0]["alive"]
+    del old  # still running, but no longer advertised — exactly the point
+
+
 def test_remote_ssp_blocks_fast_worker(sched_and_servers):
     """SSP clocks as a WIRE op: two clients of one van server share the
     clock table; the fast worker times out while too far ahead and

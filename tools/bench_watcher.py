@@ -29,7 +29,8 @@ CMDS = ["gpt", "resnet", "ctr", "moe"]
 
 PROBE_TIMEOUT_S = 75.0
 POLL_S = 60.0
-BENCH_TIMEOUT_S = 1800.0  # first compile over a tunnel is slow
+BENCH_TIMEOUT_S = 2700.0  # first compile over a tunnel is slow, and every
+# bench now measures its A/B baseline variant too (two compiles each)
 
 
 def log(msg: str) -> None:
