@@ -7,9 +7,10 @@ writing the wire format directly via `hetu_tpu.onnx.proto` (no `onnx`
 package in this environment).
 
 Weights (jaxpr consts) become graph initializers, as ONNX stores them.
-pjit / custom_jvp / closed_call sub-jaxprs are inlined; `scan` is rejected
-with a pointer at the per-layer model variants (HeteroGPT) whose traces are
-flat.  Target opset 13.
+pjit / custom_jvp / closed_call sub-jaxprs are inlined; `scan` (RNNs,
+scan-stacked layers) is UNROLLED — the static trip count is in the jaxpr,
+and the unrolled form round-trips through any consumer without Loop/Scan
+subgraph support (size-capped; see _unroll_scan).  Target opset 13.
 """
 
 from __future__ import annotations
@@ -210,6 +211,9 @@ def _emit_eqn(ctx: _Ctx, eqn, ins, outs):
         ctx.emit("Squeeze", [ins[0], axes], outs)
     elif prim == "concatenate":
         ctx.emit("Concat", ins, outs, {"axis": int(p["dimension"])})
+    elif prim == "split":
+        st = ctx.init_tensor(np.asarray(p["sizes"], np.int64), "split")
+        ctx.emit("Split", [ins[0], st], outs, {"axis": int(p["axis"])})
     elif prim == "slice":
         if p.get("strides") and any(s != 1 for s in p["strides"]):
             steps = list(p["strides"])
@@ -257,10 +261,7 @@ def _emit_eqn(ctx: _Ctx, eqn, ins, outs):
         dt = P.NP_TO_ONNX[np.dtype(p["index_dtype"])]
         ctx.emit("Cast", [mid], outs, {"to": dt})
     else:
-        raise ValueError(
-            f"ONNX export: unsupported primitive '{prim}'"
-            + (" — scan-stacked models can't flatten; export the per-layer"
-               " variant (e.g. HeteroGPT)" if prim == "scan" else ""))
+        raise ValueError(f"ONNX export: unsupported primitive '{prim}'")
 
 
 def _emit_gather(ctx, eqn, ins, outs):
@@ -297,30 +298,136 @@ def _emit_gather(ctx, eqn, ins, outs):
     ctx.emit("Gather", [ins[0], idx_in], outs, {"axis": int(axis)})
 
 
-def _flat_eqns(jaxpr, ctx, env):
-    """Yield eqns with pjit/custom_jvp/closed_call sub-jaxprs inlined
-    (env maps var id -> onnx name; sub-jaxpr vars get bridged)."""
+_UNROLL_NODE_CAP = 20_000  # unrolled-scan size guard (nodes)
+
+_CALL_PRIMS = ("pjit", "jit", "closed_call", "custom_jvp_call",
+               "custom_vjp_call", "custom_vjp_call_jaxpr",
+               "remat", "checkpoint")
+
+
+def _est_nodes(jaxpr) -> int:
+    """Recursive node-count estimate for the unroll cap: nested scans
+    multiply by their trip count and call sub-jaxprs count at their true
+    size, so a scan-of-scans cannot sneak under the guard as one eqn."""
+    total = 0
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
-        if prim in ("pjit", "jit", "closed_call", "custom_jvp_call",
-                    "custom_vjp_call", "custom_vjp_call_jaxpr",
-                    "remat", "checkpoint"):
+        if prim == "scan":
+            inner = getattr(eqn.params["jaxpr"], "jaxpr",
+                            eqn.params["jaxpr"])
+            total += int(eqn.params["length"]) * max(1, _est_nodes(inner))
+        elif prim in _CALL_PRIMS:
+            sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") \
+                or eqn.params.get("fun_jaxpr")
+            if sub is not None:
+                total += max(1, _est_nodes(getattr(sub, "jaxpr", sub)))
+            else:
+                total += 1
+        else:
+            total += 1
+    return total
+
+
+def _unroll_scan(ctx, env, eqn):
+    """Inline a lax.scan by unrolling its body `length` times (static trip
+    count — jax guarantees it).  Reference round-trips RNNs through ONNX
+    (tests/onnx); the unrolled form is the most portable encoding (no Loop/
+    Scan subgraph support required of the consumer) at the cost of model
+    size, hence the node cap.  xs are sliced per step with a scalar Gather
+    (drops axis 0), ys re-stacked with Unsqueeze+Concat; `reverse` scans
+    iterate back-to-front but ys keep index order (lax semantics).
+    """
+    p = eqn.params
+    closed = p["jaxpr"]
+    inner = getattr(closed, "jaxpr", closed)
+    nc, nk = p["num_consts"], p["num_carry"]
+    length, reverse = int(p["length"]), bool(p["reverse"])
+    est = length * max(1, _est_nodes(inner))
+    if est > _UNROLL_NODE_CAP:
+        raise ValueError(
+            f"ONNX export: scan unroll would emit ~{est} nodes "
+            f"(cap {_UNROLL_NODE_CAP}); shorten the sequence for export or "
+            "export the per-layer variant (e.g. HeteroGPT)")
+    const_names = [_name_of(ctx, env, v) for v in eqn.invars[:nc]]
+    carries = [_name_of(ctx, env, v) for v in eqn.invars[nc:nc + nk]]
+    xs_names = [_name_of(ctx, env, v) for v in eqn.invars[nc + nk:]]
+    n_ys = len(inner.outvars) - nk
+    ys_names: List[List] = [[] for _ in range(n_ys)]
+    ax0 = ctx.init_tensor(np.asarray([0], np.int64), "axes")
+    order = range(length - 1, -1, -1) if reverse else range(length)
+    for t in order:
+        # each iteration gets a FRESH env: the body's internal vars (same
+        # jaxpr objects every iteration) must resolve to fresh node names,
+        # or all iterations would write the same outputs
+        body_env: Dict[int, str] = {}
+        for iv, nm in zip(inner.invars[:nc], const_names):
+            body_env[id(iv)] = nm
+        for iv, cname in zip(inner.invars[nc:nc + nk], carries):
+            body_env[id(iv)] = cname
+        for iv, xname in zip(inner.invars[nc + nk:], xs_names):
+            # 1-D index + Squeeze (not a 0-d index: scalar TensorProtos
+            # don't survive every codec; [t] then squeeze is equivalent)
+            idx = ctx.init_literal(np.asarray([t], np.int64))
+            gat = ctx.fresh("xg")
+            ctx.emit("Gather", [xname, idx], [gat], {"axis": 0})
+            sl = ctx.fresh("xt")
+            ctx.emit("Squeeze", [gat, ax0], [sl])
+            body_env[id(iv)] = sl
+        for cv, c in zip(inner.constvars, getattr(closed, "consts", [])):
+            body_env[id(cv)] = ctx.init_literal(np.asarray(c))
+        _emit_jaxpr(inner, ctx, body_env)
+        carries = [_name_of(ctx, body_env, ov)
+                   for ov in inner.outvars[:nk]]
+        for j, ov in enumerate(inner.outvars[nk:]):
+            ys_names[j].append((t, _name_of(ctx, body_env, ov)))
+    for souter, name in zip(eqn.outvars[:nk], carries):
+        env[id(souter)] = name
+    if n_ys:
+        for j, pairs in enumerate(ys_names):
+            pairs.sort()  # ys keep index order even for reverse scans
+            stacked = []
+            for _, nm in pairs:
+                u = ctx.fresh("yt")
+                ctx.emit("Unsqueeze", [nm, ax0], [u])
+                stacked.append(u)
+            out_name = _name_of(ctx, env, eqn.outvars[nk + j])
+            if len(stacked) == 1:
+                ctx.emit("Identity", stacked, [out_name])
+            else:
+                ctx.emit("Concat", stacked, [out_name], {"axis": 0})
+
+
+def _emit_jaxpr(jaxpr, ctx, env):
+    """Emit every eqn of `jaxpr`: pjit/custom_jvp/closed_call sub-jaxprs
+    are inlined with a FRESH scoped env per call site (jax caches traces,
+    so two calls of one jitted helper share the same sub-jaxpr objects — a
+    shared env would make the second call overwrite the first call's
+    output names and silently miscompute), and scan bodies are unrolled
+    the same way (fresh env per iteration)."""
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim == "scan":
+            _unroll_scan(ctx, env, eqn)
+            continue
+        if prim in _CALL_PRIMS:
             sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") \
                 or eqn.params.get("fun_jaxpr")
             if sub is None:
                 raise ValueError(f"ONNX export: opaque call '{prim}'")
             consts = getattr(sub, "consts", [])
             inner = getattr(sub, "jaxpr", sub)
-            # bind actual args to sub invars
+            sub_env: Dict[int, str] = {}
             for iv, ov in zip(inner.invars, eqn.invars):
-                env[id(iv)] = _name_of(ctx, env, ov)
+                sub_env[id(iv)] = _name_of(ctx, env, ov)
             for cv, c in zip(inner.constvars, consts):
-                env[id(cv)] = ctx.init_tensor(np.asarray(c), "w")
-            yield from _flat_eqns(inner, ctx, env)
+                sub_env[id(cv)] = ctx.init_tensor(np.asarray(c), "w")
+            _emit_jaxpr(inner, ctx, sub_env)
             for souter, sinner in zip(eqn.outvars, inner.outvars):
-                env[id(souter)] = _name_of(ctx, env, sinner)
-        else:
-            yield eqn
+                env[id(souter)] = _name_of(ctx, sub_env, sinner)
+            continue
+        ins = [_name_of(ctx, env, v) for v in eqn.invars]
+        outs = [_name_of(ctx, env, v) for v in eqn.outvars]
+        _emit_eqn(ctx, eqn, ins, outs)
 
 
 def _name_of(ctx, env, var):
@@ -339,6 +446,19 @@ def jaxpr_to_onnx(fn, *example_args, graph_name="hetu_tpu") -> bytes:
 
     closed = jax.make_jaxpr(fn)(*example_args)
     jaxpr = closed.jaxpr
+    try:
+        # make_jaxpr does not DCE: inference traces often carry dead
+        # training-only machinery (threaded-but-unused PRNG keys inside
+        # scan bodies, etc.) whose primitives have no ONNX lowering.
+        # dce_jaxpr prunes them — including inside scan params.
+        from jax._src.interpreters.partial_eval import dce_jaxpr
+
+        # instantiate=True keeps ALL invars so the ONNX graph signature
+        # still matches example_args even when an arg is unused
+        jaxpr, _ = dce_jaxpr(jaxpr, [True] * len(jaxpr.outvars),
+                             instantiate=True)
+    except Exception:
+        pass  # private API moved: export the un-DCE'd jaxpr as before
     ctx = _Ctx()
     env: Dict[int, str] = {}
 
@@ -353,10 +473,7 @@ def jaxpr_to_onnx(fn, *example_args, graph_name="hetu_tpu") -> bytes:
     for cv, c in zip(jaxpr.constvars, closed.consts):
         env[id(cv)] = ctx.init_tensor(np.asarray(c), "w")
 
-    for eqn in _flat_eqns(jaxpr, ctx, env):
-        ins = [_name_of(ctx, env, v) for v in eqn.invars]
-        outs = [_name_of(ctx, env, v) for v in eqn.outvars]
-        _emit_eqn(ctx, eqn, ins, outs)
+    _emit_jaxpr(jaxpr, ctx, env)
 
     graph_outputs = []
     for v in jaxpr.outvars:

@@ -188,6 +188,13 @@ _OPS = {
         i[0], tuple(int(a) for a in np.asarray(i[1]).ravel())
         if len(i) > 1 else tuple(n["attrs"]["axes"])),
     "Concat": lambda n, i: jnp.concatenate(i, axis=n["attrs"]["axis"]),
+    "Split": lambda n, i: tuple(jnp.split(
+        i[0],
+        (np.cumsum(np.asarray(
+            i[1] if len(i) > 1 else n["attrs"]["split"]))[:-1].tolist()
+         if len(i) > 1 or "split" in n["attrs"]
+         else len(n["outputs"])),  # neither form: equal sectioning
+        axis=n["attrs"].get("axis", 0))),
     "Cast": lambda n, i: i[0].astype(P.ONNX_TO_NP[n["attrs"]["to"]]),
     "Where": lambda n, i: jnp.where(i[0].astype(bool), i[1], i[2]),
     "Gather": lambda n, i: _gather(n, i),
