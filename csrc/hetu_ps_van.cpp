@@ -52,6 +52,7 @@ int ps_sparse_push(int id, const int64_t* idx, const float* grads, int64_t n);
 int ps_sparse_set(int id, const int64_t* idx, const float* vals, int64_t n);
 int ps_table_save(int id, const char* path);
 int ps_table_load(int id, const char* path);
+int ps_table_clear(int id);
 int64_t ps_sync_pull(int id, const int64_t* idx, const uint64_t* cached_ver,
                      int64_t n, uint64_t bound, uint32_t* sel_out,
                      uint64_t* vers_out, float* rows_out);
@@ -83,6 +84,9 @@ enum VanOp : uint8_t {
   // scheduler / node-management role (reference ps-lite/src/postoffice.cc):
   // dynamic server registration, liveness via beats, endpoint-map queries
   OP_SCHED_REGISTER = 19, OP_SCHED_MAP = 20, OP_SCHED_BEAT = 21,
+  // table lifecycle: zero a table in place (ParamClear analog) — reusable
+  // accumulators instead of per-step table leaks
+  OP_CLEAR = 22,
 };
 
 // Per-table bounded set of recently applied push request-ids.  A repeated
@@ -283,7 +287,7 @@ void handle_conn(int fd) {
     // frames BEFORE any rd<> touches the body (overread-proof)
     static const uint32_t kMinBody[] = {
         0, 48, 28, 4, 4, 13, 12, 12, 8, 8, 0, 12, 20,
-        20, 36, 12, 12, 8, 16, 8, 0, 8};
+        20, 36, 12, 12, 8, 16, 8, 0, 8, 4};
     if (op < sizeof(kMinBody) / sizeof(uint32_t) &&
         blen < 1 + kMinBody[op]) {
       send_resp(fd, -3, nullptr, 0);
@@ -546,6 +550,11 @@ void handle_conn(int fd) {
           break;
         }
         send_resp(fd, 0, &rank, sizeof(rank));
+        break;
+      }
+      case OP_CLEAR: {
+        int id = rd<int32_t>(p);
+        send_resp(fd, ps_table_clear(id), nullptr, 0);
         break;
       }
       case OP_SCHED_MAP: {
@@ -830,6 +839,13 @@ static int van_file_op(uint8_t op, int fd, int id, const char* path) {
   size_t o = b.size();
   b.resize(o + plen);
   std::memcpy(b.data() + o, path, plen);
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
+}
+
+int ps_van_table_clear(int fd, int id) {
+  std::vector<char> b{(char)OP_CLEAR}, pay;
+  put<int32_t>(b, id);
   int32_t rc = kTransportErr;
   return request(fd, b, &rc, &pay) ? rc : kTransportErr;
 }

@@ -348,6 +348,13 @@ def _unroll_scan(ctx, env, eqn):
             f"ONNX export: scan unroll would emit ~{est} nodes "
             f"(cap {_UNROLL_NODE_CAP}); shorten the sequence for export or "
             "export the per-layer variant (e.g. HeteroGPT)")
+    if length == 0 and len(inner.outvars) > nk:
+        # ys would need a zero-input Concat — invalid ONNX.  A 0-length
+        # scan in an exported model is a degenerate trace; reject loudly.
+        raise ValueError(
+            "ONNX export: cannot unroll a length-0 scan with scan outputs "
+            "(the empty ys has no ONNX encoding); trace with a non-empty "
+            "sequence")
     const_names = [_name_of(ctx, env, v) for v in eqn.invars[:nc]]
     carries = [_name_of(ctx, env, v) for v in eqn.invars[nc:nc + nk]]
     xs_names = [_name_of(ctx, env, v) for v in eqn.invars[nc + nk:]]

@@ -37,39 +37,37 @@ def round_robin_assignments(n_microbatches: int, n_src: int,
 
 
 class VanMailbox:
-    """One-way single-slot channel over a PS van table.
+    """One-way ACKED channel over a PS van table.
 
-    Layout: rows [0, capacity) hold the payload, row `capacity` holds the
-    sequence flag.  `put` writes payload THEN flag; `get` polls the flag —
-    the van server applies one connection's requests in order, so the
-    reader observing seq implies the payload is complete.  A fresh `seq`
-    per message makes the channel reusable (ping-pong for fwd/bwd).
-
-    At most ONE message may be outstanding per channel: there is no reader
-    ack, so a second `put` can overwrite the payload between the reader's
-    flag poll and its (separate) payload pull, tearing the data.  Callers
-    must externally order put(seq=n+1) after the consumer of seq=n has
-    returned (the pipeline schedules here use one channel per microbatch
-    or strict ping-pong, which satisfies this).
+    Layout: rows [0, capacity) hold the payload, row `capacity` the
+    sender's sequence flag, row `capacity + 1` the reader's ack flag.
+    `put` first waits until the previous message is acked (flag == ack),
+    then writes payload THEN flag; `get` polls the flag, pulls the
+    payload, and writes the ack.  The van server applies one connection's
+    requests in order, so the reader observing seq implies the payload is
+    complete — and the ack makes back-to-back `put`s safe: a second
+    message can never overwrite a payload the reader is still pulling
+    (round 3's single-slot caveat is gone; senders just block).
     """
 
     def __init__(self, host: str, port: int, channel_id: int,
                  capacity: int, *, connect_timeout_s: float = 20.0):
         from hetu_tpu.ps.van import RemotePSTable
         self.capacity = capacity
+        self._last_seq = 0
         deadline = time.time() + connect_timeout_s
         # both endpoints race to create; -2 (exists) means the peer won
         while True:
             try:
                 self.table = RemotePSTable(
-                    host, port, capacity + 1, 1, table_id=channel_id,
+                    host, port, capacity + 2, 1, table_id=channel_id,
                     create=True, init="zeros",
                     connect_timeout_s=connect_timeout_s)
                 break
             except RuntimeError:
                 try:
                     self.table = RemotePSTable(
-                        host, port, capacity + 1, 1, table_id=channel_id,
+                        host, port, capacity + 2, 1, table_id=channel_id,
                         create=False,
                         connect_timeout_s=connect_timeout_s)
                     break
@@ -78,14 +76,28 @@ class VanMailbox:
                         raise
                     time.sleep(0.05)
 
-    def put(self, arr, seq: int) -> None:
+    def _flag(self, row: int) -> float:
+        return float(self.table.sparse_pull([row])[0, 0])
+
+    def put(self, arr, seq: int, *, timeout_s: float = 60.0,
+            poll_s: float = 0.002) -> None:
         flat = np.ascontiguousarray(arr, np.float32).ravel()
         if flat.size > self.capacity:
             raise ValueError(f"message {flat.size} > capacity "
                              f"{self.capacity}")
+        deadline = time.time() + timeout_s
+        # wait for the reader's ack of the previous message
+        while self._last_seq and \
+                int(self._flag(self.capacity + 1)) != self._last_seq:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"mailbox: ack of seq {self._last_seq} not observed "
+                    f"within {timeout_s}s")
+            time.sleep(poll_s)
         self.table.sparse_set(np.arange(flat.size), flat.reshape(-1, 1))
         self.table.sparse_set([self.capacity],
                               np.asarray([[float(seq)]], np.float32))
+        self._last_seq = seq
 
     def get(self, shape, seq: int, *, timeout_s: float = 60.0,
             poll_s: float = 0.002) -> np.ndarray:
@@ -93,11 +105,14 @@ class VanMailbox:
         deadline = time.time() + timeout_s
         while True:
             try:
-                flag = float(self.table.sparse_pull([self.capacity])[0, 0])
+                flag = self._flag(self.capacity)
             except RuntimeError:
                 flag = None  # table not created yet / transient
             if flag is not None and int(flag) == seq:
                 data = self.table.sparse_pull(np.arange(n))
+                self.table.sparse_set(
+                    [self.capacity + 1],
+                    np.asarray([[float(seq)]], np.float32))
                 return data.ravel().reshape(shape)
             if time.time() > deadline:
                 raise TimeoutError(
@@ -107,3 +122,194 @@ class VanMailbox:
 
     def close(self) -> None:
         self.table.close()
+
+
+class MPMDStageRunner:
+    """General N-stage, unequal-DP MPMD pipeline worker (reference
+    pipeline_subexecutor.py:87-128 + context.py:164-188 round-robin
+    machinery, generalized from round 3's 2-stage prototype).
+
+    Each PROCESS runs one (stage, replica) pair of a pipeline whose stage
+    s has ``stage_dps[s]`` data-parallel replicas.  Microbatch i is
+    produced by stage-s replica ``i % stage_dps[s]`` and consumed by
+    stage-(s+1) replica ``i % stage_dps[s+1]`` — activations and
+    cotangents hop processes through acked :class:`VanMailbox` channels on
+    a shared van server; cross-replica gradient reduction rides a PS
+    accumulator table with a preduce barrier (the PS-DP path).
+
+    ``run_step(params, loss_fn, data=...)`` executes one GPipe-flush
+    fwd+bwd over all M microbatches and returns
+    ``(loss_sum_of_my_microbatches, param_grads)`` where grads are the
+    stage's microbatch-mean, already reduced across its replicas.
+    """
+
+    def __init__(self, stage_fn, *, stage: int, replica: int,
+                 stage_dps: List[int], n_microbatches: int,
+                 in_shape, out_shape, host: str, port: int,
+                 base_channel: int = 5_000_000, grad_size: int,
+                 worker_uid: int | None = None):
+        import jax
+
+        self.fn = stage_fn
+        self.stage, self.replica = stage, replica
+        self.dps = list(stage_dps)
+        self.S = len(stage_dps)
+        self.M = n_microbatches
+        self.in_shape, self.out_shape = tuple(in_shape), tuple(out_shape)
+        self.host, self.port = host, port
+        self.base = base_channel
+        self.grad_size = grad_size
+        self._jax = jax
+        self._mail: dict = {}
+        self._seq: dict = {}
+        self._step = 0  # salts the per-step grad-accumulator table id
+        # unique preduce worker id across ALL processes of this pipeline
+        self.uid = worker_uid if worker_uid is not None else \
+            sum(self.dps[:stage]) + replica
+
+    # channel id for edge (s -> s+1), sender replica a, receiver replica b;
+    # backward cotangents use the mirrored id space
+    def _chan(self, edge: int, a: int, b: int, backward: bool):
+        key = (edge, a, b, backward)
+        if key not in self._mail:
+            cid = (self.base + edge * (1 << 14) + a * (1 << 7) + b
+                   + ((1 << 22) if backward else 0))
+            # edge e's messages (activations forward, cotangents backward)
+            # have stage e's output size — which is my out_shape on my
+            # downstream edge and my in_shape on my upstream edge
+            cap = int(np.prod(self.out_shape)) if edge == self.stage \
+                else int(np.prod(self.in_shape))
+            self._mail[key] = VanMailbox(self.host, self.port, cid, cap)
+            self._seq[key] = 0
+        return self._mail[key]
+
+    def _next_seq(self, edge, a, b, backward):
+        key = (edge, a, b, backward)
+        self._seq[key] += 1
+        return self._seq[key]
+
+    def _my_microbatches(self):
+        return [m for m in range(self.M)
+                if m % self.dps[self.stage] == self.replica]
+
+    def _grad_plumbing(self):
+        """One REUSABLE accumulator table + preduce barrier pool for this
+        stage, created lazily on the first reducing step (preduce pools
+        match successive rounds natively; the table is cleared in place
+        between steps — per-step table ids would leak server memory)."""
+        if getattr(self, "_acc", None) is not None:
+            return self._acc, self._barrier_cli
+        from hetu_tpu.ps.van import RemotePReduce, RemotePSTable
+        tid = self.base + (1 << 23) + self.stage
+        if self.replica == 0:
+            self._acc = RemotePSTable(self.host, self.port, self.grad_size,
+                                      1, table_id=tid, create=True,
+                                      init="zeros", optimizer="sgd",
+                                      lr=-1.0)  # push == add
+        else:
+            # wait until replica 0 created it (connecting with
+            # create=False never probes; a 1-row pull does)
+            self._acc = RemotePSTable(self.host, self.port, self.grad_size,
+                                      1, table_id=tid, create=False)
+            deadline = time.time() + 20
+            while True:
+                try:
+                    self._acc.sparse_pull([0])
+                    break
+                except RuntimeError:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.05)
+        self._barrier_cli = RemotePReduce(
+            self.host, self.port,
+            pool_id=self.base + (1 << 23) + 64 + self.stage,
+            max_group=self.dps[self.stage], wait_ms=60_000)
+        return self._acc, self._barrier_cli
+
+    def _barrier(self, cli):
+        group = cli.get_partner(self.uid)
+        assert len(group) == self.dps[self.stage], group
+
+    def run_step(self, params, *, loss_fn=None, data=None):
+        """One fwd+bwd over all microbatches this replica owns.
+
+        data: stage 0 only — list of per-microbatch inputs indexed by
+        GLOBAL microbatch id (entries for other replicas may be None).
+        loss_fn: last stage only — scalar loss on one microbatch's output;
+        the step optimizes mean-over-all-microbatches loss.
+        """
+        jax = self._jax
+        s, dps = self.stage, self.dps
+        first, last = s == 0, s == self.S - 1
+        vjps, losses = {}, {}
+        # ---- forward (microbatch order; per-channel seqs stay aligned
+        # because both endpoints walk their shared microbatches in order)
+        for m in self._my_microbatches():
+            if first:
+                x = np.asarray(data[m], np.float32)
+            else:
+                src = m % dps[s - 1]
+                ch = self._chan(s - 1, src, self.replica, False)
+                x = ch.get(self.in_shape,
+                           self._next_seq(s - 1, src, self.replica, False))
+            y, vjp = jax.vjp(lambda p, xx: self.fn(p, xx), params,
+                             jax.numpy.asarray(x))
+            vjps[m] = vjp
+            if last:
+                loss, gy = jax.value_and_grad(loss_fn)(y)
+                losses[m] = float(loss)
+                vjps[m] = (vjp, gy)
+            else:
+                dst = m % dps[s + 1]
+                ch = self._chan(s, self.replica, dst, False)
+                ch.put(np.asarray(y),
+                       self._next_seq(s, self.replica, dst, False))
+        # ---- backward (same order: flush schedule)
+        gsum = None
+        for m in self._my_microbatches():
+            if last:
+                vjp, gy = vjps[m]
+            else:
+                vjp = vjps[m]
+                dst = m % dps[s + 1]
+                ch = self._chan(s, self.replica, dst, True)
+                gy = ch.get(self.out_shape,
+                            self._next_seq(s, self.replica, dst, True))
+            gp, gx = vjp(jax.numpy.asarray(np.asarray(gy, np.float32)))
+            if not first:
+                src = m % dps[s - 1]
+                ch = self._chan(s - 1, src, self.replica, True)
+                ch.put(np.asarray(gx),
+                       self._next_seq(s - 1, src, self.replica, True))
+            gsum = gp if gsum is None else jax.tree_util.tree_map(
+                lambda a, b: a + b, gsum, gp)
+        # ---- cross-replica grad reduction: PS accumulator + barrier
+        leaves, treedef = jax.tree_util.tree_flatten(gsum)
+        flat = np.concatenate([np.asarray(g, np.float32).ravel()
+                               for g in leaves]) if leaves else \
+            np.zeros(0, np.float32)
+        self._step += 1
+        if dps[s] > 1:
+            acc, barrier = self._grad_plumbing()
+            acc.sparse_push(np.arange(flat.size), flat.reshape(-1, 1))
+            self._barrier(barrier)   # all replicas pushed
+            flat = acc.sparse_pull(np.arange(flat.size)).ravel()
+            self._barrier(barrier)   # all replicas pulled the sum
+            if self.replica == 0:
+                acc.clear()          # reuse next step: no per-step tables
+            self._barrier(barrier)   # clear landed before anyone re-pushes
+        flat /= self.M  # mean over the GLOBAL microbatch count
+        out, off = [], 0
+        for g in leaves:
+            n = int(np.prod(np.asarray(g).shape))
+            out.append(flat[off:off + n].reshape(np.asarray(g).shape))
+            off += n
+        grads = jax.tree_util.tree_unflatten(treedef, out)
+        return sum(losses.values()), grads
+
+    def close(self):
+        for mb in self._mail.values():
+            mb.close()
+        if getattr(self, "_acc", None) is not None:
+            self._acc.close()
+            self._barrier_cli.close()

@@ -112,6 +112,7 @@ def _load():
             "ps_van_sparse_push_id": ([c.c_int, c.c_int, i64p, f32p,
                                        c.c_int64, c.c_int64, c.c_uint64],
                                       c.c_int),
+            "ps_van_table_clear": ([c.c_int, c.c_int], c.c_int),
             "ps_van_table_save": ([c.c_int, c.c_int, c.c_char_p], c.c_int),
             "ps_van_table_load": ([c.c_int, c.c_int, c.c_char_p], c.c_int),
             # partitioned multi-server group (csrc/hetu_ps_group.cpp)

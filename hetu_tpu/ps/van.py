@@ -173,6 +173,12 @@ class RemotePSTable:
                                      idx.shape[0], self.dim),
                "van_sparse_set")
 
+    def clear(self) -> None:
+        """Zero the table in place (ParamClear analog); bumps versions so
+        caches re-pull.  Reusable accumulators clear between steps instead
+        of leaking per-step tables on the server."""
+        _check(lib.ps_van_table_clear(self.fd, self.id), "van_table_clear")
+
     def save(self, path) -> None:
         _check(lib.ps_van_table_save(self.fd, self.id, str(path).encode()),
                "van_table_save")

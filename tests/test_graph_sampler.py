@@ -173,3 +173,98 @@ def test_distributed_sampling_trains_gcn():
         for pr in procs:
             pr.kill()
             pr.wait()
+
+
+WORKER_SRC = """
+import sys
+sys.path.insert(0, {repo!r})
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from hetu_tpu.data.graph_sampler import DistGraph, NeighborSampler
+from hetu_tpu.ps import van
+
+wid = int(sys.argv[1])
+eps = {eps!r}
+tables = {{}}
+for i, tag in enumerate(("adj", "feat", "label")):
+    dims = {{"adj": 11, "feat": 8, "label": 1}}[tag]
+    tables[tag] = van.PartitionedPSTable(eps, {n}, dims, init="zeros",
+                                         table_id=9200 + i)
+g = DistGraph(tables["adj"], tables["feat"], tables["label"], max_degree=10)
+sampler = NeighborSampler(g, seed=10 + wid)
+rng = np.random.default_rng(wid)
+all_src, all_dst = [], []
+for _ in range(5):
+    seeds = rng.integers(0, {n}, 6)
+    batch = sampler.sample(seeds, fanouts=(4, 3))
+    assert batch.features.shape[1] == 8
+    # relabel back to GLOBAL ids and record the sampled edges
+    all_src.append(batch.nodes[batch.edge_src])
+    all_dst.append(batch.nodes[batch.edge_dst])
+np.savez({out!r}, src=np.concatenate(all_src), dst=np.concatenate(all_dst))
+print("OK", flush=True)
+"""
+
+
+def test_two_workers_sample_same_distributed_graph(tmp_path):
+    """TWO worker processes sample concurrently from one graph partitioned
+    over TWO server processes (the full GraphMix deployment: sampling tier
+    multi-server AND multi-client); every sampled edge is a real edge of
+    the published graph."""
+    from hetu_tpu.ps import van
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    ports = [free_port(), free_port()]
+    procs = []
+    for p in ports:
+        code = (f"import sys,time; sys.path.insert(0,{str(REPO)!r}); "
+                f"from hetu_tpu.ps import van; van.serve({p}); "
+                "print('R',flush=True); time.sleep(300)")
+        pr = subprocess.Popen([sys.executable, "-c", code],
+                              stdout=subprocess.PIPE, text=True)
+        pr.stdout.readline()
+        procs.append(pr)
+    workers = []
+    try:
+        eps = [("127.0.0.1", p) for p in ports]
+        n = 40
+        tags = {}
+
+        def factory(rows, dim, tag):
+            tags[tag] = van.PartitionedPSTable(
+                eps, rows, dim, init="zeros",
+                table_id=9200 + ["adj", "feat", "label"].index(tag))
+            return tags[tag]
+
+        src, dst, feats, labels = _two_cluster_graph(n=n)
+        DistGraph.publish(src, dst, feats, labels, max_degree=10,
+                          table_factory=factory)
+        outs = [str(tmp_path / f"w{i}.npz") for i in range(2)]
+        for i in range(2):
+            script = tmp_path / f"worker{i}.py"
+            script.write_text(WORKER_SRC.format(repo=str(REPO), eps=eps,
+                                                n=n, out=outs[i]))
+            workers.append(subprocess.Popen(
+                [sys.executable, str(script), str(i)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        for w in workers:
+            so, se = w.communicate(timeout=180)
+            assert w.returncode == 0 and "OK" in so, se[-2000:]
+        real = set(zip(src.tolist(), dst.tolist()))
+        for o in outs:
+            z = np.load(o)
+            assert len(z["src"]) > 0
+            # sampled edge u -> v means v pulled u as a neighbor, so the
+            # PUBLISHED edge is (v, u) (message flows neighbor -> seed)
+            for s_, d_ in zip(z["src"].tolist(), z["dst"].tolist()):
+                assert (d_, s_) in real, (s_, d_)
+    finally:
+        for p in procs + workers:
+            p.kill()
+            p.wait()

@@ -1,0 +1,63 @@
+"""One-shot on-chip cost-model calibration (VERDICT r3 weak #3).
+
+Runs `profiler.calibrate.calibrate_simulator` against the REAL device
+backend (single-chip: MXU-utilization fit from a measured bf16 matmul) and
+writes the fit report to CALIBRATION.json at the repo root.  The
+profilers' JSON cost cache persists the raw measurements, so searchers in
+later sessions replay the fitted costs without touching the device.
+
+Invoked by tools/bench_watcher.py whenever the TPU tunnel answers; safe to
+run by hand: `python tools/calibrate_chip.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS", "").strip()
+    if want:
+        # the tunnel plugin's sitecustomize force-sets the platform at
+        # interpreter start; re-assert the env choice (CPU smoke runs)
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+    from hetu_tpu.utils.platform import wait_for_devices
+
+    devs = wait_for_devices(120.0)
+    if devs is None:
+        print("calibrate: device backend unreachable", file=sys.stderr)
+        return 3
+    import jax
+
+    backend = jax.default_backend()
+    from hetu_tpu.profiler.calibrate import calibrate_simulator
+
+    t0 = time.time()
+    _, report = calibrate_simulator()  # 1-chip: MXU fit only
+    report.update({
+        "backend": backend,
+        "n_devices": len(devs),
+        "measured_unix": time.time(),
+        "measure_seconds": round(time.time() - t0, 2),
+    })
+    out = REPO / "CALIBRATION.json"
+    out.write_text(json.dumps(report, indent=1))
+    print(json.dumps(report))
+    return 0 if backend == "tpu" else 4  # CPU run: report but flag it
+
+
+if __name__ == "__main__":
+    sys.exit(main())
