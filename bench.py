@@ -467,16 +467,9 @@ _METRIC_BY_CMD = {
 
 
 def main():
-    import os
-    want = os.environ.get("JAX_PLATFORMS", "").strip()
-    if want:
-        # the tunnel plugin's sitecustomize force-sets the platform config
-        # at interpreter start, so the env var alone is ignored once jax is
-        # imported — re-assert it (lets HETU_BENCH_SMOKE runs use cpu)
-        try:
-            jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
+    from hetu_tpu.utils.platform import apply_env_platform
+
+    apply_env_platform()  # lets HETU_BENCH_SMOKE runs force cpu
     _enable_compile_cache()
     cmd = sys.argv[1] if len(sys.argv) > 1 else "gpt"
     # Once-per-round capture: retry a flaky tunnel for up to 10 minutes

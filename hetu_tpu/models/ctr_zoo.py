@@ -1,6 +1,7 @@
-"""CTR model zoo beyond Wide&Deep: DeepFM and DCN.
+"""CTR model zoo beyond Wide&Deep: DeepFM, DCN, and Deep Crossing.
 
-Reference: examples/ctr/models/{deepfm.py, dcn.py} (alongside wdl.py →
+Reference: examples/ctr/models/{deepfm_criteo.py, dcn_criteo.py,
+dc_criteo.py} (alongside wdl.py →
 hetu_tpu/models/wdl.py).  Same hybrid contract as WideDeep: the huge sparse
 embeddings live on the PS plane and arrive as pulled rows; these modules
 hold only dense parameters and return d(loss)/d(rows) for the host push.
@@ -62,6 +63,60 @@ class DeepFM(Module):
         """Dense update + (emb_grads, fm_linear_grads) for the PS push."""
         from hetu_tpu.models.ctr_common import make_hybrid_step
         return make_hybrid_step(self, optimizer, n_sparse_inputs=2)
+
+
+class ResidualUnit(Module):
+    """Deep Crossing residual unit (reference dc_criteo.py:8-27):
+    y = relu(x + W2 relu(W1 x + b1) + b2)."""
+
+    def __init__(self, dim: int, hidden: int):
+        self.dim, self.hidden = dim, hidden
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"params": {
+            "w1": jax.random.normal(k1, (self.dim, self.hidden)) * 0.1,
+            "b1": jnp.zeros((self.hidden,)),
+            "w2": jax.random.normal(k2, (self.hidden, self.dim)) * 0.1,
+            "b2": jnp.zeros((self.dim,))}, "state": {}}
+
+    def apply(self, variables, x, *, train: bool = False, rng=None):
+        p = variables["params"]
+        h = ops.relu(x @ p["w1"] + p["b1"])
+        return ops.relu(x + h @ p["w2"] + p["b2"]), {}
+
+
+class DeepCrossing(Module):
+    """Deep Crossing (reference dc_criteo.py): a stack of residual units
+    over the concatenated [embeddings, dense] features, linear head."""
+
+    def __init__(self, num_sparse_fields: int, emb_dim: int, dense_dim: int,
+                 hidden: int = 64, n_units: int = 3):
+        self.in_dim = num_sparse_fields * emb_dim + dense_dim
+        self.units = [ResidualUnit(self.in_dim, hidden)
+                      for _ in range(n_units)]
+        self.head = layers.Linear(self.in_dim, 1)
+
+    def init(self, key):
+        ks = jax.random.split(key, len(self.units) + 1)
+        return {"params": {
+            **{f"unit{i}": u.init(k)["params"]
+               for i, (u, k) in enumerate(zip(self.units, ks))},
+            "head": self.head.init(ks[-1])["params"]}, "state": {}}
+
+    def apply(self, variables, dense_x, emb_rows, *, train: bool = False,
+              rng=None):
+        p = variables["params"]
+        x = jnp.concatenate(
+            [emb_rows.reshape(emb_rows.shape[0], -1), dense_x], axis=-1)
+        for i, u in enumerate(self.units):
+            x, _ = u.apply({"params": p[f"unit{i}"], "state": {}}, x)
+        logit, _ = self.head.apply({"params": p["head"], "state": {}}, x)
+        return logit[:, 0], {}
+
+    def hybrid_step_fn(self, optimizer):
+        from hetu_tpu.models.ctr_common import make_hybrid_step
+        return make_hybrid_step(self, optimizer, n_sparse_inputs=1)
 
 
 class CrossNet(Module):

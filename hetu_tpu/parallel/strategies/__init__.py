@@ -1,6 +1,7 @@
 from hetu_tpu.parallel.strategies.base import Strategy
 from hetu_tpu.parallel.strategies.simple import (
-    DataParallel, MegatronLM, ModelParallel4CNN, OneWeirdTrick4CNN,
+    DataParallel, MegatronLM, ModelParallel4CNN, ModelParallel4LM,
+    OneWeirdTrick4CNN,
 )
 from hetu_tpu.parallel.strategies.search import (
     FlexFlowSearching, GalvatronSearching, GPipeSearching, OptCNNSearching,

@@ -52,6 +52,13 @@ class OneWeirdTrick4CNN(ModelParallel4CNN):
     the difference is the runtime pairing with a dp axis in the mesh."""
 
 
+class ModelParallel4LM(ModelParallel4CNN):
+    """LM flavor of the CNN MP preset (simple.py:113 — upstream it is
+    literally ModelParallel4CNN with an mp_4_lm flag): dense projection
+    weights tp-column-split, everything else replicated.  MegatronLM is
+    the recommended LM strategy; this exists for preset-name parity."""
+
+
 class MegatronLM(Strategy):
     """Megatron-style tensor parallel for the transformer models.
 

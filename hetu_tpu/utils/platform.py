@@ -130,6 +130,26 @@ def wait_for_devices(deadline_s: float = 600.0, *,
         time.sleep(poll_s)
 
 
+def apply_env_platform() -> None:
+    """Re-assert ``JAX_PLATFORMS`` from the environment.
+
+    The tunnel plugin's sitecustomize force-sets the platform config at
+    interpreter start, so the env var alone is ignored once jax is
+    imported; entry points (examples, bench) call this so
+    ``JAX_PLATFORMS=cpu python examples/...`` runs anywhere — including
+    with the TPU tunnel down.  A no-op when the var is unset or a backend
+    already initialized."""
+    want = os.environ.get("JAX_PLATFORMS", "").strip()
+    if not want:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # backend already initialized: too late, leave it
+
+
 def default_backend_is_tpu() -> bool:
     """Whether the default backend is a real TPU (cached after first call).
 

@@ -9,7 +9,8 @@ pytestmark = pytest.mark.slow
 
 import hetu_tpu as ht
 from hetu_tpu import optim
-from hetu_tpu.models.ctr_zoo import DCN, CrossNet, DeepFM
+from hetu_tpu.models.ctr_zoo import (DCN, CrossNet,
+                                     DeepCrossing, DeepFM)
 from hetu_tpu.ps import available
 
 
@@ -58,7 +59,7 @@ def test_crossnet_explicit_feature_crossing():
 
 
 @pytest.mark.skipif(not available(), reason="native PS lib unavailable")
-@pytest.mark.parametrize("model_kind", ["deepfm", "dcn"])
+@pytest.mark.parametrize("model_kind", ["deepfm", "dcn", "dc"])
 def test_ctr_zoo_hybrid_learns(model_kind):
     from hetu_tpu.ps import PSEmbedding
     fields, dense_dim, vocab, B = 4, 3, 50, 64
@@ -86,7 +87,9 @@ def test_ctr_zoo_hybrid_learns(model_kind):
             lin_emb.push(ids, np.asarray(gf))
             losses.append(float(loss))
     else:
-        model = DCN(fields, 8, dense_dim, hidden=(32,), n_cross=2)
+        model = DCN(fields, 8, dense_dim, hidden=(32,), n_cross=2) \
+            if model_kind == "dcn" else \
+            DeepCrossing(fields, 8, dense_dim, hidden=32, n_units=2)
         v = model.init(jax.random.PRNGKey(0))
         params, mstate = v["params"], v["state"]
         ostate = opt.init_state(params)

@@ -78,6 +78,12 @@ def test_cnn_model_parallel_specs():
     # OneWeirdTrick inherits the same spec table
     assert OneWeirdTrick4CNN().param_spec("['fc']['weight']", fc_w) == \
         P(None, "tp")
+    # ModelParallel4LM (upstream: MP4CNN with a flag, simple.py:113) too
+    from hetu_tpu.parallel.strategies import ModelParallel4LM
+    assert ModelParallel4LM().param_spec("['dense']['weight']", fc_w) == \
+        P(None, "tp")
+    assert ModelParallel4LM().param_spec("['conv1']['weight']",
+                                         conv_w) == P()
 
 
 def test_cnn_mp_trains_on_mesh():

@@ -22,20 +22,9 @@ sys.path.insert(0, str(REPO))
 
 
 def main() -> int:
-    import os
+    from hetu_tpu.utils.platform import apply_env_platform, wait_for_devices
 
-    want = os.environ.get("JAX_PLATFORMS", "").strip()
-    if want:
-        # the tunnel plugin's sitecustomize force-sets the platform at
-        # interpreter start; re-assert the env choice (CPU smoke runs)
-        import jax
-
-        try:
-            jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
-    from hetu_tpu.utils.platform import wait_for_devices
-
+    apply_env_platform()  # CPU smoke runs force cpu past the sitecustomize
     devs = wait_for_devices(120.0)
     if devs is None:
         print("calibrate: device backend unreachable", file=sys.stderr)
