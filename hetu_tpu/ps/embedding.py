@@ -23,18 +23,54 @@ from hetu_tpu.ps.client import CacheSparseTable, PSTable
 
 
 class PSEmbedding:
-    """num_embeddings x dim table on the PS, with optional HET cache tier."""
+    """num_embeddings x dim table on the PS, with optional HET cache tier.
+
+    Tiers (same pull/push/prefetch surface for all three):
+      * default — in-process C++ table (single TPU-VM host);
+      * ``endpoints=`` — the table key-range-partitioned over remote van
+        servers ("host:port,host:port" or [(host, port), ...]);
+      * ``scheduler=(host, port, n_servers)`` — endpoints resolved from
+        the PS scheduler (servers may rejoin at new addresses).
+    With ``cache_capacity`` the worker fronts the table with the HET cache
+    (in-process or the multi-host RemoteCacheTable, matching the tier).
+    """
 
     def __init__(self, num_embeddings: int, dim: int, *,
                  optimizer: str = "sgd", lr: float = 0.01,
                  cache_capacity: Optional[int] = None,
                  cache_policy: str = "lfuopt", pull_bound: int = 0,
-                 init: str = "normal", init_b: float = 0.01, seed: int = 0):
-        self.table = PSTable(num_embeddings, dim, init=init, init_b=init_b,
-                             seed=seed, optimizer=optimizer, lr=lr)
-        self.cache = (CacheSparseTable(self.table, cache_capacity,
-                                       cache_policy, pull_bound=pull_bound)
-                      if cache_capacity else None)
+                 init: str = "normal", init_b: float = 0.01, seed: int = 0,
+                 endpoints=None, scheduler=None, table_id=None):
+        if table_id is not None and endpoints is None and scheduler is None:
+            raise ValueError(
+                "table_id applies to the remote tiers only (the in-process "
+                "PSTable assigns its own id); pass endpoints= or "
+                "scheduler=, or drop table_id")
+        if endpoints is not None or scheduler is not None:
+            from hetu_tpu.ps.van import PartitionedPSTable, RemoteCacheTable
+            if scheduler is not None:
+                host, port, n_servers = scheduler
+                self.table = PartitionedPSTable.from_scheduler(
+                    host, port, n_servers, num_embeddings, dim, init=init,
+                    init_b=init_b, seed=seed, optimizer=optimizer, lr=lr,
+                    table_id=table_id)
+            else:
+                self.table = PartitionedPSTable(
+                    endpoints, num_embeddings, dim, init=init,
+                    init_b=init_b, seed=seed, optimizer=optimizer, lr=lr,
+                    table_id=table_id)
+            self.cache = (RemoteCacheTable(self.table, cache_capacity,
+                                           cache_policy,
+                                           pull_bound=pull_bound)
+                          if cache_capacity else None)
+        else:
+            self.table = PSTable(num_embeddings, dim, init=init,
+                                 init_b=init_b, seed=seed,
+                                 optimizer=optimizer, lr=lr)
+            self.cache = (CacheSparseTable(self.table, cache_capacity,
+                                           cache_policy,
+                                           pull_bound=pull_bound)
+                          if cache_capacity else None)
         self.dim = dim
         # one worker thread: prefetch overlaps the NEXT batch's pull with
         # the current device step (reference prefetch pipeline,
@@ -63,7 +99,19 @@ class PSEmbedding:
         self._pending = self._prefetcher.submit(self.pull, idx)
 
     def close(self) -> None:
-        self._prefetcher.shutdown(wait=False)
+        # wait=True: an in-flight prefetch still holds the native cache /
+        # group handles — freeing them under it would be a use-after-free
+        self._prefetcher.shutdown(wait=True)
+        self._pending = None
+        try:
+            self.flush()  # dirty cached grads must reach the servers;
+            # ps_rcache_close only retries already-SENT pushes
+        except Exception:
+            pass  # servers already gone: nothing durable left to save
+        if self.cache is not None and hasattr(self.cache, "close"):
+            self.cache.close()
+        if hasattr(self.table, "close"):
+            self.table.close()
 
     def __del__(self):  # pragma: no cover - interpreter-shutdown best effort
         try:

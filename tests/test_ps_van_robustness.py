@@ -1,0 +1,142 @@
+"""Van wire-protocol robustness: malformed frames must never crash the
+server — every op validates before it touches memory (the kMinBody table
+and per-op bounds in csrc/hetu_ps_van.cpp), answers an error rc, and keeps
+serving well-formed clients afterwards.
+
+Reference analog: ps-lite's van decodes only trusted intra-cluster
+traffic, but a server that a bad frame can kill takes the whole table
+plane down — the reliability bar here is: garbage in, error rc (or
+dropped connection) out, server alive.
+"""
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from hetu_tpu.ps import available
+
+if not available():  # pragma: no cover
+    pytest.skip("native PS lib unavailable", allow_module_level=True)
+
+from hetu_tpu.ps import van
+
+REPO = Path(__file__).resolve().parent.parent
+
+SERVER_SRC = """
+import sys, time
+sys.path.insert(0, {repo!r})
+from hetu_tpu.ps import van
+port = van.serve({port})
+print("READY", port, flush=True)
+time.sleep(300)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def server(tmp_path):
+    port = _free_port()
+    script = tmp_path / "server.py"
+    script.write_text(SERVER_SRC.format(repo=str(REPO), port=port))
+    proc = subprocess.Popen([sys.executable, str(script)],
+                            stdout=subprocess.PIPE, text=True)
+    assert proc.stdout.readline().startswith("READY")
+    yield port, proc
+    proc.kill()
+    proc.wait()
+
+
+def _send_raw(port: int, frame: bytes, *, expect_reply: bool) -> bytes:
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        s.sendall(frame)
+        if not expect_reply:
+            # half-close: the server's EOF/close ends recv immediately
+            # instead of idling out a full timeout per garbage frame
+            s.shutdown(socket.SHUT_WR)
+        s.settimeout(5 if expect_reply else 0.5)
+        try:
+            return s.recv(8)
+        except (socket.timeout, ConnectionResetError):
+            if expect_reply:
+                raise
+            return b""
+
+
+def _server_alive(port: int) -> bool:
+    t = van.RemotePSTable("127.0.0.1", port, 4, 2, init="zeros",
+                          optimizer="sgd", lr=1.0)
+    try:
+        t.sparse_set([0], np.ones((1, 2), np.float32))
+        out = t.sparse_pull([0])
+        return bool(np.allclose(out, 1.0))
+    finally:
+        t.close()
+
+
+def test_malformed_frames_do_not_kill_server(server):
+    port, proc = server
+    rng = np.random.default_rng(0)
+    frames = [
+        b"",                                        # empty, just close
+        struct.pack("<I", 0),                       # zero-length body
+        struct.pack("<I", 1 << 31),                 # absurd length
+        struct.pack("<IB", 1, 99),                  # unknown op
+        struct.pack("<IB", 1, 5),                   # sparse_pull, no header
+        # sparse_pull with giant n but no payload
+        struct.pack("<IBiqB", 1 + 13, 5, 1, 1 << 40, 0),
+        # push with negative n
+        struct.pack("<IBiq", 1 + 12, 6, 1, -5),
+        # create with zero rows/dims then ops against it
+        struct.pack("<IBiqqiddQ", 1 + 48, 1, 7, 0, 0, 0, 0.0, 0.0, 0),
+        # sched register with absurd rank hint (bounded-slot validation)
+        struct.pack("<IBii", 1 + 8, 19, 1 << 30, 80),
+        # sync_pull with huge n
+        struct.pack("<IBiqQ", 1 + 20, 13, 1, 1 << 30, 0),
+    ]
+    for i in range(30):  # plus random garbage
+        n = int(rng.integers(1, 64))
+        frames.append(struct.pack("<I", n) + rng.bytes(n))
+    for f in frames:
+        _send_raw(port, f, expect_reply=False)
+    assert proc.poll() is None, "server process died on malformed input"
+    assert _server_alive(port), "server stopped serving after bad frames"
+
+
+def test_error_rcs_not_crashes_for_short_but_valid_headers(server):
+    port, proc = server
+    # a well-formed header with a too-short body for each sized op must
+    # answer rc=-3 (bad frame) on the SAME connection, not desync or die
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        for op in (1, 2, 5, 6, 7, 13, 14, 15, 16, 17, 18, 19, 21, 22):
+            body = bytes([op])  # op byte only: below every op's kMinBody
+            s.sendall(struct.pack("<I", len(body)) + body)
+            s.settimeout(5)
+            blen = s.recv(4)
+            assert len(blen) == 4
+            (n,) = struct.unpack("<I", blen)
+            payload = b""
+            while len(payload) < n:
+                payload += s.recv(n - len(payload))
+            (rc,) = struct.unpack("<i", payload[:4])
+            assert rc < 0, (op, rc)  # an error, never success
+        # and the connection still works for a real request afterwards
+        s.sendall(struct.pack("<IB", 1, 10))  # PING
+        blen = s.recv(4)
+        (n,) = struct.unpack("<I", blen)
+        payload = s.recv(n)
+        assert struct.unpack("<i", payload[:4])[0] == 0
+    assert proc.poll() is None
+    assert _server_alive(port)
