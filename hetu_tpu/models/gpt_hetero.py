@@ -63,6 +63,14 @@ class HeteroGPT(GPTModel):
                 f"{config.num_layers} layers")
         self.layer_remat = layer_remat
 
+    @classmethod
+    def from_plan(cls, config: GPTConfig, plan: "Plan") -> "HeteroGPT":
+        """The full Galvatron loop in one call: build the model with the
+        plan's searched per-layer remat flags applied (pair with
+        ``PlanStrategy(plan)`` on the Executor for the sharding half)."""
+        return cls(config,
+                   layer_remat=plan_block_remat(plan, config.num_layers))
+
     def init(self, key):
         c = self.c
         ks = jax.random.split(key, c.num_layers + 3)

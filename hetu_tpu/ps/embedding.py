@@ -46,6 +46,10 @@ class PSEmbedding:
                 "table_id applies to the remote tiers only (the in-process "
                 "PSTable assigns its own id); pass endpoints= or "
                 "scheduler=, or drop table_id")
+        if endpoints is not None and scheduler is not None:
+            raise ValueError(
+                "pass endpoints= OR scheduler=, not both (the scheduler "
+                "resolves the endpoints itself)")
         if endpoints is not None or scheduler is not None:
             from hetu_tpu.ps.van import PartitionedPSTable, RemoteCacheTable
             if scheduler is not None:
@@ -59,18 +63,22 @@ class PSEmbedding:
                     endpoints, num_embeddings, dim, init=init,
                     init_b=init_b, seed=seed, optimizer=optimizer, lr=lr,
                     table_id=table_id)
-            self.cache = (RemoteCacheTable(self.table, cache_capacity,
-                                           cache_policy,
-                                           pull_bound=pull_bound)
-                          if cache_capacity else None)
+            cache_cls = RemoteCacheTable
         else:
             self.table = PSTable(num_embeddings, dim, init=init,
                                  init_b=init_b, seed=seed,
                                  optimizer=optimizer, lr=lr)
-            self.cache = (CacheSparseTable(self.table, cache_capacity,
-                                           cache_policy,
-                                           pull_bound=pull_bound)
+            cache_cls = CacheSparseTable
+        try:
+            self.cache = (cache_cls(self.table, cache_capacity,
+                                    cache_policy, pull_bound=pull_bound)
                           if cache_capacity else None)
+        except Exception:
+            # don't leak the just-created native group/heartbeat thread on
+            # a failed cache construction (mirrors van.py's discipline)
+            if hasattr(self.table, "close"):
+                self.table.close()
+            raise
         self.dim = dim
         # one worker thread: prefetch overlaps the NEXT batch's pull with
         # the current device step (reference prefetch pipeline,

@@ -188,8 +188,9 @@ def test_galvatron_budgeted_plan_runs_under_memory_the_plain_plan_exceeds():
     assert any(flags), plan.meta
     ids = jnp.asarray(
         np.random.default_rng(1).integers(0, 128, (B, S)), jnp.int32)
-    bytes_plan, _, f_plan = _grad_residual_bytes(
-        HeteroGPT(cfg, layer_remat=flags), ids)
+    model = HeteroGPT.from_plan(cfg, plan)  # one-call Galvatron loop
+    assert model.layer_remat == flags
+    bytes_plan, _, f_plan = _grad_residual_bytes(model, ids)
     bytes_plain, params, _ = _grad_residual_bytes(HeteroGPT(cfg), ids)
     assert bytes_plan < bytes_plain, (bytes_plan, bytes_plain)
     assert np.isfinite(float(f_plan(params)))

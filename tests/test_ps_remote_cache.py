@@ -286,3 +286,47 @@ def test_psembedding_remote_tier_trains_wdl(two_servers):
     assert losses[-1] < losses[0], (losses[0], losses[-1])
     assert emb.cache.hit_rate > 0.0  # the cache tier actually engaged
     emb.close()
+
+
+def test_remote_cache_concurrent_threads_consistent(two_servers):
+    """Thread-safety soak: many threads hammer ONE worker-side cache with
+    disjoint-key updates and overlapping lookups; the final table equals
+    the deterministic mirror (the RCache mutex + group fan-out must hold
+    up under real concurrency, not just sequential tests)."""
+    import threading
+
+    ports, _ = two_servers
+    eps = [("127.0.0.1", p) for p in ports]
+    ROWS, DIM, LR, THREADS, STEPS = 64, 2, 1.0, 4, 15
+    t = van.PartitionedPSTable(eps, rows=ROWS, dim=DIM, init="zeros",
+                               optimizer="sgd", lr=LR)
+    cache = van.RemoteCacheTable(t, capacity=24, policy="lru",
+                                 pull_bound=3)
+    errs = []
+
+    def worker(wid):
+        try:
+            own = np.arange(wid, ROWS, THREADS)  # disjoint strided keys
+            g = np.ones((own.size, DIM), np.float32) * (wid + 1)
+            rng = np.random.default_rng(wid)
+            for _ in range(STEPS):
+                cache.embedding_lookup(rng.integers(0, ROWS, 16))
+                cache.embedding_update(own, g)
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(THREADS)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    assert not errs, errs
+    cache.flush()
+    got = t.sparse_pull(np.arange(ROWS))
+    want = np.zeros((ROWS, DIM), np.float32)
+    for wid in range(THREADS):
+        own = np.arange(wid, ROWS, THREADS)
+        want[own] = -LR * STEPS * (wid + 1)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    cache.close()
+    t.close()
