@@ -63,13 +63,28 @@ struct Table {
 static std::mutex g_tables_mu;
 static std::map<int, Table*> g_tables;
 
+// Version base for a fresh table: wall-clock ms with headroom.  Row
+// versions are OPAQUE monotonic counters to clients; starting each table
+// incarnation at a later base than any version the previous incarnation
+// could have reached (rows would need >1024 updates/ms sustained to
+// outpace it) makes a recreated shard's versions jump FORWARD — worker
+// caches from the old incarnation then fail the normal staleness check
+// and refresh, exactly, instead of relying on best-effort regression
+// heuristics.
+static uint64_t version_base_now() {
+  auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                std::chrono::system_clock::now().time_since_epoch())
+                .count();
+  return (uint64_t)ms * 1024;
+}
+
 int ps_table_create(int id, int64_t rows, int64_t dim, int init_kind,
                     double a, double b, uint64_t seed) {
   // init_kind: 0 zeros, 1 constant(a), 2 uniform(a,b), 3 normal(mean=a,std=b)
   auto* t = new Table();
   t->rows = rows; t->dim = dim;
   t->data.resize(rows * dim);
-  t->version.assign(rows, 0);
+  t->version.assign(rows, version_base_now());
   std::mt19937_64 rng(seed);
   if (init_kind == 1) {
     std::fill(t->data.begin(), t->data.end(), (float)a);
@@ -286,7 +301,12 @@ int ps_sparse_push(int id, const int64_t* idx, const float* grads,
 // Version-bounded sync pull (HET kSyncEmbedding server handler,
 // ps-lite/include/ps/psf/cachetable.h:24-40): the worker sends each key's
 // cached version (UINT64_MAX = "not cached, always send"); the server
-// returns only rows whose version exceeds cached_version + bound.
+// returns rows whose version exceeds cached_version + bound — which
+// includes every row of a RECREATED shard, whose fresh version base jumps
+// past any previous incarnation's versions (version_base_now above) — and,
+// as a belt-and-braces net, rows whose version regressed below the cached
+// one (only possible across incarnations).  Returned versions are OPAQUE:
+// clients must not assume they start at 0 or grow by 1.
 // Outputs: sel_out[m] = positions into the request batch, vers_out[m] =
 // server versions, rows_out[m*dim] = row values.  Returns m (#sent) or <0.
 int64_t ps_sync_pull(int id, const int64_t* idx, const uint64_t* cached_ver,
@@ -300,8 +320,13 @@ int64_t ps_sync_pull(int id, const int64_t* idx, const uint64_t* cached_ver,
     int64_t r = idx[i];
     if (r < 0 || r >= t->rows) continue;  // never sent: workers zero-fill
     uint64_t cv = cached_ver[i];
-    bool send = cv == UINT64_MAX ||
-                t->version[r] > cv + bound;  // bound: staleness tolerance
+    // send when: not cached (MAX) | newer than the staleness bound | the
+    // server's version REGRESSED below the cached one — versions only
+    // ever move up within one table incarnation, so a regression means
+    // the shard was recreated (restart/recovery) and the worker's cache
+    // is from a previous life: it must refresh, not trust its copy
+    bool send = cv == UINT64_MAX || t->version[r] > cv + bound ||
+                t->version[r] < cv;
     if (!send) continue;
     sel_out[m] = (uint32_t)i;
     vers_out[m] = t->version[r];

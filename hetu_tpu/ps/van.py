@@ -327,9 +327,13 @@ class PartitionedPSTable:
 
     def sync_pull(self, indices, cached_versions, bound: int = 0):
         """Version-bounded sync (HET kSyncEmbedding over the wire): returns
-        ``(positions, versions, rows)`` for only the requested rows whose
-        server version exceeds ``cached_versions + bound``
-        (``np.uint64(-1)`` = "not cached, always send")."""
+        ``(positions, versions, rows)`` for the requested rows whose server
+        version exceeds ``cached_versions + bound`` — including every row
+        of a shard recreated since the caller cached (fresh incarnations
+        start at a later version base) — plus any row whose version
+        regressed (cross-incarnation safety net).  ``np.uint64(-1)`` =
+        "not cached, always send".  Versions are OPAQUE monotonic
+        counters: do not assume they start at 0 or advance by exactly 1."""
         import ctypes as c
         idx = _as_idx(indices)
         vers = np.ascontiguousarray(cached_versions, np.uint64).reshape(-1)

@@ -75,23 +75,27 @@ def test_sync_pull_version_bound_semantics(two_servers):
     t = van.PartitionedPSTable(eps, rows=10, dim=2, init="zeros",
                                optimizer="sgd", lr=1.0)
     NOT_CACHED = np.uint64(0xFFFFFFFFFFFFFFFF)
-    # fresh table: all versions 0; "not cached" rows always arrive
-    sel, vers, rows = t.sync_pull([1, 6], [NOT_CACHED, NOT_CACHED])
+    # fresh table: "not cached" rows always arrive (versions are opaque —
+    # fresh incarnations start at a wall-clock-derived base, not 0)
+    sel, base, rows = t.sync_pull([1, 6], [NOT_CACHED, NOT_CACHED])
     assert sorted(sel.tolist()) == [0, 1]
-    np.testing.assert_array_equal(vers, 0)
     np.testing.assert_allclose(rows, 0.0)
-    # cached at version 0, no updates since: nothing to send
-    sel, _, _ = t.sync_pull([1, 6], [0, 0], bound=0)
+    v1, v6 = (base[list(sel).index(0)], base[list(sel).index(1)])
+    # cached at the current versions, no updates since: nothing to send
+    sel, _, _ = t.sync_pull([1, 6], [v1, v6], bound=0)
     assert sel.size == 0
     # one update bumps the version past the bound=0 check on both shards
     t.sparse_push([1, 6], np.ones((2, 2), np.float32))
-    sel, vers, rows = t.sync_pull([1, 6], [0, 0], bound=0)
+    sel, vers, rows = t.sync_pull([1, 6], [v1, v6], bound=0)
     assert sorted(sel.tolist()) == [0, 1]
-    np.testing.assert_array_equal(vers, 1)
     np.testing.assert_allclose(rows, -1.0)  # sgd lr=1 on ones
     # bound=1 tolerates exactly that staleness: nothing to send
-    sel, _, _ = t.sync_pull([1, 6], [0, 0], bound=1)
+    sel, _, _ = t.sync_pull([1, 6], [v1, v6], bound=1)
     assert sel.size == 0
+    # version REGRESSION (cached > server): the cached copy is from a
+    # previous table incarnation — always re-sent, regardless of bound
+    sel, _, _ = t.sync_pull([1, 6], [v1 + 50, v6 + 50], bound=1000)
+    assert sorted(sel.tolist()) == [0, 1]
     t.close()
 
 

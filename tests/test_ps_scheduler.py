@@ -178,6 +178,48 @@ def test_rank_takeover_converges_no_flap(sched_and_servers):
     del old  # still running, but no longer advertised — exactly the point
 
 
+def test_cache_tier_survives_rejoin_at_new_port(sched_and_servers):
+    """Integration of the two round-4 subsystems: a RemoteCacheTable over a
+    scheduler-resolved group keeps working after its backing server is
+    killed and rejoins at a DIFFERENT port — the cache's wire sync rides
+    the group's endpoint re-resolution; the restarted-blank shard serves
+    fresh zeros (its versions jump FORWARD to a new incarnation base, so
+    the cache's staleness check forces the refresh) and new updates
+    land."""
+    sched_port, servers, tmp_path = sched_and_servers
+    t = van.PartitionedPSTable.from_scheduler(
+        "127.0.0.1", sched_port, 2, rows=20, dim=2, init="zeros",
+        optimizer="sgd", lr=1.0)
+    cache = van.RemoteCacheTable(t, capacity=8, policy="lru", pull_bound=0)
+    cache.embedding_lookup(np.arange(10, 16))  # rank-1 shard rows cached
+    cache.embedding_update([12], np.ones((1, 2), np.float32))
+    cache.flush()
+    np.testing.assert_allclose(t.sparse_pull([12]), -1.0)
+
+    victim = next(p for p in servers if int(p._ready[2]) == 1)
+    victim.kill()
+    victim.wait()
+    servers.append(_spawn(tmp_path, "srv1c", SERVER_SRC,
+                          sched_port=sched_port, port=_free_port(),
+                          rank_hint=1))
+    deadline = time.time() + 25
+    got = None
+    while time.time() < deadline:
+        try:
+            # bound=0 forces a wire sync -> exercises reconnect+re-resolve
+            got = cache.embedding_lookup([12])
+            break
+        except RuntimeError:
+            time.sleep(0.3)
+    assert got is not None, "cache never recovered through the scheduler"
+    np.testing.assert_allclose(got, 0.0)  # blank restart: fresh zeros
+    cache.embedding_update([12], np.ones((1, 2), np.float32))
+    cache.flush()
+    np.testing.assert_allclose(cache.embedding_lookup([12]), -1.0)
+    cache.close()
+    t.close()
+
+
 def test_remote_ssp_blocks_fast_worker(sched_and_servers):
     """SSP clocks as a WIRE op: two clients of one van server share the
     clock table; the fast worker times out while too far ahead and
