@@ -138,7 +138,9 @@ int push_or_stash(RCache* c, std::vector<int64_t>&& keys,
                   std::vector<float>&& grads, uint64_t req_base) {
   if (keys.empty()) return 0;
   if (req_base == 0) req_base = ps_group_alloc_reqs(64);
-  std::vector<int32_t> rcs(c->shard_starts.size(), 0);
+  std::vector<int32_t> rcs(c->shard_starts.size(), -1);  // sentinel:
+  // a whole-call failure before the group writes per-shard rcs (e.g.
+  // closed gid) must read as all-shards-failed, not all-acked
   int64_t rc = ps_group_push_sync_req(
       c->gid, keys.data(), grads.data(), (int64_t)keys.size(), nullptr,
       nullptr, 0, 0, req_base, nullptr, nullptr, nullptr, rcs.data());
@@ -253,7 +255,9 @@ int64_t ps_rcache_lookup(int cid, const int64_t* idx, int64_t n,
   std::vector<uint64_t> vout(nu);
   std::vector<float> rout(nu * c->dim);
   uint64_t req_base = push_keys.empty() ? 0 : ps_group_alloc_reqs(64);
-  std::vector<int32_t> rcs(c->shard_starts.size(), 0);
+  std::vector<int32_t> rcs(c->shard_starts.size(), -1);  // sentinel:
+  // a whole-call failure before the group writes per-shard rcs (e.g.
+  // closed gid) must read as all-shards-failed, not all-acked
   int64_t m = ps_group_push_sync_req(
       c->gid, push_keys.data(), push_grads.data(),
       (int64_t)push_keys.size(), uniq.data(), vers.data(), nu, bound,
@@ -363,7 +367,9 @@ int ps_rcache_flush(int cid) {
   std::vector<uint64_t> vout(nk);
   std::vector<float> rout(nk * c->dim);
   uint64_t req_base = ps_group_alloc_reqs(64);
-  std::vector<int32_t> rcs(c->shard_starts.size(), 0);
+  std::vector<int32_t> rcs(c->shard_starts.size(), -1);  // sentinel:
+  // a whole-call failure before the group writes per-shard rcs (e.g.
+  // closed gid) must read as all-shards-failed, not all-acked
   int64_t m = ps_group_push_sync_req(c->gid, keys.data(), grads.data(), nk,
                                      keys.data(), maxv.data(), nk, 0,
                                      req_base, sel.data(), vout.data(),

@@ -458,14 +458,19 @@ void handle_conn(int fd) {
         uint64_t bound = rd<uint64_t>(p);
         int64_t dim = ps_table_dim(id);
         if (dim <= 0) { send_resp(fd, -1, nullptr, 0); break; }
+        // range-check np/ns BEFORE any byte math: a hostile count would
+        // overflow the int64 multiplications below (UB) even though the
+        // frame is ultimately rejected
+        if (np < 0 || ns < 0 || np > (1 << 24) || ns > (1 << 24)) {
+          send_resp(fd, -3, nullptr, 0); break;
+        }
         int64_t have = body.data() + blen - p;
         int64_t push_bytes = np * (int64_t)(sizeof(int64_t) +
                                             dim * sizeof(float));
         int64_t sync_bytes = ns * (int64_t)(sizeof(int64_t) +
                                             sizeof(uint64_t));
         int64_t resp_bytes = 8 + ns * (int64_t)(4 + 8 + dim * sizeof(float));
-        if (np < 0 || ns < 0 || np > (1 << 24) || ns > (1 << 24) ||
-            have < push_bytes + sync_bytes ||
+        if (have < push_bytes + sync_bytes ||
             resp_bytes > (int64_t)(1u << 30)) {
           send_resp(fd, -3, nullptr, 0); break;
         }

@@ -48,7 +48,19 @@ class VanMailbox:
     complete — and the ack makes back-to-back `put`s safe: a second
     message can never overwrite a payload the reader is still pulling
     (round 3's single-slot caveat is gone; senders just block).
+
+    Flags live in f32 rows, which represent integers exactly only up to
+    2**24 — so the wire flag is the logical seq wrapped into [1, 2**20]
+    (``_wire``).  The ack keeps the channel in lockstep (at most one
+    message between the endpoints), so wrapped flags are unambiguous and
+    the channel's message lifetime is unbounded.
     """
+
+    _SEQ_MOD = 1 << 20
+
+    @classmethod
+    def _wire(cls, seq: int) -> int:
+        return (seq - 1) % cls._SEQ_MOD + 1 if seq > 0 else 0
 
     def __init__(self, host: str, port: int, channel_id: int,
                  capacity: int, *, connect_timeout_s: float = 20.0):
@@ -88,15 +100,17 @@ class VanMailbox:
         deadline = time.time() + timeout_s
         # wait for the reader's ack of the previous message
         while self._last_seq and \
-                int(self._flag(self.capacity + 1)) != self._last_seq:
+                int(self._flag(self.capacity + 1)) != \
+                self._wire(self._last_seq):
             if time.time() > deadline:
                 raise TimeoutError(
                     f"mailbox: ack of seq {self._last_seq} not observed "
                     f"within {timeout_s}s")
             time.sleep(poll_s)
         self.table.sparse_set(np.arange(flat.size), flat.reshape(-1, 1))
-        self.table.sparse_set([self.capacity],
-                              np.asarray([[float(seq)]], np.float32))
+        self.table.sparse_set(
+            [self.capacity],
+            np.asarray([[float(self._wire(seq))]], np.float32))
         self._last_seq = seq
 
     def get(self, shape, seq: int, *, timeout_s: float = 60.0,
@@ -108,11 +122,11 @@ class VanMailbox:
                 flag = self._flag(self.capacity)
             except RuntimeError:
                 flag = None  # table not created yet / transient
-            if flag is not None and int(flag) == seq:
+            if flag is not None and int(flag) == self._wire(seq):
                 data = self.table.sparse_pull(np.arange(n))
                 self.table.sparse_set(
                     [self.capacity + 1],
-                    np.asarray([[float(seq)]], np.float32))
+                    np.asarray([[float(self._wire(seq))]], np.float32))
                 return data.ravel().reshape(shape)
             if time.time() > deadline:
                 raise TimeoutError(
@@ -162,7 +176,6 @@ class MPMDStageRunner:
         self._jax = jax
         self._mail: dict = {}
         self._seq: dict = {}
-        self._step = 0  # salts the per-step grad-accumulator table id
         # unique preduce worker id across ALL processes of this pipeline
         self.uid = worker_uid if worker_uid is not None else \
             sum(self.dps[:stage]) + replica
@@ -288,7 +301,6 @@ class MPMDStageRunner:
         flat = np.concatenate([np.asarray(g, np.float32).ravel()
                                for g in leaves]) if leaves else \
             np.zeros(0, np.float32)
-        self._step += 1
         if dps[s] > 1:
             acc, barrier = self._grad_plumbing()
             acc.sparse_push(np.arange(flat.size), flat.reshape(-1, 1))

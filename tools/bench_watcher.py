@@ -12,6 +12,17 @@ official capture, ``_emit_stale_or_die`` has an honest number to re-emit.
 
 Exit: when all bench commands have succeeded, or after ``--deadline-s``.
 Log: ``.bench_watch.log`` next to this file's repo root.
+
+Survival (VERDICT r4 weak #1): the watcher DOUBLE-FORKS into its own session
+at startup, so it keeps running when the launching shell dies — round 4 lost
+the watcher three times because ``nohup ... &`` from the harness shell is
+killed with the shell.  A pidfile (``.bench_watch.pid``) makes launches
+idempotent: if a live watcher already holds it, the new launch exits
+immediately, so any entry point may ``spawn_if_absent()`` without stacking
+watchers — entry points call :func:`spawn_if_absent`.  A successful capture
+is git-committed on the spot (LKG + calibration files), so a later crash or
+round handoff cannot lose the only measurement of the round.
+``--foreground`` (or HETU_WATCHER_NO_DAEMON=1) disables the fork for tests.
 """
 
 from __future__ import annotations
@@ -25,10 +36,12 @@ import time
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 LOG = REPO / ".bench_watch.log"
+PIDFILE = REPO / ".bench_watch.pid"
 CMDS = ["gpt", "resnet", "ctr", "moe"]
 
 PROBE_TIMEOUT_S = 75.0
 POLL_S = 60.0
+HEARTBEAT_S = 1800.0  # prove liveness in the log twice an hour
 BENCH_TIMEOUT_S = 2700.0  # first compile over a tunnel is slow, and every
 # bench now measures its A/B baseline variant too (two compiles each)
 
@@ -38,6 +51,111 @@ def log(msg: str) -> None:
     print(line, flush=True)
     with LOG.open("a") as f:
         f.write(line + "\n")
+
+
+def _pid_is_watcher(pid: int) -> bool:
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            argv = [a.decode(errors="replace")
+                    for a in f.read().split(b"\0") if a]
+    except OSError:
+        return False
+    # a recycled pid must not false-positive on e.g. `vim .../bench_watcher.py`
+    # or a grep for the name: require a python interpreter running this script
+    return bool(argv) and "python" in os.path.basename(argv[0]) and any(
+        os.path.basename(a) == "bench_watcher.py" for a in argv[1:])
+
+
+def already_running() -> int | None:
+    """Pid of a live watcher holding the pidfile, else None."""
+    try:
+        pid = int(PIDFILE.read_text().strip())
+    except (OSError, ValueError):
+        return None
+    return pid if _pid_is_watcher(pid) else None
+
+
+def claim_pidfile() -> bool:
+    """Atomically claim the pidfile; False if a live watcher holds it.
+    O_EXCL closes the check-then-write race between concurrent launches —
+    exactly one of them creates the file and runs."""
+    while True:
+        try:
+            fd = os.open(str(PIDFILE),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+            os.write(fd, str(os.getpid()).encode())
+            os.close(fd)
+            return True
+        except FileExistsError:
+            live = already_running()
+            if live is not None and live != os.getpid():
+                return False
+            try:  # stale holder: clear and race for the claim again
+                PIDFILE.unlink()
+            except OSError:
+                pass
+
+
+def release_pidfile() -> None:
+    """Remove the pidfile iff this process still holds it — a stale file
+    would make every later launch in the round exit 'already running'."""
+    try:
+        if int(PIDFILE.read_text().strip()) == os.getpid():
+            PIDFILE.unlink()
+    except (OSError, ValueError):
+        pass
+
+
+def spawn_if_absent(deadline_s: float = 11.0 * 3600) -> None:
+    """Idempotent launch for entry points: start a detached watcher unless
+    one already holds the pidfile.  Runs in a subprocess because main()
+    daemonizes with os._exit — calling it in-process would kill the caller."""
+    if already_running() is not None:
+        return
+    subprocess.run(
+        [sys.executable, str(pathlib.Path(__file__).resolve()),
+         "--deadline-s", str(deadline_s)],
+        capture_output=True, timeout=120)
+
+
+def daemonize() -> None:
+    """Detach into our own session so the launching shell's death (the
+    harness kills its process group between commands) cannot take the
+    watcher down — the round-4 failure mode, 3 restarts in one round."""
+    if os.fork() > 0:
+        os._exit(0)
+    os.setsid()
+    if os.fork() > 0:
+        os._exit(0)
+    devnull = os.open(os.devnull, os.O_RDWR)
+    for fd in (0, 1, 2):
+        os.dup2(devnull, fd)
+    os.close(devnull)
+    os.chdir(str(REPO))
+
+
+def commit_capture(what: str) -> None:
+    """Best-effort commit of measurement artifacts the moment they exist —
+    a later crash or round handoff must not lose the round's only capture."""
+    # only paths that exist: git stages NOTHING when any pathspec is
+    # unmatched, which would silently drop the whole capture commit
+    paths = [p for p in (".bench_lkg.json", "CALIBRATION.json")
+             if (REPO / p).exists()]
+    if not paths:
+        log(f"commit({what}): no artifact files on disk yet — skipped")
+        return
+    try:
+        subprocess.run(["git", "add", "-f", *paths], cwd=str(REPO),
+                       capture_output=True, timeout=60)
+        r = subprocess.run(
+            ["git", "commit", "-m",
+             f"Record TPU capture from bench watcher ({what})",
+             "--", *paths],
+            cwd=str(REPO), capture_output=True, timeout=60, text=True)
+        log(f"commit({what}): rc={r.returncode} "
+            f"{(r.stdout or r.stderr).strip()[-120:]!r}")
+    except Exception as e:  # never let bookkeeping kill the watcher
+        log(f"commit({what}): error {e!r}")
 
 
 def probe_tpu() -> bool:
@@ -82,14 +200,36 @@ def run_bench(cmd: str) -> bool:
 def main() -> None:
     deadline_s = float(sys.argv[sys.argv.index("--deadline-s") + 1]) \
         if "--deadline-s" in sys.argv else 11.0 * 3600
+    live = already_running()
+    if live is not None:
+        print(f"watcher already running (pid {live}) — exiting", flush=True)
+        return
+    if "--foreground" not in sys.argv \
+            and not os.environ.get("HETU_WATCHER_NO_DAEMON"):
+        daemonize()
+    if not claim_pidfile():
+        log("lost the pidfile race to a concurrent launch — exiting")
+        return
+    try:
+        _watch(deadline_s)
+    finally:
+        release_pidfile()
+
+
+def _watch(deadline_s: float) -> None:
     start = time.monotonic()
+    last_beat = start
     done: set[str] = set()
     fails: dict[str, int] = {}
     MAX_FAILS = 3  # a bench failing repeatedly while the tunnel is up is a
     # deterministic bug, not a blip — don't burn tunnel time on it forever
-    log(f"watcher up (pid {os.getpid()}), cmds={CMDS}, "
+    log(f"watcher up (pid {os.getpid()}, own session), cmds={CMDS}, "
         f"deadline={deadline_s / 3600:.1f}h")
     while time.monotonic() - start < deadline_s:
+        if time.monotonic() - last_beat >= HEARTBEAT_S:
+            last_beat = time.monotonic()
+            log(f"heartbeat: alive {((last_beat - start) / 3600):.1f}h, "
+                f"done={sorted(done)}")
         if probe_tpu():
             log("tunnel UP — running pending benches")
             if "calibrate" not in done and \
@@ -108,6 +248,7 @@ def main() -> None:
                     if r.returncode == 0:
                         done.add("calibrate")
                         log(f"calibrate: OK {r.stdout.strip()[-200:]}")
+                        commit_capture("calibrate")
                     else:
                         fails["calibrate"] = fails.get("calibrate", 0) + 1
                         log(f"calibrate: rc={r.returncode} "
@@ -121,6 +262,7 @@ def main() -> None:
                     continue
                 if run_bench(cmd):
                     done.add(cmd)
+                    commit_capture(cmd)
                 elif not probe_tpu():
                     log("tunnel dropped mid-matrix; back to polling")
                     break
@@ -132,8 +274,10 @@ def main() -> None:
             pending = [c for c in CMDS + ["calibrate"]
                        if c not in done and fails.get(c, 0) < MAX_FAILS]
             if not pending:
-                log(f"done={sorted(done)} given_up="
-                    f"{sorted(set(CMDS) - done)} — watcher exiting")
+                given_up = sorted(c for c, n in fails.items()
+                                  if n >= MAX_FAILS and c not in done)
+                log(f"done={sorted(done)} given_up={given_up} "
+                    "— watcher exiting")
                 return
         time.sleep(POLL_S)
     log(f"deadline reached with {sorted(done)} captured — exiting")
