@@ -466,10 +466,27 @@ _METRIC_BY_CMD = {
 }
 
 
+def _rearm_watcher():
+    """Every bench invocation re-arms the round-long tunnel watcher (a
+    crashed or deadline-expired watcher would otherwise silently miss the
+    round's only tunnel-up window).  No-op if one is already running."""
+    import os
+    if os.environ.get("HETU_BENCH_SMOKE"):
+        return  # CI smoke runs must not spawn daemons
+    try:
+        sys.path.insert(0, str(__import__("pathlib").Path(
+            __file__).resolve().parent / "tools"))
+        import bench_watcher
+        bench_watcher.spawn_if_absent()
+    except Exception:
+        pass
+
+
 def main():
     from hetu_tpu.utils.platform import apply_env_platform
 
     apply_env_platform()  # lets HETU_BENCH_SMOKE runs force cpu
+    _rearm_watcher()
     _enable_compile_cache()
     cmd = sys.argv[1] if len(sys.argv) > 1 else "gpt"
     # Once-per-round capture: retry a flaky tunnel for up to 10 minutes

@@ -87,6 +87,17 @@ enum VanOp : uint8_t {
   // table lifecycle: zero a table in place (ParamClear analog) — reusable
   // accumulators instead of per-step table leaks
   OP_CLEAR = 22,
+  // bulk-blob channel (reference zmq_van.h SArray zero-copy send): one
+  // contiguous payload per frame with seq + server-side blocking, so an
+  // activation/cotangent message is ONE round trip instead of
+  // element-per-row sparse traffic plus client-side flag polling
+  OP_BLOB_PUT = 23, OP_BLOB_GET = 24, OP_BLOB_ACK = 25,
+  // first-class worker barrier (reference python_binding.cc BarrierWorker);
+  // preduce matchmaking stays reserved for partial reduce
+  OP_BARRIER = 26,
+  // observability: frames handled since server start (transport-efficiency
+  // assertions in tests)
+  OP_STATS = 27,
 };
 
 // Per-table bounded set of recently applied push request-ids.  A repeated
@@ -167,6 +178,97 @@ int64_t now_ms() {
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
 }
+
+// ------------------------------------------------------------ blob channel
+// One single-slot acked mailbox per channel id.  PUT blocks (server-side
+// condvar, not client polling) until the previous message is acked, GET
+// blocks until the requested seq is stored, ACK releases the slot.  All
+// three are idempotent under same-seq resend, so a client may retry after
+// any transport failure.  Thread-per-connection makes server-side blocking
+// safe: a waiting channel occupies its own thread only.
+struct BlobChan {
+  std::mutex mu;
+  std::condition_variable cv;
+  uint64_t seq = 0;   // seq of stored payload; 0 = never written
+  bool acked = true;  // reader consumed the stored payload
+  std::vector<char> data;
+};
+std::mutex g_blobs_mu;
+std::map<int64_t, std::shared_ptr<BlobChan>> g_blobs;
+constexpr size_t kMaxBlobChans = 1 << 16;   // wire-supplied ids: bound them
+constexpr int64_t kMaxBlobBytes = 1 << 28;  // 256 MB per message
+
+// shared_ptr so eviction can drop a channel from the registry while a
+// handler thread still holds it; payload bytes are freed on ack (the slot
+// is consumed), so an idle channel costs only its struct
+std::shared_ptr<BlobChan> get_blob(int64_t channel) {
+  std::lock_guard<std::mutex> lk(g_blobs_mu);
+  auto it = g_blobs.find(channel);
+  if (it != g_blobs.end()) return it->second;
+  if (g_blobs.size() >= kMaxBlobChans) {
+    // registry full: evict an idle consumed channel (acked, no handler
+    // holding it).  Evicting one is safe — its endpoints see a fresh slot
+    // whose next put/get pair works normally; only permanent refusal of
+    // NEW channels on a long-lived server would be an outage.
+    bool evicted = false;
+    for (auto jt = g_blobs.begin(); jt != g_blobs.end(); ++jt) {
+      if (jt->second.use_count() == 1) {
+        std::unique_lock<std::mutex> clk(jt->second->mu, std::try_to_lock);
+        if (clk.owns_lock() && jt->second->acked) {
+          clk.unlock();
+          g_blobs.erase(jt);
+          evicted = true;
+          break;
+        }
+      }
+    }
+    if (!evicted) return nullptr;  // every channel mid-message: refuse
+  }
+  auto chan = std::make_shared<BlobChan>();
+  g_blobs[channel] = chan;
+  return chan;
+}
+
+// --------------------------------------------------------------- barrier
+// Reusable generation-counted barrier (python_binding.cc BarrierWorker):
+// the nworkers-th arrival bumps the generation and wakes everyone; a
+// timed-out waiter withdraws its arrival so the barrier cannot release
+// with fewer live workers than it counted.
+struct VanBarrier {
+  std::mutex mu;
+  std::condition_variable cv;
+  int64_t generation = 0;
+  int count = 0;
+};
+std::mutex g_barriers_mu;
+std::map<int64_t, std::shared_ptr<VanBarrier>> g_barriers;
+
+std::shared_ptr<VanBarrier> get_barrier(int64_t bid) {
+  std::lock_guard<std::mutex> lk(g_barriers_mu);
+  auto it = g_barriers.find(bid);
+  if (it != g_barriers.end()) return it->second;
+  if (g_barriers.size() >= kMaxBlobChans) {
+    // evict an idle barrier (nobody waiting, no handler holding it)
+    bool evicted = false;
+    for (auto jt = g_barriers.begin(); jt != g_barriers.end(); ++jt) {
+      if (jt->second.use_count() == 1) {
+        std::unique_lock<std::mutex> blk(jt->second->mu, std::try_to_lock);
+        if (blk.owns_lock() && jt->second->count == 0) {
+          blk.unlock();
+          g_barriers.erase(jt);
+          evicted = true;
+          break;
+        }
+      }
+    }
+    if (!evicted) return nullptr;
+  }
+  auto bar = std::make_shared<VanBarrier>();
+  g_barriers[bid] = bar;
+  return bar;
+}
+
+std::atomic<uint64_t> g_frames_handled{0};
 
 std::string peer_host(int fd) {
   sockaddr_in addr{};
@@ -287,12 +389,14 @@ void handle_conn(int fd) {
     // frames BEFORE any rd<> touches the body (overread-proof)
     static const uint32_t kMinBody[] = {
         0, 48, 28, 4, 4, 13, 12, 12, 8, 8, 0, 12, 20,
-        20, 36, 12, 12, 8, 16, 8, 0, 8, 4};
+        20, 36, 12, 12, 8, 16, 8, 0, 8, 4,
+        24, 20, 16, 16, 0};
     if (op < sizeof(kMinBody) / sizeof(uint32_t) &&
         blen < 1 + kMinBody[op]) {
       send_resp(fd, -3, nullptr, 0);
       continue;
     }
+    g_frames_handled.fetch_add(1, std::memory_order_relaxed);
     switch (op) {
       case OP_PING: {
         send_resp(fd, 0, nullptr, 0);
@@ -587,6 +691,117 @@ void handle_conn(int fd) {
         send_resp(fd, 0, pay.data(), (uint32_t)pay.size());
         break;
       }
+      case OP_BLOB_PUT: {
+        // [i64 channel][u64 seq][i32 wait_ms][u32 nbytes][payload]
+        int64_t channel = rd<int64_t>(p);
+        uint64_t seq = rd<uint64_t>(p);
+        int32_t wait_ms = rd<int32_t>(p);
+        uint32_t nbytes = rd<uint32_t>(p);
+        int64_t have = body.data() + blen - p;
+        if (seq == 0 || (int64_t)nbytes > kMaxBlobBytes || have < nbytes) {
+          send_resp(fd, -3, nullptr, 0); break;
+        }
+        auto c = get_blob(channel);
+        if (!c) { send_resp(fd, -6, nullptr, 0); break; }
+        int32_t rc = 0;
+        {
+          std::unique_lock<std::mutex> lk(c->mu);
+          if (seq != c->seq) {  // same-seq resend is an idempotent ack
+            bool free_slot = c->cv.wait_for(
+                lk, std::chrono::milliseconds(std::max(wait_ms, 0)),
+                [&] { return c->acked; });
+            if (!free_slot) {
+              rc = -11;  // previous message still unread past the deadline
+            } else {
+              c->data.assign(p, p + nbytes);
+              c->seq = seq;
+              c->acked = false;
+              c->cv.notify_all();
+            }
+          }
+        }
+        send_resp(fd, rc, nullptr, 0);
+        break;
+      }
+      case OP_BLOB_GET: {
+        // [i64 channel][u64 seq][i32 wait_ms]
+        // resp payload: the stored bytes (no copy survives the ack)
+        int64_t channel = rd<int64_t>(p);
+        uint64_t seq = rd<uint64_t>(p);
+        int32_t wait_ms = rd<int32_t>(p);
+        if (seq == 0) { send_resp(fd, -3, nullptr, 0); break; }
+        auto c = get_blob(channel);
+        if (!c) { send_resp(fd, -6, nullptr, 0); break; }
+        std::vector<char> out;
+        int32_t rc = 0;
+        {
+          std::unique_lock<std::mutex> lk(c->mu);
+          bool ready = c->cv.wait_for(
+              lk, std::chrono::milliseconds(std::max(wait_ms, 0)),
+              [&] { return c->seq >= seq; });
+          if (!ready) rc = -12;        // writer never delivered seq in time
+          else if (c->seq != seq) rc = -5;  // reader skipped a message
+          else out = c->data;  // copy under the lock; respond outside it
+        }
+        send_resp(fd, rc, out.data(), (uint32_t)out.size());
+        break;
+      }
+      case OP_BLOB_ACK: {
+        // [i64 channel][u64 seq] — idempotent: acking a seq the slot no
+        // longer holds is a no-op success (duplicate after a retry)
+        int64_t channel = rd<int64_t>(p);
+        uint64_t seq = rd<uint64_t>(p);
+        auto c = get_blob(channel);
+        if (!c) { send_resp(fd, -6, nullptr, 0); break; }
+        {
+          std::lock_guard<std::mutex> lk(c->mu);
+          if (c->seq == seq && !c->acked) {
+            c->acked = true;
+            // slot consumed: free the payload now (an idle channel must
+            // not pin its last message's bytes)
+            std::vector<char>().swap(c->data);
+            c->cv.notify_all();
+          }
+        }
+        send_resp(fd, 0, nullptr, 0);
+        break;
+      }
+      case OP_BARRIER: {
+        // [i64 barrier_id][i32 nworkers][i32 wait_ms]
+        int64_t bid = rd<int64_t>(p);
+        int32_t nworkers = rd<int32_t>(p);
+        int32_t wait_ms = rd<int32_t>(p);
+        if (nworkers <= 0 || nworkers > 4096) {
+          send_resp(fd, -3, nullptr, 0); break;
+        }
+        auto bar = get_barrier(bid);
+        if (!bar) { send_resp(fd, -6, nullptr, 0); break; }
+        int32_t rc = 0;
+        {
+          std::unique_lock<std::mutex> lk(bar->mu);
+          int64_t gen = bar->generation;
+          if (++bar->count >= nworkers) {
+            bar->count = 0;
+            ++bar->generation;
+            bar->cv.notify_all();
+          } else {
+            bool released = bar->cv.wait_for(
+                lk, std::chrono::milliseconds(std::max(wait_ms, 0)),
+                [&] { return bar->generation != gen; });
+            if (!released) {
+              --bar->count;  // withdraw: a timeout must not leave a ghost
+              rc = -9;       // arrival that releases a later barrier early
+            }
+          }
+        }
+        send_resp(fd, rc, nullptr, 0);
+        break;
+      }
+      case OP_STATS: {
+        uint64_t frames = g_frames_handled.load(std::memory_order_relaxed);
+        send_resp(fd, 0, &frames, 8);
+        break;
+      }
       default:
         send_resp(fd, -100, nullptr, 0);
     }
@@ -857,6 +1072,62 @@ int ps_van_table_clear(int fd, int id) {
 
 int ps_van_table_save(int fd, int id, const char* path) {
   return van_file_op(OP_SAVE, fd, id, path);
+}
+
+// ---- bulk-blob channel + barrier + stats ----
+
+int ps_van_blob_put(int fd, int64_t channel, uint64_t seq, const void* data,
+                    int64_t nbytes, int wait_ms) {
+  if (nbytes < 0 || nbytes > (int64_t)(1 << 28)) return -3;
+  std::vector<char> b{(char)OP_BLOB_PUT}, pay;
+  put<int64_t>(b, channel); put<uint64_t>(b, seq);
+  put<int32_t>(b, wait_ms); put<uint32_t>(b, (uint32_t)nbytes);
+  size_t o = b.size();
+  b.resize(o + nbytes);
+  std::memcpy(b.data() + o, data, nbytes);
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
+}
+
+// Returns the message byte count (copied into `out`, up to `cap`), or < 0.
+int64_t ps_van_blob_get(int fd, int64_t channel, uint64_t seq, void* out,
+                        int64_t cap, int wait_ms) {
+  std::vector<char> b{(char)OP_BLOB_GET}, pay;
+  put<int64_t>(b, channel); put<uint64_t>(b, seq);
+  put<int32_t>(b, wait_ms);
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
+  if ((int64_t)pay.size() > cap) return -102;  // caller buffer too small
+  std::memcpy(out, pay.data(), pay.size());
+  return (int64_t)pay.size();
+}
+
+int ps_van_blob_ack(int fd, int64_t channel, uint64_t seq) {
+  std::vector<char> b{(char)OP_BLOB_ACK}, pay;
+  put<int64_t>(b, channel); put<uint64_t>(b, seq);
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
+}
+
+int ps_van_barrier(int fd, int64_t barrier_id, int nworkers, int wait_ms) {
+  std::vector<char> b{(char)OP_BARRIER}, pay;
+  put<int64_t>(b, barrier_id); put<int32_t>(b, nworkers);
+  put<int32_t>(b, wait_ms);
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
+}
+
+// Frames the server has handled since start; < 0 on transport failure.
+int64_t ps_van_stats_frames(int fd) {
+  std::vector<char> b{(char)OP_STATS}, pay;
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
+  if (pay.size() != 8) return -5;
+  uint64_t frames;
+  std::memcpy(&frames, pay.data(), 8);
+  return (int64_t)frames;
 }
 
 int ps_van_table_load(int fd, int id, const char* path) {

@@ -37,23 +37,22 @@ def round_robin_assignments(n_microbatches: int, n_src: int,
 
 
 class VanMailbox:
-    """One-way ACKED channel over a PS van table.
+    """One-way ACKED channel between two processes over the van.
 
-    Layout: rows [0, capacity) hold the payload, row `capacity` the
-    sender's sequence flag, row `capacity + 1` the reader's ack flag.
-    `put` first waits until the previous message is acked (flag == ack),
-    then writes payload THEN flag; `get` polls the flag, pulls the
-    payload, and writes the ack.  The van server applies one connection's
-    requests in order, so the reader observing seq implies the payload is
-    complete — and the ack makes back-to-back `put`s safe: a second
-    message can never overwrite a payload the reader is still pulling
-    (round 3's single-slot caveat is gone; senders just block).
+    Default transport (``impl="blob"``): the van's bulk-blob channel
+    (OP_BLOB_PUT/GET/ACK, csrc/hetu_ps_van.cpp) — one contiguous payload
+    frame per message with server-side blocking, so a message costs the
+    sender ONE round trip and the reader two (get + ack), no client
+    polling.  This is the zmq_van.h SArray-send analog and the shipped
+    path.
 
-    Flags live in f32 rows, which represent integers exactly only up to
-    2**24 — so the wire flag is the logical seq wrapped into [1, 2**20]
-    (``_wire``).  The ack keeps the channel in lockstep (at most one
-    message between the endpoints), so wrapped flags are unambiguous and
-    the channel's message lifetime is unbounded.
+    Legacy transport (``impl="sparse"``): payload spread over f32 table
+    rows with seq/ack flag rows polled at ``poll_s`` — kept as the
+    measured baseline the blob path must beat (see
+    tests/test_ps_van.py frame-count A/B) and as a fallback that needs
+    nothing but table ops.  Flag rows are f32, exact only to 2**24, so
+    the wire flag wraps into [1, 2**20] (``_wire``); the ack lockstep
+    (at most one in-flight message) keeps wrapped flags unambiguous.
     """
 
     _SEQ_MOD = 1 << 20
@@ -63,10 +62,19 @@ class VanMailbox:
         return (seq - 1) % cls._SEQ_MOD + 1 if seq > 0 else 0
 
     def __init__(self, host: str, port: int, channel_id: int,
-                 capacity: int, *, connect_timeout_s: float = 20.0):
-        from hetu_tpu.ps.van import RemotePSTable
+                 capacity: int, *, impl: str = "blob",
+                 connect_timeout_s: float = 20.0):
+        if impl not in ("blob", "sparse"):
+            raise ValueError(f"unknown mailbox impl {impl!r}")
         self.capacity = capacity
+        self.impl = impl
         self._last_seq = 0
+        if impl == "blob":
+            from hetu_tpu.ps.van import BlobChannel
+            self._chan = BlobChannel(host, port, channel_id,
+                                     connect_timeout_s=connect_timeout_s)
+            return
+        from hetu_tpu.ps.van import RemotePSTable
         deadline = time.time() + connect_timeout_s
         # both endpoints race to create; -2 (exists) means the peer won
         while True:
@@ -97,6 +105,10 @@ class VanMailbox:
         if flat.size > self.capacity:
             raise ValueError(f"message {flat.size} > capacity "
                              f"{self.capacity}")
+        if self.impl == "blob":
+            self._chan.put(flat, seq, timeout_s=timeout_s)
+            self._last_seq = seq
+            return
         deadline = time.time() + timeout_s
         # wait for the reader's ack of the previous message
         while self._last_seq and \
@@ -116,6 +128,14 @@ class VanMailbox:
     def get(self, shape, seq: int, *, timeout_s: float = 60.0,
             poll_s: float = 0.002) -> np.ndarray:
         n = int(np.prod(shape))
+        if self.impl == "blob":
+            data = self._chan.get(seq, timeout_s=timeout_s)
+            a = np.frombuffer(data, np.float32)
+            if a.size != n:
+                raise ValueError(
+                    f"mailbox: message has {a.size} f32s, expected "
+                    f"{n} for shape {shape}")
+            return a.reshape(shape)
         deadline = time.time() + timeout_s
         while True:
             try:
@@ -135,7 +155,10 @@ class VanMailbox:
             time.sleep(poll_s)
 
     def close(self) -> None:
-        self.table.close()
+        if self.impl == "blob":
+            self._chan.close()
+        else:
+            self.table.close()
 
 
 class MPMDStageRunner:
@@ -149,7 +172,7 @@ class MPMDStageRunner:
     stage-(s+1) replica ``i % stage_dps[s+1]`` — activations and
     cotangents hop processes through acked :class:`VanMailbox` channels on
     a shared van server; cross-replica gradient reduction rides a PS
-    accumulator table with a preduce barrier (the PS-DP path).
+    accumulator table with a first-class van barrier (the PS-DP path).
 
     ``run_step(params, loss_fn, data=...)`` executes one GPipe-flush
     fwd+bwd over all M microbatches and returns
@@ -176,7 +199,8 @@ class MPMDStageRunner:
         self._jax = jax
         self._mail: dict = {}
         self._seq: dict = {}
-        # unique preduce worker id across ALL processes of this pipeline
+        # unique worker id across ALL processes of this pipeline (kept for
+        # callers that address workers globally, e.g. logging/launchers)
         self.uid = worker_uid if worker_uid is not None else \
             sum(self.dps[:stage]) + replica
 
@@ -206,13 +230,15 @@ class MPMDStageRunner:
                 if m % self.dps[self.stage] == self.replica]
 
     def _grad_plumbing(self):
-        """One REUSABLE accumulator table + preduce barrier pool for this
-        stage, created lazily on the first reducing step (preduce pools
-        match successive rounds natively; the table is cleared in place
-        between steps — per-step table ids would leak server memory)."""
+        """One REUSABLE accumulator table + first-class OP_BARRIER for
+        this stage, created lazily on the first reducing step (the
+        barrier's server-side generation counter matches successive
+        rounds natively; the table is cleared in place between steps —
+        per-step table ids would leak server memory).  Preduce
+        matchmaking is reserved for actual partial reduce."""
         if getattr(self, "_acc", None) is not None:
             return self._acc, self._barrier_cli
-        from hetu_tpu.ps.van import RemotePReduce, RemotePSTable
+        from hetu_tpu.ps.van import RemoteBarrier, RemotePSTable
         tid = self.base + (1 << 23) + self.stage
         if self.replica == 0:
             self._acc = RemotePSTable(self.host, self.port, self.grad_size,
@@ -233,15 +259,14 @@ class MPMDStageRunner:
                     if time.time() > deadline:
                         raise
                     time.sleep(0.05)
-        self._barrier_cli = RemotePReduce(
+        self._barrier_cli = RemoteBarrier(
             self.host, self.port,
-            pool_id=self.base + (1 << 23) + 64 + self.stage,
-            max_group=self.dps[self.stage], wait_ms=60_000)
+            barrier_id=self.base + (1 << 23) + 64 + self.stage,
+            n_workers=self.dps[self.stage])
         return self._acc, self._barrier_cli
 
     def _barrier(self, cli):
-        group = cli.get_partner(self.uid)
-        assert len(group) == self.dps[self.stage], group
+        cli.wait(timeout_s=60.0)
 
     def run_step(self, params, *, loss_fn=None, data=None):
         """One fwd+bwd over all microbatches this replica owns.

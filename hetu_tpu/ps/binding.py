@@ -173,6 +173,15 @@ def _load():
             "ps_group_push_sync": ([c.c_int, i64p, f32p, c.c_int64, i64p,
                                     u64p, c.c_int64, c.c_uint64, u32p, u64p,
                                     f32p], c.c_int64),
+            # bulk-blob channel + barrier + frame stats (round 5)
+            "ps_van_blob_put": ([c.c_int, c.c_int64, c.c_uint64, c.c_void_p,
+                                 c.c_int64, c.c_int], c.c_int),
+            "ps_van_blob_get": ([c.c_int, c.c_int64, c.c_uint64, c.c_void_p,
+                                 c.c_int64, c.c_int], c.c_int64),
+            "ps_van_blob_ack": ([c.c_int, c.c_int64, c.c_uint64], c.c_int),
+            "ps_van_barrier": ([c.c_int, c.c_int64, c.c_int, c.c_int],
+                               c.c_int),
+            "ps_van_stats_frames": ([c.c_int], c.c_int64),
             "ps_rcache_create": ([c.c_int, c.c_int64, c.c_int, c.c_float],
                                  c.c_int),
             "ps_rcache_lookup": ([c.c_int, i64p, c.c_int64, c.c_uint64,

@@ -1,0 +1,166 @@
+"""Bulk-blob van channel, first-class barrier, and the frame-count A/B
+against the sparse-table mailbox transport.
+
+Reference analogs: ps-lite/src/zmq_van.h (SArray contiguous send — the
+blob channel is the one-frame-per-message counterpart) and
+ps-lite/src/python_binding.cc BarrierWorker (OP_BARRIER)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import available
+
+if not available():  # pragma: no cover
+    pytest.skip("native PS lib unavailable", allow_module_level=True)
+
+from hetu_tpu.parallel.mpmd import VanMailbox
+from hetu_tpu.ps import van
+
+
+@pytest.fixture(scope="module")
+def server_port():
+    port = van.serve(0)
+    yield port
+    van.stop()
+
+
+def test_blob_roundtrip_in_order(server_port):
+    tx = van.BlobChannel("127.0.0.1", server_port, 9001)
+    rx = van.BlobChannel("127.0.0.1", server_port, 9001)
+    msgs = [np.arange(64, dtype=np.float32) + i for i in range(5)]
+
+    def writer():
+        for i, m in enumerate(msgs):
+            tx.put(m, seq=i + 1)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    for i, m in enumerate(msgs):
+        got = np.frombuffer(rx.get(i + 1), np.float32)
+        np.testing.assert_array_equal(got, m)
+    t.join()
+    tx.close()
+    rx.close()
+
+
+def test_blob_put_blocks_until_acked(server_port):
+    """A second put must not overwrite an unread message."""
+    tx = van.BlobChannel("127.0.0.1", server_port, 9002)
+    rx = van.BlobChannel("127.0.0.1", server_port, 9002)
+    tx.put(b"first", 1)
+    with pytest.raises(RuntimeError):  # slot still unread: put times out
+        tx.put(b"second", 2, timeout_s=0.3)
+    assert rx.get(1) == b"first"
+    tx.put(b"second", 2, timeout_s=5.0)  # freed by the ack
+    assert rx.get(2) == b"second"
+    tx.close()
+    rx.close()
+
+
+def test_blob_large_message_grows_buffer(server_port):
+    """Messages larger than the reader's initial 1 MB buffer round-trip."""
+    tx = van.BlobChannel("127.0.0.1", server_port, 9003)
+    rx = van.BlobChannel("127.0.0.1", server_port, 9003)
+    big = np.random.default_rng(0).standard_normal(1 << 19).astype(np.float32)
+    t = threading.Thread(target=lambda: tx.put(big, 1))  # 2 MB payload
+    t.start()
+    np.testing.assert_array_equal(np.frombuffer(rx.get(1), np.float32), big)
+    t.join()
+    tx.close()
+    rx.close()
+
+
+def test_blob_get_timeout(server_port):
+    rx = van.BlobChannel("127.0.0.1", server_port, 9004)
+    with pytest.raises(RuntimeError):
+        rx.get(1, timeout_s=0.2)
+    rx.close()
+
+
+def test_barrier_releases_all(server_port):
+    n = 4
+    released = []
+
+    def worker(i):
+        b = van.RemoteBarrier("127.0.0.1", server_port, 9100, n)
+        for round_ in range(3):  # reusable across rounds (generations)
+            b.wait(timeout_s=10.0)
+            released.append((round_, i))
+        b.close()
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(released) == 3 * n
+    # every round released all n workers before any later round finished a
+    # worker twice: counts per round are exact
+    for r in range(3):
+        assert sum(1 for rr, _ in released if rr == r) == n
+
+
+def test_barrier_timeout_withdraws_arrival(server_port):
+    """A timed-out waiter must not leave a ghost arrival behind."""
+    b = van.RemoteBarrier("127.0.0.1", server_port, 9101, 2)
+    with pytest.raises(TimeoutError):
+        b.wait(timeout_s=0.2)
+    # the withdrawn arrival must not release a later 2-party barrier early
+    done = []
+
+    def late():
+        b2 = van.RemoteBarrier("127.0.0.1", server_port, 9101, 2)
+        b2.wait(timeout_s=10.0)
+        done.append(1)
+        b2.close()
+
+    t = threading.Thread(target=late)
+    t.start()
+    time.sleep(0.3)
+    assert not done  # one live arrival only: still waiting
+    b.wait(timeout_s=10.0)  # second arrival releases both
+    t.join()
+    assert done
+    b.close()
+
+
+def test_mailbox_blob_vs_sparse_frame_count(server_port):
+    """VERDICT r4 #4: the blob mailbox must cut van frames by >=50x.
+
+    Workload: 8 messages of 4096 f32, writer "computes" 300 ms between
+    messages while the reader is already waiting — the MPMD steady state.
+    The sparse transport burns a poll frame every ms of that wait; the
+    blob transport parks the reader in one server-side blocking GET.
+    """
+    N, SIZE, COMPUTE_S = 8, 4096, 0.3
+    msgs = [np.full(SIZE, i + 1, np.float32) for i in range(N)]
+
+    def run(impl, channel):
+        tx = VanMailbox("127.0.0.1", server_port, channel, SIZE, impl=impl)
+        rx = VanMailbox("127.0.0.1", server_port, channel, SIZE, impl=impl)
+        f0 = van.stats_frames("127.0.0.1", server_port)
+
+        def writer():
+            for i, m in enumerate(msgs):
+                time.sleep(COMPUTE_S)  # stand-in for the stage's compute
+                tx.put(m, i + 1)
+
+        t = threading.Thread(target=writer)
+        t.start()
+        for i, m in enumerate(msgs):
+            got = rx.get((SIZE,), i + 1, poll_s=0.001)
+            np.testing.assert_array_equal(got, m)
+        t.join()
+        frames = van.stats_frames("127.0.0.1", server_port) - f0
+        tx.close()
+        rx.close()
+        return frames
+
+    blob_frames = run("blob", 9200)
+    sparse_frames = run("sparse", 9201)
+    # blob: put + get + ack = 3 frames per message (+2 stats queries)
+    assert blob_frames <= 4 * N + 4, blob_frames
+    assert sparse_frames >= 50 * blob_frames, (sparse_frames, blob_frames)

@@ -109,13 +109,24 @@ def release_pidfile() -> None:
 def spawn_if_absent(deadline_s: float = 11.0 * 3600) -> None:
     """Idempotent launch for entry points: start a detached watcher unless
     one already holds the pidfile.  Runs in a subprocess because main()
-    daemonizes with os._exit — calling it in-process would kill the caller."""
-    if already_running() is not None:
-        return
-    subprocess.run(
-        [sys.executable, str(pathlib.Path(__file__).resolve()),
-         "--deadline-s", str(deadline_s)],
-        capture_output=True, timeout=120)
+    daemonizes with os._exit — calling it in-process would kill the caller.
+    Never raises: a failed relaunch must not break the calling entry point.
+    Called from bench.py main(), so every bench invocation (driver capture,
+    smoke run) re-arms the watcher for the rest of the round."""
+    try:
+        if already_running() is not None:
+            return
+        env = dict(os.environ)
+        # the child MUST daemonize even when the caller's env disables it
+        # for foreground tests — otherwise run() would block, then kill the
+        # watcher at the timeout
+        env.pop("HETU_WATCHER_NO_DAEMON", None)
+        subprocess.run(
+            [sys.executable, str(pathlib.Path(__file__).resolve()),
+             "--deadline-s", str(deadline_s)],
+            capture_output=True, timeout=120, env=env)
+    except Exception:
+        pass
 
 
 def daemonize() -> None:
