@@ -1,0 +1,51 @@
+// Shared row-dtype codecs for the PS core and the van wire layer.
+//
+// The SAME bf16 rounding (round-to-nearest-even) and symmetric per-row
+// int8 scheme (scale = max|v|/127, clamp to [-127, 127]) must be used for
+// stored rows (csrc/hetu_ps.cpp row_store) and wire rows
+// (csrc/hetu_ps_van.cpp encode_rows) — a drift between the two would make
+// pulled values disagree with stored ones.  Keep every codec here.
+
+#pragma once
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+namespace hetu_ps_dtype {
+
+inline float bf16_to_f32(uint16_t h) {
+  uint32_t u = (uint32_t)h << 16;
+  float f;
+  std::memcpy(&f, &u, 4);
+  return f;
+}
+
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  uint32_t lsb = (u >> 16) & 1;  // round-to-nearest-even
+  u += 0x7fffu + lsb;
+  return (uint16_t)(u >> 16);
+}
+
+// symmetric per-row int8: scale maps the row's max magnitude onto 127
+inline float q8_scale(const float* v, int64_t d) {
+  float mx = 0.f;
+  for (int64_t i = 0; i < d; i++) mx = std::max(mx, std::fabs(v[i]));
+  return mx > 0.f ? mx / 127.f : 0.f;
+}
+
+inline void q8_quantize(const float* v, int64_t d, float s, int8_t* out) {
+  float inv = s > 0.f ? 1.f / s : 0.f;
+  for (int64_t i = 0; i < d; i++)
+    out[i] = (int8_t)std::lround(
+        std::max(-127.f, std::min(127.f, v[i] * inv)));
+}
+
+inline void q8_dequantize(const int8_t* q, int64_t d, float s, float* out) {
+  for (int64_t i = 0; i < d; i++) out[i] = q[i] * s;
+}
+
+}  // namespace hetu_ps_dtype

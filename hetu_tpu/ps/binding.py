@@ -173,6 +173,27 @@ def _load():
             "ps_group_push_sync": ([c.c_int, i64p, f32p, c.c_int64, i64p,
                                     u64p, c.c_int64, c.c_uint64, u32p, u64p,
                                     f32p], c.c_int64),
+            # dtype'd rows: bf16/int8 storage + wire encoding (round 5)
+            "ps_table_create_ex": ([c.c_int, c.c_int64, c.c_int64, c.c_int,
+                                    c.c_double, c.c_double, c.c_uint64,
+                                    c.c_int], c.c_int),
+            "ps_table_dtype": ([c.c_int], c.c_int),
+            "ps_van_table_create_dt": ([c.c_int, c.c_int, c.c_int64,
+                                        c.c_int64, c.c_int, c.c_double,
+                                        c.c_double, c.c_uint64, c.c_int],
+                                       c.c_int),
+            "ps_van_sparse_pull_dt": ([c.c_int, c.c_int, i64p, c.c_int64,
+                                       f32p, c.c_int64, c.c_int], c.c_int),
+            "ps_van_sparse_set_dt": ([c.c_int, c.c_int, i64p, f32p,
+                                      c.c_int64, c.c_int64, c.c_int],
+                                     c.c_int),
+            "ps_van_sparse_push_dt": ([c.c_int, c.c_int, i64p, f32p,
+                                       c.c_int64, c.c_int64, c.c_int],
+                                      c.c_int),
+            "ps_van_sparse_push_id_dt": ([c.c_int, c.c_int, i64p, f32p,
+                                          c.c_int64, c.c_int64, c.c_int,
+                                          c.c_uint64], c.c_int),
+            "ps_van_stats": ([c.c_int, u64p, u64p, u64p], c.c_int),
             # bulk-blob channel + barrier + frame stats (round 5)
             "ps_van_blob_put": ([c.c_int, c.c_int64, c.c_uint64, c.c_void_p,
                                  c.c_int64, c.c_int], c.c_int),

@@ -49,22 +49,33 @@ def _check(rc, what: str):
 
 
 _INIT_KINDS = {"zeros": 0, "constant": 1, "uniform": 2, "normal": 3}
+TABLE_DTYPES = {"f32": 0, "bf16": 1, "int8": 2}  # row STORAGE dtypes
 _OPT_KINDS = {"sgd": 0, "momentum": 1, "adagrad": 2, "adam": 3,
               "nesterov": 4}
 
 
 class PSTable:
-    """A server-held parameter table with a server-side optimizer."""
+    """A server-held parameter table with a server-side optimizer.
+
+    ``dtype`` selects ROW STORAGE only (reference hetu_cache row storage):
+    "f32" (default), "bf16" (half the bytes), or "int8" (quarter, with a
+    per-row dequant scale).  All arithmetic — server-side optimizer math
+    and every pull seen by callers — stays f32; optimizer slots are f32
+    regardless of row dtype.
+    """
 
     def __init__(self, rows: int, dim: int, *, init: str = "normal",
                  init_a: float = 0.0, init_b: float = 0.01, seed: int = 0,
                  optimizer: str = "sgd", lr: float = 0.01,
                  momentum: float = 0.9, eps: float = 1e-7,
-                 beta1: float = 0.9, beta2: float = 0.999):
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 dtype: str = "f32"):
         self.id = next(_table_ids)
         self.rows, self.dim = rows, dim
-        _check(lib.ps_table_create(self.id, rows, dim, _INIT_KINDS[init],
-                                   init_a, init_b, seed), "table_create")
+        self.dtype = dtype
+        _check(lib.ps_table_create_ex(self.id, rows, dim, _INIT_KINDS[init],
+                                      init_a, init_b, seed,
+                                      TABLE_DTYPES[dtype]), "table_create")
         _check(lib.ps_table_set_optimizer(self.id, _OPT_KINDS[optimizer], lr,
                                           momentum, eps, beta1, beta2),
                "set_optimizer")

@@ -40,7 +40,12 @@ class PSEmbedding:
                  cache_capacity: Optional[int] = None,
                  cache_policy: str = "lfuopt", pull_bound: int = 0,
                  init: str = "normal", init_b: float = 0.01, seed: int = 0,
-                 endpoints=None, scheduler=None, table_id=None):
+                 endpoints=None, scheduler=None, table_id=None,
+                 dtype: str = "f32"):
+        # dtype: row storage (+ wire encoding on the remote tier) —
+        # "bf16" halves, "int8" quarters embedding memory/traffic while
+        # optimizer state and every pulled row stay f32 (in-process tier;
+        # the partitioned remote tier is f32-only for now)
         if table_id is not None and endpoints is None and scheduler is None:
             raise ValueError(
                 "table_id applies to the remote tiers only (the in-process "
@@ -50,6 +55,11 @@ class PSEmbedding:
             raise ValueError(
                 "pass endpoints= OR scheduler=, not both (the scheduler "
                 "resolves the endpoints itself)")
+        if dtype != "f32" and (endpoints is not None or
+                               scheduler is not None):
+            raise ValueError(
+                "dtype'd rows are supported on the in-process tier and "
+                "RemotePSTable; the partitioned tier is f32-only for now")
         if endpoints is not None or scheduler is not None:
             from hetu_tpu.ps.van import PartitionedPSTable, RemoteCacheTable
             if scheduler is not None:
@@ -67,7 +77,7 @@ class PSEmbedding:
         else:
             self.table = PSTable(num_embeddings, dim, init=init,
                                  init_b=init_b, seed=seed,
-                                 optimizer=optimizer, lr=lr)
+                                 optimizer=optimizer, lr=lr, dtype=dtype)
             cache_cls = CacheSparseTable
         try:
             self.cache = (cache_cls(self.table, cache_capacity,

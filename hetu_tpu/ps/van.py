@@ -112,7 +112,13 @@ def scheduler_map(host: str, port: int) -> list[dict]:
 
 
 class RemotePSTable:
-    """PSTable API over the van (reference worker-side kvworker)."""
+    """PSTable API over the van (reference worker-side kvworker).
+
+    ``dtype`` ("f32"/"bf16"/"int8") selects row storage AND wire encoding:
+    pulls/sets of a bf16 table move half the bytes, int8 a quarter (plus a
+    per-row scale); gradients push bf16 for bf16 tables and f32 otherwise.
+    Callers always see f32 arrays — codecs live in the C client stubs.
+    BOTH endpoints of a shared table id must agree on its dtype."""
 
     def __init__(self, host: str, port: int, rows: int, dim: int, *,
                  table_id: Optional[int] = None, create: bool = True,
@@ -121,16 +127,19 @@ class RemotePSTable:
                  optimizer: str = "sgd", lr: float = 0.01,
                  momentum: float = 0.9, eps: float = 1e-7,
                  beta1: float = 0.9, beta2: float = 0.999,
+                 dtype: str = "f32",
                  connect_timeout_s: float = 10.0):
-        from hetu_tpu.ps.client import _INIT_KINDS, _OPT_KINDS
+        from hetu_tpu.ps.client import TABLE_DTYPES, _INIT_KINDS, _OPT_KINDS
         self.rows, self.dim = rows, dim
+        self.dtype = dtype
+        self._dt = TABLE_DTYPES[dtype]
         self.fd = _connect_with_deadline(host, port, connect_timeout_s)
         self.id = table_id if table_id is not None else _fresh_remote_id()
         if create:
             try:
-                _check(lib.ps_van_table_create(
+                _check(lib.ps_van_table_create_dt(
                     self.fd, self.id, rows, dim, _INIT_KINDS[init], init_a,
-                    init_b, seed), "van_table_create")
+                    init_b, seed, self._dt), "van_table_create")
                 _check(lib.ps_van_set_optimizer(
                     self.fd, self.id, _OPT_KINDS[optimizer], lr, momentum,
                     eps, beta1, beta2), "van_set_optimizer")
@@ -144,16 +153,18 @@ class RemotePSTable:
     def sparse_pull(self, indices) -> np.ndarray:
         idx = _as_idx(indices)
         out = np.empty((idx.shape[0], self.dim), np.float32)
-        _check(lib.ps_van_sparse_pull(self.fd, self.id, _i64p(idx),
-                                      idx.shape[0], _f32p(out), self.dim),
+        _check(lib.ps_van_sparse_pull_dt(self.fd, self.id, _i64p(idx),
+                                         idx.shape[0], _f32p(out),
+                                         self.dim, self._dt),
                "van_sparse_pull")
         return out
 
     def sparse_push(self, indices, grads) -> None:
         idx = _as_idx(indices)
         g = _as_mat(grads, idx.shape[0], self.dim)
-        _check(lib.ps_van_sparse_push(self.fd, self.id, _i64p(idx), _f32p(g),
-                                      idx.shape[0], self.dim),
+        _check(lib.ps_van_sparse_push_dt(self.fd, self.id, _i64p(idx),
+                                         _f32p(g), idx.shape[0], self.dim,
+                                         self._dt),
                "van_sparse_push")
 
     def dense_pull(self) -> np.ndarray:
@@ -170,8 +181,9 @@ class RemotePSTable:
     def sparse_set(self, indices, values) -> None:
         idx = _as_idx(indices)
         v = _as_mat(values, idx.shape[0], self.dim)
-        _check(lib.ps_van_sparse_set(self.fd, self.id, _i64p(idx), _f32p(v),
-                                     idx.shape[0], self.dim),
+        _check(lib.ps_van_sparse_set_dt(self.fd, self.id, _i64p(idx),
+                                        _f32p(v), idx.shape[0], self.dim,
+                                        self._dt),
                "van_sparse_set")
 
     def clear(self) -> None:
@@ -511,6 +523,11 @@ class BlobChannel:
         self.host, self.port = host, port
         self.id = int(channel_id)
         self._timeout_s = connect_timeout_s
+        # receive buffer persists across get() calls: messages are usually
+        # the same size per channel, so after one grow every later get is
+        # a single round trip (a fresh 1 MB buffer each call would
+        # re-transfer every >1 MB message just to learn its size)
+        self._rbuf = ctypes.create_string_buffer(1 << 20)
         self.fd = _connect_with_deadline(host, port, connect_timeout_s)
 
     def _reconnect(self) -> None:
@@ -530,28 +547,29 @@ class BlobChannel:
                                      len(buf), wait_ms)
             if rc == 0:
                 return
-            if time.time() > deadline or rc in (-3, -6):
+            if time.time() > deadline:
                 raise RuntimeError(f"blob put failed (rc={rc})")
             if rc == -101:  # transport: reconnect and resend (idempotent)
                 self._reconnect()
-            # -11 (slot still unread) falls through to retry with the
-            # remaining wait budget
+            elif rc != -11:
+                # only "slot still unread" (-11) retries; anything else is
+                # a server-side refusal — resending the payload in a tight
+                # loop would hammer the van for the whole timeout
+                raise RuntimeError(f"blob put failed (rc={rc})")
 
     def get(self, seq: int, *, timeout_s: float = 60.0) -> bytes:
         cap = 1 << 28
-        # size the receive buffer lazily: start at 1 MB, grow on -102
-        out = ctypes.create_string_buffer(1 << 20)
         deadline = time.time() + timeout_s
         while True:
             wait_ms = max(1, int((deadline - time.time()) * 1000))
-            n = lib.ps_van_blob_get(self.fd, self.id, seq, out,
-                                    len(out), wait_ms)
+            n = lib.ps_van_blob_get(self.fd, self.id, seq, self._rbuf,
+                                    len(self._rbuf), wait_ms)
             if n >= 0:
                 self._ack(seq, deadline)
-                return ctypes.string_at(out, n)
-            if n == -102 and len(out) < cap:  # buffer too small: grow
-                out = ctypes.create_string_buffer(
-                    min(cap, len(out) * 16))
+                return ctypes.string_at(self._rbuf, n)
+            if n == -102 and len(self._rbuf) < cap:  # too small: grow
+                self._rbuf = ctypes.create_string_buffer(
+                    min(cap, len(self._rbuf) * 16))
                 continue
             if time.time() > deadline:
                 raise RuntimeError(f"blob get failed (rc={n})")
@@ -604,14 +622,24 @@ class RemoteBarrier:
             self.fd = -1
 
 
-def stats_frames(host: str, port: int, timeout_s: float = 10.0) -> int:
-    """Total frames the server has handled — transport-efficiency metric
-    (the blob path must beat the sparse path by orders of magnitude)."""
+def stats(host: str, port: int, timeout_s: float = 10.0) -> dict:
+    """Server transport counters since start: frames handled and bytes
+    received/sent.  Transport-efficiency metrics — the blob path must beat
+    the sparse path on frames, dtype'd tables must beat f32 on bytes."""
     fd = _connect_with_deadline(host, port, timeout_s)
     try:
-        n = int(lib.ps_van_stats_frames(fd))
-        if n < 0:
-            raise RuntimeError(f"stats query failed (rc={n})")
-        return n
+        frames = ctypes.c_uint64()
+        rx = ctypes.c_uint64()
+        tx = ctypes.c_uint64()
+        rc = lib.ps_van_stats(fd, ctypes.byref(frames), ctypes.byref(rx),
+                              ctypes.byref(tx))
+        if rc != 0:
+            raise RuntimeError(f"stats query failed (rc={rc})")
+        return {"frames": frames.value, "bytes_rx": rx.value,
+                "bytes_tx": tx.value}
     finally:
         lib.ps_van_close(fd)
+
+
+def stats_frames(host: str, port: int, timeout_s: float = 10.0) -> int:
+    return stats(host, port, timeout_s)["frames"]

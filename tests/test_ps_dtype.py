@@ -1,0 +1,181 @@
+"""bf16 / int8 row storage in the PS tier (VERDICT r4 weak #5).
+
+Reference analog: src/hetu_cache/include/cache.h row storage — HET-style
+deployments ship embedding tiers in compressed dtypes.  Rows here store
+(and travel the wire) as bf16/int8 while ALL arithmetic stays f32:
+server-side optimizer slots are f32, every pull callers see is f32.
+"""
+
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import available
+
+if not available():  # pragma: no cover
+    pytest.skip("native PS lib unavailable", allow_module_level=True)
+
+from hetu_tpu.ps import PSEmbedding, PSTable
+from hetu_tpu.ps import van
+
+
+@pytest.fixture(scope="module")
+def server_port():
+    port = van.serve(0)
+    yield port
+    van.stop()
+
+
+def test_bf16_table_matches_f32_within_precision():
+    f32 = PSTable(32, 8, init="normal", init_b=0.5, seed=7)
+    b16 = PSTable(32, 8, init="normal", init_b=0.5, seed=7, dtype="bf16")
+    a, b = f32.sparse_pull(np.arange(32)), b16.sparse_pull(np.arange(32))
+    # same RNG stream, bf16 rounding only (~3 decimal digits)
+    np.testing.assert_allclose(a, b, rtol=1e-2, atol=1e-2)
+    assert not np.array_equal(a, b)  # rounding actually happened
+
+
+def test_bf16_sgd_training_tracks_f32():
+    """Server-side optimizer math is f32; only row storage rounds."""
+    idx = np.arange(16)
+    g = np.random.default_rng(1).standard_normal((16, 4)).astype(np.float32)
+    f32 = PSTable(16, 4, init="zeros", optimizer="adagrad", lr=0.1)
+    b16 = PSTable(16, 4, init="zeros", optimizer="adagrad", lr=0.1,
+                  dtype="bf16")
+    for _ in range(10):
+        f32.sparse_push(idx, g)
+        b16.sparse_push(idx, g)
+    np.testing.assert_allclose(f32.sparse_pull(idx), b16.sparse_pull(idx),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_int8_set_pull_roundtrip():
+    t = PSTable(8, 16, init="zeros", dtype="int8")
+    v = np.random.default_rng(2).standard_normal((8, 16)).astype(np.float32)
+    t.sparse_set(np.arange(8), v)
+    got = t.sparse_pull(np.arange(8))
+    # symmetric per-row quantization: error bounded by scale/2 per element
+    scales = np.abs(v).max(axis=1, keepdims=True) / 127.0
+    assert np.all(np.abs(got - v) <= scales * 0.51 + 1e-7)
+
+
+def test_dtype_checkpoint_interchange(tmp_path):
+    """Checkpoints serialize rows as f32 whatever the storage dtype."""
+    src = PSTable(8, 4, init="normal", init_b=0.3, seed=3, dtype="bf16")
+    dst = PSTable(8, 4, init="zeros")
+    p = tmp_path / "t.ps"
+    src.save(p)
+    dst.load(p)
+    np.testing.assert_allclose(dst.sparse_pull(np.arange(8)),
+                               src.sparse_pull(np.arange(8)), rtol=1e-6)
+
+
+def test_remote_bf16_roundtrip_and_wire_bytes(server_port):
+    """bf16 rows on the wire: pulls move ~half the bytes of f32 pulls."""
+    ROWS, DIM, N_PULLS = 256, 32, 20
+    idx = np.arange(ROWS)
+
+    def measure(dtype, table_id):
+        t = van.RemotePSTable("127.0.0.1", server_port, ROWS, DIM,
+                              table_id=table_id, init="normal",
+                              init_b=0.1, seed=5, dtype=dtype)
+        t.sparse_pull(idx)  # warm (create/optimizer frames excluded below)
+        before = van.stats("127.0.0.1", server_port)["bytes_tx"]
+        for _ in range(N_PULLS):
+            out = t.sparse_pull(idx)
+        delta = van.stats("127.0.0.1", server_port)["bytes_tx"] - before
+        t.close()
+        return out, delta
+
+    a, f32_bytes = measure("f32", 9301)
+    b, bf16_bytes = measure("bf16", 9302)
+    np.testing.assert_allclose(a, b, rtol=1e-2, atol=1e-2)  # same seed
+    # each pull response: ROWS*DIM elements — 4 B vs 2 B + frame headers;
+    # the stats probes themselves add two small frames per measure
+    ratio = bf16_bytes / f32_bytes
+    assert 0.45 < ratio < 0.6, (f32_bytes, bf16_bytes, ratio)
+
+
+def test_remote_bf16_push_halves_grad_bytes(server_port):
+    ROWS, DIM, N = 128, 32, 20
+    idx = np.arange(ROWS)
+    g = np.random.default_rng(4).standard_normal((ROWS, DIM)) \
+        .astype(np.float32)
+
+    def measure(dtype, table_id):
+        t = van.RemotePSTable("127.0.0.1", server_port, ROWS, DIM,
+                              table_id=table_id, init="zeros",
+                              optimizer="sgd", lr=0.1, dtype=dtype)
+        t.sparse_push(idx, g)  # warm
+        before = van.stats("127.0.0.1", server_port)["bytes_rx"]
+        for _ in range(N):
+            t.sparse_push(idx, g)
+        delta = van.stats("127.0.0.1", server_port)["bytes_rx"] - before
+        t.close()
+        return delta
+
+    f32_bytes = measure("f32", 9303)
+    bf16_bytes = measure("bf16", 9304)
+    # push frame = 8 B key + grad bytes per row: bf16 grads cut the grad
+    # half in half -> ratio ~ (8 + 64) / (8 + 128) = 0.53
+    ratio = bf16_bytes / f32_bytes
+    assert 0.45 < ratio < 0.65, (f32_bytes, bf16_bytes, ratio)
+
+
+def test_remote_int8_pull_quarters_row_bytes(server_port):
+    ROWS, DIM, N = 128, 64, 20
+    idx = np.arange(ROWS)
+
+    def measure(dtype, table_id):
+        t = van.RemotePSTable("127.0.0.1", server_port, ROWS, DIM,
+                              table_id=table_id, init="normal",
+                              init_b=0.1, seed=6, dtype=dtype)
+        t.sparse_pull(idx)
+        before = van.stats("127.0.0.1", server_port)["bytes_tx"]
+        for _ in range(N):
+            out = t.sparse_pull(idx)
+        delta = van.stats("127.0.0.1", server_port)["bytes_tx"] - before
+        t.close()
+        return out, delta
+
+    a, f32_bytes = measure("f32", 9305)
+    b, int8_bytes = measure("int8", 9306)
+    scales = np.abs(a).max(axis=1, keepdims=True) / 127.0
+    assert np.all(np.abs(a - b) <= scales * 0.51 + 1e-7)
+    # int8 row = DIM bytes + 4 B scale vs DIM*4 B: ~0.27 at DIM=64
+    ratio = int8_bytes / f32_bytes
+    assert 0.2 < ratio < 0.35, (f32_bytes, int8_bytes, ratio)
+
+
+def test_wdl_hybrid_learns_on_bf16_rows():
+    """VERDICT r4 'done' criterion: the WDL hybrid path trains with bf16
+    embedding tables (storage compressed, learning intact)."""
+    import jax
+
+    from hetu_tpu import optim
+    from hetu_tpu.models.wdl import WideDeep
+
+    g = np.random.default_rng(0)
+    fields, dense_dim, vocab, B = 4, 3, 50, 64
+    sparse = g.integers(0, vocab, (B * 8, fields)).astype(np.int64)
+    dense_x = g.standard_normal((B * 8, dense_dim)).astype(np.float32)
+    y = ((sparse.sum(-1) % 2) ^ (dense_x[:, 0] > 0)).astype(np.float32)
+
+    emb = PSEmbedding(vocab, 8, optimizer="adagrad", lr=0.1, seed=0,
+                      dtype="bf16")
+    model = WideDeep(fields, 8, dense_dim, hidden=(32,))
+    opt = optim.AdamOptimizer(5e-3)
+    v = model.init(jax.random.PRNGKey(0))
+    params, model_state = v["params"], v["state"]
+    opt_state = opt.init_state(params)
+    step = model.hybrid_step_fn(opt)
+
+    losses = []
+    for it in range(40):
+        lo = (it * B) % (sparse.shape[0] - B)
+        ids, dx, yy = (sparse[lo:lo + B], dense_x[lo:lo + B], y[lo:lo + B])
+        rows = emb.pull(ids)
+        params, opt_state, model_state, loss, _, ge = step(
+            params, opt_state, model_state, dx, rows, yy)
+        emb.push(ids, np.asarray(ge))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
