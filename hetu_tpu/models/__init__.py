@@ -13,3 +13,5 @@ from hetu_tpu.models.gcn import GCN
 from hetu_tpu.models.wdl import WideDeep
 from hetu_tpu.models.gpt_hetero import HeteroGPT, PlanStrategy
 from hetu_tpu.models.ctr_zoo import DeepFM, DCN, CrossNet
+from hetu_tpu.models.llama import (HeteroLlama, LlamaConfig, LlamaModel,
+                                   llama2_7b)

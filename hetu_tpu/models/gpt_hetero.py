@@ -174,8 +174,13 @@ class PlanStrategy(Strategy):
     position/token) embedding tables as well.
     """
 
+    # Megatron split points by param name, shared across model families:
+    # GPT blocks expose ffn_in/ffn_out, Llama blocks ffn_gate/ffn_up/
+    # ffn_down (SwiGLU: both input mats col-split, down row-split)
     COL = ("qkv_weight", "qkv_bias")
     ROW = ("out_weight",)
+    FFN_COL = ("ffn_in", "ffn_gate", "ffn_up")
+    FFN_ROW = ("ffn_out", "ffn_down")
 
     def __init__(self, plan: Plan, *, embed_sdp: bool = False):
         if plan.stage_bounds or plan.meta.get("pp", 1) > 1:
@@ -212,19 +217,24 @@ class PlanStrategy(Strategy):
     def _tp_spec(self, path, ndim, tp):
         if tp <= 1:
             return P()
-        if any(k in path for k in self.COL) or "ffn_in" in path:
+        if any(k in path for k in self.COL + self.FFN_COL):
             return P(*((None,) * (ndim - 1)), "tp")
-        if "bias" not in path and (any(k in path for k in self.ROW)
-                                   or "ffn_out" in path):
+        if "bias" not in path and any(k in path
+                                      for k in self.ROW + self.FFN_ROW):
             if ndim >= 2:
                 return P(*((None,) * (ndim - 2)), "tp", None)
         return P()
+
+    # edge (non-transformer) params the embed/head dp_type options govern:
+    # tied GPT embeddings and Llama's UNTIED lm_head — the searcher's
+    # memory certificate assumes the head shards when its edge says so
+    EDGE = ("tok_emb", "pos_emb", "lm_head")
 
     def param_spec(self, path, leaf):
         ndim = leaf.ndim if hasattr(leaf, "ndim") else len(leaf.shape)
         opt = self._layer_opt(path)
         if opt is None:
-            if self.embed_sdp and ("tok_emb" in path or "pos_emb" in path):
+            if self.embed_sdp and any(k in path for k in self.EDGE):
                 return _add_dp_axis(P(), ndim)
             return P()
         spec = self._tp_spec(path, ndim, opt.tp)
@@ -237,7 +247,7 @@ class PlanStrategy(Strategy):
         opt = self._layer_opt(path)
         if opt is None:
             if (self.embed_sdp or self.embed_zero1) and \
-                    ("tok_emb" in path or "pos_emb" in path):
+                    any(k in path for k in self.EDGE):
                 return _add_dp_axis(P(), ndim)
             return self.param_spec(path, leaf)
         spec = self._tp_spec(path, ndim, opt.tp)

@@ -23,7 +23,10 @@ from hetu_tpu.ops.conv import (
     conv2d, conv2d_add_bias, max_pool2d, avg_pool2d,
 )
 from hetu_tpu.ops.norm import (
-    batch_norm, layer_norm, instance_norm2d,
+    batch_norm, layer_norm, instance_norm2d, rms_norm,
+)
+from hetu_tpu.ops.rope import (
+    apply_rope, rope_tables,
 )
 from hetu_tpu.ops.activations import (
     relu, leaky_relu, gelu, sigmoid, tanh, softmax, log_softmax, silu,

@@ -10,6 +10,7 @@ no custom kernels are needed (the reductions are fast on VPU).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -34,6 +35,18 @@ def batch_norm(x, scale, bias, running_mean, running_var, *,
         jnp.sqrt(var.reshape(shape) + eps))
     y = y * scale.reshape(shape) + bias.reshape(shape)
     return y, new_rm, new_rv
+
+
+def rms_norm(x, scale, *, eps: float = 1e-6, axis: int = -1):
+    """RMSNorm (Zhang & Sennrich '19): x / rms(x) * scale — no mean
+    subtraction, no bias.  The Llama-family norm (reference analog:
+    tools/Galvatron llama models use HF LlamaRMSNorm).  Statistics in f32
+    whatever the input dtype, result cast back (bf16-safe)."""
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=axis, keepdims=True)
+    return (xf * jax.lax.rsqrt(ms + eps) * scale.astype(jnp.float32)) \
+        .astype(dt)
 
 
 def layer_norm(x, scale, bias, *, eps: float = 1e-5, axis: int = -1):

@@ -1,0 +1,41 @@
+"""Rotary position embedding (RoPE, Su et al. '21).
+
+Reference analog: the Llama/Baichuan models under tools/Galvatron
+(galvatron/models/llama_hf) position-encode q/k with HF's rotary embedding
+inside the attention kernel.  TPU form: precompute the [S, D/2] cos/sin
+tables once per call (XLA hoists them out of the layer scan) and rotate
+pairs with two fused multiplies — no gather, no complex dtype.
+
+Convention: HALF-ROTATION layout (the HF/Llama one) — the head dim is
+split [x1 | x2] and rotated as (x1*cos - x2*sin, x2*cos + x1*sin).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_tables(seq_len: int, head_dim: int, *, theta: float = 10000.0,
+                dtype=jnp.float32):
+    """cos/sin tables ``[S, D/2]`` for :func:`apply_rope`."""
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
+    inv_freq = 1.0 / theta ** (
+        jnp.arange(0, head_dim, 2, jnp.float32) / head_dim)
+    ang = jnp.outer(jnp.arange(seq_len, dtype=jnp.float32), inv_freq)
+    return jnp.cos(ang).astype(dtype), jnp.sin(ang).astype(dtype)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate ``x [..., S, D]`` by position; cos/sin are ``[S, D/2]``.
+
+    Works for any leading batch/head dims (tables broadcast over them).
+    Computation in the input dtype — the tables should be f32 for long
+    sequences (angles lose precision in bf16) and are cast here.
+    """
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    cos = cos.astype(x.dtype)
+    sin = sin.astype(x.dtype)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)

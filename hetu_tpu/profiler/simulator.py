@@ -234,13 +234,14 @@ class Simulator:
         return params + opt_state + acts
 
 
-def transformer_layer_specs(num_layers: int, hidden: int, ffn: int,
-                            seq: int, batch: int, vocab: int,
-                            *, tp_candidates=(1, 2, 4, 8),
-                            bytes_per_el: int = 2) -> List[LayerSpec]:
-    """Build the LayerSpec chain for a GPT-style model — the bridge from
-    model configs to the searchers (reference: backbone node-group formation,
-    distributed_strategies/base.py:47-156)."""
+def _lm_layer_specs(num_layers: int, hidden: int, seq: int, batch: int,
+                    vocab: int, *, attn_flops: float,
+                    attn_param_bytes: float, ffn_flops: float,
+                    ffn_param_bytes: float, head_param_bytes: float,
+                    tp_candidates, bytes_per_el: int) -> List[LayerSpec]:
+    """Shared [embed, (attn_i, ffn_i)*, head] chain builder: the model
+    families differ only in per-layer flop/param constants, so those are
+    the ONLY per-family inputs (one costing convention, no drift)."""
     tokens = batch * seq
     layers = [LayerSpec(
         name="embed",
@@ -248,27 +249,67 @@ def transformer_layer_specs(num_layers: int, hidden: int, ffn: int,
         param_bytes=float(vocab * hidden * 4),
         act_bytes=float(tokens * hidden * bytes_per_el),
         options=[ShardOption("dp")])]
-    attn_flops = (4 * tokens * hidden * hidden            # qkv+out proj
-                  + 2 * batch * seq * seq * hidden)       # scores+values
-    ffn_flops = 4.0 * tokens * hidden * ffn
     for i in range(num_layers):
-        opts_attn = [ShardOption("dp")] + [
-            ShardOption("tp_col", t) for t in tp_candidates if t > 1]
         layers.append(LayerSpec(
             name=f"attn_{i}", flops=float(attn_flops),
-            param_bytes=float(4 * hidden * hidden * 4),
+            param_bytes=float(attn_param_bytes),
             act_bytes=float(tokens * hidden * bytes_per_el),
-            options=opts_attn))
-        opts_ffn = [ShardOption("dp")] + [
-            ShardOption("tp_row", t) for t in tp_candidates if t > 1]
+            options=[ShardOption("dp")] + [
+                ShardOption("tp_col", t) for t in tp_candidates if t > 1]))
         layers.append(LayerSpec(
             name=f"ffn_{i}", flops=float(ffn_flops),
-            param_bytes=float(2 * hidden * ffn * 4),
+            param_bytes=float(ffn_param_bytes),
             act_bytes=float(tokens * hidden * bytes_per_el),
-            options=opts_ffn))
+            options=[ShardOption("dp")] + [
+                ShardOption("tp_row", t) for t in tp_candidates if t > 1]))
     layers.append(LayerSpec(
         name="head", flops=2.0 * tokens * hidden * vocab,
-        param_bytes=0.0,  # tied
+        param_bytes=float(head_param_bytes),
         act_bytes=float(tokens * vocab * bytes_per_el),
         options=[ShardOption("dp")]))
     return layers
+
+
+def transformer_layer_specs(num_layers: int, hidden: int, ffn: int,
+                            seq: int, batch: int, vocab: int,
+                            *, tp_candidates=(1, 2, 4, 8),
+                            bytes_per_el: int = 2) -> List[LayerSpec]:
+    """LayerSpec chain for a GPT-style model — the bridge from model
+    configs to the searchers (reference: backbone node-group formation,
+    distributed_strategies/base.py:47-156).  Flops at 2 per MAC
+    throughout (q,k,v,out projections = 8*T*H^2; scores+values =
+    4*B*S^2*H; 2-mat GELU ffn = 4*T*H*F)."""
+    tokens = batch * seq
+    return _lm_layer_specs(
+        num_layers, hidden, seq, batch, vocab,
+        attn_flops=8.0 * tokens * hidden * hidden
+        + 4.0 * batch * seq * seq * hidden,
+        attn_param_bytes=4 * hidden * hidden * 4,
+        ffn_flops=4.0 * tokens * hidden * ffn,
+        ffn_param_bytes=2 * hidden * ffn * 4,
+        head_param_bytes=0.0,  # tied to tok_emb
+        tp_candidates=tp_candidates, bytes_per_el=bytes_per_el)
+
+
+def llama_layer_specs(num_layers: int, hidden: int, ffn: int,
+                      seq: int, batch: int, vocab: int,
+                      *, num_kv_heads: int = 0, num_heads: int = 0,
+                      tp_candidates=(1, 2, 4, 8),
+                      bytes_per_el: int = 2) -> List[LayerSpec]:
+    """LayerSpec chain for the Llama family (models/llama.py HeteroLlama):
+    GQA-sized qkv params (k,v scaled by num_kv_heads/num_heads), SwiGLU
+    ffn (3 mats, 6*T*H*F flops at 2/MAC), UNTIED head.  Same chain shape
+    as the GPT builder, so every searcher and PlanStrategy consume it
+    unchanged (reference tools/Galvatron/galvatron/models/llama_hf)."""
+    tokens = batch * seq
+    kv_frac = (num_kv_heads / num_heads) if num_heads and num_kv_heads \
+        else 1.0
+    return _lm_layer_specs(
+        num_layers, hidden, seq, batch, vocab,
+        attn_flops=(4.0 + 4.0 * kv_frac) * tokens * hidden * hidden
+        + 4.0 * batch * seq * seq * hidden,
+        attn_param_bytes=(2 + 2 * kv_frac) * hidden * hidden * 4,
+        ffn_flops=6.0 * tokens * hidden * ffn,
+        ffn_param_bytes=3 * hidden * ffn * 4,
+        head_param_bytes=vocab * hidden * 4,  # UNTIED lm_head
+        tp_candidates=tp_candidates, bytes_per_el=bytes_per_el)
