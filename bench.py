@@ -334,6 +334,7 @@ def bench_ctr():
 
     # PS-hybrid path at the same shapes, small vocab (host-RAM tier)
     ps_sps = None
+    p3_ab = None
     try:
         from hetu_tpu.ps import PSEmbedding
         emb = PSEmbedding(1_000_000, DIM, optimizer="sgd", lr=0.01, seed=0)
@@ -352,6 +353,33 @@ def bench_ctr():
             p2, o2, ms2, _, _, ge = hstep(p2, o2, ms2, dx, rows, y)
             emb.push(np_ids, np.asarray(ge))
         ps_sps = round(B * iters / (time.perf_counter() - t0), 1)
+
+        # P3-style priority prefetch A/B (ps-lite p3_van.h analog): time
+        # until the FIRST-NEEDED rows are ready to compute on.  Baseline =
+        # monolithic prefetch (all fields in one pull, first rows ready
+        # only when the whole batch lands); optimized = layered prefetch
+        # issuing the first-use segment first (compute starts while the
+        # tail segments are still pulling).
+        first_fields = 4  # the wide tower's first-consumed slice
+        reps = 8
+        t_mono = t_layered = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            emb.prefetch(np_ids)
+            emb.pull_prefetched()
+            t_mono += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            emb.prefetch_layered([(0, np_ids[:, :first_fields]),
+                                  (1, np_ids[:, first_fields:])])
+            emb.pull_layered(0)          # first-needed rows ready HERE
+            t_first = time.perf_counter() - t0
+            emb.pull_layered(1)          # drain the tail segment
+            t_layered += t_first
+        p3_ab = {"optimized": "layered_priority_prefetch_first_segment_s",
+                 "baseline": "monolithic_prefetch_all_fields_s",
+                 "first_ready_s": round(t_layered / reps, 6),
+                 "monolithic_s": round(t_mono / reps, 6),
+                 "speedup_to_first_rows": round(t_mono / t_layered, 2)}
     except Exception as e:  # PS lib unavailable: report, don't fail the bench
         ps_sps = f"unavailable: {type(e).__name__}"
 
@@ -361,7 +389,8 @@ def bench_ctr():
         "unit": "samples/s/chip",
         "vs_baseline": round(base_step_s / step_s, 3),
         "extra": {"roofline_sps": round(roofline_sps, 1),
-                  "ps_hybrid_sps": ps_sps, "batch": B, "fields": FIELDS,
+                  "ps_hybrid_sps": ps_sps, "p3_prefetch_ab": p3_ab,
+                  "batch": B, "fields": FIELDS,
                   "vocab": VOCAB, "emb_dim": DIM,
                   "step_s": round(step_s, 6),
                   "ab": {"optimized": "pallas_scalar_prefetch_gather",

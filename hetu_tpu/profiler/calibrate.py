@@ -102,6 +102,46 @@ def calibrate_simulator(mesh=None, *, chip: Optional[ChipSpec] = None,
     return Simulator(fitted, axis_rates=axis_rates), report
 
 
+def simulator_from_calibration(report, *, axis_of=None) -> Simulator:
+    """Rebuild a Simulator from a persisted calibration report.
+
+    ``report``: the dict `calibrate_simulator` returns (also the content
+    of CALIBRATION.json written by tools/calibrate_chip.py), or a path to
+    such a JSON file.  The fitted mxu_util and per-axis ici rates are
+    re-applied, so searchers price plans from the last real measurement
+    without touching devices — the reference's cached-cost contract
+    (python/hetu/profiler.py:609-1266 replays its pickled op times the
+    same way).  ``axis_of`` maps parallelism roles to fitted mesh axes
+    (see Simulator).
+    """
+    import json
+    import pathlib
+
+    if isinstance(report, (str, pathlib.Path)):
+        report = json.loads(pathlib.Path(report).read_text())
+    chip = detect_chip()
+    if report.get("chip") and report["chip"] != chip.name:
+        import warnings
+
+        # a foreign-chip fit still applies RELATIVELY (axis-rate ratios
+        # order collectives correctly) but absolute times will be off
+        warnings.warn(
+            f"calibration was fitted on {report['chip']!r} but this "
+            f"backend detects {chip.name!r}; applying it anyway — "
+            "rankings stay meaningful, absolute times may not",
+            stacklevel=2)
+    fitted = dataclasses.replace(
+        chip, mxu_util=float(report.get("mxu_util_fit", chip.mxu_util)))
+    axis_rates = {}
+    for ax, fit in (report.get("ici_fit") or {}).items():
+        axis_rates[ax] = (float(fit["bw_bytes_per_s"]),
+                          float(fit["latency_s"]))
+    if axis_rates:
+        worst = min(bw for bw, _ in axis_rates.values())
+        fitted = dataclasses.replace(fitted, ici_bw=worst, ici_util=1.0)
+    return Simulator(fitted, axis_rates=axis_rates, axis_of=axis_of)
+
+
 def layer_spec_from_measurement(name: str, fwd_fn, args, *,
                                 param_bytes: float, act_bytes: float,
                                 options: Optional[Sequence[ShardOption]]

@@ -95,6 +95,7 @@ class PSEmbedding:
         # executor.py:384 + PSEvent discipline)
         self._prefetcher = ThreadPoolExecutor(max_workers=1)
         self._pending: Optional[Future] = None
+        self._layered: dict = {}  # layer_idx -> Future (prefetch_layered)
 
     def pull(self, indices) -> np.ndarray:
         """rows for this batch: [*indices.shape, dim] float32."""
@@ -116,11 +117,43 @@ class PSEmbedding:
         idx = np.array(indices, copy=True)
         self._pending = self._prefetcher.submit(self.pull, idx)
 
+    def prefetch_layered(self, segments) -> None:
+        """Priority prefetch (reference ps-lite/src/p3_van.h): issue the
+        batch's pulls as SEGMENTS ordered by first-use layer index, so the
+        rows the model consumes first land first and compute starts while
+        later segments are still on the wire.
+
+        ``segments``: iterable of ``(layer_idx, indices)``.  Pulls are
+        submitted in ascending ``layer_idx`` on the single prefetch worker
+        (issue order = completion order on an in-order tier, exactly P3's
+        priority scheduling); collect each with ``pull_layered(layer_idx)``
+        in ANY order — only that segment's future blocks.
+        """
+        if self._layered:
+            raise RuntimeError(
+                "previous layered prefetch not fully collected; call "
+                "pull_layered() for every segment first")
+        segs = [(int(li), np.array(idx, copy=True)) for li, idx in segments]
+        if len({li for li, _ in segs}) != len(segs):
+            raise ValueError("duplicate segment layer index")
+        for li, idx in sorted(segs, key=lambda t: t[0]):
+            self._layered[li] = self._prefetcher.submit(self.pull, idx)
+
+    def pull_layered(self, layer_idx: int) -> np.ndarray:
+        """Collect one segment of :meth:`prefetch_layered` (blocks only on
+        that segment — earlier-priority segments were issued first)."""
+        fut = self._layered.pop(int(layer_idx), None)
+        if fut is None:
+            raise RuntimeError(
+                f"no layered prefetch in flight for layer {layer_idx}")
+        return fut.result()
+
     def close(self) -> None:
         # wait=True: an in-flight prefetch still holds the native cache /
         # group handles — freeing them under it would be a use-after-free
         self._prefetcher.shutdown(wait=True)
         self._pending = None
+        self._layered.clear()
         try:
             self.flush()  # dirty cached grads must reach the servers;
             # ps_rcache_close only retries already-SENT pushes

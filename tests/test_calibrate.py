@@ -181,3 +181,64 @@ def test_calibrated_simulator_carries_per_axis_rates():
     for ax, (bw, lat) in sim.axis_rates.items():
         assert bw > 0 and lat >= 0
         assert report["ici_fit"][ax]["bw_bytes_per_s"] == bw
+
+
+def test_simulator_from_calibration_file_roundtrip(tmp_path):
+    """CALIBRATION.json -> Simulator: the persisted fit re-applies without
+    touching devices (the reference's cached-cost replay contract)."""
+    import json
+
+    from hetu_tpu.profiler.calibrate import simulator_from_calibration
+
+    report = {"chip": "cpu", "mxu_util_fit": 0.37,
+              "ici_fit": {"tp": {"bw_bytes_per_s": 4e10, "latency_s": 1e-6},
+                          "dp": {"bw_bytes_per_s": 5e9, "latency_s": 2e-5}}}
+    path = tmp_path / "CALIBRATION.json"
+    path.write_text(json.dumps(report))
+    sim = simulator_from_calibration(path)
+    assert sim.chip.mxu_util == pytest.approx(0.37)
+    assert sim.axis_rates["tp"] == (4e10, 1e-6)
+    # chip fallback is the slowest fitted axis (conservative feasibility)
+    assert sim.chip.ici_bw == pytest.approx(5e9)
+    # the fitted rates actually price collectives per-axis
+    t_tp = sim._allreduce(1 << 24, 4, "tp")
+    t_dp = sim._allreduce(1 << 24, 4, "dp")
+    assert t_dp > 5 * t_tp, (t_tp, t_dp)
+
+
+def test_searcher_ranking_changes_when_calibration_swapped(tmp_path):
+    """VERDICT r4 #3 'done' criterion: swapping the calibration file in
+    CHANGES what the searcher picks — rankings are evidence-driven, not
+    constants.  Fast-tp calibration -> the planner buys TP for the
+    ffn-heavy chain; tp-axis-crippled calibration -> it stays dp."""
+    import json
+
+    from hetu_tpu.parallel.strategies import OptCNNSearching
+    from hetu_tpu.profiler.calibrate import simulator_from_calibration
+
+    layers = transformer_layer_specs(2, hidden=4096, ffn=16384, seq=2048,
+                                     batch=8, vocab=32000,
+                                     tp_candidates=(1, 4))
+    fast_tp = {"chip": "cpu", "mxu_util_fit": 0.8,
+               "ici_fit": {"tp": {"bw_bytes_per_s": 4.5e10,
+                                  "latency_s": 1e-6},
+                           "dp": {"bw_bytes_per_s": 4.5e10,
+                                  "latency_s": 1e-6}}}
+    slow_tp = json.loads(json.dumps(fast_tp))
+    # cripple the tp axis far below the compute roofline so the fitted
+    # comm term outweighs the 4x compute win (synthetic by design: the
+    # test is that rankings FOLLOW the file, not the constants)
+    slow_tp["ici_fit"]["tp"]["bw_bytes_per_s"] = 1e4
+    (tmp_path / "fast.json").write_text(json.dumps(fast_tp))
+    (tmp_path / "slow.json").write_text(json.dumps(slow_tp))
+
+    def plan_tps(calib_path):
+        sim = simulator_from_calibration(calib_path)
+        plan = OptCNNSearching(sim, dp=2).search(layers)
+        return [o.tp for o in plan.layer_options]
+
+    tps_fast = plan_tps(tmp_path / "fast.json")
+    tps_slow = plan_tps(tmp_path / "slow.json")
+    assert any(t > 1 for t in tps_fast), tps_fast   # fast tp: planner buys it
+    assert all(t == 1 for t in tps_slow), tps_slow  # crippled: stays dp
+    assert tps_fast != tps_slow

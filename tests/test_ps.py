@@ -306,3 +306,42 @@ def test_ps_embedding_learns():
         emb.push(ids, np.asarray(grows))
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_layered_priority_prefetch():
+    """P3 analog (ps-lite p3_van.h): segments issue in ascending first-use
+    layer order regardless of the order given, each collects
+    independently, and results match direct pulls."""
+    from hetu_tpu.ps import PSEmbedding
+
+    emb = PSEmbedding(100, 4, optimizer="sgd", lr=0.1, seed=1)
+    issue_order = []
+    orig_pull = emb.pull
+
+    def spy_pull(idx):
+        issue_order.append(int(np.asarray(idx).ravel()[0]))
+        return orig_pull(idx)
+
+    emb.pull = spy_pull
+    a = np.arange(10, 14).reshape(2, 2)
+    b = np.arange(50, 54).reshape(2, 2)
+    c = np.arange(90, 94).reshape(2, 2)
+    # given out of order: must ISSUE as layer 0, 1, 2 (10, 50, 90)
+    emb.prefetch_layered([(2, c), (0, a), (1, b)])
+    got_c = emb.pull_layered(2)      # collect out of order too
+    got_a = emb.pull_layered(0)
+    got_b = emb.pull_layered(1)
+    assert issue_order == [10, 50, 90], issue_order
+    np.testing.assert_allclose(got_a, orig_pull(a))
+    np.testing.assert_allclose(got_b, orig_pull(b))
+    np.testing.assert_allclose(got_c, orig_pull(c))
+    with pytest.raises(RuntimeError, match="no layered prefetch"):
+        emb.pull_layered(0)
+    # uncollected segments block a new layered prefetch
+    emb.prefetch_layered([(0, a)])
+    with pytest.raises(RuntimeError, match="not fully collected"):
+        emb.prefetch_layered([(1, b)])
+    emb.pull_layered(0)
+    with pytest.raises(ValueError, match="duplicate"):
+        emb.prefetch_layered([(0, a), (0, b)])
+    emb.close()

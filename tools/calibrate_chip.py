@@ -35,7 +35,25 @@ def main() -> int:
     from hetu_tpu.profiler.calibrate import calibrate_simulator
 
     t0 = time.time()
-    _, report = calibrate_simulator()  # 1-chip: MXU fit only
+    mesh = None
+    if len(devs) > 1:
+        # multi-chip: fit per-axis ICI rates too (a 2D factoring when the
+        # count allows, so hierarchical layouts price both tiers)
+        import numpy as np
+        from jax.sharding import Mesh
+
+        n = len(devs)
+        # largest PROPER inner factor so both tiers get >= 2 devices
+        # (n=4 -> 2x2, n=8 -> 2x4, n=16 -> 2x8); prime/2-device counts
+        # fall back to one 'ici' axis
+        inner = max((d for d in (8, 4, 2) if n % d == 0 and n // d > 1),
+                    default=1)
+        if inner > 1:
+            mesh = Mesh(np.array(devs).reshape(n // inner, inner),
+                        ("outer", "inner"))
+        else:
+            mesh = Mesh(np.array(devs), ("ici",))
+    _, report = calibrate_simulator(mesh)  # mesh=None (1 chip): MXU only
     report.update({
         "backend": backend,
         "n_devices": len(devs),
