@@ -8,3 +8,6 @@ from hetu_tpu.embedding_compress.layers import (
     optembed_row_pruned,
 )
 from hetu_tpu.embedding_compress.scheduler import CompressionScheduler
+from hetu_tpu.embedding_compress.recipes import (
+    AutoDimBiLevelTrainer, MultiStageFlow, OptEmbedFlow,
+)

@@ -1,0 +1,251 @@
+"""Per-method training flows for the compression zoo.
+
+Reference: tools/EmbeddingMemoryCompression/methods/scheduler/ — beyond
+the stage *machine* (scheduler.py here), the VLDB'24 tool ships per-method
+TRAINING RECIPES: AutoDim's bi-level architecture search (autodim.py:13-180,
+alternating arch-parameter steps on validation batches with weight steps on
+train batches), and OptEmbed's three-stage flow (optembed.py:11-58:
+supernet training with a threshold regularizer, evolutionary mask search,
+masked retrain).  This module is those flows, TPU-first: each trainer
+holds jitted pure steps (weights and arch/threshold parameters split into
+separate optimizer trees — the reference splits its `train_op` list the
+same way) and plain-python orchestration around them.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MultiStageFlow:
+    """Chain stage trainers, inheriting PARAMETERS but not optimizer state
+    (reference multistage.py + optembed.py:12-13: "the parameters are
+    inherited from the previous stage; but the optimizer states is new in
+    every stage").
+
+    ``stages``: ordered ``(name, fn)`` where ``fn(carry) -> carry``; the
+    carry is whatever the stages agree on (typically the variables dict).
+    ``run`` executes from ``start_stage`` (reference --stage resume flag).
+    """
+
+    def __init__(self, stages: Sequence[Tuple[str, Callable]]):
+        if not stages:
+            raise ValueError("MultiStageFlow needs at least one stage")
+        self.stages = list(stages)
+        self.history: List[str] = []
+
+    def run(self, carry, *, start_stage: int = 0):
+        self.history = []  # per-run record, not a cross-run accumulator
+        for name, fn in self.stages[start_stage:]:
+            carry = fn(carry)
+            self.history.append(name)
+        return carry
+
+
+class AutoDimBiLevelTrainer:
+    """AutoDim's bi-level search (reference autodim.py AutoDimTrainer):
+    weights train on TRAIN batches, the architecture softmax trains on
+    VALIDATION batches with its own learning rate — the first-order
+    (`ignore_second`) DARTS approximation the reference defaults to; the
+    second-order term costs an extra fwd+bwd pair per step for a
+    correction that rarely changes the winner.
+
+    loss_fn(params, batch) -> scalar must route embeddings through the
+    AutoDimEmbedding whose params live under ``params[embed_key]`` with
+    the ``arch`` leaf.
+    """
+
+    def __init__(self, embed_module, loss_fn, *, embed_key: str = "embed",
+                 weight_opt=None, alpha_lr: float = 1e-3):
+        from hetu_tpu import optim
+
+        self.module = embed_module
+        self.embed_key = embed_key
+        self.weight_opt = weight_opt or optim.AdamOptimizer(1e-3)
+        self.arch_opt = optim.AdamOptimizer(alpha_lr)
+        self._loss_fn = loss_fn
+        self._weight_step = jax.jit(self._make_weight_step())
+        self._arch_step = jax.jit(self._make_arch_step())
+
+    def _split(self, params):
+        arch = params[self.embed_key]["arch"]
+        return arch
+
+    def _with_arch(self, params, arch):
+        emb = dict(params[self.embed_key])
+        emb["arch"] = arch
+        out = dict(params)
+        out[self.embed_key] = emb
+        return out
+
+    def _make_weight_step(self):
+        def step(params, wstate, batch):
+            arch = jax.lax.stop_gradient(self._split(params))
+
+            def lf(p):
+                return self._loss_fn(self._with_arch(p, arch), batch)
+
+            loss, grads = jax.value_and_grad(lf)(params)
+            # freeze arch in this half: its grad leaf is zeroed (it moves
+            # only on validation batches, below)
+            grads[self.embed_key]["arch"] = jnp.zeros_like(arch)
+            params, wstate = self.weight_opt.update(grads, wstate, params)
+            return params, wstate, loss
+        return step
+
+    def _make_arch_step(self):
+        def step(params, astate, val_batch):
+            def lf(arch):
+                return self._loss_fn(self._with_arch(params, arch),
+                                     val_batch)
+
+            loss, g = jax.value_and_grad(lf)(self._split(params))
+            arch, astate = self.arch_opt.update(g, astate,
+                                                self._split(params))
+            return self._with_arch(params, arch), astate, loss
+        return step
+
+    def init_states(self, params):
+        return (self.weight_opt.init_state(params),
+                self.arch_opt.init_state(self._split(params)))
+
+    def fit(self, params, train_batches, val_batches, *,
+            arch_every: int = 1):
+        """Alternate weight/arch steps (reference first_stage_train_step
+        interleaving).  Returns (params, train_losses, val_losses)."""
+        wstate, astate = self.init_states(params)
+        tl, vl = [], []
+        vb = iter(val_batches)
+        for i, batch in enumerate(train_batches):
+            params, wstate, loss = self._weight_step(params, wstate, batch)
+            tl.append(float(loss))
+            if i % max(arch_every, 1) == 0:
+                try:
+                    val = next(vb)
+                except StopIteration:
+                    vb = iter(val_batches)
+                    val = next(vb)
+                params, astate, vloss = self._arch_step(params, astate, val)
+                vl.append(float(vloss))
+        return params, tl, vl
+
+    def finalize(self, variables):
+        """Winner-take-all retrain conversion (AutoDimRetrainEmbedding):
+        keep only the selected candidate's table + projection."""
+        from hetu_tpu.embedding_compress.layers import autodim_to_retrain
+        return autodim_to_retrain(self.module, variables)
+
+
+class OptEmbedFlow:
+    """OptEmbed's three stages (reference optembed.py):
+
+    1. ``supernet_step`` — train weights + per-row thresholds jointly;
+       the loss carries the reference's ``alpha * sum(exp(-threshold))``
+       regularizer and the thresholds get their OWN learning rate
+       (reference splits threshold_update out of train_op and re-wraps it
+       in a separate SGDOptimizer — here the param tree is split into two
+       optimizer trees, same effect, no graph surgery).
+    2. ``evolutionary_search`` — per-field dim-prefix masks evolve under
+       mutation + crossover, ranked by a caller-supplied fitness
+       (validation loss of the masked supernet).
+    3. ``retrain`` setup via :func:`finalize` — row-pruned weights plus
+       the winning field mask, parameters inherited, optimizer fresh.
+    """
+
+    def __init__(self, embed_module, loss_fn, *, embed_key: str = "embed",
+                 weight_opt=None, thresh_lr: float = 1e-2,
+                 alpha: float = 1e-4):
+        from hetu_tpu import optim
+
+        self.module = embed_module
+        self.embed_key = embed_key
+        self.alpha = alpha
+        self.weight_opt = weight_opt or optim.AdamOptimizer(1e-3)
+        self.thresh_opt = optim.SGDOptimizer(thresh_lr)
+        self._loss_fn = loss_fn
+        self._supernet_step = jax.jit(self._make_supernet_step())
+
+    def _make_supernet_step(self):
+        def step(params, wstate, tstate, batch):
+            def lf(p):
+                base = self._loss_fn(p, batch)
+                reg = self.alpha * jnp.sum(
+                    jnp.exp(-p[self.embed_key]["t"]))
+                return base + reg
+
+            loss, grads = jax.value_and_grad(lf)(params)
+            tgrad = grads[self.embed_key]["t"]
+            t = params[self.embed_key]["t"]
+            # thresholds ride their own optimizer; zero their leaf in the
+            # weight tree so the weight optimizer never touches them
+            grads[self.embed_key]["t"] = jnp.zeros_like(tgrad)
+            params, wstate = self.weight_opt.update(grads, wstate, params)
+            new_t, tstate = self.thresh_opt.update(tgrad, tstate, t)
+            emb = dict(params[self.embed_key])
+            emb["t"] = new_t
+            params = dict(params)
+            params[self.embed_key] = emb
+            return params, wstate, tstate, loss
+        return step
+
+    def train_supernet(self, params, batches):
+        wstate = self.weight_opt.init_state(params)
+        tstate = self.thresh_opt.init_state(params[self.embed_key]["t"])
+        losses = []
+        for batch in batches:
+            params, wstate, tstate, loss = self._supernet_step(
+                params, wstate, tstate, batch)
+            losses.append(float(loss))
+        return params, losses
+
+    @staticmethod
+    def evolutionary_search(fitness_fn, *, n_fields: int, dim: int,
+                            population: int = 8, generations: int = 5,
+                            parents: int = 4, mutate_prob: float = 0.2,
+                            seed: int = 0):
+        """Reference OptEmbedEvoTrainer: evolve per-field dim choices.
+
+        A candidate assigns each field a kept-dim prefix in [1, dim];
+        ``fitness_fn(cand) -> float`` (LOWER is better, e.g. validation
+        loss).  Mutation redraws a field's dim; crossover takes fields
+        from two parents.  Returns (best_candidate, best_fitness).
+        """
+        rng = np.random.default_rng(seed)
+        pop = [rng.integers(1, dim + 1, n_fields) for _ in range(population)]
+        best, best_fit = None, np.inf
+        for _ in range(generations):
+            scored = sorted(((float(fitness_fn(c)), c) for c in pop),
+                            key=lambda t: t[0])
+            if scored[0][0] < best_fit:
+                best_fit, best = scored[0][0], scored[0][1].copy()
+            keep = [c for _, c in scored[:parents]]
+            children = []
+            while len(children) < population - len(keep):
+                pa, pb = rng.choice(len(keep), 2, replace=False)
+                child = np.where(rng.random(n_fields) < 0.5,
+                                 keep[pa], keep[pb])
+                redraw = rng.random(n_fields) < mutate_prob
+                child = np.where(redraw, rng.integers(1, dim + 1, n_fields),
+                                 child)
+                children.append(child)
+            pop = keep + children
+        return best, best_fit
+
+    @staticmethod
+    def field_mask(cand, dim: int) -> jnp.ndarray:
+        """[n_fields, dim] 0/1 mask keeping each field's dim prefix."""
+        return (jnp.arange(dim)[None, :] <
+                jnp.asarray(cand)[:, None]).astype(jnp.float32)
+
+    def finalize(self, variables, cand=None):
+        """Stage-3 retrain variables: row-pruned weights (threshold mask
+        baked), plus the evolutionary winner's per-field mask if given."""
+        from hetu_tpu.embedding_compress.layers import optembed_row_pruned
+        out = optembed_row_pruned(self.module, variables)
+        if cand is not None:
+            out["state"]["field_dims"] = jnp.asarray(cand)
+        return out

@@ -202,3 +202,117 @@ def test_scheduler_stages_and_hooks():
     ])
     v2 = sched2.maybe_transition(12, v2)
     assert "q" in v2["state"] and v2["state"]["q"].dtype == jnp.int8
+
+
+# ---- per-method training recipes (VERDICT r4 weak #7) ----
+
+def _ctr_problem(embed_cls, n=64, dim=8, fields=3, **kw):
+    """Tiny CTR task: loss_fn routes through params['embed'] + a linear
+    head, labels depend on a fixed random table so learning shows."""
+    import jax
+    import jax.numpy as jnp
+
+    module = embed_cls(n, dim, **kw)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, n, (256, fields))
+    w_true = rng.standard_normal((n,))
+    y = (w_true[ids].sum(-1) > 0).astype(np.float32)
+
+    def loss_fn(params, batch):
+        bids, by = batch
+        emb, _ = module.apply({"params": params["embed"], "state": {}},
+                              bids)
+        logit = emb.reshape(emb.shape[0], -1) @ params["head"]
+        return jnp.mean(jnp.maximum(logit, 0) - logit * by +
+                        jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    head = jnp.zeros((fields * dim,))
+    params = {"embed": module.init(jax.random.PRNGKey(0))["params"],
+              "head": head}
+    batches = [(jnp.asarray(ids[i::4]), jnp.asarray(y[i::4]))
+               for i in range(4)]
+    return module, loss_fn, params, batches
+
+
+def test_autodim_bilevel_trainer_learns_and_finalizes():
+    import jax.numpy as jnp
+
+    from hetu_tpu.embedding_compress import AutoDimBiLevelTrainer
+    from hetu_tpu.embedding_compress.layers import AutoDimEmbedding
+
+    module, loss_fn, params, batches = _ctr_problem(
+        AutoDimEmbedding, candidate_dims=[8, 4, 2])
+    trainer = AutoDimBiLevelTrainer(module, loss_fn, alpha_lr=5e-2)
+    arch0 = np.asarray(params["embed"]["arch"])
+    params, tl, vl = trainer.fit(params, batches * 10, batches[:1])
+    assert tl[-1] < tl[0], (tl[0], tl[-1])
+    assert vl, "arch steps never ran"
+    # the arch softmax MOVED (bi-level step is live), on val batches only
+    assert not np.allclose(np.asarray(params["embed"]["arch"]), arch0)
+    retrain = trainer.finalize({"params": params["embed"], "state": {}})
+    assert retrain["state"]["dim"] in (8, 4, 2)
+    assert retrain["params"]["t"].shape[1] == retrain["state"]["dim"]
+
+
+def test_optembed_three_stage_flow():
+    import jax.numpy as jnp
+
+    from hetu_tpu.embedding_compress import MultiStageFlow, OptEmbedFlow
+    from hetu_tpu.embedding_compress.layers import OptEmbedEmbedding
+
+    module, loss_fn, params, batches = _ctr_problem(OptEmbedEmbedding)
+    flow = OptEmbedFlow(module, loss_fn, thresh_lr=5e-2, alpha=1e-3)
+
+    # stage 1: supernet (weights + thresholds on separate optimizers)
+    t0 = np.asarray(params["embed"]["t"])
+    params, losses = flow.train_supernet(params, batches * 10)
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    assert not np.allclose(np.asarray(params["embed"]["t"]), t0)
+
+    # stage 2: evolutionary per-field dim search on the frozen supernet
+    def fitness(cand):
+        mask = OptEmbedFlow.field_mask(cand, 8)
+
+        def masked_loss(batch):
+            bids, by = batch
+            emb, _ = module.apply(
+                {"params": params["embed"], "state": {}}, bids)
+            emb = emb * mask[None, :, :]
+            logit = emb.reshape(emb.shape[0], -1) @ params["head"]
+            return float(jnp.mean(
+                jnp.maximum(logit, 0) - logit * by +
+                jnp.log1p(jnp.exp(-jnp.abs(logit)))))
+
+        # memory cost regularizer mirrors the reference's target-dim bias
+        return masked_loss(batches[0]) + 1e-3 * float(np.sum(cand))
+
+    best, best_fit = OptEmbedFlow.evolutionary_search(
+        fitness, n_fields=3, dim=8, population=6, generations=3, seed=1)
+    assert best.shape == (3,) and np.isfinite(best_fit)
+    assert np.all((best >= 1) & (best <= 8))
+
+    # stage 3: retrain variables inherit pruned params + winning mask
+    rv = flow.finalize({"params": params["embed"], "state": {}}, best)
+    assert rv["state"]["row_mask"].shape == (64,)
+    np.testing.assert_array_equal(np.asarray(rv["state"]["field_dims"]),
+                                  best)
+
+    # the whole thing also composes as a MultiStageFlow
+    ms = MultiStageFlow([
+        ("supernet", lambda c: flow.train_supernet(c, batches * 2)[0]),
+        ("evo+prune", lambda c: flow.finalize(
+            {"params": c["embed"], "state": {}}, best)),
+    ])
+    out = ms.run(params)
+    assert ms.history == ["supernet", "evo+prune"]
+    assert "row_mask" in out["state"]
+
+
+def test_multistage_flow_validation():
+    from hetu_tpu.embedding_compress import MultiStageFlow
+
+    with pytest.raises(ValueError):
+        MultiStageFlow([])
+    ms = MultiStageFlow([("a", lambda c: c + 1), ("b", lambda c: c * 2)])
+    assert ms.run(1) == 4
+    assert ms.run(1, start_stage=1) == 2  # reference --stage resume
