@@ -353,7 +353,10 @@ def bench_ctr():
             p2, o2, ms2, _, _, ge = hstep(p2, o2, ms2, dx, rows, y)
             emb.push(np_ids, np.asarray(ge))
         ps_sps = round(B * iters / (time.perf_counter() - t0), 1)
+    except Exception as e:  # PS lib unavailable: report, don't fail the bench
+        ps_sps = f"unavailable: {type(e).__name__}"
 
+    try:
         # P3-style priority prefetch A/B (ps-lite p3_van.h analog): time
         # until the FIRST-NEEDED rows are ready to compute on.  Baseline =
         # monolithic prefetch (all fields in one pull, first rows ready
@@ -380,8 +383,8 @@ def bench_ctr():
                  "first_ready_s": round(t_layered / reps, 6),
                  "monolithic_s": round(t_mono / reps, 6),
                  "speedup_to_first_rows": round(t_mono / t_layered, 2)}
-    except Exception as e:  # PS lib unavailable: report, don't fail the bench
-        ps_sps = f"unavailable: {type(e).__name__}"
+    except Exception as e:  # a failed A/B must not clobber ps_hybrid_sps
+        p3_ab = f"unavailable: {type(e).__name__}"
 
     _emit({
         "metric": "wdl_criteo_device_sparse_samples_per_sec_per_chip",

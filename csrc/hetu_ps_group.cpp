@@ -40,6 +40,31 @@ int ps_van_table_create(int fd, int id, int64_t rows, int64_t dim,
                         int init_kind, double a, double b, uint64_t seed);
 int ps_van_set_optimizer(int fd, int id, int kind, float lr, float mom,
                          float eps, float b1, float b2);
+int ps_van_sparse_pull_dt(int fd, int id, const int64_t* idx, int64_t n,
+                          float* out, int64_t dim, int dtype);
+int ps_van_sparse_set_dt(int fd, int id, const int64_t* idx,
+                         const float* vals, int64_t n, int64_t dim,
+                         int dtype);
+int ps_van_sparse_push_id_dt(int fd, int id, const int64_t* idx,
+                             const float* grads, int64_t n, int64_t dim,
+                             int dtype, uint64_t req);
+int ps_van_table_create_dt(int fd, int id, int64_t rows, int64_t dim,
+                           int init_kind, double a, double b, uint64_t seed,
+                           int dtype);
+int ps_van_table_info(int fd, int id, int64_t* rows, int64_t* dim,
+                      int32_t* dtype);
+int64_t ps_van_sync_pull_dt(int fd, int id, const int64_t* keys,
+                            const uint64_t* cached_vers, int64_t ns,
+                            uint64_t bound, int64_t dim, int dtype,
+                            uint32_t* sel_out, uint64_t* vers_out,
+                            float* rows_out);
+int64_t ps_van_push_sync_dt(int fd, int id, const int64_t* push_keys,
+                            const float* push_grads, int64_t np,
+                            const int64_t* sync_keys,
+                            const uint64_t* cached_vers, int64_t ns,
+                            uint64_t bound, int64_t dim, int dtype,
+                            uint64_t req, uint32_t* sel_out,
+                            uint64_t* vers_out, float* rows_out);
 int ps_van_sparse_pull(int fd, int id, const int64_t* idx, int64_t n,
                        float* out, int64_t dim);
 int ps_van_sparse_push(int fd, int id, const int64_t* idx, const float* grads,
@@ -95,6 +120,7 @@ struct Group {
   bool opt_set = false;
   int opt_kind = 0;
   float lr = 0, mom = 0, eps = 0, b1 = 0, b2 = 0;
+  int dtype = 0;  // row storage + wire encoding (0 f32, 1 bf16, 2 int8)
   int retry_max = 3;
   int retry_backoff_ms = 100;
   // scheduler endpoint, when the group was built via ps_group_create_sched:
@@ -145,10 +171,19 @@ struct GroupRef {
 // (re)build the shard's table on its server from the recorded spec.
 // rc -2 ("id exists") counts as success: another worker created it first.
 int create_shard_table(Group* g, Shard* s, int shard_idx) {
-  int rc = ps_van_table_create(s->fd, g->table_id, s->rows, g->dim,
-                               g->init_kind, g->init_a, g->init_b,
-                               g->seed + (uint64_t)shard_idx);
-  if (rc != 0 && rc != -2) return rc;
+  int rc = ps_van_table_create_dt(s->fd, g->table_id, s->rows, g->dim,
+                                  g->init_kind, g->init_a, g->init_b,
+                                  g->seed + (uint64_t)shard_idx, g->dtype);
+  if (rc == -2) {
+    // another worker created the id first: verify ITS dtype matches ours —
+    // a mismatch would silently mis-decode every dtype'd frame from here
+    int32_t dt = -1;
+    if (ps_van_table_info(s->fd, g->table_id, nullptr, nullptr, &dt) == 0 &&
+        dt != g->dtype)
+      return -8;  // dtype mismatch on a shared table id
+  } else if (rc != 0) {
+    return rc;
+  }
   if (g->opt_set) {
     rc = ps_van_set_optimizer(s->fd, g->table_id, g->opt_kind, g->lr, g->mom,
                               g->eps, g->b1, g->b2);
@@ -293,10 +328,13 @@ static int group_create_impl(const char* endpoints, int table_id,
                              int64_t rows, int64_t dim, int init_kind,
                              double a, double b, uint64_t seed,
                              double connect_timeout_s, int hb_ms,
-                             const char* sched_host, int sched_port) {
+                             const char* sched_host, int sched_port,
+                             int dtype = 0) {
   if (!endpoints || rows <= 0 || dim <= 0) return -3;
+  if (dtype < 0 || dtype > 2) return -3;
   auto g = std::make_unique<Group>();
   g->table_id = table_id;
+  g->dtype = dtype;
   // sched fields BEFORE the heartbeat thread exists: heartbeat_loop /
   // shard_call read them unsynchronized, which is only safe because they
   // are immutable once the group is visible
@@ -370,6 +408,16 @@ int ps_group_create(const char* endpoints, int table_id, int64_t rows,
                            seed, connect_timeout_s, hb_ms, nullptr, 0);
 }
 
+// dtype'd variant: every shard table stores (and ships) rows in `dtype`
+int ps_group_create_dt(const char* endpoints, int table_id, int64_t rows,
+                       int64_t dim, int init_kind, double a, double b,
+                       uint64_t seed, double connect_timeout_s, int hb_ms,
+                       int dtype) {
+  return group_create_impl(endpoints, table_id, rows, dim, init_kind, a, b,
+                           seed, connect_timeout_s, hb_ms, nullptr, 0,
+                           dtype);
+}
+
 int ps_group_set_optimizer(int gid, int kind, float lr, float mom, float eps,
                            float b1, float b2) {
   GroupRef ref(gid);
@@ -421,9 +469,9 @@ int ps_group_sparse_pull(int gid, const int64_t* idx, int64_t n, float* out) {
   int rc = fan_out(nonempty, [&](int i) {
     bufs[i].resize(local[i].size() * g->dim);
     return shard_call(g, g->shards[i].get(), i, [&](int fd) {
-      return ps_van_sparse_pull(fd, g->table_id, local[i].data(),
-                                (int64_t)local[i].size(), bufs[i].data(),
-                                g->dim);
+      return ps_van_sparse_pull_dt(fd, g->table_id, local[i].data(),
+                                   (int64_t)local[i].size(),
+                                   bufs[i].data(), g->dim, g->dtype);
     });
   });
   if (rc != 0) return rc;
@@ -457,12 +505,14 @@ static int group_sparse_write(int gid, const int64_t* idx, const float* vals,
     uint64_t req = next_req_id();
     return shard_call(g, g->shards[i].get(), i, [&](int fd) {
       if (is_set)
-        return ps_van_sparse_set(fd, g->table_id, local[i].data(),
-                                 vbuf[i].data(), (int64_t)local[i].size(),
-                                 g->dim);
-      return ps_van_sparse_push_id(fd, g->table_id, local[i].data(),
-                                   vbuf[i].data(), (int64_t)local[i].size(),
-                                   g->dim, req);
+        return ps_van_sparse_set_dt(fd, g->table_id, local[i].data(),
+                                    vbuf[i].data(),
+                                    (int64_t)local[i].size(), g->dim,
+                                    g->dtype);
+      return ps_van_sparse_push_id_dt(fd, g->table_id, local[i].data(),
+                                      vbuf[i].data(),
+                                      (int64_t)local[i].size(), g->dim,
+                                      g->dtype, req);
     });
   });
 }
@@ -652,11 +702,11 @@ int64_t ps_group_push_sync_req(int gid, const int64_t* push_keys,
     // exactly-once on the server
     uint64_t req = req_base ? req_base + (uint64_t)i : next_req_id();
     int src = shard_call(g, g->shards[i].get(), i, [&](int fd) {
-      int64_t m = ps_van_push_sync(
+      int64_t m = ps_van_push_sync_dt(
           fd, g->table_id, pk[i].data(), pg[i].data(),
           (int64_t)pk[i].size(), sk[i].data(), sv[i].data(),
-          (int64_t)sk[i].size(), bound, g->dim, req, ssel[i].data(),
-          sver[i].data(), srows[i].data());
+          (int64_t)sk[i].size(), bound, g->dim, g->dtype, req,
+          ssel[i].data(), sver[i].data(), srows[i].data());
       if (m < 0) return (int)m;
       sm[i] = m;
       return 0;

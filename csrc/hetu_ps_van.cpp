@@ -106,6 +106,10 @@ enum VanOp : uint8_t {
   // observability: frames handled since server start (transport-efficiency
   // assertions in tests)
   OP_STATS = 27,
+  // table metadata (rows/dim/dtype): lets a joiner VERIFY that an
+  // existing table id matches its expected shape+dtype instead of
+  // silently mis-decoding dtype'd frames
+  OP_TABLE_INFO = 28,
 };
 
 // Per-table bounded set of recently applied push request-ids.  A repeated
@@ -463,7 +467,7 @@ void handle_conn(int fd) {
     static const uint32_t kMinBody[] = {
         0, 48, 28, 4, 4, 13, 12, 12, 8, 8, 0, 12, 20,
         20, 36, 12, 12, 8, 16, 8, 0, 8, 4,
-        24, 20, 16, 16, 0};
+        24, 20, 16, 16, 0, 4};
     if (op < sizeof(kMinBody) / sizeof(uint32_t) &&
         blen < 1 + kMinBody[op]) {
       send_resp(fd, -3, nullptr, 0);
@@ -666,9 +670,9 @@ void handle_conn(int fd) {
         // SYNC_PULL:  [i32 id][i64 ns][u64 bound]
         //             [i64 sync_keys x ns][u64 cached_vers x ns]
         // PUSH_SYNC:  [i32 id][u64 req][i64 np][i64 ns][u64 bound]
-        //             [i64 push_keys x np][f32 push_grads x np*dim]
+        //             [i64 push_keys x np][grads x np (wire grad dtype)]
         //             [i64 sync_keys x ns][u64 cached_vers x ns]
-        // resp: [i64 m][u32 sel x m][u64 vers x m][f32 rows x m*dim]
+        // resp: [i64 m][u32 sel x m][u64 vers x m][rows x m (row dtype)]
         // The push half is exactly-once via the request-id dedup (the sync
         // half is idempotent, so a duplicate still answers the sync).
         int id = rd<int32_t>(p);
@@ -689,25 +693,39 @@ void handle_conn(int fd) {
         if (np < 0 || ns < 0 || np > (1 << 24) || ns > (1 << 24)) {
           send_resp(fd, -3, nullptr, 0); break;
         }
+        // rows travel in the table's storage dtype both ways (push
+        // grads: bf16 for bf16 tables, f32 otherwise — same rule as
+        // OP_SPARSE_PUSH); dtype'd sync halves the HET tier's wire bytes
+        int dtype = ps_table_dtype(id);
+        int64_t grow = wire_grad_bytes(dtype, dim);
+        int64_t rrow = wire_row_bytes(dtype, dim);
         int64_t have = body.data() + blen - p;
-        int64_t push_bytes = np * (int64_t)(sizeof(int64_t) +
-                                            dim * sizeof(float));
+        int64_t push_bytes = np * ((int64_t)sizeof(int64_t) + grow);
         int64_t sync_bytes = ns * (int64_t)(sizeof(int64_t) +
                                             sizeof(uint64_t));
-        int64_t resp_bytes = 8 + ns * (int64_t)(4 + 8 + dim * sizeof(float));
+        int64_t resp_bytes = 8 + ns * (int64_t)(4 + 8 + rrow);
         if (have < push_bytes + sync_bytes ||
             resp_bytes > (int64_t)(1u << 30)) {
           send_resp(fd, -3, nullptr, 0); break;
         }
         const auto* push_keys = (const int64_t*)p;
-        const auto* push_grads = (const float*)(p + np * sizeof(int64_t));
+        const char* push_graw = p + np * sizeof(int64_t);
         const char* q = p + push_bytes;
         const auto* sync_keys = (const int64_t*)q;
         const auto* sync_vers = (const uint64_t*)(q + ns * sizeof(int64_t));
         int rc = 0;
         if (is_push && np > 0) {
           if (g_push_dedup.begin(id, req) == DedupSet::NEW) {
-            rc = ps_sparse_push(id, push_keys, push_grads, np);
+            const float* grads;
+            std::vector<float> gdec;
+            if (dtype == WDT_BF16) {
+              gdec.resize(np * dim);
+              decode_rows(WDT_BF16, push_graw, np, dim, gdec.data());
+              grads = gdec.data();
+            } else {
+              grads = (const float*)push_graw;
+            }
+            rc = ps_sparse_push(id, push_keys, grads, np);
             g_push_dedup.finish(id, req, rc == 0);
           }  // duplicate: push already applied — answer the sync only
         }
@@ -718,14 +736,28 @@ void handle_conn(int fd) {
         int64_t m = ps_sync_pull(id, sync_keys, sync_vers, ns, bound,
                                  sel.data(), vbuf.data(), fbuf.data());
         if (m < 0) { send_resp(fd, (int32_t)m, nullptr, 0); break; }
-        uint32_t plen = (uint32_t)(8 + m * (4 + 8 + dim * sizeof(float)));
+        // f32 keeps the zero-copy path (no encode allocation on the
+        // default tier's hot sync); dtype'd rows encode into a scratch
+        const char* rows_ptr;
+        size_t rows_len;
+        std::vector<char> rows;
+        if (dtype == WDT_F32) {
+          rows_ptr = (const char*)fbuf.data();
+          rows_len = m * dim * sizeof(float);
+        } else {
+          encode_rows(dtype, fbuf.data(), m, dim, rows);
+          rows_ptr = rows.data();
+          rows_len = rows.size();
+        }
+        uint32_t plen = (uint32_t)(8 + m * (4 + 8) + rows_len);
         uint32_t blen2 = 4 + plen;
         int32_t rc32 = 0;
+        g_bytes_tx.fetch_add(4 + blen2, std::memory_order_relaxed);
         if (!write_all(fd, &blen2, 4) || !write_all(fd, &rc32, 4) ||
             !write_all(fd, &m, 8) ||
             !write_all(fd, sel.data(), m * 4) ||
             !write_all(fd, vbuf.data(), m * 8) ||
-            !write_all(fd, fbuf.data(), m * dim * sizeof(float))) {
+            !write_all(fd, rows_ptr, rows_len)) {
           ::close(fd); return;
         }
         break;
@@ -916,6 +948,19 @@ void handle_conn(int fd) {
           }
         }
         send_resp(fd, rc, nullptr, 0);
+        break;
+      }
+      case OP_TABLE_INFO: {
+        // [i32 id] -> resp [i64 rows][i64 dim][i32 dtype]
+        int id = rd<int32_t>(p);
+        int64_t rows = ps_table_rows(id), dim = ps_table_dim(id);
+        int32_t dt = ps_table_dtype(id);
+        if (rows < 0) { send_resp(fd, -1, nullptr, 0); break; }
+        char pay[20];
+        std::memcpy(pay, &rows, 8);
+        std::memcpy(pay + 8, &dim, 8);
+        std::memcpy(pay + 16, &dt, 4);
+        send_resp(fd, 0, pay, 20);
         break;
       }
       case OP_STATS: {
@@ -1187,6 +1232,21 @@ static int van_file_op(uint8_t op, int fd, int id, const char* path) {
   return request(fd, b, &rc, &pay) ? rc : kTransportErr;
 }
 
+// Query a remote table's (rows, dim, dtype); returns 0 or < 0.
+int ps_van_table_info(int fd, int id, int64_t* rows, int64_t* dim,
+                      int32_t* dtype) {
+  std::vector<char> b{(char)OP_TABLE_INFO}, pay;
+  put<int32_t>(b, id);
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
+  if (pay.size() != 20) return -5;
+  if (rows) std::memcpy(rows, pay.data(), 8);
+  if (dim) std::memcpy(dim, pay.data() + 8, 8);
+  if (dtype) std::memcpy(dtype, pay.data() + 16, 4);
+  return 0;
+}
+
 int ps_van_table_clear(int fd, int id) {
   std::vector<char> b{(char)OP_CLEAR}, pay;
   put<int32_t>(b, id);
@@ -1347,26 +1407,28 @@ int ps_van_table_load(int fd, int id, const char* path) {
 // Shared response decode for sync_pull / push_sync: payload is
 // [i64 m][u32 sel x m][u64 vers x m][f32 rows x m*dim]; returns m or <0.
 static int64_t decode_sync_resp(const std::vector<char>& pay, int64_t ns,
-                                int64_t dim, uint32_t* sel_out,
+                                int64_t dim, int dtype, uint32_t* sel_out,
                                 uint64_t* vers_out, float* rows_out) {
   if (pay.size() < 8) return -5;
   int64_t m;
   std::memcpy(&m, pay.data(), 8);
+  int64_t rrow = wire_row_bytes(dtype, dim);
   if (m < 0 || m > ns ||
-      (int64_t)pay.size() != 8 + m * (int64_t)(4 + 8 + dim * sizeof(float)))
+      (int64_t)pay.size() != 8 + m * (int64_t)(4 + 8) + m * rrow)
     return -5;
   if (m == 0) return 0;  // out pointers may be null for push-only calls
   const char* q = pay.data() + 8;
   std::memcpy(sel_out, q, m * 4); q += m * 4;
   std::memcpy(vers_out, q, m * 8); q += m * 8;
-  std::memcpy(rows_out, q, m * dim * sizeof(float));
+  decode_rows(dtype, q, m, dim, rows_out);
   return m;
 }
 
-int64_t ps_van_sync_pull(int fd, int id, const int64_t* keys,
-                         const uint64_t* cached_vers, int64_t ns,
-                         uint64_t bound, int64_t dim, uint32_t* sel_out,
-                         uint64_t* vers_out, float* rows_out) {
+int64_t ps_van_sync_pull_dt(int fd, int id, const int64_t* keys,
+                            const uint64_t* cached_vers, int64_t ns,
+                            uint64_t bound, int64_t dim, int dtype,
+                            uint32_t* sel_out, uint64_t* vers_out,
+                            float* rows_out) {
   std::vector<char> b{(char)OP_SYNC_PULL}, pay;
   put<int32_t>(b, id); put<int64_t>(b, ns); put<uint64_t>(b, bound);
   size_t o = b.size();
@@ -1377,7 +1439,44 @@ int64_t ps_van_sync_pull(int fd, int id, const int64_t* keys,
   int32_t rc = kTransportErr;
   if (!request(fd, b, &rc, &pay)) return kTransportErr;
   if (rc != 0) return rc;
-  return decode_sync_resp(pay, ns, dim, sel_out, vers_out, rows_out);
+  return decode_sync_resp(pay, ns, dim, dtype, sel_out, vers_out, rows_out);
+}
+
+int64_t ps_van_sync_pull(int fd, int id, const int64_t* keys,
+                         const uint64_t* cached_vers, int64_t ns,
+                         uint64_t bound, int64_t dim, uint32_t* sel_out,
+                         uint64_t* vers_out, float* rows_out) {
+  return ps_van_sync_pull_dt(fd, id, keys, cached_vers, ns, bound, dim, 0,
+                             sel_out, vers_out, rows_out);
+}
+
+int64_t ps_van_push_sync_dt(int fd, int id, const int64_t* push_keys,
+                            const float* push_grads, int64_t np,
+                            const int64_t* sync_keys,
+                            const uint64_t* cached_vers, int64_t ns,
+                            uint64_t bound, int64_t dim, int dtype,
+                            uint64_t req, uint32_t* sel_out,
+                            uint64_t* vers_out, float* rows_out) {
+  std::vector<char> b{(char)OP_PUSH_SYNC}, pay;
+  put<int32_t>(b, id); put<uint64_t>(b, req);
+  put<int64_t>(b, np); put<int64_t>(b, ns); put<uint64_t>(b, bound);
+  size_t o = b.size();
+  // grads in the wire grad dtype (bf16 tables push bf16; int8 stay f32)
+  int gdt = dtype == WDT_BF16 ? WDT_BF16 : WDT_F32;
+  std::vector<char> grows;
+  if (np > 0) encode_rows(gdt, push_grads, np, dim, grows);
+  size_t push_bytes = np * sizeof(int64_t) + grows.size();
+  b.resize(o + push_bytes + ns * (sizeof(int64_t) + sizeof(uint64_t)));
+  std::memcpy(b.data() + o, push_keys, np * sizeof(int64_t));
+  std::memcpy(b.data() + o + np * sizeof(int64_t), grows.data(),
+              grows.size());
+  char* q = b.data() + o + push_bytes;
+  std::memcpy(q, sync_keys, ns * sizeof(int64_t));
+  std::memcpy(q + ns * sizeof(int64_t), cached_vers, ns * sizeof(uint64_t));
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
+  return decode_sync_resp(pay, ns, dim, dtype, sel_out, vers_out, rows_out);
 }
 
 int64_t ps_van_push_sync(int fd, int id, const int64_t* push_keys,
@@ -1387,22 +1486,9 @@ int64_t ps_van_push_sync(int fd, int id, const int64_t* push_keys,
                          uint64_t bound, int64_t dim, uint64_t req,
                          uint32_t* sel_out, uint64_t* vers_out,
                          float* rows_out) {
-  std::vector<char> b{(char)OP_PUSH_SYNC}, pay;
-  put<int32_t>(b, id); put<uint64_t>(b, req);
-  put<int64_t>(b, np); put<int64_t>(b, ns); put<uint64_t>(b, bound);
-  size_t o = b.size();
-  size_t push_bytes = np * (sizeof(int64_t) + dim * sizeof(float));
-  b.resize(o + push_bytes + ns * (sizeof(int64_t) + sizeof(uint64_t)));
-  std::memcpy(b.data() + o, push_keys, np * sizeof(int64_t));
-  std::memcpy(b.data() + o + np * sizeof(int64_t), push_grads,
-              np * dim * sizeof(float));
-  char* q = b.data() + o + push_bytes;
-  std::memcpy(q, sync_keys, ns * sizeof(int64_t));
-  std::memcpy(q + ns * sizeof(int64_t), cached_vers, ns * sizeof(uint64_t));
-  int32_t rc = kTransportErr;
-  if (!request(fd, b, &rc, &pay)) return kTransportErr;
-  if (rc != 0) return rc;
-  return decode_sync_resp(pay, ns, dim, sel_out, vers_out, rows_out);
+  return ps_van_push_sync_dt(fd, id, push_keys, push_grads, np, sync_keys,
+                             cached_vers, ns, bound, dim, 0, req, sel_out,
+                             vers_out, rows_out);
 }
 
 // ---- SSP / preduce wire ops ----

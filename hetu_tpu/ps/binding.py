@@ -119,6 +119,10 @@ def _load():
             "ps_group_create": ([c.c_char_p, c.c_int, c.c_int64, c.c_int64,
                                  c.c_int, c.c_double, c.c_double, c.c_uint64,
                                  c.c_double, c.c_int], c.c_int),
+            "ps_group_create_dt": ([c.c_char_p, c.c_int, c.c_int64,
+                                    c.c_int64, c.c_int, c.c_double,
+                                    c.c_double, c.c_uint64, c.c_double,
+                                    c.c_int, c.c_int], c.c_int),
             "ps_group_set_optimizer": ([c.c_int, c.c_int, c.c_float,
                                         c.c_float, c.c_float, c.c_float,
                                         c.c_float], c.c_int),

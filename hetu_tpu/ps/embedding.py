@@ -42,10 +42,10 @@ class PSEmbedding:
                  init: str = "normal", init_b: float = 0.01, seed: int = 0,
                  endpoints=None, scheduler=None, table_id=None,
                  dtype: str = "f32"):
-        # dtype: row storage (+ wire encoding on the remote tier) —
-        # "bf16" halves, "int8" quarters embedding memory/traffic while
-        # optimizer state and every pulled row stay f32 (in-process tier;
-        # the partitioned remote tier is f32-only for now)
+        # dtype: row storage + wire encoding — "bf16" halves, "int8"
+        # quarters embedding memory/traffic while optimizer state and
+        # every pulled row stay f32 (in-process tier, RemotePSTable, and
+        # the endpoints= partitioned tier incl. its HET cache sync ops)
         if table_id is not None and endpoints is None and scheduler is None:
             raise ValueError(
                 "table_id applies to the remote tiers only (the in-process "
@@ -55,11 +55,11 @@ class PSEmbedding:
             raise ValueError(
                 "pass endpoints= OR scheduler=, not both (the scheduler "
                 "resolves the endpoints itself)")
-        if dtype != "f32" and (endpoints is not None or
-                               scheduler is not None):
+        if dtype != "f32" and scheduler is not None:
             raise ValueError(
-                "dtype'd rows are supported on the in-process tier and "
-                "RemotePSTable; the partitioned tier is f32-only for now")
+                "dtype'd rows via the scheduler tier are not wired yet; "
+                "pass endpoints= (the partitioned tier supports dtype) or "
+                "use the in-process tier")
         if endpoints is not None or scheduler is not None:
             from hetu_tpu.ps.van import PartitionedPSTable, RemoteCacheTable
             if scheduler is not None:
@@ -72,7 +72,7 @@ class PSEmbedding:
                 self.table = PartitionedPSTable(
                     endpoints, num_embeddings, dim, init=init,
                     init_b=init_b, seed=seed, optimizer=optimizer, lr=lr,
-                    table_id=table_id)
+                    table_id=table_id, dtype=dtype)
             cache_cls = RemoteCacheTable
         else:
             self.table = PSTable(num_embeddings, dim, init=init,

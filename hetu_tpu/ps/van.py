@@ -228,16 +228,19 @@ class PartitionedPSTable:
                  optimizer: str = "sgd", lr: float = 0.01,
                  momentum: float = 0.9, eps: float = 1e-7,
                  beta1: float = 0.9, beta2: float = 0.999,
+                 dtype: str = "f32",
                  connect_timeout_s: float = 10.0,
                  heartbeat_ms: int = 0):
-        from hetu_tpu.ps.client import _INIT_KINDS
+        from hetu_tpu.ps.client import TABLE_DTYPES, _INIT_KINDS
         if not isinstance(endpoints, str):
             endpoints = ",".join(f"{h}:{p}" for h, p in endpoints)
         self.rows, self.dim = rows, dim
+        self.dtype = dtype
         self.id = table_id if table_id is not None else _fresh_remote_id()
-        gid = lib.ps_group_create(
+        gid = lib.ps_group_create_dt(
             endpoints.encode(), self.id, rows, dim, _INIT_KINDS[init],
-            init_a, init_b, seed, connect_timeout_s, heartbeat_ms)
+            init_a, init_b, seed, connect_timeout_s, heartbeat_ms,
+            TABLE_DTYPES[dtype])
         if gid <= 0:
             raise ConnectionError(
                 f"cannot establish PS group over {endpoints} (rc={gid})")
