@@ -582,11 +582,11 @@ int ps_group_load(int gid, const char* path) {
 // the map until all ranks are alive or the timeout expires.  The group
 // remembers the scheduler so shards can re-resolve after a server rejoins
 // at a different address/port.
-int ps_group_create_sched(const char* sched_host, int sched_port,
-                          int n_servers, int table_id, int64_t rows,
-                          int64_t dim, int init_kind, double a, double b,
-                          uint64_t seed, double connect_timeout_s,
-                          int hb_ms) {
+int ps_group_create_sched_dt(const char* sched_host, int sched_port,
+                             int n_servers, int table_id, int64_t rows,
+                             int64_t dim, int init_kind, double a, double b,
+                             uint64_t seed, double connect_timeout_s,
+                             int hb_ms, int dtype) {
   if (!sched_host || sched_port <= 0 || n_servers <= 0 || n_servers > 64)
     return -3;
   auto deadline = std::chrono::steady_clock::now() +
@@ -623,7 +623,17 @@ int ps_group_create_sched(const char* sched_host, int sched_port,
                     deadline - std::chrono::steady_clock::now()).count();
   return group_create_impl(endpoints.c_str(), table_id, rows, dim,
                            init_kind, a, b, seed, left > 1.0 ? left : 1.0,
-                           hb_ms, sched_host, sched_port);
+                           hb_ms, sched_host, sched_port, dtype);
+}
+
+int ps_group_create_sched(const char* sched_host, int sched_port,
+                          int n_servers, int table_id, int64_t rows,
+                          int64_t dim, int init_kind, double a, double b,
+                          uint64_t seed, double connect_timeout_s,
+                          int hb_ms) {
+  return ps_group_create_sched_dt(sched_host, sched_port, n_servers,
+                                  table_id, rows, dim, init_kind, a, b,
+                                  seed, connect_timeout_s, hb_ms, 0);
 }
 
 int64_t ps_group_rows(int gid) {

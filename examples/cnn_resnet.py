@@ -34,9 +34,18 @@ def main():
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--limit-batches", type=int, default=0,
                     help="cap batches per epoch (smoke tests)")
+    ap.add_argument("--subset", type=int, default=0,
+                    help="train on the first N samples only — the "
+                    "documented-synthetic convergence mode: the fallback "
+                    "dataset has RANDOM labels, so the measurable learning "
+                    "signal is memorization accuracy on a repeated subset "
+                    "(with real CIFAR-10 under ~/.hetu_tpu/data this flag "
+                    "is unnecessary)")
     args = ap.parse_args()
 
     train_x, train_y, test_x, test_y = ht.data.datasets.cifar10()
+    if args.subset:
+        train_x, train_y = train_x[:args.subset], train_y[:args.subset]
     loader = ht.data.Dataloader((train_x, train_y), args.batch, shuffle=True)
 
     model = models.ResNet18(num_classes=10)

@@ -170,6 +170,11 @@ def _load():
                                        c.c_int64, c.c_int64, c.c_int,
                                        c.c_double, c.c_double, c.c_uint64,
                                        c.c_double, c.c_int], c.c_int),
+            "ps_group_create_sched_dt": ([c.c_char_p, c.c_int, c.c_int,
+                                          c.c_int, c.c_int64, c.c_int64,
+                                          c.c_int, c.c_double, c.c_double,
+                                          c.c_uint64, c.c_double, c.c_int,
+                                          c.c_int], c.c_int),
             "ps_group_rows": ([c.c_int], c.c_int64),
             "ps_group_dim": ([c.c_int], c.c_int64),
             "ps_group_sync_pull": ([c.c_int, i64p, u64p, c.c_int64,

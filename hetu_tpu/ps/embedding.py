@@ -44,8 +44,8 @@ class PSEmbedding:
                  dtype: str = "f32"):
         # dtype: row storage + wire encoding — "bf16" halves, "int8"
         # quarters embedding memory/traffic while optimizer state and
-        # every pulled row stay f32 (in-process tier, RemotePSTable, and
-        # the endpoints= partitioned tier incl. its HET cache sync ops)
+        # every pulled row stay f32 (ALL tiers: in-process, endpoints=,
+        # scheduler=, incl. the HET cache sync ops)
         if table_id is not None and endpoints is None and scheduler is None:
             raise ValueError(
                 "table_id applies to the remote tiers only (the in-process "
@@ -55,11 +55,6 @@ class PSEmbedding:
             raise ValueError(
                 "pass endpoints= OR scheduler=, not both (the scheduler "
                 "resolves the endpoints itself)")
-        if dtype != "f32" and scheduler is not None:
-            raise ValueError(
-                "dtype'd rows via the scheduler tier are not wired yet; "
-                "pass endpoints= (the partitioned tier supports dtype) or "
-                "use the in-process tier")
         if endpoints is not None or scheduler is not None:
             from hetu_tpu.ps.van import PartitionedPSTable, RemoteCacheTable
             if scheduler is not None:
@@ -67,7 +62,7 @@ class PSEmbedding:
                 self.table = PartitionedPSTable.from_scheduler(
                     host, port, n_servers, num_embeddings, dim, init=init,
                     init_b=init_b, seed=seed, optimizer=optimizer, lr=lr,
-                    table_id=table_id)
+                    table_id=table_id, dtype=dtype)
             else:
                 self.table = PartitionedPSTable(
                     endpoints, num_embeddings, dim, init=init,

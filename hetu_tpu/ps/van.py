@@ -269,6 +269,7 @@ class PartitionedPSTable:
                        optimizer: str = "sgd", lr: float = 0.01,
                        momentum: float = 0.9, eps: float = 1e-7,
                        beta1: float = 0.9, beta2: float = 0.999,
+                       dtype: str = "f32",
                        connect_timeout_s: float = 10.0,
                        heartbeat_ms: int = 0) -> "PartitionedPSTable":
         """Resolve the server endpoints from a scheduler instead of a static
@@ -277,14 +278,15 @@ class PartitionedPSTable:
         shard's endpoint from the scheduler whenever a direct reconnect
         fails, so a server may rejoin at a different address/port with no
         client reconfiguration."""
-        from hetu_tpu.ps.client import _INIT_KINDS
+        from hetu_tpu.ps.client import TABLE_DTYPES, _INIT_KINDS
         self = cls.__new__(cls)
         self.rows, self.dim = rows, dim
+        self.dtype = dtype
         self.id = table_id if table_id is not None else _fresh_remote_id()
-        gid = lib.ps_group_create_sched(
+        gid = lib.ps_group_create_sched_dt(
             sched_host.encode(), sched_port, n_servers, self.id, rows, dim,
             _INIT_KINDS[init], init_a, init_b, seed, connect_timeout_s,
-            heartbeat_ms)
+            heartbeat_ms, TABLE_DTYPES[dtype])
         if gid <= 0:
             raise ConnectionError(
                 f"cannot establish PS group via scheduler "

@@ -265,3 +265,26 @@ def test_shared_table_id_dtype_mismatch_rejected(server_port):
         init="zeros", dtype="bf16")
     t2.close()
     t.close()
+
+
+def test_scheduler_tier_bf16(server_port):
+    """The scheduler-resolved tier creates dtype'd shard tables too.
+    The module's van doubles as its own scheduler: register rank 0
+    pointing at itself, then resolve the group through it."""
+    from hetu_tpu.ps import PSEmbedding
+    from hetu_tpu.ps.binding import lib
+
+    h = lib.ps_sched_beat_start(b"127.0.0.1", server_port, 0, server_port,
+                                500, 10.0)
+    assert h > 0
+    try:
+        emb = PSEmbedding(500, 8, optimizer="sgd", lr=0.1, seed=2,
+                          scheduler=("127.0.0.1", server_port, 1),
+                          dtype="bf16")
+        ids = np.arange(32).reshape(8, 4)
+        rows = emb.pull(ids)
+        assert rows.shape == (8, 4, 8) and rows.dtype == np.float32
+        emb.push(ids, np.full((8, 4, 8), 0.01, np.float32))
+        emb.close()
+    finally:
+        lib.ps_sched_beat_stop(h)
