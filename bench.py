@@ -194,6 +194,67 @@ def bench_gpt():
     })
 
 
+def bench_gpt_sweep():
+    """MFU-residual diagnosis sweep (VERDICT r4 #2): the headline config
+    plus targeted variants that isolate the suspected gaps — the VPU-bound
+    attention at head-dim 64 (vs a head-dim-128 factoring), the CE head
+    (vs fused off), remat recompute cost (vs off), and the wider model the
+    round-2 session measured at 35.4% MFU.  One JSON line; per-config MFU
+    in extra so first light ranks the residuals in a single capture.
+    """
+    import os
+
+    from hetu_tpu import models
+
+    B, S = 16, 1024
+    smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
+
+    def cfg(**kw):
+        base = dict(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_heads=12, ffn_size=3072, max_position=S,
+                    dropout_rate=0.0, dtype=jnp.bfloat16,
+                    attention_impl="flash", remat=True)
+        if smoke:
+            base.update(vocab_size=512, hidden_size=64, num_layers=2,
+                        num_heads=4, ffn_size=256, max_position=128)
+        base.update(kw)
+        return models.GPTConfig(**base)
+
+    variants = {
+        "headline_d64": cfg(),
+        "headdim128": cfg(num_heads=6 if not smoke else 2),
+        "no_remat": cfg(remat=False),
+        "xla_attn": cfg(attention_impl="xla"),
+        "unfused_ce": cfg(fused_ce=False),
+        "h1536_d128": cfg(hidden_size=1536 if not smoke else 64,
+                          num_heads=12 if not smoke else 4,
+                          ffn_size=6144 if not smoke else 256),
+    }
+    peak = detect_chip().bf16_flops
+    bb, ss = (4, 128) if smoke else (B, S)
+    results = {}
+    for name, c in variants.items():
+        step_s, params = _gpt_step_s(c, bb, ss, n1=1, n2=4)
+        n_params = sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(params))
+        n_nonemb = n_params - c.vocab_size * c.hidden_size \
+            - c.max_position * c.hidden_size
+        fpt = (6 * n_nonemb + 6 * c.vocab_size * c.hidden_size
+               + 12 * c.num_layers * c.hidden_size * ss)
+        results[name] = {"mfu": round(fpt * bb * ss / step_s / peak, 4),
+                         "step_s": round(step_s, 5),
+                         "tokens_per_s": round(bb * ss / step_s, 1)}
+    best = max(results.values(), key=lambda r: r["mfu"])
+    _emit({
+        "metric": "gpt_config_sweep_best_mfu_1chip",
+        "value": best["mfu"],
+        "unit": "model_flops_utilization",
+        "vs_baseline": round(best["mfu"] /
+                             max(results["headline_d64"]["mfu"], 1e-9), 3),
+        "extra": {"configs": results, "batch": bb, "seq": ss},
+    })
+
+
 def bench_resnet():
     import hetu_tpu as ht
     from hetu_tpu import models, optim
@@ -492,6 +553,7 @@ def _enable_compile_cache():
 
 _METRIC_BY_CMD = {
     "gpt": "gpt2s_bf16_train_mfu_1chip",
+    "gpt_sweep": "gpt_config_sweep_best_mfu_1chip",
     "resnet": "resnet18_cifar10_train_samples_per_sec_per_chip",
     "ctr": "wdl_criteo_device_sparse_samples_per_sec_per_chip",
     "moe": "moe_block_bf16_train_mfu_1chip",
@@ -527,8 +589,8 @@ def main():
     devs = _wait_for_devices(600.0)
     if devs is None:
         _emit_stale_or_die(_METRIC_BY_CMD.get(cmd, _METRIC_BY_CMD["gpt"]))
-    {"resnet": bench_resnet, "ctr": bench_ctr,
-     "moe": bench_moe}.get(cmd, bench_gpt)()
+    {"resnet": bench_resnet, "ctr": bench_ctr, "moe": bench_moe,
+     "gpt_sweep": bench_gpt_sweep}.get(cmd, bench_gpt)()
 
 
 if __name__ == "__main__":

@@ -68,7 +68,13 @@ def _send_raw(port: int, frame: bytes, *, expect_reply: bool) -> bytes:
             s.shutdown(socket.SHUT_WR)
         s.settimeout(5 if expect_reply else 0.5)
         try:
-            return s.recv(8)
+            buf = b""
+            while len(buf) < 8:  # [u32 blen][i32 rc] header, exactly
+                chunk = s.recv(8 - len(buf))
+                if not chunk:
+                    break
+                buf += chunk
+            return buf
         except (socket.timeout, ConnectionResetError):
             if expect_reply:
                 raise
@@ -120,7 +126,8 @@ def test_error_rcs_not_crashes_for_short_but_valid_headers(server):
     # a well-formed header with a too-short body for each sized op must
     # answer rc=-3 (bad frame) on the SAME connection, not desync or die
     with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
-        for op in (1, 2, 5, 6, 7, 13, 14, 15, 16, 17, 18, 19, 21, 22):
+        for op in (1, 2, 5, 6, 7, 13, 14, 15, 16, 17, 18, 19, 21, 22,
+                   23, 24, 25, 26, 28):
             body = bytes([op])  # op byte only: below every op's kMinBody
             s.sendall(struct.pack("<I", len(body)) + body)
             s.settimeout(5)
@@ -139,4 +146,46 @@ def test_error_rcs_not_crashes_for_short_but_valid_headers(server):
         payload = s.recv(n)
         assert struct.unpack("<i", payload[:4])[0] == 0
     assert proc.poll() is None
+    assert _server_alive(port)
+
+
+def test_blob_barrier_info_malformed_frames(server):
+    """Round-5 ops (blob channel, barrier, table info) under garbage:
+    error rcs, never a crash, never a hang on a server thread."""
+    port, proc = server
+    frames = [
+        # BLOB_PUT seq=0 (reserved) with a well-formed payload
+        struct.pack("<IBqQiI", 1 + 24 + 4, 23, 1, 0, 10, 4) + b"abcd",
+        # BLOB_PUT nbytes beyond the body
+        struct.pack("<IBqQiI", 1 + 24, 23, 1, 1, 10, 1 << 20),
+        # BLOB_PUT nbytes over the 256 MB cap
+        struct.pack("<IBqQiI", 1 + 24, 23, 1, 1, 10, (1 << 28) + 1),
+        # BLOB_GET seq=0
+        struct.pack("<IBqQi", 1 + 20, 24, 1, 0, 10),
+        # BARRIER with nworkers <= 0 and absurd nworkers
+        struct.pack("<IBqii", 1 + 16, 26, 5, 0, 10),
+        struct.pack("<IBqii", 1 + 16, 26, 5, 1 << 20, 10),
+        # TABLE_INFO for a table that does not exist
+        struct.pack("<IBi", 1 + 4, 28, 424242),
+    ]
+    for f in frames:
+        reply = _send_raw(port, f, expect_reply=True)
+        (rc,) = struct.unpack("<i", reply[4:8])
+        assert rc < 0, (f[:8], rc)
+    assert proc.poll() is None
+    assert _server_alive(port)
+
+
+def test_blob_get_timeout_frees_server_thread(server):
+    """A blocking BLOB_GET must return -12 at its deadline (not pin the
+    connection thread forever) and the server keeps serving."""
+    port, proc = server
+    frame = struct.pack("<IBqQi", 1 + 20, 24, 777, 1, 300)  # 300 ms wait
+    import time
+    t0 = time.time()
+    reply = _send_raw(port, frame, expect_reply=True)
+    dt = time.time() - t0
+    (rc,) = struct.unpack("<i", reply[4:8])
+    assert rc == -12, rc
+    assert dt < 5, dt
     assert _server_alive(port)

@@ -37,13 +37,19 @@ import time
 REPO = pathlib.Path(__file__).resolve().parent.parent
 LOG = REPO / ".bench_watch.log"
 PIDFILE = REPO / ".bench_watch.pid"
-CMDS = ["gpt", "resnet", "ctr", "moe"]
+CMDS = ["gpt", "resnet", "ctr", "moe", "gpt_sweep"]
+# gpt_sweep last: the headline matrix captures first; the sweep then maps
+# the MFU residual (attention head-dim, CE head, remat cost) in the same
+# tunnel window
 
 PROBE_TIMEOUT_S = 75.0
 POLL_S = 60.0
 HEARTBEAT_S = 1800.0  # prove liveness in the log twice an hour
 BENCH_TIMEOUT_S = 2700.0  # first compile over a tunnel is slow, and every
 # bench now measures its A/B baseline variant too (two compiles each)
+# gpt_sweep compiles 12 programs (6 configs x two loop lengths): budget it
+# proportionally so a slow first-compile window can't blacklist it
+BENCH_TIMEOUTS = {"gpt_sweep": 3 * BENCH_TIMEOUT_S}
 
 
 def log(msg: str) -> None:
@@ -186,12 +192,13 @@ def run_bench(cmd: str) -> bool:
     log(f"bench {cmd}: starting")
     t0 = time.monotonic()
     try:
+        budget = BENCH_TIMEOUTS.get(cmd, BENCH_TIMEOUT_S)
         r = subprocess.run(
             [sys.executable, str(REPO / "bench.py"), cmd],
-            capture_output=True, timeout=BENCH_TIMEOUT_S, text=True,
+            capture_output=True, timeout=budget, text=True,
             cwd=str(REPO))
     except subprocess.TimeoutExpired:
-        log(f"bench {cmd}: TIMEOUT after {BENCH_TIMEOUT_S}s")
+        log(f"bench {cmd}: TIMEOUT after {budget}s")
         return False
     dt = time.monotonic() - t0
     line = (r.stdout.strip().splitlines() or [""])[-1]
