@@ -145,6 +145,17 @@ def _gpt_step_s(cfg, B, S, *, n1=2, n2=8):
     return step_s, params
 
 
+def _gpt_flops_per_token(cfg, params, seq):
+    """Standard 6N + attention flops accounting shared by the gpt benches."""
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    n_nonemb = n_params - cfg.vocab_size * cfg.hidden_size \
+        - cfg.max_position * cfg.hidden_size
+    fpt = (6 * n_nonemb + 6 * cfg.vocab_size * cfg.hidden_size
+           + 12 * cfg.num_layers * cfg.hidden_size * seq)
+    return fpt, n_params
+
+
 def bench_gpt():
     import os
 
@@ -168,12 +179,7 @@ def bench_gpt():
     base_cfg = dataclasses.replace(cfg, attention_impl="xla",
                                    fused_ce=False)
     base_step_s, _ = _gpt_step_s(base_cfg, B, S, n1=1, n2=4)
-    n_params = sum(int(np.prod(l.shape))
-                   for l in jax.tree_util.tree_leaves(params))
-    n_nonemb = n_params - cfg.vocab_size * cfg.hidden_size \
-        - cfg.max_position * cfg.hidden_size
-    flops_per_token = (6 * n_nonemb + 6 * cfg.vocab_size * cfg.hidden_size
-                       + 12 * cfg.num_layers * cfg.hidden_size * S)
+    flops_per_token, n_params = _gpt_flops_per_token(cfg, params, S)
     mfu = flops_per_token * B * S / step_s / peak
     tokens_per_s = B * S / step_s
     _emit({
@@ -235,12 +241,7 @@ def bench_gpt_sweep():
     results = {}
     for name, c in variants.items():
         step_s, params = _gpt_step_s(c, bb, ss, n1=1, n2=4)
-        n_params = sum(int(np.prod(l.shape))
-                       for l in jax.tree_util.tree_leaves(params))
-        n_nonemb = n_params - c.vocab_size * c.hidden_size \
-            - c.max_position * c.hidden_size
-        fpt = (6 * n_nonemb + 6 * c.vocab_size * c.hidden_size
-               + 12 * c.num_layers * c.hidden_size * ss)
+        fpt, _ = _gpt_flops_per_token(c, params, ss)
         results[name] = {"mfu": round(fpt * bb * ss / step_s / peak, 4),
                          "step_s": round(step_s, 5),
                          "tokens_per_s": round(bb * ss / step_s, 1)}

@@ -44,21 +44,21 @@ def synthetic_batch(g, B, S, vocab):
     topic_a = g.integers(5, vocab, (B, 16))   # per-sequence vocabularies
     topic_b = g.integers(5, vocab, (B, 16))   # for NSP negatives
     nsp = g.integers(0, 2, (B,)).astype(np.int32)
-    ids = np.empty((B, S), np.int64)
     pick = g.integers(0, 16, (B, S))
     stay = g.random((B, S)) < 0.9
-    for b in range(B):
-        vocab_1 = topic_a[b]
-        vocab_2 = topic_a[b] if nsp[b] else topic_b[b]
-        ids[b, 0] = vocab_1[pick[b, 0]]
-        for t in range(1, S):
-            tv = vocab_1 if t < half else vocab_2
-            boundary = t == half and not nsp[b]
-            if stay[b, t] and not boundary:
-                ids[b, t] = ids[b, t - 1]
-            else:
-                ids[b, t] = tv[pick[b, t]]
-    ids = ids.astype(np.int32)
+    # vectorized sticky chain (this runs EVERY training step): each
+    # position copies the value drawn at the most recent redraw position,
+    # so ids[t] = draws[last_redraw<=t] via a running maximum of indices
+    redraw = ~stay
+    redraw[:, 0] = True
+    redraw[nsp == 0, half] = True  # negatives restart at the boundary
+    seg_vocab = np.where((np.arange(S)[None, :] < half) | (nsp[:, None]
+                                                           == 1),
+                         np.take_along_axis(topic_a, pick, 1),
+                         np.take_along_axis(topic_b, pick, 1))
+    last_redraw = np.maximum.accumulate(
+        np.where(redraw, np.arange(S)[None, :], 0), axis=1)
+    ids = np.take_along_axis(seg_vocab, last_redraw, 1).astype(np.int32)
     tok_type = (np.arange(S)[None] >= half).astype(np.int32) * np.ones(
         (B, 1), np.int32)
     attn = np.ones((B, S), np.int32)
