@@ -14,9 +14,9 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from hetu_tpu.utils.platform import apply_env_platform
+from hetu_tpu.utils.platform import bootstrap_example
 
-apply_env_platform()  # honor JAX_PLATFORMS even under the tunnel sitecustomize
+bootstrap_example(8)  # virtual devices for bare CPU runs + platform forcing
 
 import jax
 import numpy as np

@@ -13,9 +13,9 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from hetu_tpu.utils.platform import apply_env_platform
+from hetu_tpu.utils.platform import bootstrap_example
 
-apply_env_platform()  # honor JAX_PLATFORMS even under the tunnel sitecustomize
+bootstrap_example(8)  # virtual devices for bare CPU runs + platform forcing
 
 import jax
 import numpy as np
@@ -67,6 +67,9 @@ def main():
                   f"({10 * args.batch / (time.perf_counter() - t0):.1f} "
                   f"seq/s)")
             t0 = time.perf_counter()
+    if args.steps:  # short runs (< 10 steps) still report a result line
+        print(f"done: {args.steps} steps, "
+              f"final loss={float(m['loss']):.4f}")
 
 
 if __name__ == "__main__":

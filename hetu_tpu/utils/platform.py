@@ -150,6 +150,26 @@ def apply_env_platform() -> None:
         pass  # backend already initialized: too late, leave it
 
 
+def default_virtual_devices(n: int = 8) -> None:
+    """Give the HOST platform ``n`` virtual devices unless the user already
+    chose a count — examples that build multi-device meshes call this
+    before importing jax so a bare ``python examples/foo.py`` works on a
+    1-CPU box.  Harmless on real-TPU runs: the flag only affects the cpu
+    platform, which a live TPU backend never selects."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _COUNT_FLAG not in flags:
+        os.environ["XLA_FLAGS"] = f"{flags} {_COUNT_FLAG}={n}".strip()
+
+
+def bootstrap_example(n_devices: int = 8) -> None:
+    """The shared example preamble: give the host platform ``n_devices``
+    virtual devices (bare CPU runs still build multi-device meshes) and
+    re-assert JAX_PLATFORMS past the tunnel sitecustomize.  Call BEFORE
+    importing jax."""
+    default_virtual_devices(n_devices)
+    apply_env_platform()
+
+
 def default_backend_is_tpu() -> bool:
     """Whether the default backend is a real TPU (cached after first call).
 
