@@ -205,6 +205,7 @@ struct BlobChan {
   bool acked = true;  // reader consumed the stored payload
   std::vector<char> data;
 };
+extern std::atomic<bool> g_van_running;  // defined below
 std::mutex g_blobs_mu;
 std::map<int64_t, std::shared_ptr<BlobChan>> g_blobs;
 constexpr size_t kMaxBlobChans = 1 << 16;   // wire-supplied ids: bound them
@@ -215,6 +216,10 @@ constexpr int64_t kMaxBlobBytes = 1 << 28;  // 256 MB per message
 // is consumed), so an idle channel costs only its struct
 std::shared_ptr<BlobChan> get_blob(int64_t channel) {
   std::lock_guard<std::mutex> lk(g_blobs_mu);
+  // checked UNDER the map lock (stop() clears under the same lock): a
+  // surviving old-incarnation connection cannot repopulate state after
+  // the stop-time sweep
+  if (!g_van_running.load()) return nullptr;
   auto it = g_blobs.find(channel);
   if (it != g_blobs.end()) return it->second;
   if (g_blobs.size() >= kMaxBlobChans) {
@@ -257,6 +262,7 @@ std::map<int64_t, std::shared_ptr<VanBarrier>> g_barriers;
 
 std::shared_ptr<VanBarrier> get_barrier(int64_t bid) {
   std::lock_guard<std::mutex> lk(g_barriers_mu);
+  if (!g_van_running.load()) return nullptr;  // under the lock, see above
   auto it = g_barriers.find(bid);
   if (it != g_barriers.end()) return it->second;
   if (g_barriers.size() >= kMaxBlobChans) {
@@ -1022,6 +1028,18 @@ void ps_van_stop() {
   if (!g_van_running.exchange(false)) return;
   int fd = g_van_fd.exchange(-1);
   if (fd >= 0) { ::shutdown(fd, SHUT_RDWR); ::close(fd); }
+  // a stopped server drops its in-memory channel state, like a fresh
+  // server process would: stale unacked blob slots / barrier generations
+  // must not leak into the next serve() in this process (handler threads
+  // still blocked on a channel hold their shared_ptr and time out)
+  {
+    std::lock_guard<std::mutex> lk(g_blobs_mu);
+    g_blobs.clear();  // creation re-checks g_van_running under this
+  }                   // lock, so no entry can appear after the sweep
+  {
+    std::lock_guard<std::mutex> lk(g_barriers_mu);
+    g_barriers.clear();
+  }
 }
 
 // ---- client side ----

@@ -248,6 +248,27 @@ runner.close()
 """
 
 
+def _run_pipeline_procs(tmp_path, jobs, *, timeout=300):
+    """Spawn one subprocess per (name, script_source), wait for all, and
+    assert rc=0 + a DONE line each; kills survivors on any failure."""
+    procs = []
+    try:
+        for name, src in jobs:
+            p = tmp_path / f"{name}.py"
+            p.write_text(src)
+            procs.append(subprocess.Popen(
+                [sys.executable, str(p)], stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=timeout)
+            assert p.returncode == 0, stderr[-3000:]
+            assert "DONE" in stdout
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait()
+
+
 def test_three_stage_unequal_dp(tmp_path):
     """3 stages at dp (2, 1, 1) = 4 PROCESSES: activations/cotangents
     round-robin through acked mailboxes, stage-0 grads reduced across its
@@ -257,25 +278,17 @@ def test_three_stage_unequal_dp(tmp_path):
     DPS = [2, 1, 1]
     from hetu_tpu.ps import van
     port = van.serve(0)
-    procs = []
     outs = {}
     try:
+        jobs = []
         for stage, dp in enumerate(DPS):
             for rep in range(dp):
                 out = str(tmp_path / f"g_{stage}_{rep}.npy")
                 outs[(stage, rep)] = out
-                src = RUNNER_SRC.format(repo=str(REPO), stage=stage,
-                                        replica=rep, D=D, B=B, M=M,
-                                        dps=DPS, port=port, out=out)
-                p = tmp_path / f"runner_{stage}_{rep}.py"
-                p.write_text(src)
-                procs.append(subprocess.Popen(
-                    [sys.executable, str(p)], stdout=subprocess.PIPE,
-                    stderr=subprocess.PIPE, text=True))
-        for p in procs:
-            stdout, stderr = p.communicate(timeout=300)
-            assert p.returncode == 0, stderr[-3000:]
-            assert "DONE" in stdout
+                jobs.append((f"runner_{stage}_{rep}", RUNNER_SRC.format(
+                    repo=str(REPO), stage=stage, replica=rep, D=D, B=B,
+                    M=M, dps=DPS, port=port, out=out)))
+        _run_pipeline_procs(tmp_path, jobs)
 
         # single-process oracle: same 3-layer net, mean loss over B
         import jax
@@ -304,7 +317,112 @@ def test_three_stage_unequal_dp(tmp_path):
         np.testing.assert_allclose(np.load(outs[(0, 0)]),
                                    np.load(outs[(0, 1)]), rtol=1e-6)
     finally:
-        for p in procs:
-            p.kill()
-            p.wait()
+        van.stop()
+
+
+TP_STAGE_SRC = """
+import sys
+sys.path.insert(0, {repo!r})
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from hetu_tpu.parallel.mpmd import MPMDStageRunner
+
+stage = {stage}
+D, B, M = {D}, {B}, {M}
+mb = B // M
+
+rngw = np.random.default_rng(100 + stage)
+w = jnp.asarray(rngw.standard_normal((D, D)) * 0.4, jnp.float32)
+
+if stage == 0:
+    # this stage is ITS OWN SPMD program: a 2-device tp mesh, Megatron
+    # column-split weight — XLA partitions the matmul and gathers the
+    # activation; the OTHER stage is a different program on a different
+    # mesh (the reference's heterogeneous per-stage parallelism)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    w = jax.device_put(w, NamedSharding(mesh, P(None, "tp")))
+
+    @jax.jit
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+else:
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+runner = MPMDStageRunner(
+    stage_fn, stage=stage, replica=0, stage_dps=[1, 1],
+    n_microbatches=M, in_shape=(mb, D), out_shape=(mb, D),
+    host="127.0.0.1", port={port}, grad_size=D * D)
+
+data = None
+loss_fn = None
+if stage == 0:
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    data = [x[i * mb:(i + 1) * mb] for i in range(M)]
+else:
+    rngy = np.random.default_rng(7)
+    y = jnp.asarray(rngy.standard_normal((B, D)) * 0.1, jnp.float32)
+    ys = [y[i * mb:(i + 1) * mb] for i in range(M)]
+    seq = iter(runner._my_microbatches())
+    def loss_fn(out):
+        return jnp.mean((out - ys[next(seq)]) ** 2)
+
+if stage == 0:
+    # the stage's computation is genuinely SPMD: the jitted forward's
+    # OUTPUT spans both tp devices (not an after-the-fact attribute of w)
+    y_probe = stage_fn(w, jnp.zeros((mb, D), jnp.float32))
+    assert len(y_probe.sharding.device_set) == 2, y_probe.sharding
+loss, grads = runner.run_step(w, loss_fn=loss_fn, data=data)
+np.save({out!r}, np.asarray(grads))
+print("DONE", flush=True)
+runner.close()
+"""
+
+
+def test_heterogeneous_stage_programs_tp_inside_mpmd(tmp_path):
+    """Each MPMD stage is a FULL SPMD program with its own mesh: stage 0
+    runs internally tensor-parallel (2-device tp mesh, col-split weight,
+    XLA-inserted collectives), stage 1 runs unsharded — different
+    programs, different meshes, one pipeline (the reference's
+    heterogeneous hybrid parallelism, beyond per-stage DP)."""
+    D, B, M = 8, 8, 4
+    from hetu_tpu.ps import van
+    port = van.serve(0)
+    outs = {}
+    try:
+        jobs = []
+        for stage in range(2):
+            out = str(tmp_path / f"g_{stage}.npy")
+            outs[stage] = out
+            jobs.append((f"tp_runner_{stage}", TP_STAGE_SRC.format(
+                repo=str(REPO), stage=stage, D=D, B=B, M=M, port=port,
+                out=out)))
+        _run_pipeline_procs(tmp_path, jobs)
+
+        import jax
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((B, D)), jnp.float32)
+        ws = [jnp.asarray(
+            np.random.default_rng(100 + s).standard_normal((D, D)) * 0.4,
+            jnp.float32) for s in range(2)]
+        y = jnp.asarray(
+            np.random.default_rng(7).standard_normal((B, D)) * 0.1,
+            jnp.float32)
+
+        def full(w0, w1):
+            return jnp.mean((jnp.tanh(jnp.tanh(x @ w0) @ w1) - y) ** 2)
+
+        want = jax.grad(full, argnums=(0, 1))(*ws)
+        for s in range(2):
+            np.testing.assert_allclose(np.load(outs[s]),
+                                       np.asarray(want[s]),
+                                       rtol=2e-4, atol=1e-6)
+    finally:
         van.stop()
