@@ -1,0 +1,162 @@
+"""The tunnel watcher's first-light sequence — exercised with fakes.
+
+The real first light has never fired (tunnel down rounds 3-5), so a bug
+in the capture sequencing would only surface when it finally matters.
+These tests drive tools/bench_watcher.py's machinery directly: the
+calibrate-then-bench order, per-success commits, give-up accounting, and
+commit_capture against a real (temporary) git repo.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import bench_watcher as bw
+
+
+def _fake_proc(stdout="{}"):
+    class P:
+        returncode = 0
+        stderr = ""
+    P.stdout = stdout
+    return P()
+
+
+@pytest.fixture
+def fresh_watcher(monkeypatch, tmp_path):
+    """Watcher module with its side effects redirected at a tmp dir."""
+    monkeypatch.setattr(bw, "LOG", tmp_path / "watch.log")
+    monkeypatch.setattr(bw, "PIDFILE", tmp_path / "watch.pid")
+    monkeypatch.setattr(bw, "POLL_S", 0.01)
+    return bw
+
+
+def test_first_light_sequencing(fresh_watcher, monkeypatch):
+    """Tunnel answers -> calibrate runs FIRST, then every bench in order,
+    each success committed, then the watcher exits (all done)."""
+    events = []
+    monkeypatch.setattr(bw, "probe_tpu", lambda: True)
+    monkeypatch.setattr(bw, "run_bench",
+                        lambda cmd: events.append(("bench", cmd)) or True)
+    monkeypatch.setattr(bw, "commit_capture",
+                        lambda what: events.append(("commit", what)))
+
+    monkeypatch.setattr(
+        bw.subprocess, "run",
+        lambda *a, **k: events.append(("calibrate",)) or
+        _fake_proc('{"chip": "tpu"}'))
+    bw._watch(deadline_s=30.0)
+    assert events[0] == ("calibrate",)
+    assert events[1] == ("commit", "calibrate")
+    ran = [e[1] for e in events if e[0] == "bench"]
+    assert ran == bw.CMDS, ran          # every bench, declared order
+    committed = [e[1] for e in events if e[0] == "commit"]
+    assert committed == ["calibrate"] + bw.CMDS
+    log = bw.LOG.read_text()
+    assert "watcher exiting" in log     # exited because all done, not
+    assert "deadline reached" not in log  # by running out the clock
+
+
+def test_first_light_gives_up_on_deterministic_failures(fresh_watcher,
+                                                        monkeypatch):
+    """A bench failing MAX_FAILS times with a LIVE tunnel is abandoned
+    (a deterministic bug must not burn the whole window) and the exit log
+    names it as given up."""
+    calls = {"n": 0}
+    monkeypatch.setattr(bw, "probe_tpu", lambda: True)
+
+    def run_bench(cmd):
+        if cmd == "ctr":
+            calls["n"] += 1
+            return False
+        return True
+
+    monkeypatch.setattr(bw, "run_bench", run_bench)
+    monkeypatch.setattr(bw, "commit_capture", lambda what: None)
+
+    monkeypatch.setattr(bw.subprocess, "run",
+                        lambda *a, **k: _fake_proc())
+    bw._watch(deadline_s=30.0)
+    assert calls["n"] == 3  # MAX_FAILS, then abandoned
+    assert "given_up=['ctr']" in bw.LOG.read_text()
+
+
+def test_tunnel_drop_mid_matrix_resumes_polling(fresh_watcher, monkeypatch):
+    """A bench failing while the tunnel ALSO dropped is a blip, not a
+    strike: the watcher goes back to polling and completes the matrix on
+    the next window without burning a failure count."""
+    state = {"window": 0, "bench_calls": []}
+
+    DROPS = 5  # > MAX_FAILS: blips must not accumulate into a give-up
+
+    def probe():
+        # odd pattern: each loop-top probe is up, the re-probe after the
+        # bench failure says DOWN, DROPS times over — then up for good
+        state["window"] += 1
+        return state["window"] > 2 * DROPS or state["window"] % 2 == 1
+
+    def run_bench(cmd):
+        state["bench_calls"].append(cmd)
+        # the first bench keeps failing while its window keeps dropping
+        return len(state["bench_calls"]) > DROPS
+
+    monkeypatch.setattr(bw, "probe_tpu", probe)
+    monkeypatch.setattr(bw, "run_bench", run_bench)
+    monkeypatch.setattr(bw, "commit_capture", lambda what: None)
+
+    monkeypatch.setattr(bw.subprocess, "run",
+                        lambda *a, **k: _fake_proc())
+    bw._watch(deadline_s=30.0)
+    log = bw.LOG.read_text()
+    assert "tunnel dropped mid-matrix" in log
+    assert "watcher exiting" in log
+    # the central claim: 5 drop-coincident failures (> MAX_FAILS) burned
+    # ZERO strikes — nothing was given up, every bench completed
+    assert "giving up" not in log
+    assert "given_up=[]" in log
+    assert set(state["bench_calls"]) == set(bw.CMDS)
+
+
+def test_commit_capture_commits_artifacts(fresh_watcher, monkeypatch,
+                                          tmp_path):
+    """commit_capture against a real temporary git repo: stages exactly
+    the artifact files that exist and creates a commit."""
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    for cmd in (["git", "init", "-q"],
+                ["git", "config", "user.email", "t@t"],
+                ["git", "config", "user.name", "t"]):
+        subprocess.run(cmd, cwd=repo, check=True, capture_output=True)
+    (repo / ".bench_lkg.json").write_text(json.dumps({"m": 1}))
+    monkeypatch.setattr(bw, "REPO", repo)
+    bw.commit_capture("gpt")
+    head = subprocess.run(["git", "log", "--oneline"], cwd=repo,
+                          capture_output=True, text=True).stdout
+    assert "bench watcher (gpt)" in head
+    files = subprocess.run(["git", "show", "--name-only", "--format="],
+                           cwd=repo, capture_output=True, text=True).stdout
+    assert ".bench_lkg.json" in files
+    assert "CALIBRATION.json" not in files  # absent file: not staged
+
+    # nothing on disk -> skipped, no crash, no empty commit (the skip
+    # branch checks disk existence only, so no index cleanup is needed)
+    (repo / ".bench_lkg.json").unlink()
+    bw.commit_capture("resnet")
+    assert "no artifact files on disk yet" in bw.LOG.read_text()
+
+
+@pytest.mark.slow
+def test_run_bench_accepts_smoke_capture(fresh_watcher, monkeypatch):
+    """run_bench on the REAL bench.py (CPU smoke): rc 0 + fresh JSON line
+    counts as a capture — the exact contract first light relies on."""
+    monkeypatch.setenv("HETU_BENCH_SMOKE", "1")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert bw.run_bench("moe") is True
+    log = bw.LOG.read_text()
+    assert "bench moe: OK" in log
