@@ -316,20 +316,25 @@ static void apply_row(Table* t, int64_t r, const float* g) {
 // stored values (no dequantize/requantize double rounding) at zero extra
 // passes.  Out-of-range rows read as zeros with scale 0.
 int ps_sparse_pull_q8(int id, const int64_t* idx, int64_t n, int8_t* q,
-                      float* scales) {
+                      float* scales, uint64_t* versions_out) {
   Table* t = get_table(id);
   if (!t) return -1;
   if (t->dtype != DT_INT8) return -3;
+  // versions are read in the SAME critical section as the row bytes: a
+  // caller pairing them (the HET-cache contract) must never see a newer
+  // version stamped onto older bytes
   std::lock_guard<std::mutex> lk(t->mu);
   for (int64_t i = 0; i < n; i++) {
     int64_t r = idx[i];
     if (r < 0 || r >= t->rows) {
       std::memset(q + i * t->dim, 0, t->dim);
       scales[i] = 0.f;
+      if (versions_out) versions_out[i] = 0;
       continue;
     }
     std::memcpy(q + i * t->dim, t->qdata.data() + r * t->dim, t->dim);
     scales[i] = t->qscale[r];
+    if (versions_out) versions_out[i] = t->version[r];
   }
   return 0;
 }

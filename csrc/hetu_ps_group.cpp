@@ -175,12 +175,14 @@ int create_shard_table(Group* g, Shard* s, int shard_idx) {
                                   g->init_kind, g->init_a, g->init_b,
                                   g->seed + (uint64_t)shard_idx, g->dtype);
   if (rc == -2) {
-    // another worker created the id first: verify ITS dtype matches ours —
-    // a mismatch would silently mis-decode every dtype'd frame from here
+    // another worker created the id first: verify ITS shape AND dtype
+    // match ours — a mismatch would silently mis-frame every row from
+    // here (OP_TABLE_INFO returns all three for exactly this check)
     int32_t dt = -1;
-    if (ps_van_table_info(s->fd, g->table_id, nullptr, nullptr, &dt) == 0 &&
-        dt != g->dtype)
-      return -8;  // dtype mismatch on a shared table id
+    int64_t rows = -1, dim = -1;
+    if (ps_van_table_info(s->fd, g->table_id, &rows, &dim, &dt) == 0 &&
+        (dt != g->dtype || rows != s->rows || dim != g->dim))
+      return -8;  // shape/dtype mismatch on a shared table id
   } else if (rc != 0) {
     return rc;
   }

@@ -47,7 +47,7 @@ int ps_table_create_ex(int id, int64_t rows, int64_t dim, int init_kind,
                        double a, double b, uint64_t seed, int dtype);
 int ps_table_dtype(int id);
 int ps_sparse_pull_q8(int id, const int64_t* idx, int64_t n, int8_t* q,
-                      float* scales);
+                      float* scales, uint64_t* versions_out);
 int ps_table_set_optimizer(int id, int kind, float lr, float mom, float eps,
                            float b1, float b2);
 int64_t ps_table_rows(int id);
@@ -575,15 +575,13 @@ void handle_conn(int fd) {
         int rc;
         if (dtype == WDT_INT8) {
           // ship stored qdata + qscale verbatim: zero extra passes and no
-          // dequantize/requantize double rounding on the hot pull path
+          // dequantize/requantize double rounding on the hot pull path;
+          // versions come from the same critical section as the bytes
           rows.resize(n * wire_row_bytes(WDT_INT8, dim));
           std::vector<int8_t> qb(n * dim);
           std::vector<float> sc(n);
-          rc = ps_sparse_pull_q8(id, idx, n, qb.data(), sc.data());
-          if (rc == 0 && with_ver) {
-            fbuf.resize(n * dim);  // versions ride the f32 pull path
-            rc = ps_sparse_pull(id, idx, n, fbuf.data(), vbuf.data());
-          }
+          rc = ps_sparse_pull_q8(id, idx, n, qb.data(), sc.data(),
+                                 with_ver ? vbuf.data() : nullptr);
           if (rc == 0) {
             char* q = rows.data();
             for (int64_t r = 0; r < n; r++) {
@@ -596,16 +594,25 @@ void handle_conn(int fd) {
           fbuf.resize(n * dim);
           rc = ps_sparse_pull(id, idx, n, fbuf.data(),
                               with_ver ? vbuf.data() : nullptr);
-          if (rc == 0) encode_rows(dtype, fbuf.data(), n, dim, rows);
+          // f32 (the default hot path) keeps zero-copy: fbuf writes to
+          // the socket directly below; only bf16 encodes into scratch
+          if (rc == 0 && dtype != WDT_F32)
+            encode_rows(dtype, fbuf.data(), n, dim, rows);
         }
         if (rc != 0) { send_resp(fd, rc, nullptr, 0); break; }
-        uint32_t plen = (uint32_t)(rows.size()
+        const char* rows_ptr = rows.data();
+        size_t rows_len = rows.size();
+        if (dtype == WDT_F32) {
+          rows_ptr = (const char*)fbuf.data();
+          rows_len = (size_t)n * dim * sizeof(float);
+        }
+        uint32_t plen = (uint32_t)(rows_len
                                    + vbuf.size() * sizeof(uint64_t));
         uint32_t blen2 = 4 + plen;
         int32_t rc32 = rc;
         g_bytes_tx.fetch_add(4 + blen2, std::memory_order_relaxed);
         if (!write_all(fd, &blen2, 4) || !write_all(fd, &rc32, 4) ||
-            !write_all(fd, rows.data(), rows.size())) {
+            !write_all(fd, rows_ptr, rows_len)) {
           ::close(fd); return;
         }
         if (with_ver &&
@@ -1367,15 +1374,21 @@ int ps_van_blob_put(int fd, int64_t channel, uint64_t seq, const void* data,
 }
 
 // Returns the message byte count (copied into `out`, up to `cap`), or < 0.
+// On -102 (buffer too small) *need_out (nullable) receives the message
+// size so the caller resizes ONCE instead of growing geometrically with a
+// full re-transfer per attempt.
 int64_t ps_van_blob_get(int fd, int64_t channel, uint64_t seq, void* out,
-                        int64_t cap, int wait_ms) {
+                        int64_t cap, int wait_ms, int64_t* need_out) {
   std::vector<char> b{(char)OP_BLOB_GET}, pay;
   put<int64_t>(b, channel); put<uint64_t>(b, seq);
   put<int32_t>(b, wait_ms);
   int32_t rc = kTransportErr;
   if (!request(fd, b, &rc, &pay)) return kTransportErr;
   if (rc != 0) return rc;
-  if ((int64_t)pay.size() > cap) return -102;  // caller buffer too small
+  if ((int64_t)pay.size() > cap) {
+    if (need_out) *need_out = (int64_t)pay.size();
+    return -102;  // caller buffer too small
+  }
   std::memcpy(out, pay.data(), pay.size());
   return (int64_t)pay.size();
 }

@@ -130,7 +130,9 @@ class VanMailbox:
         n = int(np.prod(shape))
         if self.impl == "blob":
             data = self._chan.get(seq, timeout_s=timeout_s)
-            a = np.frombuffer(data, np.float32)
+            # frombuffer over bytes is read-only; copy so consumers may
+            # mutate in place (the sparse transport's contract)
+            a = np.frombuffer(data, np.float32).copy()
             if a.size != n:
                 raise ValueError(
                     f"mailbox: message has {a.size} f32s, expected "

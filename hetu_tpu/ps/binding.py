@@ -17,6 +17,7 @@ _HERE = Path(__file__).resolve().parent
 _CSRC = _HERE.parent.parent / "csrc"
 _SRCS = [_CSRC / "hetu_ps.cpp", _CSRC / "hetu_ps_van.cpp",
          _CSRC / "hetu_ps_group.cpp", _CSRC / "hetu_ps_rcache.cpp"]
+_HDRS = [_CSRC / "hetu_ps_dtype.h"]  # staleness only (not passed to g++)
 _BUILD = _HERE / "_build"
 _SO = _BUILD / "libhetu_ps.so"
 
@@ -27,7 +28,7 @@ _err = None
 
 def _build() -> None:
     _BUILD.mkdir(parents=True, exist_ok=True)
-    newest = max(src.stat().st_mtime for src in _SRCS)
+    newest = max(src.stat().st_mtime for src in _SRCS + _HDRS)
     if _SO.exists() and _SO.stat().st_mtime >= newest:
         return
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
@@ -207,7 +208,7 @@ def _load():
             "ps_van_blob_put": ([c.c_int, c.c_int64, c.c_uint64, c.c_void_p,
                                  c.c_int64, c.c_int], c.c_int),
             "ps_van_blob_get": ([c.c_int, c.c_int64, c.c_uint64, c.c_void_p,
-                                 c.c_int64, c.c_int], c.c_int64),
+                                 c.c_int64, c.c_int, i64p], c.c_int64),
             "ps_van_blob_ack": ([c.c_int, c.c_int64, c.c_uint64], c.c_int),
             "ps_van_barrier": ([c.c_int, c.c_int64, c.c_int, c.c_int],
                                c.c_int),

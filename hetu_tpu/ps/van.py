@@ -553,6 +553,11 @@ class BlobChannel:
             if rc == 0:
                 return
             if time.time() > deadline:
+                if rc == -11:  # previous message unread: same condition
+                    # the sparse mailbox surfaces as TimeoutError
+                    raise TimeoutError(
+                        f"blob put: ack of the previous message not "
+                        f"observed within {timeout_s}s")
                 raise RuntimeError(f"blob put failed (rc={rc})")
             if rc == -101:  # transport: reconnect and resend (idempotent)
                 self._reconnect()
@@ -565,18 +570,25 @@ class BlobChannel:
     def get(self, seq: int, *, timeout_s: float = 60.0) -> bytes:
         cap = 1 << 28
         deadline = time.time() + timeout_s
+        need = ctypes.c_int64(0)
         while True:
             wait_ms = max(1, int((deadline - time.time()) * 1000))
             n = lib.ps_van_blob_get(self.fd, self.id, seq, self._rbuf,
-                                    len(self._rbuf), wait_ms)
+                                    len(self._rbuf), wait_ms,
+                                    ctypes.byref(need))
             if n >= 0:
                 self._ack(seq, deadline)
                 return ctypes.string_at(self._rbuf, n)
-            if n == -102 and len(self._rbuf) < cap:  # too small: grow
-                self._rbuf = ctypes.create_string_buffer(
-                    min(cap, len(self._rbuf) * 16))
+            if n == -102 and need.value <= cap:  # too small: resize ONCE
+                # to the reported size (one retransfer, not a geometric
+                # grow with a full transfer per step)
+                self._rbuf = ctypes.create_string_buffer(int(need.value))
                 continue
             if time.time() > deadline:
+                if n == -12:
+                    raise TimeoutError(
+                        f"blob get: seq {seq} not delivered within "
+                        f"{timeout_s}s")
                 raise RuntimeError(f"blob get failed (rc={n})")
             if n == -101:
                 self._reconnect()

@@ -51,7 +51,7 @@ def test_blob_put_blocks_until_acked(server_port):
     tx = van.BlobChannel("127.0.0.1", server_port, 9002)
     rx = van.BlobChannel("127.0.0.1", server_port, 9002)
     tx.put(b"first", 1)
-    with pytest.raises(RuntimeError):  # slot still unread: put times out
+    with pytest.raises(TimeoutError):  # slot still unread: put times out
         tx.put(b"second", 2, timeout_s=0.3)
     assert rx.get(1) == b"first"
     tx.put(b"second", 2, timeout_s=5.0)  # freed by the ack
@@ -75,8 +75,8 @@ def test_blob_large_message_grows_buffer(server_port):
 
 def test_blob_get_timeout(server_port):
     rx = van.BlobChannel("127.0.0.1", server_port, 9004)
-    with pytest.raises(RuntimeError):
-        rx.get(1, timeout_s=0.2)
+    with pytest.raises(TimeoutError):  # same contract as the sparse
+        rx.get(1, timeout_s=0.2)       # mailbox's undelivered-seq timeout
     rx.close()
 
 

@@ -59,57 +59,65 @@ def log(msg: str) -> None:
         f.write(line + "\n")
 
 
-def _pid_is_watcher(pid: int) -> bool:
-    try:
-        with open(f"/proc/{pid}/cmdline", "rb") as f:
-            argv = [a.decode(errors="replace")
-                    for a in f.read().split(b"\0") if a]
-    except OSError:
-        return False
-    # a recycled pid must not false-positive on e.g. `vim .../bench_watcher.py`
-    # or a grep for the name: require a python interpreter running this script
-    return bool(argv) and "python" in os.path.basename(argv[0]) and any(
-        os.path.basename(a) == "bench_watcher.py" for a in argv[1:])
+_pidfile_fd = None  # the claim holds this fd (and its flock) for life
 
 
 def already_running() -> int | None:
-    """Pid of a live watcher holding the pidfile, else None."""
+    """Pid of a live watcher holding the pidfile's flock, else None.
+
+    flock is authoritative: the kernel releases it when the holder dies,
+    so stale FILES are harmless and there is no pid-recycling heuristic
+    and no unlink race (a delete-the-stale-file path could remove a
+    concurrent launcher's fresh claim — the old O_EXCL design's TOCTOU).
+    """
+    import fcntl
     try:
-        pid = int(PIDFILE.read_text().strip())
-    except (OSError, ValueError):
+        fd = os.open(str(PIDFILE), os.O_RDONLY)
+    except OSError:
         return None
-    return pid if _pid_is_watcher(pid) else None
+    try:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_SH | fcntl.LOCK_NB)
+        except OSError:  # exclusively locked: a live watcher holds it
+            try:
+                pid = int(os.read(fd, 64).decode().strip() or 0)
+            except ValueError:
+                pid = 0
+            return pid or -1
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        return None  # lockable: any file content is stale
+    finally:
+        os.close(fd)
 
 
 def claim_pidfile() -> bool:
-    """Atomically claim the pidfile; False if a live watcher holds it.
-    O_EXCL closes the check-then-write race between concurrent launches —
-    exactly one of them creates the file and runs."""
-    while True:
-        try:
-            fd = os.open(str(PIDFILE),
-                         os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
-            os.write(fd, str(os.getpid()).encode())
-            os.close(fd)
-            return True
-        except FileExistsError:
-            live = already_running()
-            if live is not None and live != os.getpid():
-                return False
-            try:  # stale holder: clear and race for the claim again
-                PIDFILE.unlink()
-            except OSError:
-                pass
+    """Claim the pidfile via an exclusive flock held for the process's
+    lifetime; False if a live watcher already holds it."""
+    import fcntl
+    global _pidfile_fd
+    fd = os.open(str(PIDFILE), os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        os.close(fd)
+        return False
+    os.ftruncate(fd, 0)
+    os.write(fd, str(os.getpid()).encode())
+    _pidfile_fd = fd  # keep open: the lock IS the liveness signal
+    return True
 
 
 def release_pidfile() -> None:
-    """Remove the pidfile iff this process still holds it — a stale file
-    would make every later launch in the round exit 'already running'."""
+    """Drop the claim (unlink is cosmetic; the flock is what matters)."""
+    global _pidfile_fd
+    if _pidfile_fd is None:
+        return
     try:
-        if int(PIDFILE.read_text().strip()) == os.getpid():
-            PIDFILE.unlink()
-    except (OSError, ValueError):
+        PIDFILE.unlink()
+    except OSError:
         pass
+    os.close(_pidfile_fd)  # releases the flock
+    _pidfile_fd = None
 
 
 def spawn_if_absent(deadline_s: float = 11.0 * 3600) -> None:
