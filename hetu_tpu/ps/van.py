@@ -579,10 +579,12 @@ class BlobChannel:
             if n >= 0:
                 self._ack(seq, deadline)
                 return ctypes.string_at(self._rbuf, n)
-            if n == -102 and need.value <= cap:  # too small: resize ONCE
-                # to the reported size (one retransfer, not a geometric
-                # grow with a full transfer per step)
-                self._rbuf = ctypes.create_string_buffer(int(need.value))
+            if n == -102 and need.value <= cap:  # too small: resize to
+                # the reported size with 2x headroom, so a channel whose
+                # messages keep growing doesn't pay a full re-transfer on
+                # every small increase
+                self._rbuf = ctypes.create_string_buffer(
+                    min(cap, max(int(need.value), 2 * len(self._rbuf))))
                 continue
             if time.time() > deadline:
                 if n == -12:

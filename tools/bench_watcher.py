@@ -92,30 +92,44 @@ def already_running() -> int | None:
 
 def claim_pidfile() -> bool:
     """Claim the pidfile via an exclusive flock held for the process's
-    lifetime; False if a live watcher already holds it."""
+    lifetime; False if a live watcher already holds it.
+
+    After locking, verify the fd still names the file at PIDFILE (same
+    inode): a lock on an inode someone unlinked meanwhile would be
+    invisible to later launchers, who would O_CREAT a fresh inode and run
+    a SECOND watcher.  Nothing in this module unlinks the pidfile, so the
+    retry only fires if something external removes it."""
     import fcntl
     global _pidfile_fd
-    fd = os.open(str(PIDFILE), os.O_CREAT | os.O_RDWR, 0o644)
-    try:
-        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-    except OSError:
-        os.close(fd)
-        return False
-    os.ftruncate(fd, 0)
-    os.write(fd, str(os.getpid()).encode())
-    _pidfile_fd = fd  # keep open: the lock IS the liveness signal
-    return True
+    while True:
+        fd = os.open(str(PIDFILE), os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False
+        try:
+            same = os.fstat(fd).st_ino == os.stat(str(PIDFILE)).st_ino
+        except OSError:
+            same = False  # file vanished: our lock is on an orphan inode
+        if not same:
+            os.close(fd)
+            continue
+        os.ftruncate(fd, 0)
+        os.write(fd, str(os.getpid()).encode())
+        _pidfile_fd = fd  # keep open: the lock IS the liveness signal
+        return True
 
 
 def release_pidfile() -> None:
-    """Drop the claim (unlink is cosmetic; the flock is what matters)."""
+    """Drop the claim by closing the locked fd.  The FILE stays on disk
+    deliberately: unlinking would orphan the inode under a concurrent
+    launcher's already-opened fd, letting it lock invisibly while a third
+    launcher creates a fresh inode — two watchers.  A leftover unlocked
+    file is harmless (already_running treats lockable as absent)."""
     global _pidfile_fd
     if _pidfile_fd is None:
         return
-    try:
-        PIDFILE.unlink()
-    except OSError:
-        pass
     os.close(_pidfile_fd)  # releases the flock
     _pidfile_fd = None
 
