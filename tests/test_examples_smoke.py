@@ -1,0 +1,59 @@
+"""Every example entry point runs end-to-end (tiny args, subprocess).
+
+The examples ARE the user-facing surface a reference user tries first;
+this guards all of them against rot in one place (each was previously
+smoke-run by hand).  Heavyweight pipelines already exercised elsewhere
+(gpt_sharded/hetpipe via dryrun_multichip, mpmd via test_mpmd) run with
+their smallest knobs; everything runs on the CPU platform with virtual
+devices.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parent.parent
+
+# (example, args, substring the output must contain)
+CASES = [
+    ("cnn_resnet", ["--epochs", "1", "--batch", "64",
+                    "--limit-batches", "2"], "epoch 0:"),
+    ("rnn_mnist", ["--cell", "gru", "--epochs", "1",
+                   "--limit-batches", "2"], "epoch 0:"),
+    ("ctr_wdl", ["--steps", "50", "--batch", "128", "--vocab", "1000"],
+     "step 50:"),
+    ("bert_pretrain", ["--steps", "20", "--batch", "4", "--seq", "64"],
+     "step 20:"),
+    ("moe_gates_train", ["--steps", "2"], "loss"),
+    ("gnn_gcn", ["--epochs", "20"], "epoch"),
+    ("onnx_roundtrip", [], "round trip OK"),
+    ("rec_compressed", [], "loss"),
+    ("gpt_sharded_train", ["--steps", "1"], "done: 1 steps"),
+    ("hetpipe_train", ["--waves", "2"], "done"),
+    ("auto_parallel_resnet", [], "step"),
+    ("long_context_ring", ["--steps", "2", "--seq", "1024", "--sp", "4"],
+     "long-context ring SP: OK"),
+    ("ps_multiserver_embedding", [], "done"),
+    ("mpmd_unequal_dp", ["--steps", "1"], "MPMD 3-stage"),
+]
+
+
+@pytest.mark.parametrize("name,args,expect",
+                         CASES, ids=[c[0] for c in CASES])
+def test_example_runs(name, args, expect):
+    # 8 virtual devices EXPLICITLY: examples needing meshes must not
+    # depend on conftest's import-time flag (a shell with a smaller count
+    # exported would otherwise leak in)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "examples" / f"{name}.py"), *args],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=str(REPO))
+    assert r.returncode == 0, (name, r.stderr[-2000:])
+    assert expect in r.stdout, (name, r.stdout[-800:])

@@ -182,13 +182,10 @@ def test_blob_concurrent_channels_soak(server_port):
     errors = []
 
     def pair(ch):
+        tx = rx = None
         try:
             tx = van.BlobChannel("127.0.0.1", server_port, 9500 + ch)
             rx = van.BlobChannel("127.0.0.1", server_port, 9500 + ch)
-        except Exception as e:  # pragma: no cover - failure reporting
-            errors.append((ch, repr(e)))
-            return
-        try:
             def writer():
                 try:
                     for i in range(MSGS):
@@ -207,9 +204,14 @@ def test_blob_concurrent_channels_soak(server_port):
             assert not t.is_alive(), f"writer {ch} hung"
         except Exception as e:  # pragma: no cover - failure reporting
             errors.append((ch, repr(e)))
-        finally:  # channels must not outlive the pair into van.stop()
-            tx.close()
-            rx.close()
+        finally:  # channels must not outlive the pair into van.stop();
+            # each close is independent so one failure can't skip the other
+            for c in (tx, rx):
+                if c is not None:
+                    try:
+                        c.close()
+                    except Exception:
+                        pass
 
     ts = [threading.Thread(target=pair, args=(c,), daemon=True)
           for c in range(PAIRS)]
