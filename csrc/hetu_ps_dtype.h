@@ -25,6 +25,12 @@ inline float bf16_to_f32(uint16_t h) {
 inline uint16_t f32_to_bf16(float f) {
   uint32_t u;
   std::memcpy(&u, &f, 4);
+  if ((u & 0x7fffffffu) > 0x7f800000u) {
+    // NaN: the rounding add below would carry into the exponent and turn
+    // it into +/-Inf; quiet it instead (set the top mantissa bit), the
+    // TF/PyTorch converter behavior
+    return (uint16_t)((u >> 16) | 0x0040u);
+  }
   uint32_t lsb = (u >> 16) & 1;  // round-to-nearest-even
   u += 0x7fffu + lsb;
   return (uint16_t)(u >> 16);

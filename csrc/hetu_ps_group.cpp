@@ -180,8 +180,11 @@ int create_shard_table(Group* g, Shard* s, int shard_idx) {
     // here (OP_TABLE_INFO returns all three for exactly this check)
     int32_t dt = -1;
     int64_t rows = -1, dim = -1;
-    if (ps_van_table_info(s->fd, g->table_id, &rows, &dim, &dt) == 0 &&
-        (dt != g->dtype || rows != s->rows || dim != g->dim))
+    int qrc = ps_van_table_info(s->fd, g->table_id, &rows, &dim, &dt);
+    if (qrc != 0) return qrc;  // a transport blip here must FAIL the
+                               // attempt (retried by shard_call), not
+                               // silently skip the mismatch check
+    if (dt != g->dtype || rows != s->rows || dim != g->dim)
       return -8;  // shape/dtype mismatch on a shared table id
   } else if (rc != 0) {
     return rc;

@@ -1002,6 +1002,12 @@ extern "C" {
 // Start the server van on `port`; returns the bound port (0 on error).
 int ps_van_start(int port) {
   if (g_van_running.exchange(true)) return 0;
+  // OP_STATS counters advertise "since server start": a second serve()
+  // incarnation in one process must not inherit the previous one's
+  // frame/byte totals
+  g_frames_handled.store(0, std::memory_order_relaxed);
+  g_bytes_rx.store(0, std::memory_order_relaxed);
+  g_bytes_tx.store(0, std::memory_order_relaxed);
   int sfd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (sfd < 0) { g_van_running = false; return 0; }
   int one = 1;

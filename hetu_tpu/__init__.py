@@ -44,8 +44,9 @@ from hetu_tpu.parallel.mesh import make_mesh, local_mesh, MeshConfig
 
 # heavier/optional subsystems imported on attribute access:
 #   hetu_tpu.ps (native PS plane), hetu_tpu.onnx, hetu_tpu.graphboard,
-#   hetu_tpu.launcher, hetu_tpu.graph (define-then-run facade)
-_LAZY = {"ps", "onnx", "graphboard", "launcher", "graph"}
+#   hetu_tpu.launcher, hetu_tpu.graph (define-then-run facade),
+#   hetu_tpu.serve (inference serving tier)
+_LAZY = {"ps", "onnx", "graphboard", "launcher", "graph", "serve"}
 
 
 def __getattr__(name):

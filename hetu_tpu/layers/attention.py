@@ -64,17 +64,17 @@ class MultiHeadAttention(Module):
                 "attention_impl='flash' ignores explicit masks; falling "
                 "back to the xla path for this call (flash covers the "
                 "causal/unmasked cases)", stacklevel=2)
-        if self.attention_impl == "flash" and mask is None:
+        if mask is None and self.causal:
+            out = self._causal_core(q, k, v)  # shared with prefill_step
+        elif self.attention_impl == "flash" and mask is None:
             from hetu_tpu.ops.pallas_kernels import flash_attention
-            out = flash_attention(q, k, v, causal=self.causal)
+            out = flash_attention(q, k, v, causal=False)
         elif self.causal and mask is not None:
             # honor BOTH the causal structure and the user's mask
             causal = jnp.tril(jnp.ones((s, s), bool))
             out = ops.attention(q, k, v,
                                 mask=jnp.logical_and(mask.astype(bool),
                                                      causal))
-        elif self.causal:
-            out = ops.causal_attention(q, k, v)
         else:
             out = ops.attention(q, k, v, mask=mask)
         out = jnp.moveaxis(out, 1, 2).reshape(b, s, h)
@@ -84,3 +84,64 @@ class MultiHeadAttention(Module):
                        p["out_weight"].astype(self.dtype),
                        p["out_bias"].astype(self.dtype))
         return y, {}
+
+    def _causal_core(self, q, k, v):
+        """The unmasked causal attention core, honoring attention_impl —
+        ONE body shared by :meth:`apply` and :meth:`prefill_step` so
+        serving cannot numerically drift from training (incl. the flash
+        kernel path)."""
+        if self.attention_impl == "flash":
+            from hetu_tpu.ops.pallas_kernels import flash_attention
+            return flash_attention(q, k, v, causal=True)
+        return ops.causal_attention(q, k, v)
+
+    # ---- serving (hetu_tpu/serve): KV-cache prefill / decode ----
+
+    def _qkv(self, p, x):
+        """Fused projection split into q/k/v in cache layout [B,S,nh,hd]."""
+        b, s, _ = x.shape
+        qkv = ops.linear(x, p["qkv_weight"].astype(self.dtype),
+                         p["qkv_bias"].astype(self.dtype))
+        qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    def _out(self, p, out, b, s):
+        out = jnp.moveaxis(out, 1, 2).reshape(b, s, self.hidden_size)
+        return ops.linear(out.astype(self.dtype),
+                          p["out_weight"].astype(self.dtype),
+                          p["out_bias"].astype(self.dtype))
+
+    def prefill_step(self, variables, x):
+        """Causal prefill that also returns the chunk's K/V for a cache.
+
+        x: [B, S, H] → (y [B, S, H], k [B, S, nh, hd], v [B, S, nh, hd]).
+        Inference-only (no dropout); numerics match
+        ``apply(causal=True, train=False)`` token for token.
+        """
+        if not self.causal:
+            raise NotImplementedError("KV-cache decode is causal-LM only")
+        p = variables["params"]
+        b, s, _ = x.shape
+        x = x.astype(self.dtype)
+        q, k, v = self._qkv(p, x)
+        out = self._causal_core(*(jnp.moveaxis(t, 1, 2)
+                                  for t in (q, k, v)))
+        return self._out(p, out, b, s), k, v
+
+    def decode_step(self, variables, x, k_cache, v_cache, lengths):
+        """One-token decode against a slot cache.
+
+        x: [B, 1, H]; k_cache/v_cache: [B, T, nh, hd]; lengths: [B] int32 =
+        tokens already cached (the new token's K/V is written at that
+        index).  Returns (y [B, 1, H], new_k_cache, new_v_cache).
+        """
+        if not self.causal:
+            raise NotImplementedError("KV-cache decode is causal-LM only")
+        p = variables["params"]
+        b = x.shape[0]
+        x = x.astype(self.dtype)
+        q, k, v = self._qkv(p, x)
+        k_cache, v_cache = ops.cache_update(k_cache, v_cache, k, v, lengths)
+        out = ops.decode_attention(jnp.moveaxis(q, 1, 2), k_cache, v_cache,
+                                   lengths)
+        return self._out(p, out, b, 1), k_cache, v_cache

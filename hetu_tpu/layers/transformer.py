@@ -76,3 +76,36 @@ class TransformerBlock(Module):
         if train and self.dropout_rate > 0:
             h = ops.dropout(h, self.dropout_rate, r2, train=True)
         return mod(self.ln2, "ln2", x + h), {}
+
+    # ---- serving (hetu_tpu/serve): KV-cache prefill / decode ----
+    # Pre-LN causal blocks only — the decoder-LM configuration GPT uses;
+    # the post-LN (BERT) layout is an encoder and has no decode loop.
+
+    def _mod(self, p, m, name, h, **kw):
+        out, _ = m.apply({"params": p[name], "state": {}}, h, **kw)
+        return out
+
+    def _mlp(self, p, x):
+        h = self._mod(p, self.ffn_in, "ffn_in", self._mod(p, self.ln2,
+                                                          "ln2", x))
+        return x + self._mod(p, self.ffn_out, "ffn_out", self.activation(h))
+
+    def prefill_step(self, variables, x):
+        """x [B,S,H] → (out [B,S,H], k [B,S,nh,hd], v [B,S,nh,hd])."""
+        if not self.pre_norm:
+            raise NotImplementedError("KV-cache decode needs pre-LN blocks")
+        p = variables["params"]
+        a, k, v = self.attn.prefill_step(
+            {"params": p["attn"], "state": {}},
+            self._mod(p, self.ln1, "ln1", x))
+        return self._mlp(p, x + a), k, v
+
+    def decode_step(self, variables, x, k_cache, v_cache, lengths):
+        """x [B,1,H], caches [B,T,nh,hd] → (out, new_k_cache, new_v_cache)."""
+        if not self.pre_norm:
+            raise NotImplementedError("KV-cache decode needs pre-LN blocks")
+        p = variables["params"]
+        a, k_cache, v_cache = self.attn.decode_step(
+            {"params": p["attn"], "state": {}},
+            self._mod(p, self.ln1, "ln1", x), k_cache, v_cache, lengths)
+        return self._mlp(p, x + a), k_cache, v_cache

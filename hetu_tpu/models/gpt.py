@@ -103,6 +103,62 @@ class GPTModel(Module):
         logits = ops.linear(h, p["tok_emb"].T.astype(c.dtype))
         return logits, {}
 
+    # ---- serving (hetu_tpu/serve): KV-cache prefill / decode ----
+
+    def prefill_with_cache(self, variables, input_ids, *, last_index=None):
+        """Full-prompt forward that also returns every layer's K/V.
+
+        input_ids: [B, S] (right-padded to the serving bucket; pad
+        positions produce junk K/V that decode masks/overwrites).
+        Returns (logits, k [L, B, S, nh, hd], v [L, B, S, nh, hd]) where
+        logits is [B, S, V] — or [B, V] when ``last_index`` (the last real
+        prompt position) is given, so serving skips the [S, V] head matmul
+        for the S-1 positions whose logits it would throw away.
+        """
+        p = variables["params"]
+        c = self.c
+        b, s = input_ids.shape
+        h = ops.embedding_lookup(p["tok_emb"], input_ids)
+        h = (h + p["pos_emb"][None, :s]).astype(c.dtype)
+
+        def layer(carry, p_l):
+            out, k, v = self.block.prefill_step(
+                {"params": p_l, "state": {}}, carry)
+            return out, (k, v)
+
+        h, (ks, vs) = jax.lax.scan(layer, h, p["blocks"])
+        h = ops.layer_norm(h, p["ln_f_scale"], p["ln_f_bias"])
+        if last_index is not None:
+            h = jax.lax.dynamic_index_in_dim(h, last_index, axis=1,
+                                             keepdims=False)  # [B, H]
+        logits = ops.linear(h, p["tok_emb"].T.astype(c.dtype))
+        return logits, ks, vs
+
+    def decode_with_cache(self, variables, input_ids, k_cache, v_cache,
+                          lengths):
+        """One decode step for a batch of cached sequences.
+
+        input_ids: [B] int32 newest token per sequence; k_cache/v_cache:
+        [L, B, T, nh, hd]; lengths: [B] int32 tokens already cached (the
+        new token's position).  Returns (logits [B, V], new_k, new_v).
+        """
+        p = variables["params"]
+        c = self.c
+        h = ops.embedding_lookup(p["tok_emb"], input_ids[:, None])
+        h = (h + p["pos_emb"][lengths][:, None]).astype(c.dtype)
+
+        def layer(carry, xs):
+            p_l, k_l, v_l = xs
+            out, k_l, v_l = self.block.decode_step(
+                {"params": p_l, "state": {}}, carry, k_l, v_l, lengths)
+            return out, (k_l, v_l)
+
+        h, (k_cache, v_cache) = jax.lax.scan(
+            layer, h, (p["blocks"], k_cache, v_cache))
+        h = ops.layer_norm(h, p["ln_f_scale"], p["ln_f_bias"])
+        logits = ops.linear(h[:, 0], p["tok_emb"].T.astype(c.dtype))
+        return logits, k_cache, v_cache
+
     def lm_loss_fn(self):
         """Next-token LM loss; batch = (input_ids,) or (input_ids, labels).
 

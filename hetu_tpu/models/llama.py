@@ -92,29 +92,35 @@ class LlamaBlock(Module):
                                     jnp.float32),
         }, "state": {}}
 
-    def _attention(self, p, x, cos, sin):
+    def _attention_with_kv(self, p, x, cos, sin):
+        """Shared causal-attention body; also returns the chunk's rotated
+        un-repeated K/V in cache layout [B, S, nkv, hd] so training
+        (:meth:`apply`) and serving prefill stay ONE code path — the
+        decode-parity guarantee rides on them never drifting."""
         c = self.c
         b, s, h = x.shape
-        hd, nh, nkv = self.head_dim, c.num_heads, c.num_kv_heads
-        qkv = ops.linear(x, p["qkv_weight"].astype(c.dtype))
-        q = qkv[..., :nh * hd].reshape(b, s, nh, hd)
-        k = qkv[..., nh * hd:(nh + nkv) * hd].reshape(b, s, nkv, hd)
-        v = qkv[..., (nh + nkv) * hd:].reshape(b, s, nkv, hd)
+        nh, nkv = c.num_heads, c.num_kv_heads
+        q, k, v = self._qkv(p, x)
         q, k, v = (jnp.moveaxis(t, 1, 2) for t in (q, k, v))  # [B,h,S,D]
         q = ops.apply_rope(q, cos, sin)
         k = ops.apply_rope(k, cos, sin)
+        kr, vr = k, v
         if nkv != nh:  # GQA: each kv head serves num_heads/nkv query heads
             rep = nh // nkv
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
+            kr = jnp.repeat(k, rep, axis=1)
+            vr = jnp.repeat(v, rep, axis=1)
         if c.attention_impl == "flash":
             from hetu_tpu.ops.pallas_kernels import flash_attention
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(q, kr, vr, causal=True)
         else:
-            out = ops.causal_attention(q, k, v)
+            out = ops.causal_attention(q, kr, vr)
         out = jnp.moveaxis(out, 1, 2).reshape(b, s, h)
-        return ops.linear(out.astype(c.dtype),
-                          p["out_weight"].astype(c.dtype))
+        a = ops.linear(out.astype(c.dtype),
+                       p["out_weight"].astype(c.dtype))
+        return a, jnp.moveaxis(k, 1, 2), jnp.moveaxis(v, 1, 2)
+
+    def _attention(self, p, x, cos, sin):
+        return self._attention_with_kv(p, x, cos, sin)[0]
 
     def apply(self, variables, x, cos, sin):
         p = variables["params"]
@@ -123,12 +129,62 @@ class LlamaBlock(Module):
                             ops.rms_norm(x, p["rms1_scale"], eps=c.rms_eps),
                             cos, sin)
         x = x + a
+        return self._mlp(p, x), {}
+
+    def _mlp(self, p, x):
+        c = self.c
         hn = ops.rms_norm(x, p["rms2_scale"], eps=c.rms_eps)
         gate = ops.linear(hn, p["ffn_gate"].astype(c.dtype))
         up = ops.linear(hn, p["ffn_up"].astype(c.dtype))
         down = ops.linear(ops.silu(gate) * up,
                           p["ffn_down"].astype(c.dtype))
-        return x + down, {}
+        return x + down
+
+    # ---- serving (hetu_tpu/serve): KV-cache prefill / decode ----
+    # The cache stores ROTATED k (RoPE applied at write time, the standard
+    # serving layout) and the nkv un-repeated GQA heads; decode_attention
+    # repeats at read time.
+
+    def _qkv(self, pa, x):
+        c = self.c
+        b, s, _ = x.shape
+        hd, nh, nkv = self.head_dim, c.num_heads, c.num_kv_heads
+        qkv = ops.linear(x, pa["qkv_weight"].astype(c.dtype))
+        q = qkv[..., :nh * hd].reshape(b, s, nh, hd)
+        k = qkv[..., nh * hd:(nh + nkv) * hd].reshape(b, s, nkv, hd)
+        v = qkv[..., (nh + nkv) * hd:].reshape(b, s, nkv, hd)
+        return q, k, v
+
+    def prefill_step(self, variables, x, cos, sin):
+        """cos/sin: [S, hd/2] chunk tables (prefill starts at position 0).
+        x [B,S,H] → (out [B,S,H], k [B,S,nkv,hd] rotated, v [B,S,nkv,hd]).
+        """
+        p = variables["params"]
+        a, k, v = self._attention_with_kv(
+            p["attn"], ops.rms_norm(x, p["rms1_scale"], eps=self.c.rms_eps),
+            cos, sin)
+        return self._mlp(p, x + a), k, v
+
+    def decode_step(self, variables, x, k_cache, v_cache, lengths,
+                    cos, sin):
+        """One-token decode; cos/sin are FULL tables [T_max, hd/2] gathered
+        at each sequence's position.  x [B,1,H]; caches [B,T,nkv,hd];
+        lengths [B] = tokens already cached.  Returns (out, new_k, new_v).
+        """
+        p = variables["params"]
+        c = self.c
+        b = x.shape[0]
+        hn = ops.rms_norm(x, p["rms1_scale"], eps=c.rms_eps)
+        q, k, v = self._qkv(p["attn"], hn)
+        q = ops.apply_rope_at(jnp.moveaxis(q, 1, 2), cos, sin, lengths)
+        k = ops.apply_rope_at(jnp.moveaxis(k, 1, 2), cos, sin, lengths)
+        k_cache, v_cache = ops.cache_update(
+            k_cache, v_cache, jnp.moveaxis(k, 1, 2), v, lengths)
+        out = ops.decode_attention(q, k_cache, v_cache, lengths)
+        out = jnp.moveaxis(out, 1, 2).reshape(b, 1, c.hidden_size)
+        a = ops.linear(out.astype(c.dtype),
+                       p["attn"]["out_weight"].astype(c.dtype))
+        return self._mlp(p, x + a), k_cache, v_cache
 
 
 class LlamaModel(Module):
@@ -178,6 +234,57 @@ class LlamaModel(Module):
         logits = ops.linear(
             h, variables["params"]["lm_head"].T.astype(self.c.dtype))
         return logits, {}
+
+    # ---- serving (hetu_tpu/serve): KV-cache prefill / decode ----
+
+    def prefill_with_cache(self, variables, input_ids, *, last_index=None):
+        """Full-prompt forward returning per-layer rotated K/V.
+
+        input_ids: [B, S] → (logits, k [L, B, S, nkv, hd],
+        v [L, B, S, nkv, hd]); logits is [B, S, V], or [B, V] when
+        ``last_index`` names the last real prompt position (serving skips
+        the head matmul for the padded tail)."""
+        p = variables["params"]
+        c = self.c
+        h = ops.embedding_lookup(p["tok_emb"], input_ids).astype(c.dtype)
+        cos, sin = self._tables(input_ids.shape[1])
+
+        def layer(carry, p_l):
+            out, k, v = self.block.prefill_step(
+                {"params": p_l, "state": {}}, carry, cos, sin)
+            return out, (k, v)
+
+        h, (ks, vs) = jax.lax.scan(layer, h, p["blocks"])
+        h = ops.rms_norm(h, p["rms_f_scale"], eps=c.rms_eps)
+        if last_index is not None:
+            h = jax.lax.dynamic_index_in_dim(h, last_index, axis=1,
+                                             keepdims=False)  # [B, H]
+        logits = ops.linear(h, p["lm_head"].T.astype(c.dtype))
+        return logits, ks, vs
+
+    def decode_with_cache(self, variables, input_ids, k_cache, v_cache,
+                          lengths):
+        """One decode step; input_ids [B], caches [L, B, T, nkv, hd],
+        lengths [B].  Returns (logits [B, V], new_k, new_v)."""
+        p = variables["params"]
+        c = self.c
+        h = ops.embedding_lookup(
+            p["tok_emb"], input_ids[:, None]).astype(c.dtype)
+        # full tables, gathered per sequence at its own position
+        cos, sin = self._tables(c.max_position)
+
+        def layer(carry, xs):
+            p_l, k_l, v_l = xs
+            out, k_l, v_l = self.block.decode_step(
+                {"params": p_l, "state": {}}, carry, k_l, v_l, lengths,
+                cos, sin)
+            return out, (k_l, v_l)
+
+        h, (k_cache, v_cache) = jax.lax.scan(
+            layer, h, (p["blocks"], k_cache, v_cache))
+        h = ops.rms_norm(h, p["rms_f_scale"], eps=c.rms_eps)
+        logits = ops.linear(h[:, 0], p["lm_head"].T.astype(c.dtype))
+        return logits, k_cache, v_cache
 
     def lm_loss_fn(self):
         """Next-token loss; batch = (input_ids,).  Fused CE against the

@@ -26,7 +26,7 @@ from hetu_tpu.ops.norm import (
     batch_norm, layer_norm, instance_norm2d, rms_norm,
 )
 from hetu_tpu.ops.rope import (
-    apply_rope, rope_tables,
+    apply_rope, apply_rope_at, rope_tables,
 )
 from hetu_tpu.ops.activations import (
     relu, leaky_relu, gelu, sigmoid, tanh, softmax, log_softmax, silu,
@@ -63,7 +63,7 @@ from hetu_tpu.ops.moe_ops import (
     balance_assignment, make_slot_routing, gather_dispatch, gather_combine,
 )
 from hetu_tpu.ops.attention import (
-    attention, causal_attention,
+    attention, cache_update, causal_attention, decode_attention,
 )
 from hetu_tpu.ops.graph_ops import (
     coo_spmm, gcn_norm, gcn_conv,

@@ -33,3 +33,54 @@ def causal_attention(q, k, v, *, scale=None):
     s_q, s_k = q.shape[-2], k.shape[-2]
     mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
     return attention(q, k, v, mask=mask, scale=scale)
+
+
+# ---- serving decode: attention over a preallocated slot cache ----
+# (hetu_tpu/serve) — the cache is TIME-major ([B, T, kv_heads, D]) because
+# every write is a per-sequence update at one time index; attention
+# transposes to head-major internally.
+
+def cache_update(k_cache, v_cache, k_new, v_new, lengths):
+    """Write one new token's K/V into each sequence's cache slot.
+
+    k_cache/v_cache: [B, T, kv_heads, D]; k_new/v_new: [B, 1, kv_heads, D];
+    lengths: [B] int32 — tokens already cached per sequence, i.e. the index
+    the new token lands at.  Returns the updated caches.
+    """
+    write = jax.vmap(
+        lambda c, n, i: jax.lax.dynamic_update_slice(c, n, (i, 0, 0)))
+    return write(k_cache, k_new, lengths), write(v_cache, v_new, lengths)
+
+
+def decode_attention(q, k_cache, v_cache, lengths, *, scale=None):
+    """Single-token attention against a slot cache (GQA-aware).
+
+    q: [B, heads, 1, D] — the newest token's query, already positioned at
+    index ``lengths[b]`` in its sequence (so its K/V must have been written
+    via :func:`cache_update` first).  k_cache/v_cache: [B, T, kv_heads, D]
+    with kv_heads dividing heads (kv_heads < heads = GQA; repeats serve
+    each kv head to heads/kv_heads query heads).  lengths: [B] int32 index
+    of the newest token; positions > lengths[b] (unwritten or stale from a
+    previous slot occupant) are masked out.
+    """
+    if q.shape[-2] != 1:
+        raise ValueError(
+            f"decode_attention takes one query token, got {q.shape[-2]} "
+            "(prefill goes through causal_attention over the chunk)")
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    nh, nkv = q.shape[1], k_cache.shape[2]
+    k = jnp.moveaxis(k_cache, 1, 2)  # [B, kv_heads, T, D]
+    v = jnp.moveaxis(v_cache, 1, 2)
+    if nkv != nh:
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    t = k_cache.shape[1]
+    valid = jnp.arange(t)[None, :] <= lengths[:, None]      # [B, T]
+    scores = jnp.where(valid[:, None, None, :], scores,
+                       jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)

@@ -39,3 +39,22 @@ def apply_rope(x, cos, sin):
     sin = sin.astype(x.dtype)
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def apply_rope_at(x, cos, sin, positions):
+    """Rotate ``x [B, H, S, D]`` at per-sequence ABSOLUTE positions.
+
+    The serving decode/prefill form of :func:`apply_rope`: sequence ``b``'s
+    chunk starts at ``positions[b]`` (its token ``i`` sits at absolute
+    position ``positions[b] + i``), so each sequence gathers its own rows
+    from the full-length ``cos``/``sin`` tables ``[T_max, D/2]``.  With
+    ``positions == zeros`` this matches ``apply_rope`` exactly.
+    """
+    s = x.shape[-2]
+    pos = positions[:, None] + jnp.arange(s)        # [B, S]
+    c = cos[pos][:, None].astype(x.dtype)           # [B, 1, S, D/2]
+    sn = sin[pos][:, None].astype(x.dtype)
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate(
+        [x1 * c - x2 * sn, x2 * c + x1 * sn], axis=-1)

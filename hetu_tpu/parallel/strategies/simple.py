@@ -63,14 +63,16 @@ class MegatronLM(Strategy):
     """Megatron-style tensor parallel for the transformer models.
 
     Column-parallel (output-dim split over tp): qkv_weight, ffn_in weight —
-    and their biases.  Row-parallel (input-dim split, partial-sum output):
-    out_weight, ffn_out weight — biases replicated.  Vocab-parallel:
-    tok_emb (dim 0); the tied LM head / vocab-CE then computes with vocab
-    partials (simple.py:174-283).
+    and their biases — plus the SwiGLU gate/up pair (the Llama MLP's
+    column points, models/llama.py).  Row-parallel (input-dim split,
+    partial-sum output): out_weight, ffn_out weight and SwiGLU down —
+    biases replicated.  Vocab-parallel: tok_emb (dim 0); the tied LM head
+    / vocab-CE then computes with vocab partials (simple.py:174-283).
     """
 
-    COL = ("qkv_weight", "qkv_bias", "ffn_in")  # split output dim
-    ROW = ("out_weight", "ffn_out")             # split input dim
+    COL = ("qkv_weight", "qkv_bias", "ffn_in",  # split output dim
+           "ffn_gate", "ffn_up")
+    ROW = ("out_weight", "ffn_out", "ffn_down")  # split input dim
     VOCAB = ("tok_emb", "mlm_bias")
 
     def param_spec(self, path, leaf):

@@ -1,0 +1,35 @@
+"""TPU-native inference serving: KV-cache decode + continuous batching.
+
+The serving layer the ROADMAP's "heavy traffic" north star needs on top
+of the training-only models:
+
+  * :mod:`kv_cache` — preallocated slot-based GQA-aware K/V cache with
+    alloc/free so finished sequences release memory to queued requests;
+  * :mod:`engine` — bucketed jit-compiled prefill + fixed-shape
+    single-token decode (bounded executable count) over the existing
+    GPT/Llama forwards, optionally tp-sharded over a mesh;
+  * :mod:`scheduler` — continuous batching: admit into free slots every
+    decode step, evict on EOS/max_tokens/deadline, token-budget
+    backpressure;
+  * :mod:`server` — blob-channel front-end over the van transport with
+    per-request timeouts and graceful shutdown;
+  * :mod:`metrics` — TTFT / tokens-per-sec / queue depth / occupancy /
+    recompile counters, reportable through ``utils/logger.MetricLogger``.
+
+See examples/gpt_serve.py for the end-to-end path.
+"""
+
+from hetu_tpu.serve.engine import ServeEngine
+from hetu_tpu.serve.kv_cache import KVCache, KVCacheSpec
+from hetu_tpu.serve.metrics import ServeMetrics
+from hetu_tpu.serve.scheduler import ContinuousBatchingScheduler, Request
+from hetu_tpu.serve.server import (
+    InferenceClient, InferenceServer, request_channel, response_channel,
+)
+
+__all__ = [
+    "ServeEngine", "KVCache", "KVCacheSpec", "ServeMetrics",
+    "ContinuousBatchingScheduler", "Request",
+    "InferenceClient", "InferenceServer",
+    "request_channel", "response_channel",
+]

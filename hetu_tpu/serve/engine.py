@@ -1,0 +1,226 @@
+"""Bucketed prefill + single-token decode over the model forwards.
+
+Compilation discipline is the whole point of this module: serving traffic
+has arbitrary prompt lengths, and a naive jit would compile one executable
+per distinct length.  Instead prompts are right-padded to power-of-two
+BUCKETS (plus the cache's max_len as the last bucket), so the engine
+compiles at most ``len(buckets)`` prefill executables + 1 decode
+executable for the whole life of the server — asserted in
+tests/test_serve.py via :meth:`compiled_executables`.
+
+Prefill runs one request at a time (batch 1, bounded compile count);
+decode steps ALL cache slots at once with fixed shapes (``[num_slots]``
+tokens/lengths), so continuous batching admissions never change the
+decode executable.  Free slots ride along masked — wasted FLOPs on an
+idle slot are cheaper than a recompile.
+
+Tensor parallelism: pass ``mesh`` and the engine places the parameters
+with the Megatron split points (qkv/ffn-in column, out/ffn-down row — the
+same ``parallel.strategies.MegatronLM`` preset training uses, minus the
+vocab split: serving reads full logits every step) and shards the cache
+over the kv-head axis when it divides tp.  XLA SPMD then inserts the
+row-parallel all-reduces inside both jitted steps.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from hetu_tpu.parallel.mesh import AXIS_TP
+from hetu_tpu.parallel.strategies.simple import MegatronLM
+from hetu_tpu.serve.kv_cache import KVCache, KVCacheSpec
+from hetu_tpu.serve.metrics import ServeMetrics
+
+
+class _DecodeTP(MegatronLM):
+    """MegatronLM splits with the vocab kept replicated: a decode step
+    reads the full ``[V]`` logits row per sequence every token, so a
+    vocab-parallel embedding would all-gather per step for no win at
+    serving batch sizes."""
+
+    VOCAB = ()
+
+
+def _pow2_buckets(lo: int, hi: int) -> tuple:
+    out = []
+    b = lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return tuple(out)
+
+
+class ServeEngine:
+    """Owns params + KV cache + the jitted prefill/decode executables.
+
+    model: GPTModel or LlamaModel (anything with ``prefill_with_cache`` /
+    ``decode_with_cache``).  num_slots bounds concurrent sequences;
+    max_len bounds tokens per sequence (prompt + generation), defaulting
+    to the model's max_position.
+    """
+
+    def __init__(self, model, variables, *, num_slots: int = 8,
+                 max_len: Optional[int] = None, mesh=None,
+                 min_bucket: int = 16,
+                 metrics: Optional[ServeMetrics] = None):
+        self.model = model
+        self.metrics = metrics or ServeMetrics()
+        c = model.c
+        max_len = int(max_len or c.max_position)
+        if max_len > c.max_position:
+            raise ValueError(f"max_len {max_len} exceeds the model's "
+                             f"max_position {c.max_position}")
+        spec = KVCacheSpec.from_model(model)
+        self.buckets = _pow2_buckets(min(min_bucket, max_len), max_len)
+
+        self.mesh = mesh
+        params = variables["params"] if "params" in variables else variables
+        cache_sharding = None
+        if mesh is not None:
+            tp = mesh.shape.get(AXIS_TP, 1)
+            params = _DecodeTP().place(params, mesh)
+            # kv-head sharded cache when GQA heads divide tp, else
+            # replicated (graceful, same policy as Strategy._fit)
+            axes = (None, None, None,
+                    AXIS_TP if spec.num_kv_heads % tp == 0 else None, None)
+            cache_sharding = NamedSharding(mesh, P(*axes))
+        self.params = params
+        self.cache = KVCache(spec, num_slots, max_len,
+                             sharding=cache_sharding)
+
+        # newest token per slot (decode feeds all slots every step)
+        self.last_tokens = np.zeros(num_slots, np.int32)
+        self.active = np.zeros(num_slots, bool)
+
+        # ONE jitted prefill: jax.jit's shape cache specializes it per
+        # bucket width, so bucket_for() alone bounds the executable count
+        self._prefill_fn = None
+        self._decode_fn = None
+        self._seen_buckets = set()
+
+    # ---- compile accounting ----
+    def compiled_executables(self) -> int:
+        """Executables actually compiled so far (the recompile budget the
+        tests assert): sum of jit-cache sizes across the step fns."""
+        return sum(fn._cache_size()
+                   for fn in (self._prefill_fn, self._decode_fn)
+                   if fn is not None)
+
+    @property
+    def max_executables(self) -> int:
+        """Hard ceiling: one per bucket + one decode."""
+        return len(self.buckets) + 1
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt of {n} tokens exceeds max_len "
+                         f"{self.cache.max_len}")
+
+    # ---- jitted step builders ----
+    def _build_prefill(self):
+        model = self.model
+
+        def fn(params, k_cache, v_cache, ids, slot, true_len):
+            # last_index: only the final real position's logits are
+            # computed — the padded tail's head matmul is skipped
+            logits, k, v = model.prefill_with_cache(
+                {"params": params, "state": {}}, ids,
+                last_index=true_len - 1)
+            # k: [L, 1, S, nkv, hd] — batch dim 1 IS the slot slice, so it
+            # writes into [L, slots, T, nkv, hd] at (0, slot, 0) directly
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, slot, 0, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, slot, 0, 0, 0))
+            first = jnp.argmax(logits[0], -1).astype(jnp.int32)
+            return k_cache, v_cache, first
+
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    def _build_decode(self):
+        model = self.model
+
+        def fn(params, k_cache, v_cache, tokens, lengths):
+            logits, k_cache, v_cache = model.decode_with_cache(
+                {"params": params, "state": {}}, tokens, k_cache, v_cache,
+                lengths)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return k_cache, v_cache, nxt
+
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    # ---- serving steps ----
+    def prefill(self, slot: int, prompt_ids) -> int:
+        """Run the prompt through the bucketed prefill into ``slot``;
+        returns the first generated (greedy) token."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        n = prompt.shape[0]
+        if n < 1:
+            raise ValueError("empty prompt")
+        if n >= self.cache.max_len:
+            raise ValueError(f"prompt of {n} tokens leaves no room to "
+                             f"generate within max_len {self.cache.max_len}")
+        s = self.bucket_for(n)
+        if self._prefill_fn is None:
+            self._prefill_fn = self._build_prefill()
+        if s not in self._seen_buckets:
+            self._seen_buckets.add(s)
+            self.metrics.inc("prefill_compiles")
+        ids = np.zeros((1, s), np.int32)
+        ids[0, :n] = prompt
+        k, v, first = self._prefill_fn(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(ids), jnp.int32(slot), jnp.int32(n))
+        self.cache.update(k, v)
+        self.cache.lengths[slot] = n
+        first = int(first)
+        self.last_tokens[slot] = first
+        self.active[slot] = True
+        self.metrics.inc("prefill_tokens", n)
+        return first
+
+    def decode(self) -> dict:
+        """One decode step over every slot; returns {slot: token} for the
+        active ones.  Inactive slots compute masked garbage (cheaper than
+        a shape change) and are ignored."""
+        if not self.active.any():
+            return {}
+        if (self.cache.lengths[self.active] >= self.cache.max_len).any():
+            raise RuntimeError(
+                "an active slot is at max_len; the scheduler must evict "
+                "before decoding further")
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+            self.metrics.inc("decode_compiles")
+        k, v, nxt = self._decode_fn(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(self.last_tokens), jnp.asarray(self.cache.lengths))
+        self.cache.update(k, v)
+        nxt = np.asarray(nxt)
+        out = {}
+        for slot in np.nonzero(self.active)[0]:
+            self.cache.lengths[slot] += 1
+            self.last_tokens[slot] = nxt[slot]
+            out[int(slot)] = int(nxt[slot])
+        self.metrics.inc("decode_steps")
+        self.metrics.observe_decode(len(out))
+        return out
+
+    # ---- slot lifecycle (delegates; engine keeps its masks in sync) ----
+    def alloc_slot(self) -> int:
+        slot = self.cache.alloc()
+        self.active[slot] = False
+        return slot
+
+    def release(self, slot: int) -> None:
+        self.active[slot] = False
+        self.last_tokens[slot] = 0
+        self.cache.free(slot)

@@ -1,0 +1,116 @@
+"""Preallocated slot-based KV cache for decoder-LM serving.
+
+The serving analog of a paged allocator at sequence granularity: the cache
+is ONE pair of arrays ``[L, num_slots, max_len, kv_heads, head_dim]``
+allocated up front, and a host-side free list hands whole slots to
+admitted requests and reclaims them on eviction — finished sequences
+release their memory to queued requests immediately (continuous batching,
+scheduler.py) instead of waiting for a static batch to drain.
+
+GQA-aware: the cache stores the model's ``num_kv_heads`` heads un-repeated
+(half or a quarter of the MHA footprint for typical GQA configs);
+``ops.decode_attention`` repeats them at read time.  Works for both
+``GPTConfig`` (kv_heads == num_heads) and ``LlamaConfig``
+(``num_kv_heads <= num_heads``).
+
+The arrays are functionally updated inside the engine's jitted steps
+(donated, so XLA updates in place); this class owns the slot lifecycle and
+the per-slot host-side lengths.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class KVCacheSpec:
+    """Per-layer cache geometry, derived from a model config."""
+
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: object = jnp.float32
+
+    @staticmethod
+    def from_model(model) -> "KVCacheSpec":
+        """Read the geometry off a GPTModel/LlamaModel config: models with
+        ``num_kv_heads`` are GQA (cache the un-repeated heads); the rest
+        cache all ``num_heads``."""
+        c = model.c
+        nkv = getattr(c, "num_kv_heads", None) or c.num_heads
+        return KVCacheSpec(
+            num_layers=c.num_layers, num_kv_heads=nkv,
+            head_dim=c.hidden_size // c.num_heads, dtype=c.dtype)
+
+
+class KVCache:
+    """Slot-allocated K/V arrays + free list.
+
+    ``k``/``v``: ``[L, num_slots, max_len, kv_heads, head_dim]`` jax
+    arrays, replaced wholesale by the engine after each jitted step.
+    ``lengths``: host-side int32 per slot — tokens currently cached.
+    """
+
+    def __init__(self, spec: KVCacheSpec, num_slots: int, max_len: int, *,
+                 sharding=None):
+        if num_slots < 1 or max_len < 2:
+            raise ValueError(f"need >=1 slot and max_len >= 2, got "
+                             f"{num_slots}/{max_len}")
+        self.spec = spec
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        shape = (spec.num_layers, num_slots, max_len, spec.num_kv_heads,
+                 spec.head_dim)
+        self.k = jnp.zeros(shape, spec.dtype)
+        self.v = jnp.zeros(shape, spec.dtype)
+        if sharding is not None:
+            import jax
+            self.k = jax.device_put(self.k, sharding)
+            self.v = jax.device_put(self.v, sharding)
+        self.lengths = np.zeros(num_slots, np.int32)
+        # LIFO keeps hot slots hot (their pages are the ones most recently
+        # touched by a jitted step)
+        self._free = list(range(num_slots - 1, -1, -1))
+
+    # ---- slot lifecycle ----
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return 1.0 - len(self._free) / self.num_slots
+
+    def alloc(self) -> int:
+        """Claim a free slot (length reset); raises if none are free —
+        callers gate admission on ``num_free`` (scheduler backpressure)."""
+        if not self._free:
+            raise RuntimeError("KV cache has no free slots")
+        slot = self._free.pop()
+        self.lengths[slot] = 0
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Release a slot back to the pool.  The K/V bytes are NOT zeroed —
+        decode masks positions beyond ``lengths`` and prefill overwrites
+        from position 0, so stale rows are unreachable."""
+        if slot in self._free:
+            raise ValueError(f"slot {slot} double-freed")
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range")
+        self.lengths[slot] = 0
+        self._free.append(slot)
+
+    def update(self, k, v) -> None:
+        """Swap in the arrays a jitted step returned."""
+        self.k, self.v = k, v
+
+    @property
+    def active_tokens(self) -> int:
+        """Tokens currently cached across occupied slots (the scheduler's
+        token-budget currency)."""
+        return int(self.lengths.sum())

@@ -1,0 +1,79 @@
+"""Serving metrics: TTFT, tokens/sec, queue depth, occupancy, recompiles.
+
+Host-side counters shared by the engine (compile counts), scheduler
+(admission/eviction, queue depth, occupancy) and server (request
+outcomes).  Thread-safe — listener threads and the engine loop update
+concurrently.  ``report()`` flushes a snapshot through the repo's
+``utils/logger.MetricLogger`` so serving runs log/means/wandb exactly like
+training runs do.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class ServeMetrics:
+    def __init__(self, *, window: int = 512):
+        self._lock = threading.Lock()
+        self._counters = defaultdict(int)
+        self._gauges = {}
+        self._ttft = []          # seconds, bounded ring
+        self._window = window
+        self._decode_tokens = 0  # since last snapshot window start
+        self._decode_t0 = None
+
+    # ---- counters / gauges ----
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+
+    def set_gauge(self, name: str, value) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            return self._counters[name]
+
+    # ---- latency / throughput ----
+    def observe_ttft(self, seconds: float) -> None:
+        """Time-to-first-token: request admission → prefill's first token."""
+        with self._lock:
+            self._ttft.append(float(seconds))
+            if len(self._ttft) > self._window:
+                self._ttft = self._ttft[-self._window:]
+
+    def observe_decode(self, n_tokens: int) -> None:
+        """One decode step produced ``n_tokens`` (tokens/sec derives from
+        the wall clock between the first and latest observation)."""
+        with self._lock:
+            now = time.perf_counter()
+            if self._decode_t0 is None:
+                self._decode_t0 = now
+            self._decode_tokens += int(n_tokens)
+            self._decode_now = now
+
+    # ---- reporting ----
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            out.update(self._gauges)
+            if self._ttft:
+                ts = sorted(self._ttft)
+                out["ttft_avg_s"] = sum(ts) / len(ts)
+                out["ttft_p50_s"] = ts[len(ts) // 2]
+                out["ttft_max_s"] = ts[-1]
+            if self._decode_t0 is not None:
+                dt = max(self._decode_now - self._decode_t0, 1e-9)
+                if dt > 0 and self._decode_tokens:
+                    out["tokens_per_sec"] = self._decode_tokens / dt
+        return out
+
+    def report(self, logger, step=None) -> dict:
+        """Log the snapshot through utils/logger.MetricLogger."""
+        snap = self.snapshot()
+        logger.log(snap, step=step)
+        return snap

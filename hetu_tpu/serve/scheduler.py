@@ -1,0 +1,214 @@
+"""Continuous-batching scheduler over a ServeEngine.
+
+Static batch-at-once serving wastes every slot that finishes early;
+continuous batching admits new requests into freed slots at EVERY decode
+step (the Orca/vLLM iteration-level scheduling idea): each ``step()``
+first admits queued requests while (a) a cache slot is free and (b) the
+token budget holds the working set — prompt + one generated token must
+fit alongside the tokens already cached (backpressure, so a burst of
+long prompts queues instead of thrashing the cache) — then runs ONE
+decode step for every active slot and evicts sequences that hit EOS,
+their ``max_tokens``, the cache's ``max_len``, or their deadline.
+
+Thread-safe: the server's listener threads ``submit()``/``cancel()``
+concurrently with the engine loop calling ``step()``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+_ids = itertools.count(1)
+
+
+@dataclass
+class Request:
+    """One generation request and its lifecycle record."""
+
+    prompt: list
+    max_tokens: int = 16
+    eos_id: Optional[int] = None
+    timeout_s: Optional[float] = None   # deadline from submit()
+    rid: int = field(default_factory=lambda: next(_ids))
+
+    # filled in by the scheduler
+    tokens: list = field(default_factory=list)
+    state: str = "new"        # new|queued|running|done
+    status: str = ""          # ok|timeout|cancelled|overflow|shutdown
+    slot: Optional[int] = None
+    submitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    done: threading.Event = field(default_factory=threading.Event)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None or self.submitted_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, engine, *, token_budget: Optional[int] = None,
+                 metrics=None):
+        self.engine = engine
+        self.metrics = metrics or engine.metrics
+        cache = engine.cache
+        # default budget: the cache itself (backpressure only kicks in
+        # when admission would overrun physical capacity anyway)
+        self.token_budget = int(token_budget or
+                                cache.num_slots * cache.max_len)
+        self._lock = threading.Lock()
+        self._queue = deque()
+        self._running = {}   # slot -> Request
+        self._accepting = True
+
+    # ---- request intake ----
+    def submit(self, request: Request) -> Request:
+        request.submitted_at = time.monotonic()
+        with self._lock:
+            if not self._accepting:
+                # shutdown already drained the queue and the engine loop
+                # is gone — complete immediately so the submitting
+                # listener doesn't park on a request nothing will serve
+                self._finish(request, "shutdown")
+                return request
+            request.state = "queued"
+            self._queue.append(request)
+            self.metrics.inc("requests_submitted")
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+        return request
+
+    def cancel(self, request: Request) -> None:
+        """Abandon a request wherever it is (listener timeout path)."""
+        with self._lock:
+            if request.done.is_set():
+                return
+            if request in self._queue:
+                self._queue.remove(request)
+            if request.slot is not None and \
+                    self._running.get(request.slot) is request:
+                del self._running[request.slot]
+                self.engine.release(request.slot)
+            self._finish(request, "cancelled")
+
+    # ---- the continuous-batching step ----
+    def step(self) -> list:
+        """Admit + one decode round.  Returns requests completed now."""
+        completed = []
+        with self._lock:
+            self._admit(completed)
+            if self._running:
+                toks = self.engine.decode()
+                now = time.monotonic()
+                for slot, req in list(self._running.items()):
+                    req.tokens.append(toks[slot])
+                    if self._should_evict(req, now):
+                        del self._running[slot]
+                        self.engine.release(slot)
+                        self._finish(req, req.status or "ok")
+                        completed.append(req)
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self.metrics.set_gauge("slot_occupancy",
+                                   self.engine.cache.occupancy)
+        return completed
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._queue or self._running)
+
+    # ---- internals (called under the lock) ----
+    def _admit(self, completed: list) -> None:
+        now = time.monotonic()
+        while self._queue and self.engine.cache.num_free:
+            req = self._queue[0]
+            if req.timeout_s is not None and \
+                    now - req.submitted_at > req.timeout_s:
+                self._queue.popleft()
+                self._finish(req, "timeout")
+                completed.append(req)
+                continue
+            n = len(req.prompt)
+            if n == 0 or n + 1 > self.engine.cache.max_len \
+                    or n + 1 > self.token_budget:
+                # empty prompts, prompts too long for a slot, and prompts
+                # whose working set could NEVER fit the budget must fail
+                # the REQUEST — the alternatives are an exception in the
+                # engine loop thread or a queue head wedged forever
+                self._queue.popleft()
+                self._finish(req, "overflow")
+                completed.append(req)
+                continue
+            # token-budget backpressure: the working set after admission
+            # (fits eventually — running sequences will finish and free it)
+            if self.engine.cache.active_tokens + n + 1 > self.token_budget:
+                break
+            self._queue.popleft()
+            slot = self.engine.alloc_slot()
+            req.slot = slot
+            req.state = "running"
+            first = self.engine.prefill(slot, req.prompt)
+            req.tokens.append(first)
+            req.first_token_at = time.monotonic()
+            self.metrics.observe_ttft(req.ttft_s)
+            self._running[slot] = req
+            if self._should_evict(req, req.first_token_at):
+                del self._running[slot]
+                self.engine.release(slot)
+                self._finish(req, req.status or "ok")
+                completed.append(req)
+
+    def _should_evict(self, req: Request, now: float) -> bool:
+        if req.eos_id is not None and req.tokens[-1] == req.eos_id:
+            return True
+        if len(req.tokens) >= req.max_tokens:
+            return True
+        # the cache slot is full: the next decode would have nowhere to
+        # write — finish what we have
+        if self.engine.cache.lengths[req.slot] + 1 >= self.engine.cache.max_len:
+            return True
+        if req.timeout_s is not None and \
+                now - req.submitted_at > req.timeout_s:
+            req.status = "timeout"
+            return True
+        return False
+
+    def _finish(self, req: Request, status: str) -> None:
+        req.status = status
+        req.state = "done"
+        req.finished_at = time.monotonic()
+        self.metrics.inc(f"requests_{status}")
+        self.metrics.inc("generated_tokens", len(req.tokens))
+        req.done.set()
+
+    # ---- convenience driver (tests / offline batch use) ----
+    def run(self, requests, *, max_steps: int = 100_000) -> dict:
+        """Submit everything, step until drained; {rid: tokens}."""
+        for r in requests:
+            self.submit(r)
+        for _ in range(max_steps):
+            if not self.has_work():
+                break
+            self.step()
+        return {r.rid: list(r.tokens) for r in requests}
+
+    def drain(self, status: str = "shutdown", *,
+              stop_accepting: bool = False) -> None:
+        """Complete everything still queued/running.  With
+        ``stop_accepting`` (shutdown), later ``submit()`` calls finish
+        immediately as 'shutdown' — an engine-error drain keeps accepting
+        so the loop can serve the next request."""
+        with self._lock:
+            if stop_accepting:
+                self._accepting = False
+            while self._queue:
+                self._finish(self._queue.popleft(), status)
+            for slot, req in list(self._running.items()):
+                self.engine.release(slot)
+                self._finish(req, status)
+            self._running.clear()

@@ -1,0 +1,239 @@
+"""Inference front-end over the van's blob-channel transport.
+
+Reuses the thread-per-connection C++ van server (csrc/hetu_ps_van.cpp —
+the same single-slot acked blob channels the MPMD mailbox and its 16-pair
+concurrency soak already exercise) as the wire: client ``i`` talks on a
+dedicated request/response channel pair derived from its ``client_id``
+(ids are caller-assigned, the same convention as van table ids), with
+monotonically increasing seqs per channel, so every wire op inherits the
+blob channel's idempotent-retry reliability.
+
+Threads:
+  * one listener per client id — blocks in a server-side blob GET (no
+    polling frames while idle beyond the shutdown-check interval),
+    submits to the scheduler, waits on the request's completion event
+    with the per-request timeout, sends the response;
+  * one engine loop — runs ``scheduler.step()`` whenever there is work
+    (continuous batching: admissions interleave with decode steps).
+
+Graceful shutdown: ``close()`` stops the loop, drains the scheduler (so
+waiting listeners get 'shutdown' responses instead of hanging), joins
+every thread, then stops the van if this server started it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from hetu_tpu.serve.scheduler import ContinuousBatchingScheduler, Request
+
+# channel namespace: far above the table/mailbox ids the tests use
+SERVE_CHANNEL_BASE = 0x53525645  # 'SRVE'
+
+
+def request_channel(client_id: int) -> int:
+    return SERVE_CHANNEL_BASE + 2 * int(client_id)
+
+
+def response_channel(client_id: int) -> int:
+    return SERVE_CHANNEL_BASE + 2 * int(client_id) + 1
+
+
+class InferenceServer:
+    def __init__(self, scheduler: ContinuousBatchingScheduler, *,
+                 port: int = 0, max_clients: int = 4,
+                 request_timeout_s: float = 60.0,
+                 poll_s: float = 0.25, own_van: bool = True):
+        """port=0 picks a free port; ``own_van=False`` attaches to a van
+        already serving in this process (the server then must be handed
+        that van's port)."""
+        from hetu_tpu.ps import van
+        self._van = van
+        self.scheduler = scheduler
+        self.metrics = scheduler.metrics
+        self.request_timeout_s = float(request_timeout_s)
+        self._poll_s = float(poll_s)
+        self._own_van = own_van
+        if own_van:
+            self.port = van.serve(port)
+        else:
+            if not port:
+                raise ValueError("own_van=False needs the running van's port")
+            self.port = port
+        self._stop = threading.Event()
+        self.last_loop_error = None
+        self._loop = threading.Thread(target=self._engine_loop, daemon=True)
+        self._listeners = [
+            threading.Thread(target=self._listen, args=(cid,), daemon=True)
+            for cid in range(max_clients)]
+        self._loop.start()
+        for t in self._listeners:
+            t.start()
+
+    # ---- engine loop ----
+    def _engine_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.scheduler.has_work():
+                    self.scheduler.step()
+                else:
+                    time.sleep(0.002)
+            except Exception:
+                # a step blowing up must fail the in-flight requests (the
+                # listeners are waiting on their events), not wedge them —
+                # but keep the evidence: traceback to stderr, repr for the
+                # operator, a counter for dashboards
+                import traceback
+                self.last_loop_error = traceback.format_exc()
+                traceback.print_exc()
+                self.metrics.inc("engine_loop_errors")
+                self.scheduler.drain("error")
+
+    # ---- one listener per client channel pair ----
+    def _listen(self, cid: int) -> None:
+        req_ch = self._van.BlobChannel("127.0.0.1", self.port,
+                                       request_channel(cid))
+        resp_ch = self._van.BlobChannel("127.0.0.1", self.port,
+                                        response_channel(cid))
+        seq = 1
+        sent_seq = 0  # last response seq that reached the slot
+        try:
+            while not self._stop.is_set():
+                try:
+                    raw = req_ch.get(seq, timeout_s=self._poll_s)
+                except TimeoutError:
+                    # reconnect probe: a client that RESTARTED with this
+                    # id begins again at seq 1 while we wait at seq N+1 —
+                    # without this it could never be served again.  An
+                    # EMPTY read is the already-consumed seq-1 slot (ack
+                    # frees the payload but keeps its seq), not a request.
+                    if seq > 1:
+                        try:
+                            raw = req_ch.get(1, timeout_s=0.05)
+                        except (TimeoutError, RuntimeError):
+                            continue
+                        if not raw:
+                            continue
+                        seq = 1
+                    else:
+                        continue
+                except RuntimeError:
+                    break  # van stopped under us
+                resp = self._handle(raw)
+                payload = json.dumps(resp).encode()
+                for attempt in range(2):
+                    try:
+                        resp_ch.put(payload, seq,
+                                    timeout_s=min(self.request_timeout_s,
+                                                  10.0))
+                        sent_seq = seq
+                        break
+                    except (TimeoutError, RuntimeError):
+                        # unread slot: a client-side wire timeout left our
+                        # previous response stored unacked, which would
+                        # wedge this channel FOREVER (puts only overwrite
+                        # acked slots).  Consume our own stale response
+                        # (get acks it) and retry once; failing that, drop
+                        # this response but keep the listener alive.
+                        if attempt == 0 and sent_seq:
+                            try:
+                                resp_ch.get(sent_seq, timeout_s=0.2)
+                                continue
+                            except (TimeoutError, RuntimeError):
+                                pass
+                        self.metrics.inc("responses_dropped")
+                        break
+                seq += 1
+        finally:
+            req_ch.close()
+            resp_ch.close()
+
+    def _handle(self, raw: bytes) -> dict:
+        try:
+            msg = json.loads(raw)
+            if not msg["prompt"]:
+                raise ValueError("empty prompt")
+            req = Request(
+                prompt=[int(t) for t in msg["prompt"]],
+                max_tokens=int(msg.get("max_tokens", 16)),
+                eos_id=msg.get("eos_id"),
+                timeout_s=min(float(msg.get("timeout_s",
+                                            self.request_timeout_s)),
+                              self.request_timeout_s))
+        except (KeyError, TypeError, ValueError) as e:
+            return {"id": None, "status": "bad_request", "error": str(e),
+                    "tokens": []}
+        self.scheduler.submit(req)
+        # event wait (not scheduler polling): the engine loop completes the
+        # request and sets the event; the deadline here backstops a wedged
+        # loop so the client always gets a response frame
+        if not req.done.wait(timeout=req.timeout_s + self._poll_s + 5.0):
+            self.scheduler.cancel(req)
+            req.status = req.status or "timeout"
+        return {"id": msg.get("id"), "status": req.status or "ok",
+                "tokens": list(req.tokens),
+                "ttft_s": req.ttft_s}
+
+    # ---- lifecycle ----
+    def close(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        self.scheduler.drain("shutdown", stop_accepting=True)
+        self._loop.join(timeout_s)
+        for t in self._listeners:
+            t.join(timeout_s)
+        if self._own_van:
+            self._van.stop()
+
+
+class InferenceClient:
+    """Blocking client for one channel pair.  ``client_id`` must be unique
+    per concurrently-connected client and < the server's ``max_clients``
+    (the van-table-id convention: caller-assigned, concurrent collision =
+    crossed wires).  A RESTARTED client may reuse its id: the listener
+    detects the seq reset and resyncs."""
+
+    def __init__(self, host: str, port: int, client_id: int, *,
+                 connect_timeout_s: float = 20.0):
+        from hetu_tpu.ps import van
+        self._req = van.BlobChannel(host, port, request_channel(client_id),
+                                    connect_timeout_s=connect_timeout_s)
+        self._resp = van.BlobChannel(host, port, response_channel(client_id),
+                                     connect_timeout_s=connect_timeout_s)
+        self._seq = 0
+
+    def generate(self, prompt, *, max_tokens: int = 16, eos_id=None,
+                 timeout_s: float = 120.0, deadline_s=None) -> dict:
+        """prompt: token ids in → {'tokens': [...], 'status': ...} out.
+
+        ``timeout_s`` bounds the WIRE wait (put + blocking get);
+        ``deadline_s`` is the per-request serving deadline enforced by the
+        scheduler (queue wait + decode), defaulting to ``timeout_s``."""
+        self._seq += 1
+        msg = {"id": self._seq, "prompt": [int(t) for t in prompt],
+               "max_tokens": int(max_tokens),
+               "timeout_s": timeout_s if deadline_s is None
+               else float(deadline_s)}
+        if eos_id is not None:
+            msg["eos_id"] = int(eos_id)
+        self._req.put(json.dumps(msg).encode(), self._seq,
+                      timeout_s=timeout_s)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                return json.loads(self._resp.get(
+                    self._seq, timeout_s=max(deadline - time.monotonic(),
+                                             0.05)))
+            except RuntimeError as e:
+                # rc=-5: the slot still holds a PREVIOUS incarnation's
+                # response (this client restarted with a reused id); the
+                # server overwrites it with our seq once it resyncs —
+                # retry until the deadline
+                if "rc=-5" not in str(e) or time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    def close(self) -> None:
+        self._req.close()
+        self._resp.close()

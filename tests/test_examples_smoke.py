@@ -40,6 +40,7 @@ CASES = [
      "long-context ring SP: OK"),
     ("ps_multiserver_embedding", [], "done"),
     ("mpmd_unequal_dp", ["--steps", "1"], "MPMD 3-stage"),
+    ("gpt_serve", ["--requests", "4", "--max-tokens", "8"], "serve: OK"),
 ]
 
 

@@ -48,6 +48,42 @@ def test_bf16_sgd_training_tracks_f32():
                                rtol=3e-2, atol=3e-2)
 
 
+def test_bf16_nan_stays_nan_not_inf():
+    """f32→bf16 round-to-nearest-even must QUIET a NaN, not let the
+    mantissa carry overflow the exponent into ±Inf (the TF/PyTorch
+    converter behavior).  A NaN mantissa of all-ones is exactly the
+    pattern the naive rounding add breaks on."""
+    t = PSTable(4, 4, init="zeros", dtype="bf16")
+    v = np.zeros((4, 4), np.float32)
+    # all-ones-mantissa NaN: +0x7fffff — the worst case for the carry
+    v[0, 0] = np.frombuffer(np.uint32(0x7FFFFFFF).tobytes(), np.float32)[0]
+    v[0, 1] = np.frombuffer(np.uint32(0xFFFFFFFF).tobytes(), np.float32)[0]
+    v[1, 1] = np.inf       # real infinities must still pass through
+    v[2, 2] = -np.inf
+    v[3, 3] = 3.0e38       # large finite still rounds finitely (bf16 max
+    #                        is ~3.39e38, so no overflow-to-inf either)
+    t.sparse_set(np.arange(4), v)
+    got = t.sparse_pull(np.arange(4))
+    assert np.isnan(got[0, 0]) and np.isnan(got[0, 1])
+    assert np.isposinf(got[1, 1]) and np.isneginf(got[2, 2])
+    assert np.isfinite(got[3, 3]) and got[3, 3] > 2.9e38
+
+
+def test_bf16_nan_quieting_preserves_sign_and_wire_path(server_port):
+    """The same guard holds on the WIRE codec (csrc/hetu_ps_van.cpp
+    encode_rows shares hetu_ps_dtype.h): a NaN gradient row pulled from a
+    remote bf16 table comes back NaN, not Inf."""
+    t = van.RemotePSTable("127.0.0.1", server_port, 4, 4, table_id=9501,
+                          init="zeros", dtype="bf16")
+    try:
+        v = np.full((1, 4), np.nan, np.float32)
+        t.sparse_set([2], v)
+        got = t.sparse_pull([2])
+        assert np.isnan(got).all(), got
+    finally:
+        t.close()
+
+
 def test_int8_set_pull_roundtrip():
     t = PSTable(8, 16, init="zeros", dtype="int8")
     v = np.random.default_rng(2).standard_normal((8, 16)).astype(np.float32)

@@ -1,0 +1,192 @@
+"""Inference server over the van blob-channel transport: end-to-end
+generate, concurrent clients, per-request timeout, graceful shutdown —
+plus the OP_STATS since-server-start regression (counters must reset
+across serve() incarnations in one process).
+"""
+
+import json
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import available
+
+if not available():  # pragma: no cover
+    pytest.skip("native PS lib unavailable", allow_module_level=True)
+
+import jax.numpy as jnp
+
+from hetu_tpu.models.gpt import GPTConfig, GPTModel
+from hetu_tpu.ps import van
+from hetu_tpu.serve import (
+    ContinuousBatchingScheduler, InferenceClient, InferenceServer,
+    ServeEngine, request_channel, response_channel,
+)
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    m = GPTModel(GPTConfig(
+        vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+        ffn_size=128, max_position=64, dropout_rate=0.0))
+    return m, m.init(jax.random.PRNGKey(0))
+
+
+@pytest.fixture
+def server(gpt):
+    model, variables = gpt
+    engine = ServeEngine(model, variables, num_slots=4, max_len=48,
+                         min_bucket=8)
+    sched = ContinuousBatchingScheduler(engine)
+    srv = InferenceServer(sched, max_clients=3, request_timeout_s=60.0,
+                          poll_s=0.1)
+    yield srv, model, variables
+    srv.close()
+
+
+def _ref_greedy(model, variables, prompt, n):
+    ids = list(prompt)
+    out = []
+    for _ in range(n):
+        logits, _ = model.apply(variables, jnp.asarray([ids], jnp.int32))
+        tok = int(jnp.argmax(logits[0, -1]))
+        out.append(tok)
+        ids.append(tok)
+    return out
+
+
+def test_generate_end_to_end_matches_reference(server):
+    srv, model, variables = server
+    prompt = [3, 14, 15, 9, 2, 6]
+    client = InferenceClient("127.0.0.1", srv.port, 0)
+    try:
+        resp = client.generate(prompt, max_tokens=8)
+    finally:
+        client.close()
+    assert resp["status"] == "ok"
+    assert resp["tokens"] == _ref_greedy(model, variables, prompt, 8)
+    assert resp["ttft_s"] > 0
+
+
+def test_concurrent_clients_each_get_their_own_answer(server):
+    srv, model, variables = server
+    prompts = {0: [1, 2, 3], 1: [9, 8, 7, 6], 2: [42]}
+    results = {}
+    errors = []
+
+    def worker(cid):
+        c = InferenceClient("127.0.0.1", srv.port, cid)
+        try:
+            for j in range(2):  # two sequential requests per client
+                results[(cid, j)] = c.generate(prompts[cid], max_tokens=5)
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append((cid, repr(e)))
+        finally:
+            c.close()
+
+    ts = [threading.Thread(target=worker, args=(cid,)) for cid in prompts]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    assert not errors, errors
+    assert len(results) == 6
+    for (cid, _), resp in results.items():
+        assert resp["status"] == "ok"
+        assert resp["tokens"] == _ref_greedy(model, variables,
+                                             prompts[cid], 5)
+
+
+def test_per_request_timeout_returns_timeout_status(server):
+    """A request whose deadline is already past when admission runs must
+    come back status=timeout with no tokens — the wire analog of the
+    scheduler's queue-expiry eviction."""
+    srv, _, _ = server
+    client = InferenceClient("127.0.0.1", srv.port, 1)
+    try:
+        resp = client.generate([1, 2, 3], max_tokens=8, deadline_s=0.0)
+    finally:
+        client.close()
+    assert resp["status"] in ("timeout", "cancelled")
+    assert resp["tokens"] == []
+
+
+def test_graceful_shutdown_drains_and_stops_van(gpt):
+    model, variables = gpt
+    engine = ServeEngine(model, variables, num_slots=2, max_len=32,
+                         min_bucket=8)
+    sched = ContinuousBatchingScheduler(engine)
+    srv = InferenceServer(sched, max_clients=1, poll_s=0.05)
+    client = InferenceClient("127.0.0.1", srv.port, 0)
+    try:
+        assert client.generate([5, 6], max_tokens=3)["status"] == "ok"
+    finally:
+        client.close()
+    srv.close()
+    assert not srv._loop.is_alive()
+    assert not any(t.is_alive() for t in srv._listeners)
+    # the van really stopped: a fresh serve() binds again in this process
+    port = van.serve(0)
+    assert port > 0
+    van.stop()
+
+
+def test_client_restart_with_same_id_is_served(server):
+    """A client process that dies and reconnects under the same id starts
+    its seqs over at 1; the listener must resync instead of waiting
+    forever at the old seq."""
+    srv, model, variables = server
+    first = InferenceClient("127.0.0.1", srv.port, 0)
+    try:
+        for _ in range(2):  # advance the server listener's seq past 1
+            assert first.generate([1, 2], max_tokens=3)["status"] == "ok"
+    finally:
+        first.close()
+    reborn = InferenceClient("127.0.0.1", srv.port, 0)  # seq restarts at 1
+    try:
+        resp = reborn.generate([9, 8, 7], max_tokens=4, timeout_s=30.0)
+    finally:
+        reborn.close()
+    assert resp["status"] == "ok"
+    assert resp["tokens"] == _ref_greedy(model, variables, [9, 8, 7], 4)
+
+
+def test_malformed_request_gets_error_response(server):
+    srv, _, _ = server
+    ch_req = van.BlobChannel("127.0.0.1", srv.port, request_channel(2))
+    ch_resp = van.BlobChannel("127.0.0.1", srv.port, response_channel(2))
+    try:
+        ch_req.put(json.dumps({"max_tokens": 4}).encode(), 1)  # no prompt
+        resp = json.loads(ch_resp.get(1, timeout_s=30))
+        assert resp["status"] == "bad_request" and resp["tokens"] == []
+        ch_req.put(json.dumps({"prompt": []}).encode(), 2)  # empty prompt
+        resp = json.loads(ch_resp.get(2, timeout_s=30))
+        assert resp["status"] == "bad_request" and resp["tokens"] == []
+    finally:
+        ch_req.close()
+        ch_resp.close()
+
+
+def test_van_stats_reset_across_serve_incarnations():
+    """csrc satellite: g_frames_handled/g_bytes_rx/g_bytes_tx zero at
+    serve() start, so OP_STATS really reads "since server start"."""
+    port = van.serve(0)
+    try:
+        t = van.RemotePSTable("127.0.0.1", port, 8, 4, table_id=701,
+                              init="zeros")
+        t.sparse_pull(np.arange(8))
+        t.close()
+        s1 = van.stats("127.0.0.1", port)
+        assert s1["frames"] > 2 and s1["bytes_rx"] > 0
+    finally:
+        van.stop()
+    port = van.serve(0)
+    try:
+        s2 = van.stats("127.0.0.1", port)
+        # only the probe's own frame has been counted in this incarnation
+        assert s2["frames"] <= 2, s2
+        assert s2["bytes_rx"] < s1["bytes_rx"], (s1, s2)
+    finally:
+        van.stop()

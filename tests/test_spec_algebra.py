@@ -54,6 +54,31 @@ def test_local_transitions_predict_none():
     assert predict_collective(s, s) is None
 
 
+def test_check_alltoall_split_dim_migration():
+    """Round-5 VERDICT repro: ('tp', None) → (None, 'tp') is an
+    all_to_all-class resharding, NOT free/local — the Ulysses
+    sequence↔head transpose every SP plan prices."""
+    src = ShardSpec(dims=("tp", None))
+    dst = ShardSpec(dims=(None, "tp"))
+    assert src.check_alltoall(dst) == ("tp", 0, 1)
+    assert predict_collective(src, dst)[0] == "all-to-all"
+    # and the mirrored direction
+    assert dst.check_alltoall(src) == ("tp", 1, 0)
+    assert predict_collective(dst, src)[0] == "all-to-all"
+
+
+def test_check_alltoall_requires_same_axis_and_no_partial():
+    # different axes moving = not a single all_to_all
+    assert ShardSpec(dims=("tp", None)).check_alltoall(
+        ShardSpec(dims=(None, "dp"))) is None
+    # partial values reshard through reduce paths, not all_to_all
+    assert ShardSpec(dims=("tp", None), partial=("dp",)).check_alltoall(
+        ShardSpec(dims=(None, "tp"))) is None
+    # 3D migration across non-adjacent dims still matches
+    assert ShardSpec(dims=(None, "tp", None)).check_alltoall(
+        ShardSpec(dims=(None, None, "tp"))) == ("tp", 1, 2)
+
+
 # ---- XLA agreement: the checks must match the partitioner's insertions ----
 
 def test_xla_inserts_predicted_allreduce(mesh):
@@ -83,6 +108,17 @@ def test_xla_inserts_predicted_allgather(mesh):
         ShardSpec(dims=(None, "tp")),
         ShardSpec.replicated(2))
     assert kind == "all-gather"
+
+
+def test_xla_inserts_predicted_alltoall(mesh):
+    """Split-dim migration really lowers to an all-to-all on the compiled
+    HLO (the transition the algebra used to call free)."""
+    kind, audited = verify_spec_transition(
+        mesh, (16, 256),
+        ShardSpec(dims=("tp", None)),
+        ShardSpec(dims=(None, "tp")))
+    assert kind == "all-to-all"
+    assert "all-to-all" in audited
 
 
 def test_xla_local_transition_no_collective(mesh):
