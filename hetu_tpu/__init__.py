@@ -45,8 +45,10 @@ from hetu_tpu.parallel.mesh import make_mesh, local_mesh, MeshConfig
 # heavier/optional subsystems imported on attribute access:
 #   hetu_tpu.ps (native PS plane), hetu_tpu.onnx, hetu_tpu.graphboard,
 #   hetu_tpu.launcher, hetu_tpu.graph (define-then-run facade),
-#   hetu_tpu.serve (inference serving tier)
-_LAZY = {"ps", "onnx", "graphboard", "launcher", "graph", "serve"}
+#   hetu_tpu.serve (inference serving tier), hetu_tpu.resilience
+#   (fault-tolerant training supervisor + chaos harness)
+_LAZY = {"ps", "onnx", "graphboard", "launcher", "graph", "serve",
+         "resilience"}
 
 
 def __getattr__(name):

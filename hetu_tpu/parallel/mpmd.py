@@ -75,7 +75,7 @@ class VanMailbox:
                                      connect_timeout_s=connect_timeout_s)
             return
         from hetu_tpu.ps.van import RemotePSTable
-        deadline = time.time() + connect_timeout_s
+        deadline = time.monotonic() + connect_timeout_s
         # both endpoints race to create; -2 (exists) means the peer won
         while True:
             try:
@@ -92,7 +92,7 @@ class VanMailbox:
                         connect_timeout_s=connect_timeout_s)
                     break
                 except RuntimeError:
-                    if time.time() > deadline:
+                    if time.monotonic() > deadline:
                         raise
                     time.sleep(0.05)
 
@@ -109,12 +109,12 @@ class VanMailbox:
             self._chan.put(flat, seq, timeout_s=timeout_s)
             self._last_seq = seq
             return
-        deadline = time.time() + timeout_s
+        deadline = time.monotonic() + timeout_s
         # wait for the reader's ack of the previous message
         while self._last_seq and \
                 int(self._flag(self.capacity + 1)) != \
                 self._wire(self._last_seq):
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"mailbox: ack of seq {self._last_seq} not observed "
                     f"within {timeout_s}s")
@@ -138,7 +138,7 @@ class VanMailbox:
                     f"mailbox: message has {a.size} f32s, expected "
                     f"{n} for shape {shape}")
             return a.reshape(shape)
-        deadline = time.time() + timeout_s
+        deadline = time.monotonic() + timeout_s
         while True:
             try:
                 flag = self._flag(self.capacity)
@@ -150,7 +150,7 @@ class VanMailbox:
                     [self.capacity + 1],
                     np.asarray([[float(self._wire(seq))]], np.float32))
                 return data.ravel().reshape(shape)
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"mailbox: seq {seq} not observed within {timeout_s}s "
                     f"(last flag: {flag})")
@@ -252,13 +252,13 @@ class MPMDStageRunner:
             # create=False never probes; a 1-row pull does)
             self._acc = RemotePSTable(self.host, self.port, self.grad_size,
                                       1, table_id=tid, create=False)
-            deadline = time.time() + 20
+            deadline = time.monotonic() + 20
             while True:
                 try:
                     self._acc.sparse_pull([0])
                     break
                 except RuntimeError:
-                    if time.time() > deadline:
+                    if time.monotonic() > deadline:
                         raise
                     time.sleep(0.05)
         self._barrier_cli = RemoteBarrier(

@@ -27,13 +27,41 @@ def _fresh_remote_id() -> int:
     return (1 << 24) + int.from_bytes(os.urandom(3), "little")
 
 
+# All deadline arithmetic in this module uses time.monotonic(): wall-clock
+# (time.time) jumps — NTP slew, manual resets, VM suspend/resume — must not
+# spuriously expire or indefinitely extend transport timeouts.  The native
+# layer (csrc) already uses std::chrono::steady_clock for the same reason.
+
+_fault_hook = None
+
+
+def set_fault_hook(hook):
+    """Install a callable invoked as ``hook(op: str)`` before every
+    client-side wire op (pulls/pushes/sets, blob put/get).  The hook may
+    sleep (delay injection) or raise (transient-error injection) — a raise
+    surfaces to the caller exactly like a real transport failure, so retry
+    paths are exercised end-to-end.  Returns the previously installed hook
+    (chain or restore it).  Used by resilience/faults.py; never installed
+    in production paths."""
+    global _fault_hook
+    prev = _fault_hook
+    _fault_hook = hook
+    return prev
+
+
+def _maybe_inject(op: str) -> None:
+    hook = _fault_hook
+    if hook is not None:
+        hook(op)
+
+
 def _connect_with_deadline(host: str, port: int, timeout_s: float) -> int:
     """Poll ``ps_van_connect`` until it succeeds or the deadline expires;
     shared by every van client constructor."""
-    deadline = time.time() + timeout_s
+    deadline = time.monotonic() + timeout_s
     fd = lib.ps_van_connect(host.encode(), port)
     while fd < 0:
-        if time.time() > deadline:
+        if time.monotonic() > deadline:
             raise ConnectionError(f"cannot reach PS van {host}:{port}")
         time.sleep(0.05)
         fd = lib.ps_van_connect(host.encode(), port)
@@ -151,6 +179,7 @@ class RemotePSTable:
         return lib.ps_van_ping(self.fd) == 0
 
     def sparse_pull(self, indices) -> np.ndarray:
+        _maybe_inject("van_sparse_pull")
         idx = _as_idx(indices)
         out = np.empty((idx.shape[0], self.dim), np.float32)
         _check(lib.ps_van_sparse_pull_dt(self.fd, self.id, _i64p(idx),
@@ -160,6 +189,7 @@ class RemotePSTable:
         return out
 
     def sparse_push(self, indices, grads) -> None:
+        _maybe_inject("van_sparse_push")
         idx = _as_idx(indices)
         g = _as_mat(grads, idx.shape[0], self.dim)
         _check(lib.ps_van_sparse_push_dt(self.fd, self.id, _i64p(idx),
@@ -168,17 +198,20 @@ class RemotePSTable:
                "van_sparse_push")
 
     def dense_pull(self) -> np.ndarray:
+        _maybe_inject("van_dense_pull")
         out = np.empty((self.rows, self.dim), np.float32)
         _check(lib.ps_van_dense_pull(self.fd, self.id, _f32p(out),
                                      self.rows * self.dim), "van_dense_pull")
         return out
 
     def dense_push(self, grad) -> None:
+        _maybe_inject("van_dense_push")
         g = _as_mat(grad, self.rows, self.dim)
         _check(lib.ps_van_dense_push(self.fd, self.id, _f32p(g),
                                      self.rows * self.dim), "van_dense_push")
 
     def sparse_set(self, indices, values) -> None:
+        _maybe_inject("van_sparse_set")
         idx = _as_idx(indices)
         v = _as_mat(values, idx.shape[0], self.dim)
         _check(lib.ps_van_sparse_set_dt(self.fd, self.id, _i64p(idx),
@@ -314,6 +347,7 @@ class PartitionedPSTable:
         return int(lib.ps_group_recovered(self.gid))
 
     def sparse_pull(self, indices) -> np.ndarray:
+        _maybe_inject("group_sparse_pull")
         idx = _as_idx(indices)
         out = np.empty((idx.shape[0], self.dim), np.float32)
         _check(lib.ps_group_sparse_pull(self.gid, _i64p(idx), idx.shape[0],
@@ -321,24 +355,28 @@ class PartitionedPSTable:
         return out
 
     def sparse_push(self, indices, grads) -> None:
+        _maybe_inject("group_sparse_push")
         idx = _as_idx(indices)
         g = _as_mat(grads, idx.shape[0], self.dim)
         _check(lib.ps_group_sparse_push(self.gid, _i64p(idx), _f32p(g),
                                         idx.shape[0]), "group_sparse_push")
 
     def sparse_set(self, indices, values) -> None:
+        _maybe_inject("group_sparse_set")
         idx = _as_idx(indices)
         v = _as_mat(values, idx.shape[0], self.dim)
         _check(lib.ps_group_sparse_set(self.gid, _i64p(idx), _f32p(v),
                                        idx.shape[0]), "group_sparse_set")
 
     def dense_pull(self) -> np.ndarray:
+        _maybe_inject("group_dense_pull")
         out = np.empty((self.rows, self.dim), np.float32)
         _check(lib.ps_group_dense_pull(self.gid, _f32p(out)),
                "group_dense_pull")
         return out
 
     def dense_push(self, grad) -> None:
+        _maybe_inject("group_dense_push")
         g = _as_mat(grad, self.rows, self.dim)
         _check(lib.ps_group_dense_push(self.gid, _f32p(g)),
                "group_dense_push")
@@ -542,17 +580,18 @@ class BlobChannel:
                                          self._timeout_s)
 
     def put(self, data, seq: int, *, timeout_s: float = 60.0) -> None:
+        _maybe_inject("blob_put")
         buf = np.ascontiguousarray(data).tobytes() \
             if not isinstance(data, (bytes, bytearray, memoryview)) else \
             bytes(data)
-        deadline = time.time() + timeout_s
+        deadline = time.monotonic() + timeout_s
         while True:
-            wait_ms = max(1, int((deadline - time.time()) * 1000))
+            wait_ms = max(1, int((deadline - time.monotonic()) * 1000))
             rc = lib.ps_van_blob_put(self.fd, self.id, seq, buf,
                                      len(buf), wait_ms)
             if rc == 0:
                 return
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 if rc == -11:  # previous message unread: same condition
                     # the sparse mailbox surfaces as TimeoutError
                     raise TimeoutError(
@@ -568,11 +607,12 @@ class BlobChannel:
                 raise RuntimeError(f"blob put failed (rc={rc})")
 
     def get(self, seq: int, *, timeout_s: float = 60.0) -> bytes:
+        _maybe_inject("blob_get")
         cap = 1 << 28
-        deadline = time.time() + timeout_s
+        deadline = time.monotonic() + timeout_s
         need = ctypes.c_int64(0)
         while True:
-            wait_ms = max(1, int((deadline - time.time()) * 1000))
+            wait_ms = max(1, int((deadline - time.monotonic()) * 1000))
             n = lib.ps_van_blob_get(self.fd, self.id, seq, self._rbuf,
                                     len(self._rbuf), wait_ms,
                                     ctypes.byref(need))
@@ -586,7 +626,7 @@ class BlobChannel:
                 self._rbuf = ctypes.create_string_buffer(
                     min(cap, max(int(need.value), 2 * len(self._rbuf))))
                 continue
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 if n == -12:
                     raise TimeoutError(
                         f"blob get: seq {seq} not delivered within "
@@ -604,7 +644,7 @@ class BlobChannel:
             rc = lib.ps_van_blob_ack(self.fd, self.id, seq)
             if rc == 0:
                 return
-            if rc != -101 or time.time() > deadline:
+            if rc != -101 or time.monotonic() > deadline:
                 raise RuntimeError(f"blob ack failed (rc={rc})")
             self._reconnect()
 

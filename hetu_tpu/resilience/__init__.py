@@ -1,0 +1,20 @@
+"""Fault-tolerant training runtime: deterministic chaos harness +
+supervisor (checkpoint retention, retry, NaN guard, PS shard repair).
+
+See README "Fault tolerance" for usage and guarantees/limits.
+"""
+
+from hetu_tpu.resilience.faults import (
+    FaultEvent, FaultInjector, FaultSchedule, TransientDataError,
+    TransientFault,
+)
+from hetu_tpu.resilience.supervisor import (
+    CheckpointManager, NonFiniteAbort, PSShardGuard, Supervisor,
+    SupervisorReport, default_is_transient,
+)
+
+__all__ = [
+    "FaultEvent", "FaultInjector", "FaultSchedule", "TransientDataError",
+    "TransientFault", "CheckpointManager", "NonFiniteAbort", "PSShardGuard",
+    "Supervisor", "SupervisorReport", "default_is_transient",
+]

@@ -1,0 +1,290 @@
+"""Deterministic, seedable fault injection for chaos-testing training.
+
+Reference context: ps-lite's reliability machinery (heartbeats, resender,
+SaveParam/LoadParam) exists because servers DIE in production; the papers
+this repo tracks (PAPERS.md — MPMD pipelines, cross-replica sharding)
+assume preemptible fleets as table stakes.  A recovery path that is never
+exercised is a recovery path that does not work — this module makes the
+faults injectable, and crucially REPLAYABLE: every fault is drawn from a
+seeded :class:`FaultSchedule`, so a chaos run that fails reproduces
+byte-for-byte from its seed (``FaultSchedule.to_json`` is the evidence).
+
+Fault kinds
+-----------
+``van_error``      next client-side van wire op raises :class:`TransientFault`
+``van_delay``      next client-side van wire op sleeps ``arg`` seconds first
+``data_error``     next dataloader fetch raises :class:`TransientDataError`
+``nan_grad``       the step's batch gets a NaN poisoned into its first float
+                   leaf — the loss/grads of a NaN input are NaN, exercising
+                   the supervisor's nonfinite-step guard without reaching
+                   inside jit
+``kill_shard``     SIGKILL the PS shard subprocess ``arg`` (mid-step death)
+``suspend_shard``  SIGSTOP shard ``arg`` for ``arg2`` seconds (GC-pause /
+                   network-partition lookalike), then SIGCONT
+``preempt``        deliver SIGTERM to the training process (simulated
+                   preemption; the supervisor checkpoints and exits)
+
+The van hooks ride :func:`hetu_tpu.ps.van.set_fault_hook`; everything else
+is plain process/OS plumbing, so the harness needs no native lib to import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass
+
+import numpy as np
+
+
+class TransientFault(ConnectionError):
+    """Injected transient van transport failure (send/recv)."""
+
+
+class TransientDataError(RuntimeError):
+    """Injected transient dataloader failure (flaky storage / decode)."""
+
+
+KINDS = ("van_error", "van_delay", "data_error", "nan_grad",
+         "kill_shard", "suspend_shard", "preempt")
+
+
+@dataclass(frozen=True, order=True)
+class FaultEvent:
+    """One scheduled fault.  ``arg``/``arg2`` meaning depends on ``kind``:
+    van_delay: arg=seconds; kill/suspend_shard: arg=shard index (arg2 =
+    suspend duration seconds); others unused."""
+
+    step: int
+    kind: str
+    arg: float = 0.0
+    arg2: float = 0.0
+
+
+class FaultSchedule:
+    """An immutable, fully materialized list of :class:`FaultEvent`.
+
+    Build one explicitly from events, or :meth:`generate` one from a seed —
+    generation consumes a ``np.random.default_rng(seed)`` in a fixed order,
+    so the same (seed, kwargs) always yields the identical schedule and
+    ``to_json`` is byte-for-byte stable (the replay contract chaos tests
+    assert on).
+    """
+
+    def __init__(self, events):
+        events = list(events)
+        bad = sorted({e.kind for e in events} - set(KINDS))
+        if bad:
+            raise ValueError(f"unknown fault kinds {bad}; known: {KINDS}")
+        self.events = sorted(events)
+        self._by_step = defaultdict(list)
+        for e in self.events:
+            self._by_step[int(e.step)].append(e)
+
+    @classmethod
+    def generate(cls, *, steps: int, seed: int,
+                 van_errors: int = 0, van_delays: int = 0,
+                 delay_s: float = 0.02, data_errors: int = 0,
+                 nan_steps: int = 0, kill_shards: int = 0,
+                 suspend_shards: int = 0, suspend_s: float = 0.3,
+                 n_shards: int = 1,
+                 preempt_at: int | None = None) -> "FaultSchedule":
+        """Draw a schedule over training steps ``[1, steps)`` from ``seed``.
+
+        Counts are clipped to the available steps.  Shard-targeted faults
+        pick a victim shard uniformly from ``n_shards``.  ``preempt_at`` is
+        explicit (a random preemption inside a bounded test run is rarely
+        what you want — pass it when you do).
+        """
+        rng = np.random.default_rng(seed)
+        hi = max(int(steps), 2)
+
+        def pick(n: int) -> list[int]:
+            n = min(int(n), hi - 1)
+            if n <= 0:
+                return []
+            return [int(s) for s in rng.choice(np.arange(1, hi), size=n,
+                                               replace=False)]
+
+        events = []
+        for s in pick(van_errors):
+            events.append(FaultEvent(s, "van_error"))
+        for s in pick(van_delays):
+            events.append(FaultEvent(s, "van_delay", float(delay_s)))
+        for s in pick(data_errors):
+            events.append(FaultEvent(s, "data_error"))
+        for s in pick(nan_steps):
+            events.append(FaultEvent(s, "nan_grad"))
+        for s in pick(kill_shards):
+            events.append(FaultEvent(s, "kill_shard",
+                                     float(rng.integers(max(n_shards, 1)))))
+        for s in pick(suspend_shards):
+            events.append(FaultEvent(s, "suspend_shard",
+                                     float(rng.integers(max(n_shards, 1))),
+                                     float(suspend_s)))
+        if preempt_at is not None:
+            events.append(FaultEvent(int(preempt_at), "preempt"))
+        return cls(events)
+
+    def at(self, step: int) -> list[FaultEvent]:
+        return self._by_step.get(int(step), [])
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def to_json(self) -> str:
+        """Canonical serialization — two schedules are the same chaos run
+        iff their to_json bytes are equal."""
+        return json.dumps([[e.step, e.kind, e.arg, e.arg2]
+                           for e in self.events], separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, s: str) -> "FaultSchedule":
+        return cls([FaultEvent(int(st), k, float(a), float(a2))
+                    for st, k, a, a2 in json.loads(s)])
+
+
+class FaultInjector:
+    """Drives a :class:`FaultSchedule` against a live training run.
+
+    The supervisor calls :meth:`on_step` at the top of every step (arming
+    one-shot van/data faults, killing/suspending shard subprocesses,
+    delivering the preemption signal) and :meth:`corrupt_batch` on the
+    fetched batch.  ``install()`` hooks the van client ops; always pair
+    with ``uninstall()`` (the supervisor does both).
+
+    ``counters`` tallies everything injected — the supervisor merges them
+    into its own counters so they flow out through ``MetricLogger``.
+    """
+
+    def __init__(self, schedule: FaultSchedule, *, shard_procs=(),
+                 pid: int | None = None):
+        self.schedule = schedule
+        self.shard_procs = list(shard_procs)  # subprocess.Popen-likes
+        self.pid = int(pid) if pid is not None else os.getpid()
+        self.counters = defaultdict(int)
+        self._armed_van = deque()   # one-shot ("error"|"delay", arg)
+        self._armed_data = 0
+        self._nan_armed = False
+        self._lock = threading.Lock()
+        self._prev_hook = None
+        self._installed = False
+
+    # ---- lifecycle ----
+    def install(self) -> "FaultInjector":
+        from hetu_tpu.ps import van
+        if not self._installed:
+            self._prev_hook = van.set_fault_hook(self._van_hook)
+            self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            from hetu_tpu.ps import van
+            van.set_fault_hook(self._prev_hook)
+            self._installed = False
+
+    # ---- van hook ----
+    def _van_hook(self, op: str) -> None:
+        with self._lock:
+            fault = self._armed_van.popleft() if self._armed_van else None
+        if fault is None:
+            if self._prev_hook is not None:
+                self._prev_hook(op)
+            return
+        kind, arg = fault
+        if kind == "delay":
+            self.counters["van_delays_injected"] += 1
+            time.sleep(arg)
+        else:
+            self.counters["van_errors_injected"] += 1
+            raise TransientFault(f"injected transient van fault before {op}")
+
+    # ---- per-step driver ----
+    def on_step(self, step: int) -> None:
+        for ev in self.schedule.at(step):
+            self.counters["faults_injected"] += 1
+            k = ev.kind
+            if k == "van_error":
+                with self._lock:
+                    self._armed_van.append(("error", 0.0))
+            elif k == "van_delay":
+                with self._lock:
+                    self._armed_van.append(("delay", ev.arg or 0.02))
+            elif k == "data_error":
+                with self._lock:
+                    self._armed_data += 1
+            elif k == "nan_grad":
+                self._nan_armed = True
+            elif k == "kill_shard":
+                self._kill(int(ev.arg))
+            elif k == "suspend_shard":
+                self._suspend(int(ev.arg), ev.arg2 or 0.3)
+            elif k == "preempt":
+                self.counters["preempts_injected"] += 1
+                os.kill(self.pid, signal.SIGTERM)
+
+    def _proc(self, idx: int):
+        if 0 <= idx < len(self.shard_procs):
+            return self.shard_procs[idx]
+        self.counters["shard_faults_skipped_no_proc"] += 1
+        return None
+
+    def _kill(self, idx: int) -> None:
+        p = self._proc(idx)
+        if p is None:
+            return
+        p.kill()
+        p.wait()
+        self.counters["shards_killed"] += 1
+
+    def _suspend(self, idx: int, duration_s: float) -> None:
+        p = self._proc(idx)
+        if p is None:
+            return
+        p.send_signal(signal.SIGSTOP)
+        self.counters["shards_suspended"] += 1
+        t = threading.Timer(duration_s,
+                            lambda: p.send_signal(signal.SIGCONT))
+        t.daemon = True
+        t.start()
+
+    # ---- batch plumbing ----
+    def corrupt_batch(self, step: int, batch):
+        """Poison the first float leaf with NaN when a ``nan_grad`` fault
+        is armed.  Returns the (possibly copied) batch."""
+        if not self._nan_armed:
+            return batch
+        self._nan_armed = False
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        for i, leaf in enumerate(leaves):
+            a = np.asarray(leaf)
+            if np.issubdtype(a.dtype, np.floating):
+                a = a.copy()
+                a.flat[0] = np.nan
+                leaves[i] = a
+                self.counters["nan_injected"] += 1
+                break
+        else:
+            self.counters["nan_skipped_no_float_leaf"] += 1
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def wrap_batch_fn(self, batch_fn):
+        """Wrap a ``batch_fn(step)`` so armed data faults raise
+        :class:`TransientDataError` once each (the retry then succeeds)."""
+        def wrapped(step):
+            with self._lock:
+                armed = self._armed_data > 0
+                if armed:
+                    self._armed_data -= 1
+            if armed:
+                self.counters["data_errors_injected"] += 1
+                raise TransientDataError(
+                    f"injected dataloader fault at step {step}")
+            return batch_fn(step)
+        return wrapped

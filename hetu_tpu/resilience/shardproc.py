@@ -1,0 +1,50 @@
+"""Spawn throwaway PS van server subprocesses.
+
+Shared by the chaos tests and ``bench.py resilience`` — the
+:class:`~hetu_tpu.resilience.faults.FaultInjector`'s kill/suspend targets
+are exactly these ``Popen`` handles, so keeping the bootstrap (inline
+script, READY handshake, port allocation) in ONE place keeps the harness
+and the bench from drifting apart.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parents[2]
+
+_SERVER_SRC = """\
+import sys, time
+sys.path.insert(0, {repo!r})
+from hetu_tpu.ps import van
+port = van.serve({port})
+print("READY", port, flush=True)
+time.sleep({lifetime})
+"""
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn_shard_server(workdir, port: int, tag: str = "s", *,
+                       lifetime_s: int = 600) -> subprocess.Popen:
+    """Start a van server subprocess on ``port``; blocks until it prints
+    READY (the server is accepting connections).  The caller owns the
+    returned ``Popen`` — kill()/wait() it (chaos does exactly that)."""
+    script = Path(workdir) / f"shard_server_{tag}.py"
+    script.write_text(_SERVER_SRC.format(repo=str(_REPO), port=int(port),
+                                         lifetime=int(lifetime_s)))
+    proc = subprocess.Popen([sys.executable, str(script)],
+                            stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    if not line.startswith("READY"):
+        proc.kill()
+        proc.wait()
+        raise RuntimeError(f"shard server failed to start: {line!r}")
+    return proc

@@ -1,0 +1,501 @@
+"""Fault-tolerant training supervisor over ``train.executor.Executor``.
+
+Closes the recovery contracts the lower layers explicitly punt to the
+caller:
+
+* ``ps/van.py`` (PartitionedPSTable docstring): a killed PS shard that
+  restarts blank is transparently re-created with FRESH-INIT weights and
+  ``recovered`` increments — "the caller decides whether to re-push
+  weights".  :class:`PSShardGuard` is that caller: it snapshots the table
+  on the checkpoint cadence and replays the recovered shard's rows via
+  ``sparse_set``, so a resurrected shard carries learned embeddings.
+* ``train/checkpoint.py``: atomic single-file save/load, but no retention
+  policy and no corrupt-file fallback.  :class:`CheckpointManager` adds
+  keep-K, a CRC32 sidecar, and newest-valid-wins restore.
+* ``train/executor.py``: the ``train_guarded`` subexecutor skips nonfinite
+  updates in-graph; :class:`Supervisor` counts the skips and aborts after
+  N consecutive.
+
+The supervisor's per-step loop is: injected faults (optional chaos
+harness) → shard-guard poll/repair → batch fetch (retried) → guarded
+train step → post-step hook (retried; skipped on a nonfinite step so
+poisoned gradients never reach the PS) → cadence checkpoint → preemption
+check.
+Retries use exponential backoff with seeded jitter and a transient-error
+predicate — van/PS transport failures and injected faults retry; real
+bugs raise immediately.
+
+SIGTERM (preemption) is handled cooperatively: the handler only sets a
+flag; at the END of the in-flight step the supervisor checkpoints
+(params + optimizer + RNG seed/seqnum, plus PS snapshots) and returns with
+``preempted=True``.  A later ``run()`` with the same ``ckpt_dir`` resumes
+at the exact step with the exact RNG state.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+import zlib
+from collections import defaultdict
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from hetu_tpu.train import checkpoint as ckpt
+from hetu_tpu.train.checkpoint import CheckpointCorruptError
+
+
+class NonFiniteAbort(RuntimeError):
+    """Too many consecutive nonfinite (NaN/Inf) steps — the run is
+    diverged, not unlucky; aborting beats silently skipping forever.
+
+    ``state``/``step`` carry the last-finite training state (the guarded
+    step never let nonfinite values in), because the caller's own state
+    object was donated to the jitted step and is gone; with a
+    ``ckpt_dir`` the supervisor also checkpoints it before raising."""
+
+    def __init__(self, msg: str, *, state=None, step: int = -1):
+        super().__init__(msg)
+        self.state = state
+        self.step = step
+
+
+def default_is_transient(exc: BaseException) -> bool:
+    """Errors worth retrying: transport-level van/PS failures (a dead shard
+    mid-restart, a dropped connection, an injected fault) and flaky-data
+    errors.  Everything else — shape errors, OOM, real bugs — is not."""
+    from hetu_tpu.resilience.faults import TransientDataError
+    if isinstance(exc, (ConnectionError, TimeoutError, TransientDataError)):
+        return True  # TransientFault subclasses ConnectionError
+    # the native layer surfaces every failed wire op as
+    # RuntimeError("hetu_ps <op> failed with rc=..."); during a shard
+    # restart these clear once the heartbeat re-resolves the endpoint
+    return isinstance(exc, RuntimeError) and "hetu_ps" in str(exc)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint retention
+# ---------------------------------------------------------------------------
+
+class CheckpointManager:
+    """Atomic keep-K checkpoint directory with CRC sidecars.
+
+    ``save`` publishes ``ckpt-<step>.npz`` (checkpoint.save is atomic:
+    tmp + fsync + os.replace) plus a ``.crc`` sidecar holding
+    ``crc32 nbytes`` of the published file, then prunes to the newest
+    ``keep``.  ``restore`` walks newest→oldest, skipping any candidate
+    whose CRC mismatches or whose load raises
+    :class:`~hetu_tpu.train.checkpoint.CheckpointCorruptError` — a
+    preemption mid-save or bit rot costs at most one checkpoint interval,
+    never the run.
+    """
+
+    def __init__(self, directory, *, keep: int = 3, prefix: str = "ckpt"):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.keep = int(keep)
+        self.prefix = prefix
+        self.skipped: list[str] = []  # corrupt candidates seen by restore
+
+    def _path(self, step: int) -> Path:
+        return self.dir / f"{self.prefix}-{int(step):08d}.npz"
+
+    def steps(self) -> list[int]:
+        out = []
+        for p in self.dir.glob(f"{self.prefix}-*.npz"):
+            try:
+                out.append(int(p.stem.split("-")[-1]))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    @staticmethod
+    def _crc_file(path: Path) -> tuple[int, int]:
+        """Streamed (crc32, nbytes) — never the whole archive in RAM."""
+        crc = 0
+        n = 0
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    return crc, n
+                crc = zlib.crc32(chunk, crc)
+                n += len(chunk)
+
+    def save(self, state, step: int, *, extra: Optional[dict] = None) -> Path:
+        path = self._path(step)
+        ckpt.save(path, state, extra=extra)
+        crc, n = self._crc_file(path)
+        crc_tmp = path.with_suffix(".crc.tmp")
+        crc_tmp.write_text(f"{crc:08x} {n}\n")
+        crc_tmp.replace(path.with_suffix(".crc"))
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        for s in self.steps()[:-self.keep]:
+            self._path(s).unlink(missing_ok=True)
+            self._path(s).with_suffix(".crc").unlink(missing_ok=True)
+
+    def _crc_ok(self, path: Path) -> bool:
+        side = path.with_suffix(".crc")
+        if not side.exists():
+            return True  # no sidecar: can't judge here; load() still checks
+        try:
+            want_crc, want_n = side.read_text().split()
+            crc, n = self._crc_file(path)
+            return n == int(want_n) and crc == int(want_crc, 16)
+        except (OSError, ValueError):
+            return False
+
+    def restore(self, template, *, restore_rng: bool = True):
+        """Newest valid checkpoint → ``(state, step)``; None if none.
+
+        Only CORRUPTION falls back to an older checkpoint; a checkpoint
+        that loads but doesn't fit (wrong architecture, newer format)
+        raises — silently restarting from fresh weights because the
+        template changed is never what the caller meant."""
+        for step in reversed(self.steps()):
+            path = self._path(step)
+            if not self._crc_ok(path):
+                self.skipped.append(str(path))
+                continue
+            try:
+                state = ckpt.load(path, template, restore_rng=restore_rng)
+            except CheckpointCorruptError:
+                self.skipped.append(str(path))
+                continue
+            return state, step
+        return None
+
+
+# ---------------------------------------------------------------------------
+# PS shard snapshot / repair
+# ---------------------------------------------------------------------------
+
+class PSShardGuard:
+    """Snapshot + repair for one ``PartitionedPSTable``.
+
+    ``snapshot()`` (called on the supervisor's checkpoint cadence) pulls
+    each LIVE shard's row range into worker memory (and optionally persists
+    it, so a preempted-and-resumed worker can still repair).  ``poll()``
+    watches ``table.alive``/``table.recovered``: when a shard that died
+    comes back and the group re-created it blank (``recovered``
+    incremented), the guard replays that shard's snapshot rows via
+    ``sparse_set`` — only the recovered shard is touched, live shards never
+    rewind.
+
+    Limits (see README "Fault tolerance"): repair restores WEIGHTS as of
+    the last snapshot — updates since the snapshot and server-side
+    optimizer slots restart fresh; the checkpoint cadence bounds the loss.
+    An alive-flicker without a blank re-create (``recovered`` unchanged) is
+    left alone.
+    """
+
+    def __init__(self, table, *, snapshot_path=None, name: str = "pstable"):
+        self.table = table
+        self.name = name
+        self.snapshot_path = Path(snapshot_path) if snapshot_path else None
+        self._snap = None              # [rows, dim] f32, lazily allocated
+        self._have: set[int] = set()   # shard idx with valid snapshot rows
+        self._pending: set[int] = set()  # shards seen dead, awaiting repair
+        self._seen_recovered = int(table.recovered)
+        self.repairs = 0
+        if self.snapshot_path is not None and self.snapshot_path.exists():
+            z = np.load(self.snapshot_path)
+            self._snap = z["values"]
+            self._have = {int(i) for i in z["have"]}
+
+    def shard_rows(self, i: int) -> np.ndarray:
+        starts = self.table.shard_starts
+        hi = (starts[i + 1] if i + 1 < self.table.n_servers
+              else self.table.rows)
+        return np.arange(starts[i], hi, dtype=np.int64)
+
+    def snapshot(self) -> int:
+        """Snapshot every live shard; returns how many shards captured.
+        Dead shards keep their previous snapshot rows (that is the data the
+        repair will need) and are queued for repair."""
+        if self._snap is None:
+            self._snap = np.zeros((self.table.rows, self.table.dim),
+                                  np.float32)
+        captured = 0
+        alive = self.table.alive
+        for i, a in enumerate(alive):
+            if not a:
+                self._pending.add(i)
+                continue
+            rows = self.shard_rows(i)
+            try:
+                self._snap[rows] = self.table.sparse_pull(rows)
+            except (RuntimeError, ConnectionError, TimeoutError):
+                self._pending.add(i)  # died between the mask and the pull
+                continue
+            self._have.add(i)
+            captured += 1
+        if self.snapshot_path is not None and captured:
+            tmp = self.snapshot_path.with_name(self.snapshot_path.name
+                                               + ".tmp")
+            with open(tmp, "wb") as f:
+                np.savez(f, values=self._snap,
+                         have=np.asarray(sorted(self._have), np.int64))
+            tmp.replace(self.snapshot_path)
+        return captured
+
+    def poll(self) -> int:
+        """Detect died→alive shards; replay snapshots into any the group
+        re-created blank.  Returns repairs performed now.
+
+        Attribution: ``recovered`` is one GLOBAL counter, so each blank
+        re-create must be CLAIMED by exactly one pending shard — a bump
+        observed across a shard's own probe is attributed to that shard;
+        an unclaimed earlier bump (a training op touched the resurrected
+        shard between polls) is claimed by the first pending shard that
+        probes clean.  An alive-flicker whose incarnation never changed
+        claims nothing and is left alone; only when a flickered and a
+        re-created shard race the SAME poll and the flickered one probes
+        first can a spurious rewind (bounded by the snapshot cadence)
+        still happen."""
+        t = self.table
+        alive = t.alive
+        for i, a in enumerate(alive):
+            if not a:
+                self._pending.add(i)
+        done = 0
+        seen = self._seen_recovered  # re-creates already claimed
+        for i in sorted(self._pending):
+            if not alive[i]:
+                continue
+            rows = self.shard_rows(i)
+            rec_before = int(t.recovered)
+            try:
+                # the probe forces the group's lazy shard re-create (a
+                # blank restarted server answers 'no table' until then)
+                t.sparse_pull(rows[:1])
+            except (RuntimeError, ConnectionError, TimeoutError):
+                continue  # still coming up — next poll
+            rec_after = int(t.recovered)
+            if rec_after > rec_before:
+                recreated = True           # this probe triggered it
+                seen += rec_after - rec_before
+            elif rec_before > seen:
+                recreated = True           # claim one unattributed bump
+                seen += 1
+            else:
+                recreated = False          # flicker: data intact
+            if recreated and i in self._have:
+                t.sparse_set(rows, self._snap[rows])
+                done += 1
+                self.repairs += 1
+            self._pending.discard(i)
+        if self._pending:
+            self._seen_recovered = max(seen, self._seen_recovered)
+        else:
+            # nothing left to claim a bump: fold fully forward so a
+            # death+restart that happened entirely between polls (never
+            # observed dead, re-created by a training op, unrepairable
+            # anyway) can't misattribute to a future flicker
+            self._seen_recovered = max(self._seen_recovered,
+                                       int(t.recovered))
+        return done
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SupervisorReport:
+    """What a ``run()`` did: the final state, where it stopped, whether a
+    preemption cut it short, and the resilience counters."""
+
+    state: Any
+    step: int
+    preempted: bool
+    counters: dict = field(default_factory=dict)
+    last_metrics: dict = field(default_factory=dict)
+
+
+class Supervisor:
+    """Wraps an :class:`~hetu_tpu.train.executor.Executor` with checkpoint
+    retention, per-step retry, a nonfinite guard, PS shard repair, and
+    cooperative preemption.  See the module docstring for the loop shape.
+
+    ``run(state, batch_fn, steps)`` drives ``batch_fn(step_index)`` →
+    ``executor.run('train_guarded', ...)`` until ``state.step == steps``;
+    ``post_step(step, state, metrics, batch)`` (optional) carries hybrid
+    PS work (e.g. embedding-gradient pushes) inside the retry envelope.
+    """
+
+    def __init__(self, executor, *, ckpt_dir=None, ckpt_every: int = 0,
+                 keep: int = 3, retries: int = 8,
+                 backoff_base_s: float = 0.05, backoff_max_s: float = 2.0,
+                 backoff_jitter: float = 0.25, seed: int = 0,
+                 nonfinite_limit: int = 3, injector=None, guards=(),
+                 logger=None, is_transient: Optional[Callable] = None,
+                 preempt_signals=(signal.SIGTERM,)):
+        self.executor = executor
+        self.manager = (CheckpointManager(ckpt_dir, keep=keep)
+                        if ckpt_dir is not None else None)
+        self.ckpt_every = int(ckpt_every)
+        self.retries = int(retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.backoff_jitter = float(backoff_jitter)
+        self.nonfinite_limit = int(nonfinite_limit)
+        self.injector = injector
+        self.guards = list(guards)
+        self.logger = logger
+        self.preempt_signals = tuple(preempt_signals)
+        self._is_transient = is_transient or default_is_transient
+        self._jitter_rng = np.random.default_rng(seed)
+        self.counters: dict = defaultdict(int)
+        self._preempt = threading.Event()
+
+    # ---- retry envelope ----
+    def _with_retries(self, fn, what: str):
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:
+                if not self._is_transient(e) or attempt >= self.retries:
+                    raise
+                delay = min(self.backoff_base_s * (2.0 ** attempt),
+                            self.backoff_max_s)
+                delay *= 1.0 + self.backoff_jitter * float(
+                    self._jitter_rng.random())
+                self.counters["retries"] += 1
+                self.counters[f"retries_{what}"] += 1
+                self._log_inc("retries")
+                time.sleep(delay)
+                attempt += 1
+
+    def _log_inc(self, name: str, n: int = 1) -> None:
+        if self.logger is not None and hasattr(self.logger, "inc"):
+            self.logger.inc(name, n)
+
+    # ---- preemption ----
+    def _on_signal(self, signum, frame) -> None:
+        # only set a flag: the in-flight step finishes, then we checkpoint
+        self._preempt.set()
+        self.counters["preempt_signals"] += 1
+
+    # ---- checkpoint + snapshots ----
+    def _checkpoint(self, state, step: int) -> None:
+        t0 = time.perf_counter()
+        if self.manager is not None:
+            self.manager.save(state, step)
+        for g in self.guards:
+            try:
+                g.snapshot()
+                self.counters["shard_snapshots"] += 1
+            except (RuntimeError, ConnectionError, TimeoutError):
+                self.counters["shard_snapshot_errors"] += 1
+        dt = time.perf_counter() - t0
+        self.counters["checkpoints"] += 1
+        self.counters["checkpoint_latency_s_last"] = dt
+        self._log_inc("checkpoints")
+        if self.logger is not None:
+            self.logger.log({"checkpoint_latency_s": dt}, step=step)
+
+    # ---- the loop ----
+    def run(self, state, batch_fn: Callable[[int], Any], steps: int, *,
+            post_step: Optional[Callable] = None,
+            resume: bool = True) -> SupervisorReport:
+        self._preempt.clear()  # a prior run's preemption must not leak in
+        if self.injector is not None:
+            batch_fn = self.injector.wrap_batch_fn(batch_fn)
+            self.injector.install()
+
+        step_i = int(np.asarray(state.step))
+        if resume and self.manager is not None:
+            got = self.manager.restore(state)
+            if self.manager.skipped:
+                # recorded even when NOTHING restored: "found checkpoints
+                # and rejected every one" must never be silent
+                self.counters["corrupt_checkpoints_skipped"] = \
+                    len(self.manager.skipped)
+                self._log_inc("corrupt_checkpoints_skipped",
+                              len(self.manager.skipped))
+            if got is not None:
+                state, step_i = got
+                self.counters["resumed_from_step"] = step_i
+
+        old_handlers = {}
+        try:
+            for sg in self.preempt_signals:
+                old_handlers[sg] = signal.signal(sg, self._on_signal)
+        except ValueError:
+            old_handlers = {}  # not the main thread: injector-driven
+            # preemption still works via an externally-installed handler
+
+        nonfinite_run = 0
+        preempted = False
+        metrics: dict = {}
+        try:
+            while step_i < int(steps):
+                if self.injector is not None:
+                    self.injector.on_step(step_i)
+                for g in self.guards:
+                    repaired = self._with_retries(g.poll, "guard")
+                    if repaired:
+                        self.counters["shard_repairs"] += repaired
+                        self._log_inc("shard_repairs", repaired)
+                batch = self._with_retries(lambda: batch_fn(step_i), "data")
+                if self.injector is not None:
+                    batch = self.injector.corrupt_batch(step_i, batch)
+                state, metrics = self.executor.run("train_guarded", state,
+                                                   batch)
+                nonfinite = int(np.asarray(metrics.get("nonfinite", 0)))
+                if nonfinite:
+                    nonfinite_run += 1
+                    self.counters["nonfinite_steps_skipped"] += 1
+                    self._log_inc("nonfinite_steps_skipped")
+                    if nonfinite_run >= self.nonfinite_limit:
+                        # the caller's own state object was donated to the
+                        # jitted step — preserve the last-finite state
+                        # (checkpoint if we can, always on the exception)
+                        if self.manager is not None:
+                            self._checkpoint(state, step_i)
+                        raise NonFiniteAbort(
+                            f"{nonfinite_run} consecutive nonfinite steps "
+                            f"ending at step {step_i} — loss diverged or "
+                            "data is poisoned; aborting (exception .state "
+                            "holds the last finite values)",
+                            state=state, step=step_i)
+                else:
+                    nonfinite_run = 0
+                    if post_step is not None:
+                        self._with_retries(
+                            lambda: post_step(step_i, state, metrics,
+                                              batch), "post_step")
+                step_i += 1
+                self.counters["steps"] += 1
+                if (self.ckpt_every and step_i % self.ckpt_every == 0
+                        and step_i < int(steps)):
+                    self._checkpoint(state, step_i)
+                if self._preempt.is_set():
+                    self._checkpoint(state, step_i)
+                    preempted = True
+                    break
+        finally:
+            for sg, h in old_handlers.items():
+                signal.signal(sg, h)
+            if self.injector is not None:
+                self.injector.uninstall()
+                for k, v in self.injector.counters.items():
+                    self.counters[k] = v
+            if self.logger is not None:
+                snap = {k: float(v) for k, v in self.counters.items()}
+                self.logger.log(snap, step=step_i)
+        if not preempted and self.ckpt_every and self.manager is not None:
+            self._checkpoint(state, step_i)  # final: resume == completed
+        return SupervisorReport(state=state, step=step_i,
+                                preempted=preempted,
+                                counters=dict(self.counters),
+                                last_metrics=metrics)

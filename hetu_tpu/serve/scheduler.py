@@ -67,16 +67,19 @@ class ContinuousBatchingScheduler:
         self._queue = deque()
         self._running = {}   # slot -> Request
         self._accepting = True
+        self._reject_status = "shutdown"  # status for post-drain submits
 
     # ---- request intake ----
     def submit(self, request: Request) -> Request:
         request.submitted_at = time.monotonic()
         with self._lock:
             if not self._accepting:
-                # shutdown already drained the queue and the engine loop
-                # is gone — complete immediately so the submitting
-                # listener doesn't park on a request nothing will serve
-                self._finish(request, "shutdown")
+                # a drain already stopped intake and the engine loop is
+                # gone — complete immediately with that drain's status
+                # ('shutdown', or 'error' for a dead engine) so the
+                # submitting listener doesn't park on a request nothing
+                # will serve
+                self._finish(request, self._reject_status)
                 return request
             request.state = "queued"
             self._queue.append(request)
@@ -152,7 +155,23 @@ class ContinuousBatchingScheduler:
             slot = self.engine.alloc_slot()
             req.slot = slot
             req.state = "running"
-            first = self.engine.prefill(slot, req.prompt)
+            try:
+                first = self.engine.prefill(slot, req.prompt)
+            except Exception:
+                # a prefill blow-up must not orphan the request: at this
+                # point it is in NEITHER the queue NOR _running, so the
+                # engine loop's drain("error") could never find it — the
+                # client would hang out its full timeout undiagnosed.
+                # Fail it FIRST (req.done must be set even if the broken
+                # engine's release also throws), then free the slot
+                # best-effort, then let the loop count the error.
+                self._finish(req, "error")
+                completed.append(req)
+                try:
+                    self.engine.release(slot)
+                except Exception:
+                    pass  # engine already broken; the loop records that
+                raise
             req.tokens.append(first)
             req.first_token_at = time.monotonic()
             self.metrics.observe_ttft(req.ttft_s)
@@ -206,6 +225,7 @@ class ContinuousBatchingScheduler:
         with self._lock:
             if stop_accepting:
                 self._accepting = False
+                self._reject_status = status
             while self._queue:
                 self._finish(self._queue.popleft(), status)
             for slot, req in list(self._running.items()):

@@ -45,10 +45,14 @@ class InferenceServer:
     def __init__(self, scheduler: ContinuousBatchingScheduler, *,
                  port: int = 0, max_clients: int = 4,
                  request_timeout_s: float = 60.0,
-                 poll_s: float = 0.25, own_van: bool = True):
+                 poll_s: float = 0.25, own_van: bool = True,
+                 max_loop_errors: int = 3):
         """port=0 picks a free port; ``own_van=False`` attaches to a van
         already serving in this process (the server then must be handed
-        that van's port)."""
+        that van's port).  ``max_loop_errors`` consecutive engine-loop
+        exceptions (no successful step in between) declare the engine dead:
+        the loop exits, queued/new requests fail fast with status 'error',
+        and ``healthy`` turns False."""
         from hetu_tpu.ps import van
         self._van = van
         self.scheduler = scheduler
@@ -56,6 +60,7 @@ class InferenceServer:
         self.request_timeout_s = float(request_timeout_s)
         self._poll_s = float(poll_s)
         self._own_van = own_van
+        self._max_loop_errors = int(max_loop_errors)
         if own_van:
             self.port = van.serve(port)
         else:
@@ -64,6 +69,7 @@ class InferenceServer:
             self.port = port
         self._stop = threading.Event()
         self.last_loop_error = None
+        self._loop_dead = False
         self._loop = threading.Thread(target=self._engine_loop, daemon=True)
         self._listeners = [
             threading.Thread(target=self._listen, args=(cid,), daemon=True)
@@ -72,12 +78,23 @@ class InferenceServer:
         for t in self._listeners:
             t.start()
 
+    @property
+    def healthy(self) -> bool:
+        """True while the engine loop is alive and serving.  False once the
+        loop gave up after ``max_loop_errors`` consecutive failures, died
+        some other way, or the server was closed — callers should stop
+        sending and restart/replace the server.  ``last_loop_error`` holds
+        the final traceback when the engine failed."""
+        return self._loop.is_alive() and not self._loop_dead
+
     # ---- engine loop ----
     def _engine_loop(self) -> None:
+        consecutive = 0
         while not self._stop.is_set():
             try:
                 if self.scheduler.has_work():
                     self.scheduler.step()
+                    consecutive = 0
                 else:
                     time.sleep(0.002)
             except Exception:
@@ -89,7 +106,18 @@ class InferenceServer:
                 self.last_loop_error = traceback.format_exc()
                 traceback.print_exc()
                 self.metrics.inc("engine_loop_errors")
-                self.scheduler.drain("error")
+                consecutive += 1
+                dead = consecutive >= self._max_loop_errors
+                try:
+                    # dead engine: also stop intake, so every later submit
+                    # fails fast with 'error' instead of parking a listener
+                    self.scheduler.drain("error", stop_accepting=dead)
+                except Exception:
+                    traceback.print_exc()  # never let cleanup kill the loop
+                if dead:
+                    self._loop_dead = True
+                    self.metrics.inc("engine_loop_dead")
+                    return
 
     # ---- one listener per client channel pair ----
     def _listen(self, cid: int) -> None:

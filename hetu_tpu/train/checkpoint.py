@@ -18,6 +18,8 @@ this built-in format keeps zero deps and byte-stable tests.)
 from __future__ import annotations
 
 import json
+import os
+import zipfile
 from pathlib import Path
 from typing import Any, Optional
 
@@ -27,6 +29,18 @@ import numpy as np
 from hetu_tpu import rng as hrng
 
 _FORMAT_VERSION = 2
+
+
+class CheckpointError(ValueError):
+    """A checkpoint could not be loaded (corrupt file or format/shape
+    mismatch).  Subclasses ValueError so pre-existing callers that caught
+    ValueError keep working."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The file on disk is not a readable checkpoint: truncated write,
+    bit rot, or garbage bytes.  Resume paths (resilience.CheckpointManager)
+    catch this and fall back to the previous checkpoint."""
 
 
 def state_dict(state) -> dict:
@@ -85,42 +99,71 @@ def save(path, state, *, extra: Optional[dict] = None) -> None:
     arrays["header"] = np.frombuffer(
         json.dumps(header, default=_json_default).encode("utf-8"),
         dtype=np.uint8)
-    with open(path, "wb") as f:
-        np.savez(f, **arrays)
+    # Atomic publish: a crash/preemption mid-write must never destroy the
+    # previous checkpoint at `path`.  Write the whole archive to a sibling
+    # tmp file, fsync it, then os.replace (atomic on POSIX within one fs).
+    tmp = path.with_name(path.name + ".tmp")
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if tmp.exists():  # failed write: don't litter tmp files
+            tmp.unlink()
 
 
 def load(path, state_template, *, restore_rng: bool = True):
     """Restore into the structure (and shardings) of `state_template`."""
     try:
         z = np.load(path, allow_pickle=False)
+    except zipfile.BadZipFile as e:
+        raise CheckpointCorruptError(
+            f"{path} is truncated or corrupt (not a readable npz archive: "
+            f"{e}) — a crash mid-write or disk corruption; resume from an "
+            "older checkpoint") from e
     except ValueError as e:
-        raise ValueError(
-            f"{path} is not a v2 (npz) checkpoint — v1 checkpoints were "
-            "pickle files; re-save with this version's save() (v1 loading is "
-            "not supported because unpickling executes arbitrary code)"
-        ) from e
+        raise CheckpointCorruptError(
+            f"{path} is not a v2 (npz) checkpoint ({e}) — either corrupt "
+            "bytes, or a v1 pickle checkpoint (v1 loading is not supported "
+            "because unpickling executes arbitrary code; re-save with this "
+            "version's save())") from e
     with z:
-        header = json.loads(bytes(z["header"]).decode("utf-8"))
+        try:
+            header = json.loads(bytes(z["header"]).decode("utf-8"))
+        except (KeyError, UnicodeDecodeError, json.JSONDecodeError,
+                zipfile.BadZipFile) as e:
+            raise CheckpointCorruptError(
+                f"{path}: checkpoint header missing or unreadable ({e}) — "
+                "truncated or corrupt file") from e
         if header["version"] > _FORMAT_VERSION:
-            raise ValueError(
+            raise CheckpointError(
                 f"checkpoint format version {header['version']} is newer "
                 f"than supported ({_FORMAT_VERSION})")
         leaves = []
-        for i in range(header["n_leaves"]):
-            arr = z[f"leaf_{i}"]
-            dtype = _lookup_dtype(header["dtypes"][i])
-            if arr.dtype != dtype:  # raw-bytes path (or |V from v2 files)
-                arr = np.frombuffer(arr.tobytes(), dtype).reshape(
-                    header["shapes"][i])
-            leaves.append(arr)
+        try:
+            for i in range(header["n_leaves"]):
+                arr = z[f"leaf_{i}"]
+                dtype = _lookup_dtype(header["dtypes"][i])
+                if arr.dtype != dtype:  # raw-bytes path (or |V from v2)
+                    arr = np.frombuffer(arr.tobytes(), dtype).reshape(
+                        header["shapes"][i])
+                leaves.append(arr)
+        except (KeyError, zipfile.BadZipFile, OSError) as e:
+            # missing members / zip CRC mismatch / short reads all mean the
+            # archive body is damaged even though the directory parsed
+            raise CheckpointCorruptError(
+                f"{path}: checkpoint data is truncated or corrupt ({e})"
+            ) from e
     leaves_t, treedef = jax.tree_util.tree_flatten(state_template)
     if len(leaves) != len(leaves_t):
-        raise ValueError(
+        raise CheckpointError(
             f"checkpoint has {len(leaves)} leaves, template {len(leaves_t)}")
     out = []
     for i, (arr, tmpl) in enumerate(zip(leaves, leaves_t)):
         if hasattr(tmpl, "shape") and tuple(arr.shape) != tuple(tmpl.shape):
-            raise ValueError(
+            raise CheckpointError(
                 f"checkpoint leaf {i} shape {arr.shape} != template "
                 f"{tuple(tmpl.shape)} — wrong architecture?")
         if hasattr(tmpl, "dtype") and arr.dtype != tmpl.dtype:

@@ -155,6 +155,35 @@ class Executor:
         metrics["loss"] = loss
         return new_state, metrics
 
+    def _train_step_guarded(self, state: TrainState, batch):
+        """Train step with an in-graph nonfinite guard (resilience tier).
+
+        A poisoned batch or an exploding update yields NaN/Inf loss or
+        params; this variant keeps the PRE-step params/opt/model state in
+        that case (jnp.where select — a few elementwise reductions, cheap
+        next to the step itself) and reports ``metrics['nonfinite']`` so
+        the supervisor can count-and-abort.  The step counter and RNG still
+        advance on a skipped step, so training moves PAST the poisoned
+        batch instead of retrying it forever.
+        """
+        new_state, metrics = self._train_step(state, batch)
+        ok = jnp.isfinite(metrics["loss"])
+        for leaf in jax.tree_util.tree_leaves(new_state.params):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                ok &= jnp.all(jnp.isfinite(leaf))
+        keep = lambda n, o: jnp.where(ok, n, o)  # noqa: E731
+        guarded = TrainState(
+            params=jax.tree_util.tree_map(keep, new_state.params,
+                                          state.params),
+            opt_state=jax.tree_util.tree_map(keep, new_state.opt_state,
+                                             state.opt_state),
+            model_state=jax.tree_util.tree_map(keep, new_state.model_state,
+                                               state.model_state),
+            rng=new_state.rng, step=new_state.step)
+        metrics = dict(metrics)
+        metrics["nonfinite"] = (~ok).astype(jnp.int32)
+        return guarded, metrics
+
     def _eval_step(self, state: TrainState, batch):
         loss, (metrics, _) = self.loss_fn(state.params, state.model_state,
                                           batch, state.rng, False)
@@ -163,10 +192,12 @@ class Executor:
         return metrics
 
     def _compile(self, name: str):
-        if name == "train":
+        if name in ("train", "train_guarded"):
             if self.optimizer is None:
-                raise ValueError("train subexecutor needs an optimizer")
-            fn, donate = self._train_step, (0,)
+                raise ValueError(f"{name} subexecutor needs an optimizer")
+            fn = (self._train_step_guarded if name == "train_guarded"
+                  else self._train_step)
+            donate = (0,)
         elif name in ("validate", "eval", "test"):
             fn, donate = self._eval_step, ()
         else:

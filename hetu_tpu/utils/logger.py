@@ -21,6 +21,7 @@ class MetricLogger:
                  use_wandb: bool = False, wandb_kwargs: Optional[dict] = None):
         self.totals = defaultdict(float)
         self.counts = defaultdict(int)
+        self.counters = defaultdict(int)  # monotonic event counters
         self.step = 0
         self.t0 = time.perf_counter()
         self.log_file = open(log_path, "a") if log_path else None
@@ -47,6 +48,16 @@ class MetricLogger:
                    **{k: float(v) for k, v in metrics.items()}}
             self.log_file.write(json.dumps(rec) + "\n")
             self.log_file.flush()
+
+    def inc(self, name: str, n: int = 1) -> int:
+        """Bump a monotonic event counter (fault injected, retry, shard
+        repair, ...) — unlike ``log`` scalars these are never averaged;
+        ``counters_snapshot`` folds them into one loggable record."""
+        self.counters[name] += int(n)
+        return self.counters[name]
+
+    def counters_snapshot(self) -> dict:
+        return dict(self.counters)
 
     def means(self) -> dict:
         return {k: self.totals[k] / max(self.counts[k], 1)

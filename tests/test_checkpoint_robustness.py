@@ -1,0 +1,98 @@
+"""Checkpoint write/read robustness: a preemption mid-save must never
+destroy the previous recovery point (atomic tmp + fsync + os.replace),
+and a truncated/garbage file must raise a CLEAR CheckpointCorruptError —
+not a bare zipfile/KeyError — so resume paths can fall back instead of
+crashing on diagnosis.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hetu_tpu.train import checkpoint
+from hetu_tpu.train.checkpoint import (
+    CheckpointCorruptError, CheckpointError,
+)
+
+
+def _state(seed=0):
+    g = np.random.default_rng(seed)
+    return {"w": g.standard_normal((4, 3)).astype(np.float32),
+            "b": g.standard_normal(3).astype(np.float32)}
+
+
+def test_roundtrip_still_works(tmp_path):
+    s = _state()
+    p = tmp_path / "ckpt.npz"
+    checkpoint.save(p, s)
+    out = checkpoint.load(p, _state(seed=9))
+    np.testing.assert_array_equal(out["w"], s["w"])
+    np.testing.assert_array_equal(out["b"], s["b"])
+
+
+def test_crashed_save_preserves_previous_checkpoint(tmp_path, monkeypatch):
+    """Simulated crash mid-write: np.savez dies after emitting partial
+    bytes.  The published path must still hold the OLD checkpoint, and no
+    .tmp litter may remain."""
+    p = tmp_path / "ckpt.npz"
+    old = _state(seed=1)
+    checkpoint.save(p, old)
+
+    real_savez = np.savez
+
+    def dying_savez(f, **arrays):
+        f.write(b"partial garbage bytes")
+        raise OSError("disk gone / preempted mid-write")
+
+    monkeypatch.setattr(np, "savez", dying_savez)
+    with pytest.raises(OSError):
+        checkpoint.save(p, _state(seed=2))
+    monkeypatch.setattr(np, "savez", real_savez)
+
+    assert not list(tmp_path.glob("*.tmp")), "tmp litter left behind"
+    out = checkpoint.load(p, _state(seed=9))
+    np.testing.assert_array_equal(out["w"], old["w"])
+
+
+def test_truncated_checkpoint_raises_clear_error(tmp_path):
+    p = tmp_path / "ckpt.npz"
+    checkpoint.save(p, _state())
+    data = p.read_bytes()
+    p.write_bytes(data[: int(len(data) * 0.6)])  # crash-simulated partial
+    with pytest.raises(CheckpointCorruptError) as ei:
+        checkpoint.load(p, _state())
+    assert "corrupt" in str(ei.value).lower() or \
+        "truncat" in str(ei.value).lower()
+
+
+def test_garbage_bytes_raise_clear_error(tmp_path):
+    p = tmp_path / "ckpt.npz"
+    p.write_bytes(os.urandom(256))
+    with pytest.raises(CheckpointCorruptError):
+        checkpoint.load(p, _state())
+
+
+def test_flipped_payload_bytes_detected(tmp_path):
+    """Bit rot inside the archive body (zip member CRC mismatch) must also
+    surface as CheckpointCorruptError."""
+    p = tmp_path / "ckpt.npz"
+    checkpoint.save(p, _state())
+    data = bytearray(p.read_bytes())
+    # corrupt a run of bytes past the zip local headers
+    mid = len(data) // 2
+    for i in range(mid, mid + 32):
+        data[i] ^= 0xFF
+    p.write_bytes(bytes(data))
+    with pytest.raises((CheckpointCorruptError, CheckpointError)):
+        checkpoint.load(p, _state())
+
+
+def test_shape_mismatch_is_checkpoint_error_not_corrupt(tmp_path):
+    p = tmp_path / "ckpt.npz"
+    checkpoint.save(p, _state())
+    bad_template = {"w": np.zeros((5, 5), np.float32),
+                    "b": np.zeros(3, np.float32)}
+    with pytest.raises(CheckpointError) as ei:
+        checkpoint.load(p, bad_template)
+    assert not isinstance(ei.value, CheckpointCorruptError)

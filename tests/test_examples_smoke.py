@@ -41,6 +41,7 @@ CASES = [
     ("ps_multiserver_embedding", [], "done"),
     ("mpmd_unequal_dp", ["--steps", "1"], "MPMD 3-stage"),
     ("gpt_serve", ["--requests", "4", "--max-tokens", "8"], "serve: OK"),
+    ("resilient_train", ["--steps", "30"], "resilient train: OK"),
 ]
 
 

@@ -6,6 +6,7 @@ across serve() incarnations in one process).
 
 import json
 import threading
+import time
 
 import jax
 import numpy as np
@@ -167,6 +168,72 @@ def test_malformed_request_gets_error_response(server):
     finally:
         ch_req.close()
         ch_resp.close()
+
+
+class _BoomEngine:
+    """Engine double whose prefill always blows up — the 'unexpected
+    engine-loop exception' case the server must survive visibly."""
+
+    class _Cache:
+        num_slots = 2
+        max_len = 16
+        num_free = 2
+        active_tokens = 0
+        occupancy = 0.0
+        lengths = [0, 0]
+
+    def __init__(self):
+        from hetu_tpu.serve.metrics import ServeMetrics
+        self.cache = self._Cache()
+        self.metrics = ServeMetrics()
+
+    def alloc_slot(self):
+        return 0
+
+    def release(self, slot):
+        pass
+
+    def prefill(self, slot, prompt):
+        raise RuntimeError("boom: engine exploded mid-step")
+
+    def decode(self):
+        raise RuntimeError("boom: engine exploded mid-step")
+
+
+def test_dead_engine_fails_requests_and_reports_unhealthy():
+    """An engine whose step raises must NOT leave clients timing out with
+    no diagnosis: in-flight requests get an 'error' response, the loop
+    gives up after max_loop_errors consecutive failures, `healthy` flips
+    False, and later requests fail fast instead of parking listeners."""
+    sched = ContinuousBatchingScheduler(_BoomEngine())
+    srv = InferenceServer(sched, max_clients=1, poll_s=0.05,
+                          request_timeout_s=10.0, max_loop_errors=3)
+    client = InferenceClient("127.0.0.1", srv.port, 0)
+    try:
+        assert srv.healthy
+        # every request fails with 'error' (never a hang, never a timeout);
+        # a request can ride a PREVIOUS error's drain without triggering
+        # its own step, so loop until the errors accumulate to death —
+        # nothing ever resets the consecutive count (no step succeeds)
+        deadline = time.monotonic() + 30
+        while srv.healthy and time.monotonic() < deadline:
+            resp = client.generate([1, 2, 3], max_tokens=4, timeout_s=20.0)
+            assert resp["status"] == "error"
+            assert resp["tokens"] == []
+            time.sleep(0.05)
+        assert not srv.healthy
+        assert "boom" in srv.last_loop_error
+        assert srv.metrics.count("engine_loop_errors") == 3
+        assert srv.metrics.count("engine_loop_dead") == 1
+        # dead engine: requests now fail fast (scheduler rejects with the
+        # drain's 'error' status; nothing waits out a timeout)
+        t0 = time.monotonic()
+        resp = client.generate([4, 5], max_tokens=4, timeout_s=20.0)
+        assert resp["status"] == "error"
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        client.close()
+        srv.close()
 
 
 def test_van_stats_reset_across_serve_incarnations():
