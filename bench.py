@@ -708,6 +708,153 @@ def bench_resilience():
     })
 
 
+def bench_elastic():
+    """ElasticSupervisor steady-state overhead vs bare Supervisor (<2%
+    target) plus single-worker-loss downtime.
+
+    Two measurements, one report:
+
+    * steady state: the SAME model/batch driven by a bare ``Supervisor``
+      and an ``ElasticSupervisor`` at a fixed width — the delta is the
+      elastic layer's per-step bookkeeping (membership drain, guard-
+      promotion scan), nothing else changes;
+    * downtime: a seeded ``worker_loss`` (and a later ``worker_join``)
+      mid-run.  Downtime = detect → resharded (``ResizeEvent.downtime_s``:
+      host snapshot + mesh reform + re-place) PLUS the next completed
+      step (re-jit at the new width + the step itself), measured from
+      per-step timestamps around the batch fetch.  Reported against a
+      printed wall-clock budget.
+    """
+    import os
+
+    import hetu_tpu as ht
+    from hetu_tpu import layers, optim
+    from hetu_tpu.data.dataloader import ElasticBatchSchedule
+    from hetu_tpu.parallel.mesh import MeshConfig, elastic_mesh
+    from hetu_tpu.resilience import (
+        ElasticSupervisor, FaultEvent, FaultInjector, FaultSchedule,
+        Supervisor,
+    )
+    from hetu_tpu.train.executor import Executor
+
+    smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
+    STEPS = 40 if smoke else 200
+    WARM = 5 if smoke else 20
+    H = 256 if smoke else 1024
+    W = min(4, max(len(jax.devices()), 1))
+    BUDGET_S = 60.0 if smoke else 30.0
+    B = 24 * W  # divisible by every width 1..W for W <= 4
+
+    g = np.random.default_rng(0)
+    X = g.standard_normal((8 * B, 64)).astype(np.float32)
+    Y = g.integers(0, 32, 8 * B).astype(np.int32)
+    sched = ElasticBatchSchedule((X, Y), B, seed=0)
+
+    def make():
+        model = layers.Sequential(
+            layers.Linear(64, H), layers.Relu(), layers.Linear(H, H),
+            layers.Relu(), layers.Linear(H, 32))
+
+        def loss_fn(params, model_state, batch, rng, train):
+            out, new_state = model.apply(
+                {"params": params, "state": model_state}, batch["x"],
+                train=train, rng=rng)
+            loss = jnp.mean(
+                ht.ops.softmax_cross_entropy_sparse(out, batch["y"]))
+            return loss, ({}, new_state)
+
+        ex = Executor(loss_fn, optim.AdamOptimizer(1e-3), seed=0)
+        state = ex.init_state(model.init(jax.random.PRNGKey(0)))
+        return ex, state
+
+    def batch_fn(i):
+        x, y = sched.global_batch(i)
+        return {"x": x, "y": y}
+
+    # ---- steady-state A/B: bare Supervisor vs ElasticSupervisor ----
+    # interleaved rounds + min-of-rounds: the two arms run the same tiny
+    # step, so background contention between back-to-back loops would
+    # otherwise swamp the sub-ms bookkeeping delta being measured
+    ex, state = make()
+    ex.set_mesh(elastic_mesh(MeshConfig(dp=W), range(W)))
+    sup0 = Supervisor(ex)
+    state = sup0.run(state, batch_fn, WARM).state
+    ex1, state1 = make()
+    sup1 = ElasticSupervisor(ex1, config=MeshConfig(dp=W), schedule=sched)
+    state1 = sup1.run(state1, batch_fn, WARM).state
+
+    ROUNDS = 5
+    CH = max(STEPS // ROUNDS, 1)
+    bare_ts, elastic_ts = [], []
+    done = WARM
+    for r in range(ROUNDS):
+        t0 = time.perf_counter()
+        state = sup0.run(state, batch_fn, done + CH, resume=False).state
+        bare_ts.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        state1 = sup1.run(state1, batch_fn, done + CH, resume=False).state
+        elastic_ts.append(time.perf_counter() - t0)
+        done += CH
+    bare_s = float(np.median(bare_ts))
+    elastic_s = float(np.median(elastic_ts))
+    STEPS = CH  # per-round step count the timings cover
+
+    overhead_pct = (elastic_s / STEPS - bare_s / STEPS) \
+        / (bare_s / STEPS) * 100
+
+    # ---- downtime arm: shrink at k, regrow at m ----
+    extra = {
+        "steps": STEPS, "width": W,
+        "steps_per_s_bare_supervisor": round(STEPS / bare_s, 1),
+        "steps_per_s_elastic": round(STEPS / elastic_s, 1),
+        "downtime_budget_s": BUDGET_S,
+        "ab": {"optimized": "elastic_supervisor_steady_state",
+               "baseline": "bare_supervisor_same_model_same_mesh"},
+    }
+    if W >= 2:
+        k, m = STEPS // 3, 2 * STEPS // 3
+        faults = FaultSchedule([FaultEvent(k, "worker_loss", float(W - 1)),
+                                FaultEvent(m, "worker_join", float(W - 1))])
+        ex2, state2 = make()
+        sup2 = ElasticSupervisor(ex2, config=MeshConfig(dp=W),
+                                 schedule=sched,
+                                 injector=FaultInjector(faults))
+        step_t: dict = {}
+
+        def timed_batch_fn(i):
+            step_t[i] = time.perf_counter()
+            return batch_fn(i)
+
+        rep2 = sup2.run(state2, timed_batch_fn, STEPS)
+        assert rep2.step == STEPS and len(sup2.resizes) == 2
+        downtimes = []
+        for ev in sup2.resizes:
+            # detect→resharded (the resize itself, before the batch fetch)
+            # + resharded→next completed step (re-jit + step, bounded by
+            # the following step's batch-fetch timestamp)
+            nxt = step_t.get(ev.step + 1, step_t[ev.step])
+            downtimes.append(ev.downtime_s + (nxt - step_t[ev.step]))
+        extra.update({
+            "resizes": len(sup2.resizes),
+            "shrink_downtime_s": round(downtimes[0], 4),
+            "regrow_downtime_s": round(downtimes[1], 4),
+            "reshard_only_s": [round(e.downtime_s, 4)
+                               for e in sup2.resizes],
+            "within_budget": bool(max(downtimes) <= BUDGET_S),
+        })
+    else:
+        extra.update({"resizes": 0,
+                      "note": "single device: no width to shrink to"})
+
+    _emit({
+        "metric": "elastic_supervisor_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": "percent_overhead_vs_bare_supervisor",
+        "vs_baseline": round((STEPS / elastic_s) / (STEPS / bare_s), 4),
+        "extra": extra,
+    })
+
+
 def _measure_shard_recovery():
     """Kill one of two PS shard servers, restart it, and time from the
     kill to the guard's snapshot replay completing."""
@@ -773,6 +920,7 @@ _METRIC_BY_CMD = {
     "moe": "moe_block_bf16_train_mfu_1chip",
     "serve": "gpt_serve_decode_tokens_per_sec_1chip",
     "resilience": "resilience_supervisor_overhead_pct",
+    "elastic": "elastic_supervisor_overhead_pct",
 }
 
 
@@ -807,7 +955,8 @@ def main():
         _emit_stale_or_die(_METRIC_BY_CMD.get(cmd, _METRIC_BY_CMD["gpt"]))
     {"resnet": bench_resnet, "ctr": bench_ctr, "moe": bench_moe,
      "gpt_sweep": bench_gpt_sweep, "serve": bench_serve,
-     "resilience": bench_resilience}.get(cmd, bench_gpt)()
+     "resilience": bench_resilience,
+     "elastic": bench_elastic}.get(cmd, bench_gpt)()
 
 
 if __name__ == "__main__":

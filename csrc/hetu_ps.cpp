@@ -420,6 +420,56 @@ int ps_sparse_set(int id, const int64_t* idx, const float* vals, int64_t n) {
   return 0;
 }
 
+// Export/import server-side optimizer slots (durable-slot satellite:
+// resilience.PSShardGuard snapshots these so a SIGKILLed-and-restarted
+// shard resumes with its REAL Adam/Adagrad accumulators, not fresh
+// zeros).  s1/s2 are [n, dim] f32 — s1 = velocity (momentum/nesterov),
+// accumulator (adagrad), or m (adam); s2 = v (adam); step is [n] u64 adam
+// per-row step.  Slots the optimizer does not allocate read as zeros and
+// are ignored on set, so the wire format is optimizer-independent (all
+// five kinds, f32 always — slots never quantize whatever the row dtype).
+int ps_table_slots_get(int id, const int64_t* idx, int64_t n, float* s1_out,
+                       float* s2_out, uint64_t* step_out) {
+  Table* t = get_table(id);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  int64_t d = t->dim;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t r = idx[i];
+    bool oob = r < 0 || r >= t->rows;
+    if (oob || t->s1.empty())
+      std::memset(s1_out + i * d, 0, d * sizeof(float));
+    else
+      std::memcpy(s1_out + i * d, t->s1.data() + r * d, d * sizeof(float));
+    if (oob || t->s2.empty())
+      std::memset(s2_out + i * d, 0, d * sizeof(float));
+    else
+      std::memcpy(s2_out + i * d, t->s2.data() + r * d, d * sizeof(float));
+    step_out[i] = (oob || t->step.empty()) ? 0 : t->step[r];
+  }
+  return 0;
+}
+
+int ps_table_slots_set(int id, const int64_t* idx, int64_t n,
+                       const float* s1, const float* s2,
+                       const uint64_t* step) {
+  Table* t = get_table(id);
+  if (!t) return -1;
+  std::lock_guard<std::mutex> lk(t->mu);
+  int64_t d = t->dim;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t r = idx[i];
+    if (r < 0 || r >= t->rows) continue;
+    if (!t->s1.empty())
+      std::memcpy(t->s1.data() + r * d, s1 + i * d, d * sizeof(float));
+    if (!t->s2.empty())
+      std::memcpy(t->s2.data() + r * d, s2 + i * d, d * sizeof(float));
+    if (!t->step.empty()) t->step[r] = step[i];
+    // NOT a weight write: versions stay put, worker caches keep their rows
+  }
+  return 0;
+}
+
 // ---------------------------------------------------------------- save/load
 
 static const uint64_t kCkptMagic = 0x48545055'50533032ull;  // "HTPUPS02"

@@ -93,6 +93,12 @@ int64_t ps_van_push_sync(int fd, int id, const int64_t* push_keys,
                          float* rows_out);
 int ps_van_sched_map(int fd, int max_n, int32_t* ranks, uint8_t* alive,
                      int32_t* ports, char* hosts64);
+int ps_van_table_slots_get(int fd, int id, const int64_t* idx, int64_t n,
+                           int64_t dim, float* s1, float* s2,
+                           uint64_t* step);
+int ps_van_table_slots_set(int fd, int id, const int64_t* idx, int64_t n,
+                           int64_t dim, const float* s1, const float* s2,
+                           const uint64_t* step);
 }
 
 namespace {
@@ -530,6 +536,87 @@ int ps_group_sparse_push(int gid, const int64_t* idx, const float* grads,
 int ps_group_sparse_set(int gid, const int64_t* idx, const float* vals,
                         int64_t n) {
   return group_sparse_write(gid, idx, vals, n, true);
+}
+
+// Optimizer-slot export/import over the partitioned group (durable-slot
+// satellite): slice per shard like sparse_pull, merge back to caller
+// positions.  Out-of-range keys read as zero slots / are ignored on set.
+int ps_group_slots_get(int gid, const int64_t* idx, int64_t n, float* s1,
+                       float* s2, uint64_t* step) {
+  GroupRef ref(gid);
+  Group* g = ref.g;
+  if (!g) return -1;
+  int ns = (int)g->shards.size();
+  std::vector<std::vector<int64_t>> local(ns), pos(ns);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t k = idx[i];
+    if (k < 0 || k >= g->rows) {
+      std::memset(s1 + i * g->dim, 0, g->dim * sizeof(float));
+      std::memset(s2 + i * g->dim, 0, g->dim * sizeof(float));
+      step[i] = 0;
+      continue;
+    }
+    int sidx = shard_of(g, k);
+    local[sidx].push_back(k - g->shards[sidx]->start);
+    pos[sidx].push_back(i);
+  }
+  std::vector<int> nonempty;
+  for (int i = 0; i < ns; ++i)
+    if (!local[i].empty()) nonempty.push_back(i);
+  std::vector<std::vector<float>> b1(ns), b2(ns);
+  std::vector<std::vector<uint64_t>> bs(ns);
+  int rc = fan_out(nonempty, [&](int i) {
+    int64_t m = (int64_t)local[i].size();
+    b1[i].resize(m * g->dim);
+    b2[i].resize(m * g->dim);
+    bs[i].resize(m);
+    return shard_call(g, g->shards[i].get(), i, [&](int fd) {
+      return ps_van_table_slots_get(fd, g->table_id, local[i].data(), m,
+                                    g->dim, b1[i].data(), b2[i].data(),
+                                    bs[i].data());
+    });
+  });
+  if (rc != 0) return rc;
+  for (int i : nonempty)
+    for (size_t j = 0; j < pos[i].size(); ++j) {
+      std::memcpy(s1 + pos[i][j] * g->dim, b1[i].data() + j * g->dim,
+                  g->dim * sizeof(float));
+      std::memcpy(s2 + pos[i][j] * g->dim, b2[i].data() + j * g->dim,
+                  g->dim * sizeof(float));
+      step[pos[i][j]] = bs[i][j];
+    }
+  return 0;
+}
+
+int ps_group_slots_set(int gid, const int64_t* idx, const float* s1,
+                       const float* s2, const uint64_t* step, int64_t n) {
+  GroupRef ref(gid);
+  Group* g = ref.g;
+  if (!g) return -1;
+  int ns = (int)g->shards.size();
+  std::vector<std::vector<int64_t>> local(ns);
+  std::vector<std::vector<float>> b1(ns), b2(ns);
+  std::vector<std::vector<uint64_t>> bs(ns);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t k = idx[i];
+    if (k < 0 || k >= g->rows) continue;
+    int sidx = shard_of(g, k);
+    local[sidx].push_back(k - g->shards[sidx]->start);
+    b1[sidx].insert(b1[sidx].end(), s1 + i * g->dim, s1 + (i + 1) * g->dim);
+    b2[sidx].insert(b2[sidx].end(), s2 + i * g->dim, s2 + (i + 1) * g->dim);
+    bs[sidx].push_back(step[i]);
+  }
+  std::vector<int> nonempty;
+  for (int i = 0; i < ns; ++i)
+    if (!local[i].empty()) nonempty.push_back(i);
+  return fan_out(nonempty, [&](int i) {
+    return shard_call(g, g->shards[i].get(), i, [&](int fd) {
+      return ps_van_table_slots_set(fd, g->table_id, local[i].data(),
+                                    (int64_t)local[i].size(), g->dim,
+                                    b1[i].data(), b2[i].data(),
+                                    bs[i].data());
+    });
+  });
 }
 
 int ps_group_dense_pull(int gid, float* out) {

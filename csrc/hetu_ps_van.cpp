@@ -58,6 +58,11 @@ int ps_sparse_pull(int id, const int64_t* idx, int64_t n, float* out,
                    uint64_t* versions_out);
 int ps_sparse_push(int id, const int64_t* idx, const float* grads, int64_t n);
 int ps_sparse_set(int id, const int64_t* idx, const float* vals, int64_t n);
+int ps_table_slots_get(int id, const int64_t* idx, int64_t n, float* s1_out,
+                       float* s2_out, uint64_t* step_out);
+int ps_table_slots_set(int id, const int64_t* idx, int64_t n,
+                       const float* s1, const float* s2,
+                       const uint64_t* step);
 int ps_table_save(int id, const char* path);
 int ps_table_load(int id, const char* path);
 int ps_table_clear(int id);
@@ -110,6 +115,11 @@ enum VanOp : uint8_t {
   // existing table id matches its expected shape+dtype instead of
   // silently mis-decoding dtype'd frames
   OP_TABLE_INFO = 28,
+  // server-side optimizer slot export/import (durable-slot satellite):
+  // a restarted-blank shard's repair replays s1/s2/adam-step alongside
+  // the weights so accumulators resume bitwise-exact.  Always f32 on the
+  // wire — slots never quantize whatever the row dtype.
+  OP_SLOTS_GET = 29, OP_SLOTS_SET = 30,
 };
 
 // Per-table bounded set of recently applied push request-ids.  A repeated
@@ -473,7 +483,7 @@ void handle_conn(int fd) {
     static const uint32_t kMinBody[] = {
         0, 48, 28, 4, 4, 13, 12, 12, 8, 8, 0, 12, 20,
         20, 36, 12, 12, 8, 16, 8, 0, 8, 4,
-        24, 20, 16, 16, 0, 4};
+        24, 20, 16, 16, 0, 4, 12, 12};
     if (op < sizeof(kMinBody) / sizeof(uint32_t) &&
         blen < 1 + kMinBody[op]) {
       send_resp(fd, -3, nullptr, 0);
@@ -976,6 +986,62 @@ void handle_conn(int fd) {
         send_resp(fd, 0, pay, 20);
         break;
       }
+      case OP_SLOTS_GET: {
+        // [i32 id][i64 n][i64 idx x n]
+        // resp: [f32 s1 x n*dim][f32 s2 x n*dim][u64 step x n]
+        int id = rd<int32_t>(p);
+        int64_t n = rd<int64_t>(p);
+        int64_t dim = ps_table_dim(id);
+        if (dim <= 0) { send_resp(fd, -1, nullptr, 0); break; }
+        int64_t have = body.data() + blen - p;
+        int64_t resp_bytes = n * (2 * dim * (int64_t)sizeof(float) +
+                                  (int64_t)sizeof(uint64_t));
+        if (n < 0 || n > (1 << 24) || have < n * (int64_t)sizeof(int64_t) ||
+            resp_bytes > (int64_t)(1u << 30)) {
+          send_resp(fd, -3, nullptr, 0); break;
+        }
+        const auto* idx = (const int64_t*)p;
+        fbuf.resize(2 * n * dim);
+        vbuf.resize(n);
+        int rc = ps_table_slots_get(id, idx, n, fbuf.data(),
+                                    fbuf.data() + n * dim, vbuf.data());
+        if (rc != 0) { send_resp(fd, rc, nullptr, 0); break; }
+        uint32_t plen = (uint32_t)resp_bytes;
+        uint32_t blen2 = 4 + plen;
+        int32_t rc32 = 0;
+        g_bytes_tx.fetch_add(4 + blen2, std::memory_order_relaxed);
+        if (!write_all(fd, &blen2, 4) || !write_all(fd, &rc32, 4) ||
+            !write_all(fd, fbuf.data(), 2 * n * dim * sizeof(float)) ||
+            !write_all(fd, vbuf.data(), n * sizeof(uint64_t))) {
+          ::close(fd); return;
+        }
+        break;
+      }
+      case OP_SLOTS_SET: {
+        // [i32 id][i64 n][i64 idx x n][f32 s1 x n*dim][f32 s2 x n*dim]
+        // [u64 step x n]
+        int id = rd<int32_t>(p);
+        int64_t n = rd<int64_t>(p);
+        int64_t dim = ps_table_dim(id);
+        int rc;
+        int64_t have = body.data() + blen - p;
+        if (dim < 0) {
+          rc = -1;  // no such table: group recovery cue, like sparse ops
+        } else if (n < 0 || n > (1 << 24) ||
+                   have < n * (int64_t)(sizeof(int64_t) +
+                                        2 * dim * sizeof(float) +
+                                        sizeof(uint64_t))) {
+          rc = -3;
+        } else {
+          const auto* idx = (const int64_t*)p;
+          const auto* s1 = (const float*)(p + n * sizeof(int64_t));
+          const float* s2 = s1 + n * dim;
+          const auto* step = (const uint64_t*)(s2 + n * dim);
+          rc = ps_table_slots_set(id, idx, n, s1, s2, step);
+        }
+        send_resp(fd, rc, nullptr, 0);
+        break;
+      }
       case OP_STATS: {
         uint64_t stats[3] = {
             g_frames_handled.load(std::memory_order_relaxed),
@@ -1281,6 +1347,50 @@ int ps_van_table_info(int fd, int id, int64_t* rows, int64_t* dim,
 int ps_van_table_clear(int fd, int id) {
   std::vector<char> b{(char)OP_CLEAR}, pay;
   put<int32_t>(b, id);
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
+}
+
+// ---- server-side optimizer slot export/import (always f32) ----
+
+int ps_van_table_slots_get(int fd, int id, const int64_t* idx, int64_t n,
+                           int64_t dim, float* s1, float* s2,
+                           uint64_t* step) {
+  std::vector<char> b{(char)OP_SLOTS_GET}, pay;
+  put<int32_t>(b, id); put<int64_t>(b, n);
+  size_t o = b.size();
+  b.resize(o + n * sizeof(int64_t));
+  std::memcpy(b.data() + o, idx, n * sizeof(int64_t));
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
+  int64_t want = n * (2 * dim * (int64_t)sizeof(float) +
+                      (int64_t)sizeof(uint64_t));
+  if ((int64_t)pay.size() != want) return -5;
+  std::memcpy(s1, pay.data(), n * dim * sizeof(float));
+  std::memcpy(s2, pay.data() + n * dim * sizeof(float),
+              n * dim * sizeof(float));
+  std::memcpy(step, pay.data() + 2 * n * dim * sizeof(float),
+              n * sizeof(uint64_t));
+  return 0;
+}
+
+int ps_van_table_slots_set(int fd, int id, const int64_t* idx, int64_t n,
+                           int64_t dim, const float* s1, const float* s2,
+                           const uint64_t* step) {
+  std::vector<char> b{(char)OP_SLOTS_SET}, pay;
+  put<int32_t>(b, id); put<int64_t>(b, n);
+  size_t o = b.size();
+  b.resize(o + n * (sizeof(int64_t) + 2 * dim * sizeof(float) +
+                    sizeof(uint64_t)));
+  char* q = b.data() + o;
+  std::memcpy(q, idx, n * sizeof(int64_t));
+  q += n * sizeof(int64_t);
+  std::memcpy(q, s1, n * dim * sizeof(float));
+  q += n * dim * sizeof(float);
+  std::memcpy(q, s2, n * dim * sizeof(float));
+  q += n * dim * sizeof(float);
+  std::memcpy(q, step, n * sizeof(uint64_t));
   int32_t rc = kTransportErr;
   return request(fd, b, &rc, &pay) ? rc : kTransportErr;
 }

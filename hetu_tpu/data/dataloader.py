@@ -146,3 +146,90 @@ class Dataloader:
 
     def __len__(self):
         return self.num_batches
+
+
+class ElasticBatchSchedule:
+    """A WIDTH-INVARIANT global batch schedule for elastic training.
+
+    The plain :class:`Dataloader` shards the DATASET per dp rank up front
+    (``set_dp_rank``), which bakes the fleet width into the epoch: after an
+    elastic resize the ranks' shards, the shuffle order, and therefore the
+    training trajectory all change.  This schedule fixes the GLOBAL batch
+    sequence instead — ``global_batch(step)`` is a pure function of
+    ``(seed, step)``, independent of how many workers exist — and resizes
+    only change how each global batch is SLICED across the survivors
+    (``local_slice``).  A 4-wide run that shrinks to 3 and regrows to 4
+    consumes byte-identical global batches in the same order as a run that
+    never resized, which is what makes the elastic chaos test's
+    final-params comparison meaningful (and is the ``set_mp_parts``-style
+    re-partition the reference dataloader applies per rank).
+
+    ``batch_size`` is the GLOBAL batch and must stay divisible by every
+    width the run can shrink to — validate widths up front with
+    :meth:`check_width` (the elastic supervisor does).
+    """
+
+    def __init__(self, data, batch_size: int, *, seed: int = 0,
+                 shuffle: bool = True):
+        self.arrays = [np.asarray(a) for a in
+                       (data if isinstance(data, (tuple, list)) else [data])]
+        self._single = not isinstance(data, (tuple, list))
+        n = self.arrays[0].shape[0]
+        if any(a.shape[0] != n for a in self.arrays):
+            raise ValueError("arrays must share the leading dim")
+        if not 0 < batch_size <= n:
+            raise ValueError(f"global batch {batch_size} vs {n} rows")
+        self.n_total = n
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+        self.batches_per_epoch = n // self.batch_size
+        self._order_cache: tuple = (-1, None)  # (epoch, permutation)
+
+    def check_width(self, dp: int) -> None:
+        if dp <= 0 or self.batch_size % dp != 0:
+            raise ValueError(
+                f"global batch {self.batch_size} is not divisible by "
+                f"dp={dp}; an elastic run must pick a global batch "
+                "divisible by every width it can shrink to (e.g. a "
+                "multiple of lcm(1..nominal_dp))")
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        if self._order_cache[0] == epoch:
+            return self._order_cache[1]
+        order = np.arange(self.n_total)
+        if self.shuffle:
+            # seeded per (seed, epoch) — NOT the framework RNG stream, so
+            # the schedule replays identically regardless of what else
+            # consumed randomness (retries, resizes, chaos)
+            np.random.default_rng((self.seed, epoch)).shuffle(order)
+        # memoized per epoch: a full O(n) shuffle per STEP would dominate
+        # small steps on big datasets (every step calls global_indices)
+        self._order_cache = (epoch, order)
+        return order
+
+    def global_indices(self, step: int) -> np.ndarray:
+        epoch, b = divmod(int(step), self.batches_per_epoch)
+        order = self._epoch_order(epoch)
+        return order[b * self.batch_size:(b + 1) * self.batch_size]
+
+    def global_batch(self, step: int):
+        """The step's full global batch — single-controller callers feed
+        this straight to the executor (jit shards it over the dp axis)."""
+        sel = self.global_indices(step)
+        batch = [a[sel] for a in self.arrays]
+        return batch[0] if self._single else tuple(batch)
+
+    def local_slice(self, step: int, rank: int, dp: int):
+        """Worker ``rank``-of-``dp``'s contiguous slice of the step's
+        global batch (the multi-controller re-partition): after a resize,
+        calling with the new ``(rank, dp)`` redistributes the SAME global
+        batch over the survivors."""
+        self.check_width(dp)
+        if not 0 <= rank < dp:
+            raise ValueError(f"rank {rank} not in [0, {dp})")
+        sel = self.global_indices(step)
+        per = self.batch_size // dp
+        sel = sel[rank * per:(rank + 1) * per]
+        batch = [a[sel] for a in self.arrays]
+        return batch[0] if self._single else tuple(batch)

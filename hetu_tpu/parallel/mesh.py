@@ -79,6 +79,36 @@ def make_mesh(config: Optional[MeshConfig] = None, *, devices=None,
     return Mesh(dev, tuple(order))
 
 
+def elastic_mesh(config: MeshConfig, alive: Sequence[int], *,
+                 devices=None) -> Mesh:
+    """Re-form the mesh with only the ``alive`` data-parallel workers.
+
+    ``config`` describes the NOMINAL layout (dp = fleet width); ``alive``
+    lists the surviving dp indices (sorted, each < config.dp).  Each dp
+    worker owns one contiguous group of ``tp*pp*ep*sp`` devices in the
+    nominal device array; the elastic mesh is built from the survivors'
+    groups only, in rank order, so a worker that was never lost keeps its
+    exact devices across resizes (its replica of the state never moves —
+    only the lost/joined worker's shard placement changes).
+
+    Mesh membership as a runtime input (arxiv 2412.14374): the same
+    ``MeshConfig`` reshapes to any width 1..dp without re-describing the
+    cluster.  Used by resilience/elastic.ElasticSupervisor.
+    """
+    alive = sorted(int(i) for i in alive)
+    if not alive:
+        raise ValueError("elastic mesh needs at least one alive worker")
+    if alive[0] < 0 or alive[-1] >= config.dp:
+        raise ValueError(
+            f"alive indices {alive} out of range for nominal dp={config.dp}")
+    if len(set(alive)) != len(alive):
+        raise ValueError(f"duplicate alive indices {alive}")
+    nominal = make_mesh(config, devices=devices)
+    dp_axis = nominal.axis_names.index(AXIS_DP)
+    dev = np.take(nominal.devices, alive, axis=dp_axis)
+    return Mesh(dev, nominal.axis_names)
+
+
 def local_mesh(axis: str = AXIS_DP) -> Mesh:
     """All local devices on one axis — the default DP mesh (reference analog:
     heturun's single-host allreduce config)."""
@@ -88,6 +118,23 @@ def local_mesh(axis: str = AXIS_DP) -> Mesh:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def host_to_device(arr, sharding):
+    """``jax.device_put`` with the CPU zero-copy-adoption guard.
+
+    On CPU targets device_put can ADOPT a host numpy buffer zero-copy,
+    and a later DONATED step then frees memory numpy still owns —
+    observed as NaN state / heap corruption.  Route through a jax-owned
+    copy there.  Non-CPU targets always copy host→device, so direct
+    placement keeps sharded transfers single-pass (no full-leaf
+    materialization on one device).  Shared by train/checkpoint.load and
+    resilience/elastic's resharding — keep the workaround in ONE place.
+    """
+    import jax.numpy as jnp
+    if any(d.platform == "cpu" for d in sharding.device_set):
+        arr = jnp.array(arr)
+    return jax.device_put(arr, sharding)
 
 
 def batch_sharding(mesh: Mesh, axis: str = AXIS_DP) -> NamedSharding:

@@ -74,6 +74,21 @@ def _load():
             "ps_sparse_set": ([c.c_int, i64p, f32p, c.c_int64], c.c_int),
             "ps_table_save": ([c.c_int, c.c_char_p], c.c_int),
             "ps_table_load": ([c.c_int, c.c_char_p], c.c_int),
+            # server-side optimizer slot export/import (durable slots)
+            "ps_table_slots_get": ([c.c_int, i64p, c.c_int64, f32p, f32p,
+                                    u64p], c.c_int),
+            "ps_table_slots_set": ([c.c_int, i64p, c.c_int64, f32p, f32p,
+                                    u64p], c.c_int),
+            "ps_van_table_slots_get": ([c.c_int, c.c_int, i64p, c.c_int64,
+                                        c.c_int64, f32p, f32p, u64p],
+                                       c.c_int),
+            "ps_van_table_slots_set": ([c.c_int, c.c_int, i64p, c.c_int64,
+                                        c.c_int64, f32p, f32p, u64p],
+                                       c.c_int),
+            "ps_group_slots_get": ([c.c_int, i64p, c.c_int64, f32p, f32p,
+                                    u64p], c.c_int),
+            "ps_group_slots_set": ([c.c_int, i64p, f32p, f32p, u64p,
+                                    c.c_int64], c.c_int),
             "ps_ssp_init": ([c.c_int, c.c_int, c.c_int], c.c_int),
             "ps_ssp_clock_and_wait": ([c.c_int, c.c_int, c.c_int], c.c_int),
             "ps_ssp_get_clock": ([c.c_int, c.c_int], c.c_int64),

@@ -120,6 +120,38 @@ class PSTable:
         re-pull."""
         _check(lib.ps_table_clear(self.id), "table_clear")
 
+    # ---- server-side optimizer slots (durable-slot satellite) ----
+    def slots_get(self, indices):
+        """Export the server-side optimizer state for ``indices``:
+        ``(s1, s2, step)`` — s1 [n, dim] f32 (velocity / adagrad
+        accumulator / adam m), s2 [n, dim] f32 (adam v), step [n] u64
+        (adam per-row step).  Slots the optimizer does not allocate read
+        as zeros, so the shape is optimizer-independent."""
+        idx = _as_idx(indices)
+        n = idx.shape[0]
+        s1 = np.empty((n, self.dim), np.float32)
+        s2 = np.empty((n, self.dim), np.float32)
+        step = np.empty(n, np.uint64)
+        _check(lib.ps_table_slots_get(
+            self.id, _i64p(idx), n, _f32p(s1), _f32p(s2),
+            step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))),
+            "table_slots_get")
+        return s1, s2, step
+
+    def slots_set(self, indices, s1, s2, step) -> None:
+        """Import optimizer state previously exported by :meth:`slots_get`
+        (the shard-repair replay path).  Unlike ``sparse_set`` this does
+        NOT bump row versions — slots are invisible to pulls/caches."""
+        idx = _as_idx(indices)
+        n = idx.shape[0]
+        s1 = _as_mat(s1, n, self.dim)
+        s2 = _as_mat(s2, n, self.dim)
+        step = np.ascontiguousarray(step, np.uint64).reshape(n)
+        _check(lib.ps_table_slots_set(
+            self.id, _i64p(idx), n, _f32p(s1), _f32p(s2),
+            step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))),
+            "table_slots_set")
+
     # ---- checkpoint (reference SaveParam/LoadParam) ----
     def save(self, path) -> None:
         _check(lib.ps_table_save(self.id, str(path).encode()), "table_save")

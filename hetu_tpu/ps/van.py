@@ -225,6 +225,34 @@ class RemotePSTable:
         of leaking per-step tables on the server."""
         _check(lib.ps_van_table_clear(self.fd, self.id), "van_table_clear")
 
+    def slots_get(self, indices):
+        """Server-side optimizer slots for ``indices``: ``(s1, s2, step)``
+        (see ``PSTable.slots_get``).  Always f32 on the wire, whatever the
+        row dtype — slots never quantize."""
+        _maybe_inject("van_slots_get")
+        idx = _as_idx(indices)
+        n = idx.shape[0]
+        s1 = np.empty((n, self.dim), np.float32)
+        s2 = np.empty((n, self.dim), np.float32)
+        step = np.empty(n, np.uint64)
+        _check(lib.ps_van_table_slots_get(
+            self.fd, self.id, _i64p(idx), n, self.dim, _f32p(s1), _f32p(s2),
+            step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))),
+            "van_slots_get")
+        return s1, s2, step
+
+    def slots_set(self, indices, s1, s2, step) -> None:
+        _maybe_inject("van_slots_set")
+        idx = _as_idx(indices)
+        n = idx.shape[0]
+        s1 = _as_mat(s1, n, self.dim)
+        s2 = _as_mat(s2, n, self.dim)
+        step = np.ascontiguousarray(step, np.uint64).reshape(n)
+        _check(lib.ps_van_table_slots_set(
+            self.fd, self.id, _i64p(idx), n, self.dim, _f32p(s1), _f32p(s2),
+            step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))),
+            "van_slots_set")
+
     def save(self, path) -> None:
         _check(lib.ps_van_table_save(self.fd, self.id, str(path).encode()),
                "van_table_save")
@@ -380,6 +408,34 @@ class PartitionedPSTable:
         g = _as_mat(grad, self.rows, self.dim)
         _check(lib.ps_group_dense_push(self.gid, _f32p(g)),
                "group_dense_push")
+
+    def slots_get(self, indices):
+        """Server-side optimizer slots across the group: ``(s1, s2, step)``
+        — the durable-slot plane ``PSShardGuard`` snapshots so a repaired
+        shard resumes with its real Adam/Adagrad accumulators."""
+        _maybe_inject("group_slots_get")
+        idx = _as_idx(indices)
+        n = idx.shape[0]
+        s1 = np.empty((n, self.dim), np.float32)
+        s2 = np.empty((n, self.dim), np.float32)
+        step = np.empty(n, np.uint64)
+        _check(lib.ps_group_slots_get(
+            self.gid, _i64p(idx), n, _f32p(s1), _f32p(s2),
+            step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))),
+            "group_slots_get")
+        return s1, s2, step
+
+    def slots_set(self, indices, s1, s2, step) -> None:
+        _maybe_inject("group_slots_set")
+        idx = _as_idx(indices)
+        n = idx.shape[0]
+        s1 = _as_mat(s1, n, self.dim)
+        s2 = _as_mat(s2, n, self.dim)
+        step = np.ascontiguousarray(step, np.uint64).reshape(n)
+        _check(lib.ps_group_slots_set(
+            self.gid, _i64p(idx), _f32p(s1), _f32p(s2),
+            step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n),
+            "group_slots_set")
 
     def sync_pull(self, indices, cached_versions, bound: int = 0):
         """Version-bounded sync (HET kSyncEmbedding over the wire): returns

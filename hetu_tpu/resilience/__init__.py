@@ -1,9 +1,14 @@
 """Fault-tolerant training runtime: deterministic chaos harness +
-supervisor (checkpoint retention, retry, NaN guard, PS shard repair).
+supervisor (checkpoint retention, retry, NaN guard, PS shard repair) +
+elastic mesh resharding (survive permanent worker loss/rejoin).
 
-See README "Fault tolerance" for usage and guarantees/limits.
+See README "Fault tolerance" and "Elastic operation" for usage and
+guarantees/limits.
 """
 
+from hetu_tpu.resilience.elastic import (
+    ElasticReshardError, ElasticSupervisor, MembershipMonitor, ResizeEvent,
+)
 from hetu_tpu.resilience.faults import (
     FaultEvent, FaultInjector, FaultSchedule, TransientDataError,
     TransientFault,
@@ -17,4 +22,6 @@ __all__ = [
     "FaultEvent", "FaultInjector", "FaultSchedule", "TransientDataError",
     "TransientFault", "CheckpointManager", "NonFiniteAbort", "PSShardGuard",
     "Supervisor", "SupervisorReport", "default_is_transient",
+    "ElasticReshardError", "ElasticSupervisor", "MembershipMonitor",
+    "ResizeEvent",
 ]

@@ -1,0 +1,322 @@
+"""Elastic mesh resharding: survive permanent DP-worker loss (and rejoin)
+by reforming the mesh at the surviving width instead of aborting.
+
+PR 2's :class:`~hetu_tpu.resilience.supervisor.Supervisor` retries, guards,
+and checkpoints — but the device mesh is fixed for the life of the run, so
+a PERMANENTLY lost data-parallel worker still kills it.  On preemptible
+TPU fleets permanent loss is the common case, and
+checkpoint-restart-at-the-same-size is not an answer: arxiv 2004.13336
+shows a replica-count change is fundamentally a RESHARDING of (optimizer)
+state, and arxiv 2412.14374 motivates mesh membership as a runtime input.
+
+:class:`ElasticSupervisor` closes that gap.  Per step, BEFORE the guard
+polls and the batch fetch, it drains membership events — injected
+``worker_loss``/``worker_join`` chaos faults (authoritative) and
+:class:`MembershipMonitor` promotions of repeated PSShardGuard/van
+failures — and, when the alive set changed, runs the resharding step:
+
+1. snapshot the live :class:`~hetu_tpu.train.executor.TrainState`
+   host-side (params, optimizer state, step counter, RNG — ``np.asarray``
+   per leaf, so nothing references the old mesh's buffers);
+2. reform the mesh at the surviving width
+   (:func:`~hetu_tpu.parallel.mesh.elastic_mesh` — survivors keep their
+   exact devices, only the lost/joined worker's placement changes);
+3. re-place the state under the new mesh with ``jax.device_put`` and
+   point the executor at it (``Executor.set_mesh`` drops every compiled
+   step — shardings are baked at trace time, so the next ``run()``
+   re-jits at the new width);
+4. re-partition the data: with an :class:`ElasticBatchSchedule` the
+   GLOBAL batch sequence is a pure function of (seed, step) — a resize
+   only changes how each global batch is sliced over survivors, so a
+   4→3→4 run consumes byte-identical global batches in the same order as
+   a run that never resized.  In ``fixed_per_worker`` mode (global batch
+   = per-worker batch × width) the gradient is instead rescaled by
+   nominal/current width (``Executor.set_grad_scale``) so a
+   sum-over-nominal-batch loss keeps its scale across the shrink.
+
+Checkpoints record the live DP width (``extra['dp_width']``) and restore
+at a DIFFERENT width — leaves are global arrays, so restore re-places them
+under whatever mesh the membership says (train/checkpoint.py's
+width-portability contract).
+
+Determinism: membership events come from the seeded
+:class:`~hetu_tpu.resilience.faults.FaultSchedule` (``to_json`` is
+byte-stable), the batch schedule is seeded and width-invariant, and the
+RNG rides the TrainState — an elastic chaos run replays exactly.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from hetu_tpu.parallel.mesh import (
+    AXIS_DP, MeshConfig, elastic_mesh, host_to_device, replicated,
+)
+from hetu_tpu.resilience.supervisor import Supervisor
+
+
+class ElasticReshardError(RuntimeError):
+    """The mesh cannot be reformed at the requested membership — every
+    worker lost, a join for a worker that is present, or a global batch
+    that does not divide by the surviving width."""
+
+
+@dataclass
+class ResizeEvent:
+    """One completed resize, for reports/benches: detect→resharded wall
+    time ``downtime_s`` EXCLUDES the next step's re-jit (the bench times
+    detect → resharded → next completed step around the run loop)."""
+
+    step: int
+    kind: str                   # "shrink" | "grow"
+    worker: int
+    width: int                  # width AFTER the resize
+    downtime_s: float
+    alive: tuple = field(default_factory=tuple)
+
+
+class MembershipMonitor:
+    """Promotes failure evidence into resize decisions.
+
+    Two input planes, mirroring how loss actually shows up:
+
+    * :meth:`inject` — an AUTHORITATIVE membership event (the chaos
+      harness's seeded ``worker_loss``/``worker_join``, or a cluster
+      scheduler's notification): decided immediately.
+    * :meth:`report_failure` / :meth:`report_ok` — circumstantial
+      evidence (a PSShardGuard shard staying dead, van retries exhausting
+      against one worker's endpoint).  ``fail_threshold`` CONSECUTIVE
+      failure reports with no intervening ok promote to a loss decision —
+      one flaky poll never reshapes the fleet.
+
+    The monitor tracks the alive set itself so double-loss / double-join
+    are rejected here, once, instead of in every caller.
+    """
+
+    def __init__(self, nominal_dp: int, *, fail_threshold: int = 3):
+        if nominal_dp < 1:
+            raise ValueError("nominal_dp must be >= 1")
+        self.nominal_dp = int(nominal_dp)
+        self.fail_threshold = int(fail_threshold)
+        self.alive: set[int] = set(range(self.nominal_dp))
+        self._fails: dict[int, int] = defaultdict(int)
+        self._decisions: deque = deque()
+
+    def inject(self, kind: str, worker: int) -> None:
+        worker = int(worker)
+        if kind == "loss":
+            if worker in self.alive:
+                self.alive.discard(worker)
+                self._decisions.append(("loss", worker))
+        elif kind == "join":
+            if not 0 <= worker < self.nominal_dp:
+                raise ElasticReshardError(
+                    f"worker {worker} outside the nominal fleet "
+                    f"[0, {self.nominal_dp})")
+            if worker not in self.alive:
+                self.alive.add(worker)
+                self._fails.pop(worker, None)
+                self._decisions.append(("join", worker))
+        else:
+            raise ValueError(f"unknown membership event kind {kind!r}")
+
+    def report_failure(self, worker: int) -> None:
+        worker = int(worker)
+        if worker not in self.alive:
+            return  # already decided lost
+        self._fails[worker] += 1
+        if self._fails[worker] >= self.fail_threshold:
+            self.inject("loss", worker)
+
+    def report_ok(self, worker: int) -> None:
+        self._fails.pop(int(worker), None)
+
+    def pop_decisions(self) -> list:
+        out = list(self._decisions)
+        self._decisions.clear()
+        return out
+
+
+class ElasticSupervisor(Supervisor):
+    """:class:`Supervisor` whose mesh membership is a runtime input.
+
+    Usage::
+
+        config = MeshConfig(dp=4)
+        ex = Executor(loss_fn, opt)            # mesh installed by the sup
+        schedule = ElasticBatchSchedule((X, Y), global_batch, seed=0)
+        sup = ElasticSupervisor(ex, config=config, schedule=schedule,
+                                injector=FaultInjector(faults), ...)
+        rep = sup.run(state, lambda i: dict_batch(schedule.global_batch(i)),
+                      steps)
+
+    ``data_mode``:
+
+    * ``"fixed_global_batch"`` (default): every step consumes the same
+      global batch whatever the width (use :class:`ElasticBatchSchedule`);
+      the global batch must divide by every reachable width — validated
+      at construction against 1..nominal_dp when a schedule is given,
+      else at each resize.
+    * ``"fixed_per_worker"``: the global batch is per-worker × width, so
+      a shrink feeds fewer examples per step; gradients are rescaled by
+      nominal/current width so a loss summed over the nominal global
+      batch keeps its scale (a mean-loss run may prefer scale 1 — pass
+      ``rescale_grads=False``).
+
+    PSShardGuard/van failure promotion: ``shard_workers`` maps a guard's
+    PS shard index to the DP worker hosting it; a shard that stays dead
+    ``monitor.fail_threshold`` consecutive polls promotes that worker's
+    loss.  Without the map, only injected events and explicit
+    ``monitor.report_failure`` calls reshape the fleet.
+    """
+
+    def __init__(self, executor, *, config: MeshConfig,
+                 devices: Optional[Sequence] = None,
+                 schedule=None, data_mode: str = "fixed_global_batch",
+                 rescale_grads: bool = True,
+                 monitor: Optional[MembershipMonitor] = None,
+                 fail_threshold: int = 3,
+                 shard_workers: Optional[dict] = None,
+                 min_width: int = 1, **kw):
+        super().__init__(executor, **kw)
+        if data_mode not in ("fixed_global_batch", "fixed_per_worker"):
+            raise ValueError(f"unknown data_mode {data_mode!r}")
+        self.config = config
+        self.devices = (np.asarray(devices) if devices is not None
+                        else np.asarray(jax.devices()))
+        self.schedule = schedule
+        self.data_mode = data_mode
+        self.rescale_grads = bool(rescale_grads)
+        self.min_width = int(min_width)
+        self.monitor = monitor or MembershipMonitor(
+            config.dp, fail_threshold=fail_threshold)
+        self.shard_workers = dict(shard_workers or {})
+        self._guard_dead_polls: dict[int, int] = defaultdict(int)
+        self.resizes: list[ResizeEvent] = []
+        if schedule is not None and data_mode == "fixed_global_batch":
+            for w in range(max(self.min_width, 1), config.dp + 1):
+                schedule.check_width(w)
+        # install the nominal mesh (or adopt a caller-installed one at the
+        # nominal width) so step 0 already runs under elastic management
+        if executor.mesh is None:
+            executor.set_mesh(elastic_mesh(config, sorted(self.monitor.alive),
+                                           devices=self.devices))
+        self.counters["elastic_width"] = len(self.monitor.alive)
+
+    # ---- membership → resharding ----
+    @property
+    def width(self) -> int:
+        return len(self.monitor.alive)
+
+    def rank_of(self, worker: int) -> int:
+        """Worker's slot in the CURRENT mesh (its dp coordinate) — the
+        rank survivors use for ``ElasticBatchSchedule.local_slice``."""
+        alive = sorted(self.monitor.alive)
+        if worker not in alive:
+            raise ElasticReshardError(f"worker {worker} is not alive")
+        return alive.index(worker)
+
+    def _promote_guard_failures(self) -> None:
+        """PSShardGuard evidence: a shard pending repair for another poll
+        is one failure strike against the worker hosting it; a shard no
+        longer pending clears its worker's strikes."""
+        if not self.shard_workers:
+            return
+        pending = set()
+        for g in self.guards:
+            pending |= set(getattr(g, "_pending", ()))
+        for shard, worker in self.shard_workers.items():
+            if shard in pending:
+                self.monitor.report_failure(worker)
+            else:
+                self.monitor.report_ok(worker)
+
+    def _maybe_resize(self, state, step_i: int):
+        if self.injector is not None and \
+                hasattr(self.injector, "pop_worker_events"):
+            for kind, worker in self.injector.pop_worker_events():
+                self.monitor.inject(kind, worker)
+        self._promote_guard_failures()
+        decisions = self.monitor.pop_decisions()
+        if not decisions:
+            return state
+        # ONE reshard for the whole batch: monitor.alive already reflects
+        # every drained decision, so a loss+join landing on the same step
+        # costs one snapshot/re-place/re-jit, not one per event.  Each
+        # decision still gets its own ResizeEvent (the membership deltas),
+        # all stamped with the post-batch width and sharing the downtime.
+        t0 = time.perf_counter()
+        state = self._reshard(state)
+        dt = time.perf_counter() - t0
+        self.counters["resizes"] += 1
+        self.counters["elastic_width"] = self.width
+        self.counters["resize_downtime_s_last"] = dt
+        self._log_inc("resizes")
+        if self.logger is not None:
+            self.logger.log({"elastic_width": self.width,
+                             "resize_downtime_s": dt}, step=step_i)
+        for kind, worker in decisions:
+            ev = ResizeEvent(
+                step=step_i, kind="shrink" if kind == "loss" else "grow",
+                worker=int(worker), width=self.width, downtime_s=dt,
+                alive=tuple(sorted(self.monitor.alive)))
+            self.resizes.append(ev)
+            self.counters[f"resizes_{ev.kind}"] += 1
+        return state
+
+    def _reshard(self, state):
+        """Snapshot host-side → reform mesh → re-place → re-jit."""
+        alive = sorted(self.monitor.alive)
+        if len(alive) < max(self.min_width, 1):
+            raise ElasticReshardError(
+                f"only {len(alive)} of {self.config.dp} workers alive "
+                f"(min_width={self.min_width}); cannot reform the mesh")
+        width = len(alive)
+        if self.schedule is not None and \
+                self.data_mode == "fixed_global_batch":
+            self.schedule.check_width(width)
+        mesh = elastic_mesh(self.config, alive, devices=self.devices)
+        # host-side snapshot: every leaf leaves the old mesh's buffers
+        # before the new placement (params, optimizer slots, step, RNG).
+        # np.array(copy=True) is load-bearing: np.asarray(jax_cpu_array)
+        # is a zero-copy VIEW of the device buffer.  The re-place goes
+        # through host_to_device, which guards the CPU
+        # zero-copy-adoption + donation hazard (see parallel/mesh.py).
+        host = jax.tree_util.tree_map(lambda a: np.array(a, copy=True),
+                                      state)
+        self.executor.set_mesh(mesh)
+        if self.data_mode == "fixed_per_worker" and self.rescale_grads:
+            self.executor.set_grad_scale(self.config.dp / width)
+        sharding = replicated(mesh)
+        return jax.tree_util.tree_map(
+            lambda a: host_to_device(a, sharding), host)
+
+    # ---- checkpoints carry the width ----
+    def _ckpt_extra(self) -> dict:
+        return {"dp_width": self.width,
+                "alive": sorted(self.monitor.alive),
+                "nominal_dp": self.config.dp}
+
+    def run(self, state, batch_fn, steps, **kw):
+        if batch_fn is None and self.schedule is not None:
+            batch_fn = self.schedule.global_batch
+        # place the caller's state (the restore TEMPLATE too: checkpoint
+        # leaves re-place to the template's shardings) under the CURRENT
+        # mesh — a width-3 checkpoint restoring into a width-4 run lands
+        # replicated over the width-4 mesh, not wherever the template's
+        # buffers happened to live.  host_to_device: the caller may hand
+        # numpy leaves, and the donated train step must never free a
+        # numpy-owned buffer (see parallel/mesh.py)
+        if self.executor.mesh is not None:
+            sharding = replicated(self.executor.mesh)
+            state = jax.tree_util.tree_map(
+                lambda a: host_to_device(a, sharding), state)
+        rep = super().run(state, batch_fn, steps, **kw)
+        rep.counters.setdefault("elastic_width", self.width)
+        return rep

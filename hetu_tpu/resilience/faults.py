@@ -23,6 +23,10 @@ Fault kinds
                    network-partition lookalike), then SIGCONT
 ``preempt``        deliver SIGTERM to the training process (simulated
                    preemption; the supervisor checkpoints and exits)
+``worker_loss``    data-parallel worker ``arg`` is PERMANENTLY lost — the
+                   elastic supervisor reforms the mesh at the surviving
+                   width instead of aborting (resilience/elastic.py)
+``worker_join``    worker ``arg`` (re)joins — the mesh regrows
 
 The van hooks ride :func:`hetu_tpu.ps.van.set_fault_hook`; everything else
 is plain process/OS plumbing, so the harness needs no native lib to import.
@@ -50,7 +54,8 @@ class TransientDataError(RuntimeError):
 
 
 KINDS = ("van_error", "van_delay", "data_error", "nan_grad",
-         "kill_shard", "suspend_shard", "preempt")
+         "kill_shard", "suspend_shard", "preempt",
+         "worker_loss", "worker_join")
 
 
 @dataclass(frozen=True, order=True)
@@ -92,13 +97,23 @@ class FaultSchedule:
                  nan_steps: int = 0, kill_shards: int = 0,
                  suspend_shards: int = 0, suspend_s: float = 0.3,
                  n_shards: int = 1,
-                 preempt_at: int | None = None) -> "FaultSchedule":
+                 preempt_at: int | None = None,
+                 worker_losses: int = 0, worker_joins: int = 0,
+                 n_workers: int = 1) -> "FaultSchedule":
         """Draw a schedule over training steps ``[1, steps)`` from ``seed``.
 
         Counts are clipped to the available steps.  Shard-targeted faults
         pick a victim shard uniformly from ``n_shards``.  ``preempt_at`` is
         explicit (a random preemption inside a bounded test run is rarely
         what you want — pass it when you do).
+
+        Elastic membership: ``worker_losses`` permanent DP-worker losses
+        (distinct victims drawn from ``n_workers``) and ``worker_joins``
+        rejoins — each join revives an earlier-lost worker at a step
+        strictly after its loss, so a generated schedule is always
+        physically consistent (never joins a worker that is present).
+        New draws consume the rng AFTER all pre-existing kinds, so
+        schedules generated with the old kwargs are byte-identical.
         """
         rng = np.random.default_rng(seed)
         hi = max(int(steps), 2)
@@ -128,6 +143,29 @@ class FaultSchedule:
                                      float(suspend_s)))
         if preempt_at is not None:
             events.append(FaultEvent(int(preempt_at), "preempt"))
+        n_loss = min(int(worker_losses), max(n_workers - 1, 0), hi - 2)
+        if n_loss > 0:
+            loss_steps = sorted(pick(n_loss))
+            # a joined worker's loss must leave room for a STRICTLY later
+            # join step (a same-step pair sorts join-first and the monitor
+            # would drop it, silently losing the worker forever): clamp
+            # those losses to hi-2.  With hi < 3 there is no such room —
+            # the joins are dropped, not mis-scheduled.
+            n_join = min(int(worker_joins), n_loss) if hi >= 3 else 0
+            if n_join:
+                for i in range(n_join):
+                    loss_steps[i] = min(loss_steps[i], hi - 2)
+                loss_steps.sort()
+            victims = [int(v) for v in rng.choice(np.arange(max(n_workers,
+                                                                1)),
+                                                  size=n_loss,
+                                                  replace=False)]
+            for s, v in zip(loss_steps, victims):
+                events.append(FaultEvent(s, "worker_loss", float(v)))
+            for i in range(n_join):
+                join_s = int(rng.integers(loss_steps[i] + 1, hi))
+                events.append(FaultEvent(join_s, "worker_join",
+                                         float(victims[i])))
         return cls(events)
 
     def at(self, step: int) -> list[FaultEvent]:
@@ -170,6 +208,10 @@ class FaultInjector:
         self._armed_van = deque()   # one-shot ("error"|"delay", arg)
         self._armed_data = 0
         self._nan_armed = False
+        # membership events for the elastic supervisor: ("loss"|"join",
+        # worker_idx), drained via pop_worker_events() at the top of each
+        # step — the injector records, the supervisor decides
+        self.worker_events = deque()
         self._lock = threading.Lock()
         self._prev_hook = None
         self._installed = False
@@ -227,6 +269,22 @@ class FaultInjector:
             elif k == "preempt":
                 self.counters["preempts_injected"] += 1
                 os.kill(self.pid, signal.SIGTERM)
+            elif k == "worker_loss":
+                self.counters["worker_losses_injected"] += 1
+                with self._lock:
+                    self.worker_events.append(("loss", int(ev.arg)))
+            elif k == "worker_join":
+                self.counters["worker_joins_injected"] += 1
+                with self._lock:
+                    self.worker_events.append(("join", int(ev.arg)))
+
+    def pop_worker_events(self) -> list:
+        """Drain pending membership events as [("loss"|"join", worker)].
+        Called by the elastic supervisor once per step."""
+        with self._lock:
+            out = list(self.worker_events)
+            self.worker_events.clear()
+        return out
 
     def _proc(self, idx: int):
         if 0 <= idx < len(self.shard_procs):

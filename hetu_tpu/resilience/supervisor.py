@@ -100,6 +100,7 @@ class CheckpointManager:
         self.keep = int(keep)
         self.prefix = prefix
         self.skipped: list[str] = []  # corrupt candidates seen by restore
+        self.last_restored: Optional[str] = None  # path restore() used
 
     def _path(self, step: int) -> Path:
         return self.dir / f"{self.prefix}-{int(step):08d}.npz"
@@ -169,6 +170,7 @@ class CheckpointManager:
             except CheckpointCorruptError:
                 self.skipped.append(str(path))
                 continue
+            self.last_restored = str(path)
             return state, step
         return None
 
@@ -189,19 +191,34 @@ class PSShardGuard:
     ``sparse_set`` — only the recovered shard is touched, live shards never
     rewind.
 
-    Limits (see README "Fault tolerance"): repair restores WEIGHTS as of
-    the last snapshot — updates since the snapshot and server-side
-    optimizer slots restart fresh; the checkpoint cadence bounds the loss.
-    An alive-flicker without a blank re-create (``recovered`` unchanged) is
-    left alone.
+    Durable optimizer slots: when the table exposes ``slots_get`` /
+    ``slots_set`` (the csrc ``ps_table_slots_*`` ops over the van/group),
+    snapshots ALSO capture each live shard's server-side optimizer state —
+    s1 (velocity / adagrad accumulator / adam m), s2 (adam v), and the
+    per-row adam step — and repair replays them after the weights, so a
+    resurrected shard resumes with its REAL accumulators, bitwise, not
+    fresh zeros.  ``slots=False`` opts out (weights-only, the pre-slot
+    behavior).
+
+    Limits (see README "Fault tolerance"): repair restores weights AND
+    slots as of the last snapshot — updates since the snapshot are lost;
+    the checkpoint cadence bounds the loss.  An alive-flicker without a
+    blank re-create (``recovered`` unchanged) is left alone.
     """
 
-    def __init__(self, table, *, snapshot_path=None, name: str = "pstable"):
+    def __init__(self, table, *, snapshot_path=None, name: str = "pstable",
+                 slots: bool = True):
         self.table = table
         self.name = name
         self.snapshot_path = Path(snapshot_path) if snapshot_path else None
+        self.slots = bool(slots) and hasattr(table, "slots_get") \
+            and hasattr(table, "slots_set")
         self._snap = None              # [rows, dim] f32, lazily allocated
+        self._snap_s1 = None           # [rows, dim] f32 optimizer slot 1
+        self._snap_s2 = None           # [rows, dim] f32 optimizer slot 2
+        self._snap_step = None         # [rows] u64 per-row adam step
         self._have: set[int] = set()   # shard idx with valid snapshot rows
+        self._have_slots: set[int] = set()  # shard idx with slot snapshot
         self._pending: set[int] = set()  # shards seen dead, awaiting repair
         self._seen_recovered = int(table.recovered)
         self.repairs = 0
@@ -209,6 +226,11 @@ class PSShardGuard:
             z = np.load(self.snapshot_path)
             self._snap = z["values"]
             self._have = {int(i) for i in z["have"]}
+            if "s1" in z.files:  # pre-slot snapshot files stay loadable
+                self._snap_s1 = z["s1"]
+                self._snap_s2 = z["s2"]
+                self._snap_step = z["step"]
+                self._have_slots = {int(i) for i in z["have_slots"]}
 
     def shard_rows(self, i: int) -> np.ndarray:
         starts = self.table.shard_starts
@@ -223,6 +245,10 @@ class PSShardGuard:
         if self._snap is None:
             self._snap = np.zeros((self.table.rows, self.table.dim),
                                   np.float32)
+        if self.slots and self._snap_s1 is None:
+            self._snap_s1 = np.zeros_like(self._snap)
+            self._snap_s2 = np.zeros_like(self._snap)
+            self._snap_step = np.zeros(self.table.rows, np.uint64)
         captured = 0
         alive = self.table.alive
         for i, a in enumerate(alive):
@@ -231,18 +257,38 @@ class PSShardGuard:
                 continue
             rows = self.shard_rows(i)
             try:
-                self._snap[rows] = self.table.sparse_pull(rows)
+                # pull into locals, commit only after EVERY read succeeds:
+                # a shard dying between the weight pull and the slot pull
+                # must not leave new weights paired with the previous
+                # snapshot's accumulators (a torn pair that never
+                # coexisted would be replayed on repair)
+                vals = self.table.sparse_pull(rows)
+                if self.slots:
+                    s1, s2, st = self.table.slots_get(rows)
             except (RuntimeError, ConnectionError, TimeoutError):
                 self._pending.add(i)  # died between the mask and the pull
                 continue
+            self._snap[rows] = vals
+            if self.slots:
+                self._snap_s1[rows] = s1
+                self._snap_s2[rows] = s2
+                self._snap_step[rows] = st
+                self._have_slots.add(i)
             self._have.add(i)
             captured += 1
         if self.snapshot_path is not None and captured:
             tmp = self.snapshot_path.with_name(self.snapshot_path.name
                                                + ".tmp")
+            arrays = {"values": self._snap,
+                      "have": np.asarray(sorted(self._have), np.int64)}
+            if self.slots:
+                arrays.update(
+                    s1=self._snap_s1, s2=self._snap_s2,
+                    step=self._snap_step,
+                    have_slots=np.asarray(sorted(self._have_slots),
+                                          np.int64))
             with open(tmp, "wb") as f:
-                np.savez(f, values=self._snap,
-                         have=np.asarray(sorted(self._have), np.int64))
+                np.savez(f, **arrays)
             tmp.replace(self.snapshot_path)
         return captured
 
@@ -289,6 +335,11 @@ class PSShardGuard:
                 recreated = False          # flicker: data intact
             if recreated and i in self._have:
                 t.sparse_set(rows, self._snap[rows])
+                if self.slots and i in self._have_slots:
+                    # AFTER the weights: sparse_set leaves slots untouched,
+                    # so the restored accumulators land bitwise-exact
+                    t.slots_set(rows, self._snap_s1[rows],
+                                self._snap_s2[rows], self._snap_step[rows])
                 done += 1
                 self.repairs += 1
             self._pending.discard(i)
@@ -385,11 +436,24 @@ class Supervisor:
         self._preempt.set()
         self.counters["preempt_signals"] += 1
 
+    # ---- subclass hooks (ElasticSupervisor overrides) ----
+    def _maybe_resize(self, state, step_i: int):
+        """Membership hook, called at the top of every step AFTER injected
+        faults land: the base supervisor's mesh is fixed for the life of
+        the run, so this is the identity.  ElasticSupervisor overrides it
+        to reform the mesh and redistribute state."""
+        return state
+
+    def _ckpt_extra(self) -> Optional[dict]:
+        """Extra JSON recorded in every checkpoint header (None = none).
+        ElasticSupervisor records the live DP width here."""
+        return None
+
     # ---- checkpoint + snapshots ----
     def _checkpoint(self, state, step: int) -> None:
         t0 = time.perf_counter()
         if self.manager is not None:
-            self.manager.save(state, step)
+            self.manager.save(state, step, extra=self._ckpt_extra())
         for g in self.guards:
             try:
                 g.snapshot()
@@ -441,6 +505,7 @@ class Supervisor:
             while step_i < int(steps):
                 if self.injector is not None:
                     self.injector.on_step(step_i)
+                state = self._maybe_resize(state, step_i)
                 for g in self.guards:
                     repaired = self._with_retries(g.poll, "guard")
                     if repaired:

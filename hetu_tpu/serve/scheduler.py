@@ -41,6 +41,8 @@ class Request:
     state: str = "new"        # new|queued|running|done
     status: str = ""          # ok|timeout|cancelled|overflow|shutdown
     slot: Optional[int] = None
+    requeues: int = 0         # engine-failover requeue count (bounded)
+    folded: int = 0           # tokens already folded into prompt on requeue
     submitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
@@ -55,9 +57,13 @@ class Request:
 
 class ContinuousBatchingScheduler:
     def __init__(self, engine, *, token_budget: Optional[int] = None,
-                 metrics=None):
+                 metrics=None, max_requeues: int = 3):
         self.engine = engine
         self.metrics = metrics or engine.metrics
+        # engine-failover requeue budget per request: a request whose
+        # (re)admission keeps killing engines must eventually fail instead
+        # of poisoning every restarted incarnation
+        self.max_requeues = int(max_requeues)
         cache = engine.cache
         # default budget: the cache itself (backpressure only kicks in
         # when admission would overrun physical capacity anyway)
@@ -87,6 +93,80 @@ class ContinuousBatchingScheduler:
             self.metrics.set_gauge("queue_depth", len(self._queue))
         return request
 
+    def requeue_inflight(self, *, max_requeues: Optional[int] = None) -> int:
+        """Engine-failover path: put every RUNNING request back at the
+        head of the queue instead of failing it.  Each request's emitted
+        tokens are folded into its prompt, so the next admission
+        re-prefills from (prompt + tokens so far) and greedy decode
+        continues token-for-token — a single engine crash loses zero
+        accepted requests once a restarted engine picks the queue back up.
+
+        A request requeued more than ``max_requeues`` times is finished
+        with status 'error' instead: a deterministically-poisonous request
+        must not kill every engine incarnation forever.  Returns how many
+        requests were requeued.
+        """
+        cap = self.max_requeues if max_requeues is None else max_requeues
+        with self._lock:
+            requeued = 0
+            # newest-submitted first + appendleft = oldest request ends up
+            # at the queue head (slot index is NOT admission order once
+            # slots get reused; submission time is)
+            for slot, req in sorted(
+                    self._running.items(), reverse=True,
+                    key=lambda kv: (kv[1].submitted_at or 0.0, kv[1].rid)):
+                del self._running[slot]
+                try:
+                    self.engine.release(slot)
+                except Exception:
+                    # engine too broken to release: free the cache slot
+                    # directly, else the next step() "succeeds" doing
+                    # nothing (queue full, zero free slots, zero running)
+                    # and the loop never accumulates to dead
+                    try:
+                        self.engine.cache.free(slot)
+                    except Exception:
+                        pass  # restart replaces the whole engine+cache
+                if self._requeue_locked(req, cap):
+                    requeued += 1
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            return requeued
+
+    def _requeue_locked(self, req: Request, cap: int, *,
+                        tail: bool = False) -> bool:
+        """Fold emitted tokens into the prompt and put ``req`` back in the
+        queue (caller holds the lock) — at the head for engine-crash
+        failover (preserves admission order), at the ``tail`` for a
+        request whose own prefill failed (everyone else goes first).
+        Over-``cap`` requests finish with 'error' instead.  Returns True
+        if requeued."""
+        req.slot = None
+        req.requeues += 1
+        if req.requeues > cap:
+            self._finish(req, "error")
+            return False
+        fresh = req.tokens[req.folded:]
+        req.prompt = list(req.prompt) + list(fresh)
+        req.folded += len(fresh)
+        req.state = "queued"
+        if tail:
+            self._queue.append(req)
+        else:
+            self._queue.appendleft(req)
+        self.metrics.inc("requests_requeued")
+        return True
+
+    def replace_engine(self, engine) -> None:
+        """Swap in a (restarted) engine and reopen intake.  Any requests
+        still marked running against the old engine are requeued first, so
+        nothing references the dead engine's slots."""
+        with self._lock:
+            self._accepting = True
+            self._reject_status = "shutdown"
+        self.requeue_inflight()
+        with self._lock:
+            self.engine = engine
+
     def cancel(self, request: Request) -> None:
         """Abandon a request wherever it is (listener timeout path)."""
         with self._lock:
@@ -102,12 +182,24 @@ class ContinuousBatchingScheduler:
 
     # ---- the continuous-batching step ----
     def step(self) -> list:
-        """Admit + one decode round.  Returns requests completed now."""
+        """Admit + one decode round.  Returns requests completed now.
+
+        Error containment: a single request whose PREFILL raises is
+        charged to that request (requeued at the tail, finished 'error'
+        past its requeue cap) and other work continues — one poisoned
+        prompt must not count engine-loop strikes while the engine is
+        demonstrably serving everyone else.  The step re-raises the
+        admission error only when NOTHING progressed (no successful
+        prefill, no decode) — the whole-engine-failure signal the
+        server's death counter needs.  Decode failures always raise
+        (decode is one fused call over every slot: there is no
+        per-request attribution)."""
         completed = []
         with self._lock:
-            self._admit(completed)
+            progressed, admit_exc = self._admit(completed)
             if self._running:
                 toks = self.engine.decode()
+                progressed = True
                 now = time.monotonic()
                 for slot, req in list(self._running.items()):
                     req.tokens.append(toks[slot])
@@ -119,6 +211,8 @@ class ContinuousBatchingScheduler:
             self.metrics.set_gauge("queue_depth", len(self._queue))
             self.metrics.set_gauge("slot_occupancy",
                                    self.engine.cache.occupancy)
+            if admit_exc is not None and not progressed:
+                raise admit_exc
         return completed
 
     def has_work(self) -> bool:
@@ -126,7 +220,12 @@ class ContinuousBatchingScheduler:
             return bool(self._queue or self._running)
 
     # ---- internals (called under the lock) ----
-    def _admit(self, completed: list) -> None:
+    def _admit(self, completed: list):
+        """Admit queued requests into free slots.  Returns ``(progressed,
+        admit_exc)``: whether any prefill succeeded, and the last
+        admission exception (step() re-raises it only on zero progress)."""
+        progressed = False
+        admit_exc = None
         now = time.monotonic()
         while self._queue and self.engine.cache.num_free:
             req = self._queue[0]
@@ -152,35 +251,57 @@ class ContinuousBatchingScheduler:
             if self.engine.cache.active_tokens + n + 1 > self.token_budget:
                 break
             self._queue.popleft()
-            slot = self.engine.alloc_slot()
+            try:
+                slot = self.engine.alloc_slot()
+            except Exception as e:
+                # an engine broken enough to fail allocation must not
+                # orphan the request it was about to admit: back to the
+                # head, unchanged (no requeue charged — nothing ran).
+                # This is engine-level, not request-level: stop admitting.
+                req.state = "queued"
+                self._queue.appendleft(req)
+                admit_exc = e
+                break
             req.slot = slot
             req.state = "running"
             try:
                 first = self.engine.prefill(slot, req.prompt)
-            except Exception:
+            except Exception as e:
                 # a prefill blow-up must not orphan the request: at this
                 # point it is in NEITHER the queue NOR _running, so the
-                # engine loop's drain("error") could never find it — the
-                # client would hang out its full timeout undiagnosed.
-                # Fail it FIRST (req.done must be set even if the broken
-                # engine's release also throws), then free the slot
-                # best-effort, then let the loop count the error.
-                self._finish(req, "error")
-                completed.append(req)
+                # failover requeue could never find it — the client would
+                # hang out its full timeout undiagnosed.  Requeue it at
+                # the TAIL (other requests get served first; past its
+                # requeue cap it fails 'error' — either way req resolves
+                # even if the broken engine's release also throws), free
+                # the slot best-effort, and keep admitting: step() decides
+                # from overall progress whether this was the request's
+                # fault or the engine's.
+                admit_exc = e
+                if not self._requeue_locked(req, self.max_requeues,
+                                            tail=True):
+                    completed.append(req)
                 try:
                     self.engine.release(slot)
                 except Exception:
                     pass  # engine already broken; the loop records that
-                raise
+                continue
+            progressed = True
             req.tokens.append(first)
-            req.first_token_at = time.monotonic()
-            self.metrics.observe_ttft(req.ttft_s)
+            now_t = time.monotonic()
+            if req.first_token_at is None:
+                # only the FIRST admission observes TTFT: a failover
+                # re-prefill must not double-count the histogram or
+                # overwrite the client-visible ttft_s
+                req.first_token_at = now_t
+                self.metrics.observe_ttft(req.ttft_s)
             self._running[slot] = req
-            if self._should_evict(req, req.first_token_at):
+            if self._should_evict(req, now_t):
                 del self._running[slot]
                 self.engine.release(slot)
                 self._finish(req, req.status or "ok")
                 completed.append(req)
+        return progressed, admit_exc
 
     def _should_evict(self, req: Request, now: float) -> bool:
         if req.eos_id is not None and req.tokens[-1] == req.eos_id:

@@ -46,13 +46,22 @@ class InferenceServer:
                  port: int = 0, max_clients: int = 4,
                  request_timeout_s: float = 60.0,
                  poll_s: float = 0.25, own_van: bool = True,
-                 max_loop_errors: int = 3):
+                 max_loop_errors: int = 3,
+                 failover_grace_s: float = 10.0):
         """port=0 picks a free port; ``own_van=False`` attaches to a van
         already serving in this process (the server then must be handed
         that van's port).  ``max_loop_errors`` consecutive engine-loop
         exceptions (no successful step in between) declare the engine dead:
-        the loop exits, queued/new requests fail fast with status 'error',
-        and ``healthy`` turns False."""
+        the loop exits and ``healthy`` turns False.
+
+        Request failover: every engine-loop exception requeues the
+        in-flight requests (re-prefill from prompt + tokens emitted so
+        far, bounded by the scheduler's ``max_requeues``) instead of
+        failing them, so an engine crash followed by
+        :meth:`restart_engine` within ``failover_grace_s`` loses ZERO
+        accepted requests.  If no restart arrives inside the grace window
+        (or ``failover_grace_s <= 0``), the queue drains with status
+        'error' and new submits fail fast — the pre-failover behavior."""
         from hetu_tpu.ps import van
         self._van = van
         self.scheduler = scheduler
@@ -61,6 +70,7 @@ class InferenceServer:
         self._poll_s = float(poll_s)
         self._own_van = own_van
         self._max_loop_errors = int(max_loop_errors)
+        self._failover_grace_s = float(failover_grace_s)
         if own_van:
             self.port = van.serve(port)
         else:
@@ -70,6 +80,8 @@ class InferenceServer:
         self._stop = threading.Event()
         self.last_loop_error = None
         self._loop_dead = False
+        self._restart_evt = threading.Event()
+        self._grace_thread = None
         self._loop = threading.Thread(target=self._engine_loop, daemon=True)
         self._listeners = [
             threading.Thread(target=self._listen, args=(cid,), daemon=True)
@@ -98,26 +110,80 @@ class InferenceServer:
                 else:
                     time.sleep(0.002)
             except Exception:
-                # a step blowing up must fail the in-flight requests (the
-                # listeners are waiting on their events), not wedge them —
-                # but keep the evidence: traceback to stderr, repr for the
-                # operator, a counter for dashboards
+                # a step blowing up must not wedge the in-flight requests
+                # (the listeners are waiting on their events) OR lose them:
+                # requeue them for a retry / a restarted engine, keep the
+                # evidence (traceback to stderr, repr for the operator, a
+                # counter for dashboards)
                 import traceback
                 self.last_loop_error = traceback.format_exc()
                 traceback.print_exc()
                 self.metrics.inc("engine_loop_errors")
                 consecutive += 1
-                dead = consecutive >= self._max_loop_errors
                 try:
-                    # dead engine: also stop intake, so every later submit
-                    # fails fast with 'error' instead of parking a listener
-                    self.scheduler.drain("error", stop_accepting=dead)
+                    self.scheduler.requeue_inflight()
                 except Exception:
                     traceback.print_exc()  # never let cleanup kill the loop
-                if dead:
+                if consecutive >= self._max_loop_errors:
                     self._loop_dead = True
                     self.metrics.inc("engine_loop_dead")
+                    self._arm_failover_grace()
                     return
+
+    def _arm_failover_grace(self) -> None:
+        """The engine is dead; the queue (incl. requeued in-flight work) is
+        intact.  Hold it for ``failover_grace_s`` awaiting restart_engine;
+        expire into the fail-fast drain so clients are never wedged on a
+        restart that will not come."""
+        if self._failover_grace_s <= 0:
+            self._expire_failover()
+            return
+
+        restart_evt = self._restart_evt
+
+        def grace():
+            if not restart_evt.wait(self._failover_grace_s):
+                self._expire_failover()
+
+        self._grace_thread = threading.Thread(target=grace, daemon=True)
+        self._grace_thread.start()
+
+    def _expire_failover(self) -> None:
+        import traceback
+        try:
+            self.scheduler.drain("error", stop_accepting=True)
+            self.metrics.inc("failover_expired")
+        except Exception:
+            traceback.print_exc()
+
+    # ---- engine restart (request failover) ----
+    def restart_engine(self, engine) -> None:
+        """Swap in a fresh/recovered engine and resume serving: the
+        scheduler re-adopts its queue (requeued in-flight requests
+        re-prefill from prompt + tokens emitted so far), intake reopens,
+        a new engine loop starts, and ``healthy`` recovers.  Call within
+        ``failover_grace_s`` of the crash for the zero-loss guarantee."""
+        if self._stop.is_set():
+            raise RuntimeError("server is closed")
+        if self._loop_dead:
+            # the dying loop thread flips _loop_dead BEFORE it arms the
+            # grace timer and exits; a caller polling `healthy` can land
+            # in that window.  Join it first so the grace timer is armed
+            # with the CURRENT event (cancellable below) and is_alive()
+            # below reads the settled state.
+            self._loop.join(timeout=10.0)
+        self._restart_evt.set()           # cancel the pending grace timer
+        if self._grace_thread is not None:
+            self._grace_thread.join(timeout=5.0)
+        self._restart_evt = threading.Event()
+        self.scheduler.replace_engine(engine)
+        self.last_loop_error = None
+        self._loop_dead = False
+        if not self._loop.is_alive():
+            self._loop = threading.Thread(target=self._engine_loop,
+                                          daemon=True)
+            self._loop.start()
+        self.metrics.inc("engine_restarts")
 
     # ---- one listener per client channel pair ----
     def _listen(self, cid: int) -> None:
@@ -207,6 +273,9 @@ class InferenceServer:
     # ---- lifecycle ----
     def close(self, timeout_s: float = 10.0) -> None:
         self._stop.set()
+        self._restart_evt.set()  # a pending grace timer must not outlive us
+        if self._grace_thread is not None:
+            self._grace_thread.join(timeout_s)
         self.scheduler.drain("shutdown", stop_accepting=True)
         self._loop.join(timeout_s)
         for t in self._listeners:

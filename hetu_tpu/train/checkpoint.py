@@ -114,8 +114,38 @@ def save(path, state, *, extra: Optional[dict] = None) -> None:
             tmp.unlink()
 
 
+def read_header(path) -> dict:
+    """Parse just the JSON header of a checkpoint: ``{version, n_leaves,
+    dtypes, shapes, rng, extra}`` — no leaf bytes are decoded.  The elastic
+    resume path reads ``extra['dp_width']`` here to learn the width a run
+    was saved at BEFORE deciding what mesh to restore onto."""
+    try:
+        z = np.load(path, allow_pickle=False)
+    except (zipfile.BadZipFile, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{path} is not a readable checkpoint ({e})") from e
+    with z:
+        try:
+            return json.loads(bytes(z["header"]).decode("utf-8"))
+        except (KeyError, UnicodeDecodeError, json.JSONDecodeError,
+                zipfile.BadZipFile) as e:
+            raise CheckpointCorruptError(
+                f"{path}: checkpoint header missing or unreadable ({e})"
+            ) from e
+
+
 def load(path, state_template, *, restore_rng: bool = True):
-    """Restore into the structure (and shardings) of `state_template`."""
+    """Restore into the structure (and shardings) of `state_template`.
+
+    Width-portable by construction: leaves are saved as GLOBAL arrays, so
+    a checkpoint taken under one mesh restores under any other mesh of the
+    same global shapes — ``jax.device_put`` against the template's
+    shardings re-splits each leaf for the live layout (the reference's
+    ``load_dict(consider_splits=True)``).  A GLOBAL-shape mismatch is a
+    different architecture (or a genuinely incompatible elastic config,
+    e.g. width-dependent state) and raises :class:`CheckpointError` naming
+    the saved ``dp_width`` when the checkpoint recorded one — never a
+    silent mis-placement."""
     try:
         z = np.load(path, allow_pickle=False)
     except zipfile.BadZipFile as e:
@@ -163,15 +193,23 @@ def load(path, state_template, *, restore_rng: bool = True):
     out = []
     for i, (arr, tmpl) in enumerate(zip(leaves, leaves_t)):
         if hasattr(tmpl, "shape") and tuple(arr.shape) != tuple(tmpl.shape):
+            saved_w = (header.get("extra") or {}).get("dp_width")
+            width_note = (
+                f" (checkpoint saved at dp_width={saved_w}; resharding on "
+                "load only re-places GLOBAL arrays — a global-shape change "
+                "cannot be resharded)" if saved_w is not None else "")
             raise CheckpointError(
                 f"checkpoint leaf {i} shape {arr.shape} != template "
-                f"{tuple(tmpl.shape)} — wrong architecture?")
+                f"{tuple(tmpl.shape)} — wrong architecture?{width_note}")
         if hasattr(tmpl, "dtype") and arr.dtype != tmpl.dtype:
             # restore into the template's dtype (e.g. old bf16 Adam slots
             # into the new f32-slot layout) so the state stays dtype-stable
             arr = arr.astype(tmpl.dtype)
         if hasattr(tmpl, "sharding"):
-            arr = jax.device_put(arr, tmpl.sharding)  # re-split for new layout
+            # re-split for the live layout; host_to_device guards the CPU
+            # zero-copy-adoption + donation hazard (see parallel/mesh.py)
+            from hetu_tpu.parallel.mesh import host_to_device
+            arr = host_to_device(arr, tmpl.sharding)
         out.append(arr)
     if restore_rng:
         hrng.set_seed_status(*header["rng"])

@@ -99,7 +99,29 @@ class Executor:
             raise ValueError("dist_strategy requires a mesh")
         if seed is not None:
             hrng.set_random_seed(seed)
+        # constant baked into the traced step: an elastic shrink at fixed
+        # per-worker batch rescales gradients by nominal/current width so a
+        # sum-over-nominal-global-batch loss keeps its scale (set via
+        # set_grad_scale, which retraces)
+        self.grad_scale = 1.0
         self._compiled: Dict[str, Callable] = {}
+
+    # ---- elastic resharding support (resilience/elastic.py) ----
+    def set_mesh(self, mesh: Optional[Mesh]) -> None:
+        """Point the executor at a (re)formed mesh and drop every compiled
+        executable — shardings are baked into the jitted steps at trace
+        time, so a mesh change REQUIRES a retrace.  The caller re-places
+        the live TrainState itself (jax.device_put under the new mesh's
+        shardings) before the next run()."""
+        self.mesh = mesh
+        self._compiled.clear()
+
+    def set_grad_scale(self, scale: float) -> None:
+        """Change the gradient rescale constant (traced in, so this drops
+        the compiled steps).  No-op when the scale is unchanged."""
+        if float(scale) != self.grad_scale:
+            self.grad_scale = float(scale)
+            self._compiled.clear()
 
     # ---- state ----
     def init_state(self, variables: dict, rng_key=None) -> TrainState:
@@ -146,6 +168,9 @@ class Executor:
                                 True)
         (loss, (metrics, new_model_state)), grads = jax.value_and_grad(
             lf, has_aux=True)(state.params)
+        if self.grad_scale != 1.0:
+            s = self.grad_scale
+            grads = jax.tree_util.tree_map(lambda g: g * s, grads)
         params, opt_state = self.optimizer.update(grads, state.opt_state,
                                                   state.params)
         new_state = TrainState(params=params, opt_state=opt_state,

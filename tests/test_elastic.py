@@ -1,0 +1,306 @@
+"""Elastic mesh resharding: worker loss/rejoin reforms the mesh and
+redistributes state deterministically.
+
+Fast lane (tier-1): mesh reformation, the width-invariant batch schedule,
+membership promotion, the in-process 4→3→4 acceptance run (final params
+match a fault-free run under the same global-batch schedule, schedule
+``to_json`` byte-stable), width-recorded checkpoints restoring at a
+different width, and per-worker grad rescale.
+
+The PS-backed durable-slot chaos runs live in
+tests/test_elastic_chaos.py (slow + chaos + elastic).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import hetu_tpu as ht
+from hetu_tpu import layers, optim
+from hetu_tpu.data.dataloader import ElasticBatchSchedule
+from hetu_tpu.parallel.mesh import AXIS_DP, MeshConfig, elastic_mesh
+from hetu_tpu.resilience import (
+    CheckpointManager, ElasticReshardError, ElasticSupervisor, FaultEvent,
+    FaultInjector, FaultSchedule, MembershipMonitor, Supervisor,
+)
+from hetu_tpu.train import checkpoint as ckpt
+from hetu_tpu.train.checkpoint import CheckpointError
+from hetu_tpu.train.executor import Executor
+
+pytestmark = pytest.mark.elastic
+
+
+# ---------------------------------------------------------------------------
+# mesh reformation
+# ---------------------------------------------------------------------------
+
+def test_elastic_mesh_survivors_keep_their_devices():
+    cfg = MeshConfig(dp=4)
+    full = elastic_mesh(cfg, [0, 1, 2, 3])
+    shrunk = elastic_mesh(cfg, [0, 1, 3])
+    assert shrunk.shape[AXIS_DP] == 3
+    # survivors keep their exact devices, in rank order
+    full_dp = list(full.devices.reshape(4, -1))
+    shrunk_dp = list(shrunk.devices.reshape(3, -1))
+    for pos, worker in enumerate([0, 1, 3]):
+        assert list(shrunk_dp[pos]) == list(full_dp[worker])
+
+
+def test_elastic_mesh_with_tp_groups():
+    cfg = MeshConfig(dp=4, tp=2)
+    m = elastic_mesh(cfg, [1, 2])
+    assert m.shape[AXIS_DP] == 2 and m.shape["tp"] == 2
+    # worker 1's tp pair in the nominal mesh is devices [2, 3]
+    nominal = elastic_mesh(cfg, [0, 1, 2, 3])
+    np.testing.assert_array_equal(
+        np.vectorize(id)(m.devices[:, 0, :, :, :]),
+        np.vectorize(id)(nominal.devices[:, 1, :, :, :]))
+
+
+def test_elastic_mesh_rejects_bad_membership():
+    cfg = MeshConfig(dp=4)
+    with pytest.raises(ValueError):
+        elastic_mesh(cfg, [])
+    with pytest.raises(ValueError):
+        elastic_mesh(cfg, [0, 4])
+    with pytest.raises(ValueError):
+        elastic_mesh(cfg, [1, 1])
+
+
+# ---------------------------------------------------------------------------
+# width-invariant batch schedule
+# ---------------------------------------------------------------------------
+
+def test_schedule_global_batches_are_width_invariant():
+    X = np.arange(480, dtype=np.float32).reshape(120, 4)
+    s = ElasticBatchSchedule(X, 24, seed=7)
+    for step in (0, 3, 7):  # crosses an epoch boundary (5 batches/epoch)
+        g = s.global_batch(step)
+        for dp in (1, 2, 3, 4):
+            parts = [s.local_slice(step, r, dp) for r in range(dp)]
+            np.testing.assert_array_equal(np.concatenate(parts), g)
+    # same (seed, step) → identical batch, independent of call order
+    np.testing.assert_array_equal(s.global_batch(2), s.global_batch(2))
+
+
+def test_schedule_rejects_indivisible_width():
+    s = ElasticBatchSchedule(np.zeros((64, 2), np.float32), 16, seed=0)
+    s.check_width(4)
+    with pytest.raises(ValueError):
+        s.check_width(3)
+    with pytest.raises(ValueError):
+        s.local_slice(0, 0, 5)
+
+
+# ---------------------------------------------------------------------------
+# membership monitor
+# ---------------------------------------------------------------------------
+
+def test_monitor_threshold_promotion_and_join():
+    m = MembershipMonitor(4, fail_threshold=3)
+    m.report_failure(2)
+    m.report_failure(2)
+    m.report_ok(2)          # recovery clears the strikes
+    m.report_failure(2)
+    m.report_failure(2)
+    assert m.pop_decisions() == []
+    m.report_failure(2)     # third consecutive: promoted
+    assert m.pop_decisions() == [("loss", 2)]
+    assert m.alive == {0, 1, 3}
+    m.report_failure(2)     # already lost: no double decision
+    assert m.pop_decisions() == []
+    m.inject("join", 2)
+    assert m.pop_decisions() == [("join", 2)]
+    assert m.alive == {0, 1, 2, 3}
+    m.inject("join", 2)     # already present: no-op
+    assert m.pop_decisions() == []
+    with pytest.raises(ElasticReshardError):
+        m.inject("join", 9)
+
+
+def test_guard_failure_promotion_reshapes(monkeypatch):
+    """A PSShardGuard shard stuck pending for fail_threshold steps promotes
+    its hosting worker's loss and the supervisor reshapes."""
+    class FakeGuard:
+        _pending = {1}
+
+        def poll(self):
+            return 0
+
+        def snapshot(self):
+            return 0
+
+    model = layers.Linear(4, 2)
+
+    def loss_fn(params, model_state, batch, rng, train):
+        pred, ns = model.apply({"params": params, "state": model_state},
+                               batch["x"], train=train, rng=rng)
+        return jnp.mean((pred - batch["y"]) ** 2), ({}, ns)
+
+    ex = Executor(loss_fn, optim.SGDOptimizer(0.1), seed=0)
+    state = ex.init_state(model.init(jax.random.PRNGKey(0)))
+    g = np.random.default_rng(0)
+    batch = {"x": g.standard_normal((12, 4)).astype(np.float32),
+             "y": g.standard_normal((12, 2)).astype(np.float32)}
+    sup = ElasticSupervisor(ex, config=MeshConfig(dp=4),
+                            guards=[FakeGuard()],
+                            shard_workers={1: 3}, fail_threshold=3)
+    rep = sup.run(state, lambda i: batch, 6)
+    assert rep.step == 6
+    assert [(e.kind, e.worker, e.width) for e in sup.resizes] == \
+        [("shrink", 3, 3)]
+    assert sup.resizes[0].step == 2  # strikes at steps 0,1 → promoted at 2
+    assert ex.mesh.shape[AXIS_DP] == 3
+
+
+# ---------------------------------------------------------------------------
+# the in-process acceptance run: 4 → 3 → 4
+# ---------------------------------------------------------------------------
+
+def _make_problem(seed=1):
+    model = layers.Sequential(layers.Linear(6, 16), layers.Relu(),
+                              layers.Linear(16, 3))
+
+    def loss_fn(params, model_state, batch, rng, train):
+        pred, ns = model.apply({"params": params, "state": model_state},
+                               batch["x"], train=train, rng=rng)
+        return jnp.mean((pred - batch["y"]) ** 2), ({}, ns)
+
+    ex = Executor(loss_fn, optim.AdamOptimizer(0.03), seed=seed)
+    state = ex.init_state(model.init(jax.random.PRNGKey(seed)))
+    return ex, state
+
+
+def test_elastic_4_3_4_matches_fault_free():
+    """Seeded worker-loss at step k reshapes 4→3, a later rejoin regrows
+    to 4, the run never aborts, and the final params match a fault-free
+    run consuming the SAME global-batch schedule; the fault schedule's
+    to_json is byte-stable across replays."""
+    g = np.random.default_rng(0)
+    X = g.standard_normal((240, 6)).astype(np.float32)
+    Y = (X @ g.standard_normal((6, 3))).astype(np.float32)
+    sched = ElasticBatchSchedule((X, Y), 24, seed=3)
+
+    def batch_fn(i):
+        x, y = sched.global_batch(i)
+        return {"x": x, "y": y}
+
+    STEPS = 14
+    kw = dict(steps=STEPS, seed=11, worker_losses=1, worker_joins=1,
+              n_workers=4)
+    faults = FaultSchedule.generate(**kw)
+    assert faults.to_json() == FaultSchedule.generate(**kw).to_json()
+    kinds = sorted(e.kind for e in faults.events)
+    assert kinds == ["worker_join", "worker_loss"]
+    loss_ev = [e for e in faults.events if e.kind == "worker_loss"][0]
+    join_ev = [e for e in faults.events if e.kind == "worker_join"][0]
+    assert join_ev.step > loss_ev.step and join_ev.arg == loss_ev.arg
+
+    # fault-free reference: plain supervisor, fixed dp=4 mesh
+    ex0, st0 = _make_problem()
+    ex0.set_mesh(ht.make_mesh(dp=4))
+    rep0 = Supervisor(ex0).run(st0, batch_fn, STEPS)
+
+    ex1, st1 = _make_problem()
+    sup = ElasticSupervisor(ex1, config=MeshConfig(dp=4), schedule=sched,
+                            injector=FaultInjector(faults))
+    rep1 = sup.run(st1, batch_fn, STEPS)
+
+    assert rep1.step == STEPS and not rep1.preempted
+    assert [(e.kind, e.width) for e in sup.resizes] == \
+        [("shrink", 3), ("grow", 4)]
+    assert rep1.counters["worker_losses_injected"] == 1
+    assert rep1.counters["worker_joins_injected"] == 1
+    assert rep1.counters["elastic_width"] == 4
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5),
+        rep1.state.params, rep0.state.params)
+    # the RNG state rode the resharding exactly
+    np.testing.assert_array_equal(np.asarray(rep1.state.rng),
+                                  np.asarray(rep0.state.rng))
+
+
+def test_all_workers_lost_raises():
+    ex, state = _make_problem()
+    faults = FaultSchedule([FaultEvent(1, "worker_loss", float(w))
+                            for w in range(2)])
+    sup = ElasticSupervisor(ex, config=MeshConfig(dp=2),
+                            injector=FaultInjector(faults))
+    batch = {"x": np.zeros((8, 6), np.float32),
+             "y": np.zeros((8, 3), np.float32)}
+    with pytest.raises(ElasticReshardError):
+        sup.run(state, lambda i: batch, 4)
+
+
+def test_fixed_per_worker_mode_rescales_grads():
+    ex, state = _make_problem()
+    faults = FaultSchedule([FaultEvent(1, "worker_loss", 0.0)])
+    sup = ElasticSupervisor(ex, config=MeshConfig(dp=4),
+                            data_mode="fixed_per_worker",
+                            injector=FaultInjector(faults))
+
+    def batch_fn(i):
+        # per-worker batch of 4 at the CURRENT width
+        w = sup.width
+        return {"x": np.zeros((4 * w, 6), np.float32),
+                "y": np.zeros((4 * w, 3), np.float32)}
+
+    rep = sup.run(state, batch_fn, 3)
+    assert rep.step == 3
+    assert ex.grad_scale == pytest.approx(4 / 3)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint width portability
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_records_width_and_restores_at_different_width(tmp_path):
+    """An elastic run checkpoints at width 3 (post-shrink); a fresh run at
+    nominal width 4 resumes from it — the saved width is readable from the
+    header and the state re-places under the wider mesh."""
+    g = np.random.default_rng(0)
+    X = g.standard_normal((240, 6)).astype(np.float32)
+    Y = (X @ g.standard_normal((6, 3))).astype(np.float32)
+    sched = ElasticBatchSchedule((X, Y), 24, seed=3)
+
+    def batch_fn(i):
+        x, y = sched.global_batch(i)
+        return {"x": x, "y": y}
+
+    ex1, st1 = _make_problem()
+    faults = FaultSchedule([FaultEvent(1, "worker_loss", 2.0)])
+    sup1 = ElasticSupervisor(ex1, config=MeshConfig(dp=4), schedule=sched,
+                             injector=FaultInjector(faults),
+                             ckpt_dir=tmp_path, ckpt_every=2)
+    rep1 = sup1.run(st1, batch_fn, 6)
+    assert sup1.width == 3
+    mgr = CheckpointManager(tmp_path)
+    newest = mgr.steps()[-1]
+    hdr = ckpt.read_header(tmp_path / f"ckpt-{newest:08d}.npz")
+    assert hdr["extra"]["dp_width"] == 3
+    assert hdr["extra"]["alive"] == [0, 1, 3]
+    assert hdr["extra"]["nominal_dp"] == 4
+
+    # resume at a DIFFERENT width: full nominal fleet, no faults
+    ex2, st2 = _make_problem()
+    sup2 = ElasticSupervisor(ex2, config=MeshConfig(dp=4), schedule=sched,
+                             ckpt_dir=tmp_path, ckpt_every=2)
+    rep2 = sup2.run(st2, batch_fn, 10)
+    assert rep2.counters["resumed_from_step"] == newest
+    assert rep2.step == 10
+    assert sup2.width == 4
+    # and the restored leaves landed under the width-4 mesh
+    assert ex2.mesh.shape[AXIS_DP] == 4
+
+
+def test_incompatible_shapes_refuse_with_width_error(tmp_path):
+    """A GLOBAL-shape change cannot be resharded: restore must refuse with
+    an error naming the saved width, never silently mis-place."""
+    state = {"w": jnp.zeros((4, 3))}
+    ckpt.save(tmp_path / "c.npz", state, extra={"dp_width": 4})
+    with pytest.raises(CheckpointError) as ei:
+        ckpt.load(tmp_path / "c.npz", {"w": jnp.zeros((8, 3))})
+    assert "dp_width=4" in str(ei.value)

@@ -42,6 +42,7 @@ CASES = [
     ("mpmd_unequal_dp", ["--steps", "1"], "MPMD 3-stage"),
     ("gpt_serve", ["--requests", "4", "--max-tokens", "8"], "serve: OK"),
     ("resilient_train", ["--steps", "30"], "resilient train: OK"),
+    ("elastic_train", ["--steps", "24"], "elastic train: OK"),
 ]
 
 

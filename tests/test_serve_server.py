@@ -202,12 +202,14 @@ class _BoomEngine:
 
 def test_dead_engine_fails_requests_and_reports_unhealthy():
     """An engine whose step raises must NOT leave clients timing out with
-    no diagnosis: in-flight requests get an 'error' response, the loop
-    gives up after max_loop_errors consecutive failures, `healthy` flips
-    False, and later requests fail fast instead of parking listeners."""
+    no diagnosis: with no failover grace (restart_engine will never come),
+    in-flight requests get an 'error' response once the loop gives up
+    after max_loop_errors consecutive failures, `healthy` flips False,
+    and later requests fail fast instead of parking listeners."""
     sched = ContinuousBatchingScheduler(_BoomEngine())
     srv = InferenceServer(sched, max_clients=1, poll_s=0.05,
-                          request_timeout_s=10.0, max_loop_errors=3)
+                          request_timeout_s=10.0, max_loop_errors=3,
+                          failover_grace_s=0.0)
     client = InferenceClient("127.0.0.1", srv.port, 0)
     try:
         assert srv.healthy
@@ -233,6 +235,162 @@ def test_dead_engine_fails_requests_and_reports_unhealthy():
         assert time.monotonic() - t0 < 5.0
     finally:
         client.close()
+        srv.close()
+
+
+class _FlakyEngine:
+    """Proxy over a real ServeEngine that starts raising on command — the
+    'engine crashed mid-decode' case the failover path must survive."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.dead = False
+        self.decode_rounds = 0
+
+    @property
+    def cache(self):
+        return self.inner.cache
+
+    @property
+    def metrics(self):
+        return self.inner.metrics
+
+    def _check(self):
+        if self.dead:
+            raise RuntimeError("flaky: engine crashed")
+
+    def alloc_slot(self):
+        self._check()
+        return self.inner.alloc_slot()
+
+    def release(self, slot):
+        self._check()
+        self.inner.release(slot)
+
+    def prefill(self, slot, prompt):
+        self._check()
+        return self.inner.prefill(slot, prompt)
+
+    def decode(self):
+        self._check()
+        out = self.inner.decode()
+        self.decode_rounds += 1
+        return out
+
+
+def test_engine_crash_restart_loses_zero_requests(gpt):
+    """Kill the engine mid-generation, restart_engine a fresh one inside
+    the grace window: every accepted request completes 'ok' with the
+    token-for-token greedy answer (re-prefill from prompt + tokens
+    emitted so far), and `healthy` recovers."""
+    model, variables = gpt
+    flaky = _FlakyEngine(ServeEngine(model, variables, num_slots=2,
+                                     max_len=48, min_bucket=8))
+    sched = ContinuousBatchingScheduler(flaky)
+    srv = InferenceServer(sched, max_clients=3, poll_s=0.05,
+                          request_timeout_s=120.0, max_loop_errors=2,
+                          failover_grace_s=60.0)
+    prompts = {0: [1, 2, 3], 1: [9, 8, 7, 6], 2: [42, 5]}
+    results = {}
+    errors = []
+
+    def worker(cid):
+        c = InferenceClient("127.0.0.1", srv.port, cid)
+        try:
+            results[cid] = c.generate(prompts[cid], max_tokens=12,
+                                      timeout_s=120.0)
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append((cid, repr(e)))
+        finally:
+            c.close()
+
+    ts = [threading.Thread(target=worker, args=(cid,)) for cid in prompts]
+    try:
+        for t in ts:
+            t.start()
+        # let real decoding start, then crash the engine mid-flight
+        deadline = time.monotonic() + 60
+        while flaky.decode_rounds < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert flaky.decode_rounds >= 2, "engine never started decoding"
+        flaky.dead = True
+        while srv.healthy and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not srv.healthy
+        # restart inside the grace window: a FRESH engine adopts the queue
+        srv.restart_engine(ServeEngine(model, variables, num_slots=2,
+                                       max_len=48, min_bucket=8))
+        assert srv.healthy
+        for t in ts:
+            t.join(120)
+        assert not errors, errors
+        # ZERO loss: every accepted request completed, token-for-token
+        assert len(results) == 3
+        for cid, resp in results.items():
+            assert resp["status"] == "ok", (cid, resp)
+            assert resp["tokens"] == _ref_greedy(model, variables,
+                                                 prompts[cid], 12)
+        assert sched.metrics.count("requests_requeued") >= 1
+        assert sched.metrics.count("engine_restarts") == 1
+    finally:
+        srv.close()
+
+
+class _SelectivePoisonEngine:
+    """Proxy over a real ServeEngine whose prefill raises for ONE magic
+    prompt — the 'poisoned request' that must fail alone, not kill the
+    server."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    @property
+    def cache(self):
+        return self.inner.cache
+
+    @property
+    def metrics(self):
+        return self.inner.metrics
+
+    def alloc_slot(self):
+        return self.inner.alloc_slot()
+
+    def release(self, slot):
+        self.inner.release(slot)
+
+    def prefill(self, slot, prompt):
+        if int(np.asarray(prompt).reshape(-1)[0]) == 66:
+            raise RuntimeError("poisoned prompt")
+        return self.inner.prefill(slot, prompt)
+
+    def decode(self):
+        return self.inner.decode()
+
+
+def test_poisoned_request_fails_alone_server_stays_healthy(gpt):
+    """A request whose prefill deterministically raises is charged to the
+    REQUEST (status 'error' after its requeue cap) while the engine keeps
+    serving everyone else: no engine-loop strikes, `healthy` stays True."""
+    model, variables = gpt
+    eng = _SelectivePoisonEngine(ServeEngine(model, variables, num_slots=2,
+                                             max_len=48, min_bucket=8))
+    sched = ContinuousBatchingScheduler(eng)
+    srv = InferenceServer(sched, max_clients=2, poll_s=0.05,
+                          request_timeout_s=60.0, max_loop_errors=3)
+    good = InferenceClient("127.0.0.1", srv.port, 0)
+    bad = InferenceClient("127.0.0.1", srv.port, 1)
+    try:
+        r_bad = bad.generate([66, 2, 3], max_tokens=6, timeout_s=60.0)
+        assert r_bad["status"] == "error"
+        r_good = good.generate([5, 6, 7], max_tokens=6, timeout_s=60.0)
+        assert r_good["status"] == "ok"
+        assert r_good["tokens"] == _ref_greedy(model, variables,
+                                               [5, 6, 7], 6)
+        assert srv.healthy
+        assert srv.metrics.count("engine_loop_dead") == 0
+    finally:
+        good.close()
+        bad.close()
         srv.close()
 
 
