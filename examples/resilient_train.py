@@ -5,6 +5,11 @@ transient dataloader errors, a NaN-poisoned batch, and a simulated
 preemption (SIGTERM) — then auto-resumes from the atomic checkpoint and
 finishes, proving the run survives everything the schedule throws at it.
 
+The whole run is TRACED (hetu_tpu.telemetry): it writes a Perfetto-
+loadable trace next to the checkpoints, prints the fault → recovery
+pairing, and points at `tools/trace_report.py` for the full breakdown
+(README "Observability").
+
 Run:  python examples/resilient_train.py [--steps 40] [--seed 7]
 
 The same --seed replays the identical fault sequence (print the schedule
@@ -28,8 +33,9 @@ import jax.numpy as jnp
 import numpy as np
 
 import hetu_tpu as ht
-from hetu_tpu import layers, optim
+from hetu_tpu import layers, optim, telemetry
 from hetu_tpu.resilience import FaultInjector, FaultSchedule, Supervisor
+from hetu_tpu.telemetry import timeline
 from hetu_tpu.train.executor import Executor
 from hetu_tpu.utils.logger import MetricLogger
 
@@ -79,6 +85,10 @@ def main():
     if args.show_schedule:
         print("fault schedule:", schedule.to_json())
 
+    # trace the whole run (both supervisor incarnations share the stream)
+    trace_jsonl = str(Path(ckpt_dir) / "run.trace.jsonl")
+    telemetry.enable(jsonl_path=trace_jsonl)
+
     logger = MetricLogger()
     ex, state = make_executor(args.seed)
     sup = Supervisor(ex, ckpt_dir=ckpt_dir, ckpt_every=10,
@@ -106,6 +116,18 @@ def main():
           f"{c.get('nonfinite_steps_skipped', 0)} steps), "
           f"retries={c.get('retries', 0)}")
     assert rep2.step == args.steps and np.isfinite(loss)
+
+    # the trace: fault -> recovery pairing + a Perfetto export
+    tracer = telemetry.disable()
+    chrome = tracer.write_chrome(Path(ckpt_dir) / "run.trace.json")
+    pairs = timeline.correlate(telemetry.load_jsonl(trace_jsonl))
+    paired = sum(1 for p in pairs if p.paired)
+    expected = sum(1 for p in pairs if timeline.RECOVERY_FOR.get(p.kind))
+    print(f"trace: {len(tracer.events)} events -> {trace_jsonl}")
+    print(f"  fault->recovery pairs: {paired}/{expected} "
+          f"(report: python tools/trace_report.py {trace_jsonl}; "
+          f"Perfetto: {chrome})")
+    assert paired == expected, "every injected fault must pair"
     print("resilient train: OK")
 
 

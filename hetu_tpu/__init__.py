@@ -46,9 +46,10 @@ from hetu_tpu.parallel.mesh import make_mesh, local_mesh, MeshConfig
 #   hetu_tpu.ps (native PS plane), hetu_tpu.onnx, hetu_tpu.graphboard,
 #   hetu_tpu.launcher, hetu_tpu.graph (define-then-run facade),
 #   hetu_tpu.serve (inference serving tier), hetu_tpu.resilience
-#   (fault-tolerant training supervisor + chaos harness)
+#   (fault-tolerant training supervisor + chaos harness),
+#   hetu_tpu.telemetry (span tracing + typed metrics + chaos timelines)
 _LAZY = {"ps", "onnx", "graphboard", "launcher", "graph", "serve",
-         "resilience"}
+         "resilience", "telemetry"}
 
 
 def __getattr__(name):

@@ -8,7 +8,6 @@ JSON + svg layout, zero dependencies).
 
 from __future__ import annotations
 
-import html
 import json
 from pathlib import Path
 
@@ -96,10 +95,20 @@ graph.nodes.forEach(n => {{
 """
 
 
-def export_html(fn, *example_args, path="graphboard.html") -> str:
-    g = jaxpr_graph(fn, *example_args)
-    rows = (len(g["nodes"]) // 4 + 2)
-    out = _HTML.format(n=len(g["nodes"]), height=rows * 90,
-                       graph_json=json.dumps(g))
+def render_html(graph: dict, path="graphboard.html") -> str:
+    """Render a {nodes, edges} graph dict to a standalone HTML file.
+
+    The JSON is embedded verbatim inside a ``<script>`` block, so every
+    ``<`` is escaped to ``\\u003c`` (valid JSON, identical parse) — a
+    node label containing ``</script>`` or ``<!--`` must not terminate
+    the script block and break (or script-inject) the page."""
+    rows = (len(graph["nodes"]) // 4 + 2)
+    graph_json = json.dumps(graph).replace("<", "\\u003c")
+    out = _HTML.format(n=len(graph["nodes"]), height=rows * 90,
+                       graph_json=graph_json)
     Path(path).write_text(out)
     return str(path)
+
+
+def export_html(fn, *example_args, path="graphboard.html") -> str:
+    return render_html(jaxpr_graph(fn, *example_args), path)

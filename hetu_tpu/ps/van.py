@@ -19,6 +19,7 @@ import numpy as np
 
 from hetu_tpu.ps.binding import lib
 from hetu_tpu.ps.client import _as_idx, _as_mat, _check, _f32p, _i64p
+from hetu_tpu.telemetry import trace as _trace
 
 
 def _fresh_remote_id() -> int:
@@ -33,6 +34,90 @@ def _fresh_remote_id() -> int:
 # layer (csrc) already uses std::chrono::steady_clock for the same reason.
 
 _fault_hook = None
+
+# --- per-op client telemetry -------------------------------------------------
+# Every client-side wire op runs under _op_span(op, nbytes): the fault hook
+# fires first (unchanged injection semantics — a raise surfaces before the
+# wire op), then the op is timed into the process-default metrics registry
+# (van.<op>.calls / .bytes / .latency_s) and, when tracing is enabled, a
+# `van.<op>` span.  Metric objects are cached per op name so the steady
+# state is one dict hit + one histogram observe per RPC.
+
+_op_cache: dict = {}
+
+
+def _op_metrics(op: str):
+    m = _op_cache.get(op)
+    if m is None:
+        from hetu_tpu.telemetry import default_registry as reg
+        m = (reg.counter(f"van.{op}.calls"),
+             reg.counter(f"van.{op}.bytes"),
+             reg.histogram(f"van.{op}.latency_s"),
+             reg.counter(f"van.{op}.errors"),
+             "van." + op)
+        _op_cache[op] = m
+    return m
+
+
+class _OpSpan:
+    __slots__ = ("op", "nbytes", "_t0", "_tr0", "_traced")
+
+    def __init__(self, op: str, nbytes: int = 0):
+        self.op = op
+        self.nbytes = int(nbytes)
+
+    def __enter__(self):
+        _maybe_inject(self.op)
+        # record the span only if tracing was on for the WHOLE op: an
+        # enable() landing mid-RPC would otherwise produce a span whose
+        # start is the tracer's epoch (now_us() was 0.0 at entry)
+        self._traced = _trace.enabled()
+        if self._traced:
+            self._tr0 = _trace.now_us()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self._t0
+        calls, nbytes, lat, errors, span_name = _op_metrics(self.op)
+        calls.inc()
+        if exc_type is not None:
+            # failed/timed-out ops (incl. a listener's idle poll timeout)
+            # must not skew the success-latency histogram
+            errors.inc()
+            return False
+        if self.nbytes:
+            nbytes.inc(self.nbytes)
+        lat.observe(dt)
+        if self._traced and _trace.enabled():
+            _trace.complete(span_name, self._tr0,
+                            {"bytes": self.nbytes} if self.nbytes else None,
+                            cat="van")
+        return False
+
+
+def _op_span(op: str, nbytes: int = 0) -> _OpSpan:
+    return _OpSpan(op, nbytes)
+
+
+def op_stats() -> dict:
+    """Per-op client-side RPC stats from the process-default registry:
+    ``{op: {calls, bytes, latency: {count, sum, p50, p90, p99, ...}}}``."""
+    from hetu_tpu.telemetry import default_registry as reg
+    out: dict = {}
+    for name, m in reg.metrics().items():
+        if not name.startswith("van."):
+            continue
+        parts = name.split(".", 2)
+        if len(parts) != 3:
+            continue  # not a per-op metric
+        _, op, field = parts
+        d = out.setdefault(op, {})
+        if field == "latency_s":
+            d["latency"] = m.snapshot()
+        else:
+            d[field] = m.value
+    return out
 
 
 def set_fault_hook(hook):
@@ -179,45 +264,47 @@ class RemotePSTable:
         return lib.ps_van_ping(self.fd) == 0
 
     def sparse_pull(self, indices) -> np.ndarray:
-        _maybe_inject("van_sparse_pull")
         idx = _as_idx(indices)
         out = np.empty((idx.shape[0], self.dim), np.float32)
-        _check(lib.ps_van_sparse_pull_dt(self.fd, self.id, _i64p(idx),
-                                         idx.shape[0], _f32p(out),
-                                         self.dim, self._dt),
-               "van_sparse_pull")
+        with _op_span("van_sparse_pull", out.nbytes):
+            _check(lib.ps_van_sparse_pull_dt(self.fd, self.id, _i64p(idx),
+                                             idx.shape[0], _f32p(out),
+                                             self.dim, self._dt),
+                   "van_sparse_pull")
         return out
 
     def sparse_push(self, indices, grads) -> None:
-        _maybe_inject("van_sparse_push")
         idx = _as_idx(indices)
         g = _as_mat(grads, idx.shape[0], self.dim)
-        _check(lib.ps_van_sparse_push_dt(self.fd, self.id, _i64p(idx),
-                                         _f32p(g), idx.shape[0], self.dim,
-                                         self._dt),
-               "van_sparse_push")
+        with _op_span("van_sparse_push", g.nbytes):
+            _check(lib.ps_van_sparse_push_dt(self.fd, self.id, _i64p(idx),
+                                             _f32p(g), idx.shape[0],
+                                             self.dim, self._dt),
+                   "van_sparse_push")
 
     def dense_pull(self) -> np.ndarray:
-        _maybe_inject("van_dense_pull")
         out = np.empty((self.rows, self.dim), np.float32)
-        _check(lib.ps_van_dense_pull(self.fd, self.id, _f32p(out),
-                                     self.rows * self.dim), "van_dense_pull")
+        with _op_span("van_dense_pull", out.nbytes):
+            _check(lib.ps_van_dense_pull(self.fd, self.id, _f32p(out),
+                                         self.rows * self.dim),
+                   "van_dense_pull")
         return out
 
     def dense_push(self, grad) -> None:
-        _maybe_inject("van_dense_push")
         g = _as_mat(grad, self.rows, self.dim)
-        _check(lib.ps_van_dense_push(self.fd, self.id, _f32p(g),
-                                     self.rows * self.dim), "van_dense_push")
+        with _op_span("van_dense_push", g.nbytes):
+            _check(lib.ps_van_dense_push(self.fd, self.id, _f32p(g),
+                                         self.rows * self.dim),
+                   "van_dense_push")
 
     def sparse_set(self, indices, values) -> None:
-        _maybe_inject("van_sparse_set")
         idx = _as_idx(indices)
         v = _as_mat(values, idx.shape[0], self.dim)
-        _check(lib.ps_van_sparse_set_dt(self.fd, self.id, _i64p(idx),
-                                        _f32p(v), idx.shape[0], self.dim,
-                                        self._dt),
-               "van_sparse_set")
+        with _op_span("van_sparse_set", v.nbytes):
+            _check(lib.ps_van_sparse_set_dt(self.fd, self.id, _i64p(idx),
+                                            _f32p(v), idx.shape[0], self.dim,
+                                            self._dt),
+                   "van_sparse_set")
 
     def clear(self) -> None:
         """Zero the table in place (ParamClear analog); bumps versions so
@@ -229,29 +316,31 @@ class RemotePSTable:
         """Server-side optimizer slots for ``indices``: ``(s1, s2, step)``
         (see ``PSTable.slots_get``).  Always f32 on the wire, whatever the
         row dtype — slots never quantize."""
-        _maybe_inject("van_slots_get")
         idx = _as_idx(indices)
         n = idx.shape[0]
         s1 = np.empty((n, self.dim), np.float32)
         s2 = np.empty((n, self.dim), np.float32)
         step = np.empty(n, np.uint64)
-        _check(lib.ps_van_table_slots_get(
-            self.fd, self.id, _i64p(idx), n, self.dim, _f32p(s1), _f32p(s2),
-            step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))),
-            "van_slots_get")
+        with _op_span("van_slots_get", s1.nbytes + s2.nbytes + step.nbytes):
+            _check(lib.ps_van_table_slots_get(
+                self.fd, self.id, _i64p(idx), n, self.dim, _f32p(s1),
+                _f32p(s2),
+                step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))),
+                "van_slots_get")
         return s1, s2, step
 
     def slots_set(self, indices, s1, s2, step) -> None:
-        _maybe_inject("van_slots_set")
         idx = _as_idx(indices)
         n = idx.shape[0]
         s1 = _as_mat(s1, n, self.dim)
         s2 = _as_mat(s2, n, self.dim)
         step = np.ascontiguousarray(step, np.uint64).reshape(n)
-        _check(lib.ps_van_table_slots_set(
-            self.fd, self.id, _i64p(idx), n, self.dim, _f32p(s1), _f32p(s2),
-            step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))),
-            "van_slots_set")
+        with _op_span("van_slots_set", s1.nbytes + s2.nbytes + step.nbytes):
+            _check(lib.ps_van_table_slots_set(
+                self.fd, self.id, _i64p(idx), n, self.dim, _f32p(s1),
+                _f32p(s2),
+                step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))),
+                "van_slots_set")
 
     def save(self, path) -> None:
         _check(lib.ps_van_table_save(self.fd, self.id, str(path).encode()),
@@ -375,67 +464,72 @@ class PartitionedPSTable:
         return int(lib.ps_group_recovered(self.gid))
 
     def sparse_pull(self, indices) -> np.ndarray:
-        _maybe_inject("group_sparse_pull")
         idx = _as_idx(indices)
         out = np.empty((idx.shape[0], self.dim), np.float32)
-        _check(lib.ps_group_sparse_pull(self.gid, _i64p(idx), idx.shape[0],
-                                        _f32p(out)), "group_sparse_pull")
+        with _op_span("group_sparse_pull", out.nbytes):
+            _check(lib.ps_group_sparse_pull(self.gid, _i64p(idx),
+                                            idx.shape[0], _f32p(out)),
+                   "group_sparse_pull")
         return out
 
     def sparse_push(self, indices, grads) -> None:
-        _maybe_inject("group_sparse_push")
         idx = _as_idx(indices)
         g = _as_mat(grads, idx.shape[0], self.dim)
-        _check(lib.ps_group_sparse_push(self.gid, _i64p(idx), _f32p(g),
-                                        idx.shape[0]), "group_sparse_push")
+        with _op_span("group_sparse_push", g.nbytes):
+            _check(lib.ps_group_sparse_push(self.gid, _i64p(idx), _f32p(g),
+                                            idx.shape[0]),
+                   "group_sparse_push")
 
     def sparse_set(self, indices, values) -> None:
-        _maybe_inject("group_sparse_set")
         idx = _as_idx(indices)
         v = _as_mat(values, idx.shape[0], self.dim)
-        _check(lib.ps_group_sparse_set(self.gid, _i64p(idx), _f32p(v),
-                                       idx.shape[0]), "group_sparse_set")
+        with _op_span("group_sparse_set", v.nbytes):
+            _check(lib.ps_group_sparse_set(self.gid, _i64p(idx), _f32p(v),
+                                           idx.shape[0]),
+                   "group_sparse_set")
 
     def dense_pull(self) -> np.ndarray:
-        _maybe_inject("group_dense_pull")
         out = np.empty((self.rows, self.dim), np.float32)
-        _check(lib.ps_group_dense_pull(self.gid, _f32p(out)),
-               "group_dense_pull")
+        with _op_span("group_dense_pull", out.nbytes):
+            _check(lib.ps_group_dense_pull(self.gid, _f32p(out)),
+                   "group_dense_pull")
         return out
 
     def dense_push(self, grad) -> None:
-        _maybe_inject("group_dense_push")
         g = _as_mat(grad, self.rows, self.dim)
-        _check(lib.ps_group_dense_push(self.gid, _f32p(g)),
-               "group_dense_push")
+        with _op_span("group_dense_push", g.nbytes):
+            _check(lib.ps_group_dense_push(self.gid, _f32p(g)),
+                   "group_dense_push")
 
     def slots_get(self, indices):
         """Server-side optimizer slots across the group: ``(s1, s2, step)``
         — the durable-slot plane ``PSShardGuard`` snapshots so a repaired
         shard resumes with its real Adam/Adagrad accumulators."""
-        _maybe_inject("group_slots_get")
         idx = _as_idx(indices)
         n = idx.shape[0]
         s1 = np.empty((n, self.dim), np.float32)
         s2 = np.empty((n, self.dim), np.float32)
         step = np.empty(n, np.uint64)
-        _check(lib.ps_group_slots_get(
-            self.gid, _i64p(idx), n, _f32p(s1), _f32p(s2),
-            step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))),
-            "group_slots_get")
+        with _op_span("group_slots_get",
+                      s1.nbytes + s2.nbytes + step.nbytes):
+            _check(lib.ps_group_slots_get(
+                self.gid, _i64p(idx), n, _f32p(s1), _f32p(s2),
+                step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))),
+                "group_slots_get")
         return s1, s2, step
 
     def slots_set(self, indices, s1, s2, step) -> None:
-        _maybe_inject("group_slots_set")
         idx = _as_idx(indices)
         n = idx.shape[0]
         s1 = _as_mat(s1, n, self.dim)
         s2 = _as_mat(s2, n, self.dim)
         step = np.ascontiguousarray(step, np.uint64).reshape(n)
-        _check(lib.ps_group_slots_set(
-            self.gid, _i64p(idx), _f32p(s1), _f32p(s2),
-            step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n),
-            "group_slots_set")
+        with _op_span("group_slots_set",
+                      s1.nbytes + s2.nbytes + step.nbytes):
+            _check(lib.ps_group_slots_set(
+                self.gid, _i64p(idx), _f32p(s1), _f32p(s2),
+                step.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n),
+                "group_slots_set")
 
     def sync_pull(self, indices, cached_versions, bound: int = 0):
         """Version-bounded sync (HET kSyncEmbedding over the wire): returns
@@ -630,68 +724,73 @@ class BlobChannel:
         self.fd = _connect_with_deadline(host, port, connect_timeout_s)
 
     def _reconnect(self) -> None:
+        from hetu_tpu.telemetry import default_registry as _reg
+        # op-shaped name so op_stats() surfaces it as
+        # {"blob_channel": {"reconnects": n}}
+        _reg.counter("van.blob_channel.reconnects").inc()
         if self.fd >= 0:
             lib.ps_van_close(self.fd)
         self.fd = _connect_with_deadline(self.host, self.port,
                                          self._timeout_s)
 
     def put(self, data, seq: int, *, timeout_s: float = 60.0) -> None:
-        _maybe_inject("blob_put")
         buf = np.ascontiguousarray(data).tobytes() \
             if not isinstance(data, (bytes, bytearray, memoryview)) else \
             bytes(data)
-        deadline = time.monotonic() + timeout_s
-        while True:
-            wait_ms = max(1, int((deadline - time.monotonic()) * 1000))
-            rc = lib.ps_van_blob_put(self.fd, self.id, seq, buf,
-                                     len(buf), wait_ms)
-            if rc == 0:
-                return
-            if time.monotonic() > deadline:
-                if rc == -11:  # previous message unread: same condition
-                    # the sparse mailbox surfaces as TimeoutError
-                    raise TimeoutError(
-                        f"blob put: ack of the previous message not "
-                        f"observed within {timeout_s}s")
-                raise RuntimeError(f"blob put failed (rc={rc})")
-            if rc == -101:  # transport: reconnect and resend (idempotent)
-                self._reconnect()
-            elif rc != -11:
-                # only "slot still unread" (-11) retries; anything else is
-                # a server-side refusal — resending the payload in a tight
-                # loop would hammer the van for the whole timeout
-                raise RuntimeError(f"blob put failed (rc={rc})")
+        with _op_span("blob_put", len(buf)):
+            deadline = time.monotonic() + timeout_s
+            while True:
+                wait_ms = max(1, int((deadline - time.monotonic()) * 1000))
+                rc = lib.ps_van_blob_put(self.fd, self.id, seq, buf,
+                                         len(buf), wait_ms)
+                if rc == 0:
+                    return
+                if time.monotonic() > deadline:
+                    if rc == -11:  # previous message unread: same condition
+                        # the sparse mailbox surfaces as TimeoutError
+                        raise TimeoutError(
+                            f"blob put: ack of the previous message not "
+                            f"observed within {timeout_s}s")
+                    raise RuntimeError(f"blob put failed (rc={rc})")
+                if rc == -101:  # transport: reconnect and resend
+                    self._reconnect()  # (idempotent)
+                elif rc != -11:
+                    # only "slot still unread" (-11) retries; anything else
+                    # is a server-side refusal — resending the payload in a
+                    # tight loop would hammer the van for the whole timeout
+                    raise RuntimeError(f"blob put failed (rc={rc})")
 
     def get(self, seq: int, *, timeout_s: float = 60.0) -> bytes:
-        _maybe_inject("blob_get")
         cap = 1 << 28
-        deadline = time.monotonic() + timeout_s
-        need = ctypes.c_int64(0)
-        while True:
-            wait_ms = max(1, int((deadline - time.monotonic()) * 1000))
-            n = lib.ps_van_blob_get(self.fd, self.id, seq, self._rbuf,
-                                    len(self._rbuf), wait_ms,
-                                    ctypes.byref(need))
-            if n >= 0:
-                self._ack(seq, deadline)
-                return ctypes.string_at(self._rbuf, n)
-            if n == -102 and need.value <= cap:  # too small: resize to
-                # the reported size with 2x headroom, so a channel whose
-                # messages keep growing doesn't pay a full re-transfer on
-                # every small increase
-                self._rbuf = ctypes.create_string_buffer(
-                    min(cap, max(int(need.value), 2 * len(self._rbuf))))
-                continue
-            if time.monotonic() > deadline:
-                if n == -12:
-                    raise TimeoutError(
-                        f"blob get: seq {seq} not delivered within "
-                        f"{timeout_s}s")
-                raise RuntimeError(f"blob get failed (rc={n})")
-            if n == -101:
-                self._reconnect()
-            elif n != -12:
-                raise RuntimeError(f"blob get failed (rc={n})")
+        with _op_span("blob_get") as sp:
+            deadline = time.monotonic() + timeout_s
+            need = ctypes.c_int64(0)
+            while True:
+                wait_ms = max(1, int((deadline - time.monotonic()) * 1000))
+                n = lib.ps_van_blob_get(self.fd, self.id, seq, self._rbuf,
+                                        len(self._rbuf), wait_ms,
+                                        ctypes.byref(need))
+                if n >= 0:
+                    self._ack(seq, deadline)
+                    sp.nbytes = int(n)  # bytes known only at delivery
+                    return ctypes.string_at(self._rbuf, n)
+                if n == -102 and need.value <= cap:  # too small: resize to
+                    # the reported size with 2x headroom, so a channel whose
+                    # messages keep growing doesn't pay a full re-transfer
+                    # on every small increase
+                    self._rbuf = ctypes.create_string_buffer(
+                        min(cap, max(int(need.value), 2 * len(self._rbuf))))
+                    continue
+                if time.monotonic() > deadline:
+                    if n == -12:
+                        raise TimeoutError(
+                            f"blob get: seq {seq} not delivered within "
+                            f"{timeout_s}s")
+                    raise RuntimeError(f"blob get failed (rc={n})")
+                if n == -101:
+                    self._reconnect()
+                elif n != -12:
+                    raise RuntimeError(f"blob get failed (rc={n})")
 
     def _ack(self, seq: int, deadline: float) -> None:
         """A lost ack wedges the slot (the writer's next put blocks until

@@ -59,6 +59,7 @@ from hetu_tpu.parallel.mesh import (
     AXIS_DP, MeshConfig, elastic_mesh, host_to_device, replicated,
 )
 from hetu_tpu.resilience.supervisor import Supervisor
+from hetu_tpu.telemetry import trace
 
 
 class ElasticReshardError(RuntimeError):
@@ -252,7 +253,12 @@ class ElasticSupervisor(Supervisor):
         # decision still gets its own ResizeEvent (the membership deltas),
         # all stamped with the post-batch width and sharing the downtime.
         t0 = time.perf_counter()
-        state = self._reshard(state)
+        with trace.span("elastic.reshard") as sp:
+            sp.set("step", int(step_i))
+            sp.set("width", self.width)
+            sp.set("decisions",
+                   [f"{k}:{w}" for k, w in decisions])
+            state = self._reshard(state)
         dt = time.perf_counter() - t0
         self.counters["resizes"] += 1
         self.counters["elastic_width"] = self.width
@@ -281,21 +287,27 @@ class ElasticSupervisor(Supervisor):
         if self.schedule is not None and \
                 self.data_mode == "fixed_global_batch":
             self.schedule.check_width(width)
-        mesh = elastic_mesh(self.config, alive, devices=self.devices)
         # host-side snapshot: every leaf leaves the old mesh's buffers
         # before the new placement (params, optimizer slots, step, RNG).
         # np.array(copy=True) is load-bearing: np.asarray(jax_cpu_array)
         # is a zero-copy VIEW of the device buffer.  The re-place goes
         # through host_to_device, which guards the CPU
         # zero-copy-adoption + donation hazard (see parallel/mesh.py).
-        host = jax.tree_util.tree_map(lambda a: np.array(a, copy=True),
-                                      state)
-        self.executor.set_mesh(mesh)
-        if self.data_mode == "fixed_per_worker" and self.rescale_grads:
-            self.executor.set_grad_scale(self.config.dp / width)
-        sharding = replicated(mesh)
-        return jax.tree_util.tree_map(
-            lambda a: host_to_device(a, sharding), host)
+        with trace.span("elastic.snapshot"):
+            host = jax.tree_util.tree_map(lambda a: np.array(a, copy=True),
+                                          state)
+        with trace.span("elastic.remesh") as sp:
+            sp.set("width", width)
+            mesh = elastic_mesh(self.config, alive, devices=self.devices)
+            # set_mesh drops every compiled step: the NEXT run() pays the
+            # re-jit (its train.compile instant + step span show the cost)
+            self.executor.set_mesh(mesh)
+            if self.data_mode == "fixed_per_worker" and self.rescale_grads:
+                self.executor.set_grad_scale(self.config.dp / width)
+        with trace.span("elastic.replace"):
+            sharding = replicated(mesh)
+            return jax.tree_util.tree_map(
+                lambda a: host_to_device(a, sharding), host)
 
     # ---- checkpoints carry the width ----
     def _ckpt_extra(self) -> dict:

@@ -39,10 +39,13 @@ import os
 import signal
 import threading
 import time
+import zlib
 from collections import defaultdict, deque
 from dataclasses import dataclass
 
 import numpy as np
+
+from hetu_tpu.telemetry import trace
 
 
 class TransientFault(ConnectionError):
@@ -180,6 +183,13 @@ class FaultSchedule:
         return json.dumps([[e.step, e.kind, e.arg, e.arg2]
                            for e in self.events], separators=(",", ":"))
 
+    @property
+    def schedule_id(self) -> str:
+        """Stable 8-hex id of the canonical serialization: the tag every
+        injected fault's trace instant carries, so a trace names the exact
+        chaos run that produced it (same seed+kwargs → same id)."""
+        return f"{zlib.crc32(self.to_json().encode()):08x}"
+
     @classmethod
     def from_json(cls, s: str) -> "FaultSchedule":
         return cls([FaultEvent(int(st), k, float(a), float(a2))
@@ -251,6 +261,13 @@ class FaultInjector:
         for ev in self.schedule.at(step):
             self.counters["faults_injected"] += 1
             k = ev.kind
+            # one instant per injection: schedule.at() returns a sorted
+            # deterministic order, so two runs with the same seed emit the
+            # identical instant sequence (the timeline pairing contract)
+            trace.instant("fault." + k,
+                          {"kind": k, "step": int(step), "arg": ev.arg,
+                           "arg2": ev.arg2,
+                           "schedule": self.schedule.schedule_id})
             if k == "van_error":
                 with self._lock:
                     self._armed_van.append(("error", 0.0))

@@ -45,6 +45,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from hetu_tpu.telemetry import trace
 from hetu_tpu.train import checkpoint as ckpt
 from hetu_tpu.train.checkpoint import CheckpointCorruptError
 
@@ -410,12 +411,21 @@ class Supervisor:
     # ---- retry envelope ----
     def _with_retries(self, fn, what: str):
         attempt = 0
+        t_first_fail = 0.0  # tracing: first failure → eventual success
         while True:
             try:
-                return fn()
+                out = fn()
+                if attempt:
+                    # only a retried envelope leaves a recovery span — the
+                    # zero-retry common case records nothing
+                    trace.complete("recovery.retry", t_first_fail,
+                                   {"what": what, "attempts": attempt})
+                return out
             except Exception as e:
                 if not self._is_transient(e) or attempt >= self.retries:
                     raise
+                if attempt == 0:
+                    t_first_fail = trace.now_us()
                 delay = min(self.backoff_base_s * (2.0 ** attempt),
                             self.backoff_max_s)
                 delay *= 1.0 + self.backoff_jitter * float(
@@ -423,6 +433,10 @@ class Supervisor:
                 self.counters["retries"] += 1
                 self.counters[f"retries_{what}"] += 1
                 self._log_inc("retries")
+                trace.instant("supervisor.retry",
+                              {"what": what, "attempt": attempt,
+                               "error": type(e).__name__,
+                               "delay_s": round(delay, 4)})
                 time.sleep(delay)
                 attempt += 1
 
@@ -450,16 +464,22 @@ class Supervisor:
         return None
 
     # ---- checkpoint + snapshots ----
-    def _checkpoint(self, state, step: int) -> None:
+    def _checkpoint(self, state, step: int, *,
+                    reason: str = "cadence") -> None:
         t0 = time.perf_counter()
-        if self.manager is not None:
-            self.manager.save(state, step, extra=self._ckpt_extra())
-        for g in self.guards:
-            try:
-                g.snapshot()
-                self.counters["shard_snapshots"] += 1
-            except (RuntimeError, ConnectionError, TimeoutError):
-                self.counters["shard_snapshot_errors"] += 1
+        with trace.span("supervisor.checkpoint") as sp:
+            sp.set("step", int(step))
+            sp.set("reason", reason)
+            if self.manager is not None:
+                with trace.span("supervisor.checkpoint_write"):
+                    self.manager.save(state, step, extra=self._ckpt_extra())
+            for g in self.guards:
+                try:
+                    with trace.span("supervisor.shard_snapshot"):
+                        g.snapshot()
+                    self.counters["shard_snapshots"] += 1
+                except (RuntimeError, ConnectionError, TimeoutError):
+                    self.counters["shard_snapshot_errors"] += 1
         dt = time.perf_counter() - t0
         self.counters["checkpoints"] += 1
         self.counters["checkpoint_latency_s_last"] = dt
@@ -507,11 +527,19 @@ class Supervisor:
                     self.injector.on_step(step_i)
                 state = self._maybe_resize(state, step_i)
                 for g in self.guards:
+                    t_poll = trace.now_us()
                     repaired = self._with_retries(g.poll, "guard")
                     if repaired:
+                        # retroactive span: only a poll that actually
+                        # repaired a shard is a recovery worth a track slot
+                        trace.complete("recovery.shard_repair", t_poll,
+                                       {"repaired": repaired,
+                                        "step": step_i})
                         self.counters["shard_repairs"] += repaired
                         self._log_inc("shard_repairs", repaired)
-                batch = self._with_retries(lambda: batch_fn(step_i), "data")
+                with trace.span("train.data_wait"):
+                    batch = self._with_retries(lambda: batch_fn(step_i),
+                                               "data")
                 if self.injector is not None:
                     batch = self.injector.corrupt_batch(step_i, batch)
                 state, metrics = self.executor.run("train_guarded", state,
@@ -521,12 +549,15 @@ class Supervisor:
                     nonfinite_run += 1
                     self.counters["nonfinite_steps_skipped"] += 1
                     self._log_inc("nonfinite_steps_skipped")
+                    trace.instant("recovery.nonfinite_skip",
+                                  {"step": step_i, "run": nonfinite_run})
                     if nonfinite_run >= self.nonfinite_limit:
                         # the caller's own state object was donated to the
                         # jitted step — preserve the last-finite state
                         # (checkpoint if we can, always on the exception)
                         if self.manager is not None:
-                            self._checkpoint(state, step_i)
+                            self._checkpoint(state, step_i,
+                                             reason="nonfinite")
                         raise NonFiniteAbort(
                             f"{nonfinite_run} consecutive nonfinite steps "
                             f"ending at step {step_i} — loss diverged or "
@@ -545,7 +576,7 @@ class Supervisor:
                         and step_i < int(steps)):
                     self._checkpoint(state, step_i)
                 if self._preempt.is_set():
-                    self._checkpoint(state, step_i)
+                    self._checkpoint(state, step_i, reason="preempt")
                     preempted = True
                     break
         finally:
@@ -559,7 +590,8 @@ class Supervisor:
                 snap = {k: float(v) for k, v in self.counters.items()}
                 self.logger.log(snap, step=step_i)
         if not preempted and self.ckpt_every and self.manager is not None:
-            self._checkpoint(state, step_i)  # final: resume == completed
+            # final: resume == completed
+            self._checkpoint(state, step_i, reason="final")
         return SupervisorReport(state=state, step=step_i,
                                 preempted=preempted,
                                 counters=dict(self.counters),

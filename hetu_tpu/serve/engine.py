@@ -35,6 +35,7 @@ from hetu_tpu.parallel.mesh import AXIS_TP
 from hetu_tpu.parallel.strategies.simple import MegatronLM
 from hetu_tpu.serve.kv_cache import KVCache, KVCacheSpec
 from hetu_tpu.serve.metrics import ServeMetrics
+from hetu_tpu.telemetry import trace
 
 
 class _DecodeTP(MegatronLM):
@@ -174,14 +175,22 @@ class ServeEngine:
         if s not in self._seen_buckets:
             self._seen_buckets.add(s)
             self.metrics.inc("prefill_compiles")
-        ids = np.zeros((1, s), np.int32)
-        ids[0, :n] = prompt
-        k, v, first = self._prefill_fn(
-            self.params, self.cache.k, self.cache.v,
-            jnp.asarray(ids), jnp.int32(slot), jnp.int32(n))
+            trace.instant("serve.recompile",
+                          {"kind": "prefill", "bucket": s})
+        with trace.span("serve.prefill") as sp:
+            sp.set("slot", int(slot))
+            sp.set("tokens", n)
+            sp.set("bucket", s)
+            ids = np.zeros((1, s), np.int32)
+            ids[0, :n] = prompt
+            k, v, first = self._prefill_fn(
+                self.params, self.cache.k, self.cache.v,
+                jnp.asarray(ids), jnp.int32(slot), jnp.int32(n))
+            # the value fetch is the sync point: inside the span, so the
+            # span covers device execution, not just the async dispatch
+            first = int(first)
         self.cache.update(k, v)
         self.cache.lengths[slot] = n
-        first = int(first)
         self.last_tokens[slot] = first
         self.active[slot] = True
         self.metrics.inc("prefill_tokens", n)
@@ -200,11 +209,18 @@ class ServeEngine:
         if self._decode_fn is None:
             self._decode_fn = self._build_decode()
             self.metrics.inc("decode_compiles")
-        k, v, nxt = self._decode_fn(
-            self.params, self.cache.k, self.cache.v,
-            jnp.asarray(self.last_tokens), jnp.asarray(self.cache.lengths))
+            trace.instant("serve.recompile", {"kind": "decode"})
+        with trace.span("serve.decode") as sp:
+            if trace.enabled():  # the reduction is attr-only: skip when off
+                sp.set("active", int(self.active.sum()))
+            k, v, nxt = self._decode_fn(
+                self.params, self.cache.k, self.cache.v,
+                jnp.asarray(self.last_tokens),
+                jnp.asarray(self.cache.lengths))
+            # host fetch = the sync point; keep it inside the span (see
+            # prefill)
+            nxt = np.asarray(nxt)
         self.cache.update(k, v)
-        nxt = np.asarray(nxt)
         out = {}
         for slot in np.nonzero(self.active)[0]:
             self.cache.lengths[slot] += 1

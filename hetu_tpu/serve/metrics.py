@@ -6,45 +6,63 @@ outcomes).  Thread-safe — listener threads and the engine loop update
 concurrently.  ``report()`` flushes a snapshot through the repo's
 ``utils/logger.MetricLogger`` so serving runs log/means/wandb exactly like
 training runs do.
+
+Backed by a :class:`~hetu_tpu.telemetry.registry.MetricsRegistry`:
+counters/gauges are typed metrics, and TTFT is BOTH an exact bounded ring
+(``collections.deque(maxlen=window)`` — O(1) per observation; the old
+list-slice trim was O(window)) and a fixed-bucket
+:class:`~hetu_tpu.telemetry.registry.Histogram`.  ``snapshot()`` reports
+avg/max AND p50/p90/p99 from the ring — all WINDOWED and mutually
+consistent, the numbers a live SLO check wants — while the cumulative
+histogram feeds ``prometheus_text()`` (lifetime ``_bucket`` counts, the
+Prometheus convention).  The public API is unchanged.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import defaultdict
+from collections import deque
+from typing import Optional
+
+from hetu_tpu.telemetry.registry import (
+    DEFAULT_LATENCY_BUCKETS, MetricsRegistry,
+)
 
 
 class ServeMetrics:
-    def __init__(self, *, window: int = 512):
+    def __init__(self, *, window: int = 512,
+                 registry: Optional[MetricsRegistry] = None):
         self._lock = threading.Lock()
-        self._counters = defaultdict(int)
-        self._gauges = {}
-        self._ttft = []          # seconds, bounded ring
-        self._window = window
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._ttft = deque(maxlen=int(window))  # seconds, bounded ring
+        self._ttft_hist = self.registry.histogram(
+            "ttft_s", DEFAULT_LATENCY_BUCKETS,
+            help="request admission to first generated token")
+        self._window = int(window)
         self._decode_tokens = 0  # since last snapshot window start
         self._decode_t0 = None
 
     # ---- counters / gauges ----
     def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[name] += n
+        self.registry.counter(name).inc(n)
 
     def set_gauge(self, name: str, value) -> None:
-        with self._lock:
-            self._gauges[name] = float(value)
+        self.registry.gauge(name).set(value)
 
     def count(self, name: str) -> int:
-        with self._lock:
-            return self._counters[name]
+        return self.registry.counter(name).value
 
     # ---- latency / throughput ----
     def observe_ttft(self, seconds: float) -> None:
         """Time-to-first-token: request admission → prefill's first token."""
+        s = float(seconds)
         with self._lock:
-            self._ttft.append(float(seconds))
-            if len(self._ttft) > self._window:
-                self._ttft = self._ttft[-self._window:]
+            self._ttft.append(s)
+        # outside the ring lock: the histogram has its own lock and its
+        # only reader is the prometheus exposition — snapshot() derives
+        # every ttft_* key from the ring alone
+        self._ttft_hist.observe(s)
 
     def observe_decode(self, n_tokens: int) -> None:
         """One decode step produced ``n_tokens`` (tokens/sec derives from
@@ -58,18 +76,35 @@ class ServeMetrics:
 
     # ---- reporting ----
     def snapshot(self) -> dict:
+        from hetu_tpu.telemetry.registry import Counter, Gauge
+        out = {}
+        for name, m in self.registry.metrics().items():
+            if isinstance(m, (Counter, Gauge)):
+                out[name] = m.value
         with self._lock:
-            out = dict(self._counters)
-            out.update(self._gauges)
-            if self._ttft:
-                ts = sorted(self._ttft)
-                out["ttft_avg_s"] = sum(ts) / len(ts)
-                out["ttft_p50_s"] = ts[len(ts) // 2]
-                out["ttft_max_s"] = ts[-1]
-            if self._decode_t0 is not None:
-                dt = max(self._decode_now - self._decode_t0, 1e-9)
-                if dt > 0 and self._decode_tokens:
-                    out["tokens_per_sec"] = self._decode_tokens / dt
+            ring = list(self._ttft)
+            decode_t0 = self._decode_t0
+            decode_tokens = self._decode_tokens
+            decode_now = getattr(self, "_decode_now", None)
+        if ring:
+            # snapshot stats are all WINDOWED (the last `window`
+            # observations, like the pre-histogram implementation): avg,
+            # max AND the percentiles come from the same ring, so the
+            # numbers in one snapshot are mutually consistent and track
+            # current latency.  The cumulative histogram feeds the
+            # Prometheus exposition (where lifetime _bucket counts are
+            # the convention), not these keys.
+            ts = sorted(ring)
+            n = len(ts)
+            out["ttft_avg_s"] = sum(ts) / n
+            out["ttft_p50_s"] = ts[min(n // 2, n - 1)]
+            out["ttft_p90_s"] = ts[min(int(0.90 * n), n - 1)]
+            out["ttft_p99_s"] = ts[min(int(0.99 * n), n - 1)]
+            out["ttft_max_s"] = ts[-1]
+        if decode_t0 is not None and decode_now is not None:
+            dt = max(decode_now - decode_t0, 1e-9)
+            if dt > 0 and decode_tokens:
+                out["tokens_per_sec"] = decode_tokens / dt
         return out
 
     def report(self, logger, step=None) -> dict:
@@ -77,3 +112,6 @@ class ServeMetrics:
         snap = self.snapshot()
         logger.log(snap, step=step)
         return snap
+
+    def prometheus_text(self) -> str:
+        return self.registry.prometheus_text()

@@ -23,6 +23,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
+from hetu_tpu.telemetry import trace
+
 _ids = itertools.count(1)
 
 
@@ -195,7 +197,7 @@ class ContinuousBatchingScheduler:
         (decode is one fused call over every slot: there is no
         per-request attribution)."""
         completed = []
-        with self._lock:
+        with self._lock, trace.span("serve.step") as sp:
             progressed, admit_exc = self._admit(completed)
             if self._running:
                 toks = self.engine.decode()
@@ -211,6 +213,8 @@ class ContinuousBatchingScheduler:
             self.metrics.set_gauge("queue_depth", len(self._queue))
             self.metrics.set_gauge("slot_occupancy",
                                    self.engine.cache.occupancy)
+            sp.set("completed", len(completed))
+            sp.set("running", len(self._running))
             if admit_exc is not None and not progressed:
                 raise admit_exc
         return completed

@@ -1,0 +1,53 @@
+"""Unified observability: span tracing + typed metrics + chaos timelines.
+
+The repo's three observability fragments (``utils/logger.MetricLogger``
+running means, ``serve/metrics.ServeMetrics`` counters, the offline
+``profiler``) now share one substrate:
+
+* :mod:`trace`    — thread-safe span tracer on monotonic clocks;
+  Chrome-trace/Perfetto JSON and append-only JSONL export; true no-op
+  (one branch, zero allocation) when disabled;
+* :mod:`registry` — Counter / Gauge / fixed-bucket Histogram with
+  p50/p90/p99, JSON snapshot + Prometheus text exposition;
+* :mod:`timeline` — pairs injected-fault instants with the recovery
+  spans that answer them → per-fault-kind detection/recovery SLOs.
+
+Enable tracing for a run::
+
+    from hetu_tpu import telemetry
+    telemetry.enable(jsonl_path="run.trace.jsonl")
+    ... train / serve ...
+    telemetry.disable().write_chrome("run.trace.json")  # open in Perfetto
+
+Read a trace: ``python tools/trace_report.py run.trace.jsonl``.
+
+``default_registry`` is the process-wide metrics registry the built-in
+instrumentation (van RPC latency/bytes, serve compiles) records into;
+``prometheus_text()`` snapshots it for a file-based scrape.
+"""
+
+from hetu_tpu.telemetry import registry, timeline, trace
+from hetu_tpu.telemetry.registry import (
+    Counter, Gauge, Histogram, MetricsRegistry,
+)
+from hetu_tpu.telemetry.trace import (
+    Tracer, complete, disable, enable, enabled, get_tracer, instant,
+    load_jsonl, now_us, span,
+)
+
+# the process-default metrics registry: built-in instrumentation (ps/van,
+# serve engine) records here; scrape via prometheus_text()
+default_registry = MetricsRegistry()
+
+
+def prometheus_text() -> str:
+    return default_registry.prometheus_text()
+
+
+__all__ = [
+    "trace", "registry", "timeline",
+    "Tracer", "enable", "disable", "enabled", "get_tracer",
+    "span", "instant", "complete", "now_us", "load_jsonl",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "default_registry", "prometheus_text",
+]

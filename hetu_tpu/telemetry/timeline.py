@@ -1,0 +1,187 @@
+"""Chaos correlation: pair fault-injection instants with recovery spans.
+
+PR 2/3 made faults injectable and REPLAYABLE (seeded
+:class:`~hetu_tpu.resilience.faults.FaultSchedule`); this module makes
+the recoveries MEASURABLE.  Every injected fault leaves an instant event
+``fault.<kind>`` in the trace (args: step, kind, arg, schedule); every
+recovery mechanism leaves a span (``recovery.shard_repair``,
+``recovery.retry``, ``recovery.nonfinite_skip``, ``elastic.reshard``,
+``supervisor.checkpoint``).  :func:`correlate` pairs them, and
+:func:`recovery_histograms` folds the pairs into per-fault-kind
+detection/recovery latency histograms — a chaos run's output becomes a
+recovery SLO, not a pass/fail bit.
+
+Latency definitions (per pair):
+
+* ``detect_s``  — fault injection → recovery span START (how long the
+  fault went unnoticed);
+* ``recover_s`` — fault injection → recovery span END (total time to
+  repaired).
+
+Pairing is first-match by time: each fault claims the earliest matching
+recovery event (name in :data:`RECOVERY_FOR` for its kind) whose END is
+at-or-after the injection instant and which no earlier fault claimed —
+except that several faults may share ONE recovery event when no
+unclaimed one exists (an elastic loss+join drained in the same step is
+repaired by one reshard).  Faults whose kind needs no recovery
+(``van_delay`` just sleeps) pair with nothing by design.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+FAULT_PREFIX = "fault."
+
+# fault kind -> recovery event names that close it, in preference order
+RECOVERY_FOR = {
+    "kill_shard": ("recovery.shard_repair",),
+    "suspend_shard": ("recovery.shard_repair", "recovery.retry"),
+    "van_error": ("recovery.retry",),
+    "data_error": ("recovery.retry",),
+    "nan_grad": ("recovery.nonfinite_skip",),
+    "preempt": ("supervisor.checkpoint",),
+    "worker_loss": ("elastic.reshard",),
+    "worker_join": ("elastic.reshard",),
+    "van_delay": (),  # a delay needs no recovery — unpaired by design
+}
+
+# fault kind -> args a candidate recovery event must carry.  A preempt
+# must claim the checkpoint the SIGTERM caused (reason="preempt"), not a
+# cadence checkpoint that happened to land on the same step first.
+RECOVERY_ATTRS = {
+    "preempt": {"reason": "preempt"},
+}
+
+
+@dataclass
+class FaultPair:
+    """One injected fault and the recovery that answered it (or None)."""
+
+    kind: str
+    fault_ts_us: float
+    step: int
+    args: dict
+    recovery_name: Optional[str] = None
+    recovery_start_us: Optional[float] = None
+    recovery_end_us: Optional[float] = None
+
+    @property
+    def paired(self) -> bool:
+        return self.recovery_name is not None
+
+    @property
+    def detect_s(self) -> Optional[float]:
+        if not self.paired:
+            return None
+        return max(self.recovery_start_us - self.fault_ts_us, 0.0) / 1e6
+
+    @property
+    def recover_s(self) -> Optional[float]:
+        if not self.paired:
+            return None
+        return max(self.recovery_end_us - self.fault_ts_us, 0.0) / 1e6
+
+
+def _end_ts(ev: dict) -> float:
+    return float(ev.get("ts", 0.0)) + float(ev.get("dur", 0.0))
+
+
+def correlate(events) -> list:
+    """``events``: Chrome-trace event dicts (``Tracer.events``,
+    :func:`~hetu_tpu.telemetry.trace.load_jsonl`, or a loaded
+    ``traceEvents`` list).  Returns one :class:`FaultPair` per
+    ``fault.*`` instant, in injection order."""
+    faults = []
+    recoveries = []
+    recovery_names = {n for names in RECOVERY_FOR.values() for n in names}
+    for ev in events:
+        name = ev.get("name", "")
+        if name.startswith(FAULT_PREFIX):
+            faults.append(ev)
+        elif name in recovery_names:
+            recoveries.append(ev)
+    faults.sort(key=lambda e: (float(e.get("ts", 0.0)),
+                               e.get("seq", 0)))
+    recoveries.sort(key=lambda e: (_end_ts(e), e.get("seq", 0)))
+
+    claimed: set = set()
+    pairs = []
+    for f in faults:
+        args = dict(f.get("args") or {})
+        kind = args.get("kind") or f["name"][len(FAULT_PREFIX):]
+        ts = float(f.get("ts", 0.0))
+        pair = FaultPair(kind=kind, fault_ts_us=ts,
+                         step=int(args.get("step", -1)), args=args)
+        want = RECOVERY_FOR.get(kind, ())
+        need_attrs = RECOVERY_ATTRS.get(kind, {})
+        best = None
+        fallback = None  # already-claimed candidate (shared recovery)
+        for i, r in enumerate(recoveries):
+            if r.get("name") not in want or _end_ts(r) < ts:
+                continue
+            if need_attrs:
+                rargs = r.get("args") or {}
+                if any(rargs.get(k) != v for k, v in need_attrs.items()):
+                    continue
+            if i in claimed:
+                if fallback is None:
+                    fallback = (i, r)
+                continue
+            best = (i, r)
+            break
+        if best is None and fallback is not None:
+            # e.g. one reshard answering a same-step loss+join batch
+            best = fallback
+        if best is not None:
+            i, r = best
+            claimed.add(i)
+            pair.recovery_name = r["name"]
+            pair.recovery_start_us = float(r.get("ts", 0.0))
+            pair.recovery_end_us = _end_ts(r)
+        pairs.append(pair)
+    return pairs
+
+
+def recovery_histograms(pairs, registry=None, *, buckets=None):
+    """Fold pairs into per-kind detection/recovery latency histograms:
+    ``recovery.<kind>.detect_s`` and ``recovery.<kind>.recover_s`` in
+    ``registry`` (a fresh one when None).  Returns the registry."""
+    from hetu_tpu.telemetry.registry import (
+        DEFAULT_LATENCY_BUCKETS, MetricsRegistry,
+    )
+    reg = registry if registry is not None else MetricsRegistry()
+    buckets = buckets or DEFAULT_LATENCY_BUCKETS
+    for p in pairs:
+        if not p.paired:
+            reg.counter(f"recovery.{p.kind}.unpaired").inc()
+            continue
+        reg.histogram(f"recovery.{p.kind}.detect_s",
+                      buckets).observe(p.detect_s)
+        reg.histogram(f"recovery.{p.kind}.recover_s",
+                      buckets).observe(p.recover_s)
+    return reg
+
+
+def report(pairs) -> dict:
+    """Per-fault-kind summary: counts, pairing rate, detect/recover
+    percentiles — the dict ``tools/trace_report.py`` renders."""
+    reg = recovery_histograms(pairs)
+    by_kind: dict = {}
+    for p in pairs:
+        d = by_kind.setdefault(p.kind, {"injected": 0, "paired": 0})
+        d["injected"] += 1
+        d["paired"] += int(p.paired)
+    out = {}
+    for kind, d in sorted(by_kind.items()):
+        row = dict(d)
+        for which in ("detect_s", "recover_s"):
+            h = reg.metrics().get(f"recovery.{kind}.{which}")
+            if h is not None and h.count:
+                row[which] = {"p50": h.percentile(0.5),
+                              "p90": h.percentile(0.9),
+                              "p99": h.percentile(0.99),
+                              "max": h.snapshot()["max"]}
+        out[kind] = row
+    return out

@@ -1,0 +1,256 @@
+"""Span tracer: nestable spans, instant events, Chrome-trace/JSONL export.
+
+Reference: the reference Hetu's TimerExecutor/HetuProfiler time individual
+ops inside the executor loop; here the executor loop IS jax.jit, so what a
+live run can observe is the HOST-side phase structure — data wait,
+host-to-device, the jitted step call, checkpoint writes, reshard phases,
+serve prefill/decode batches — plus instant events (fault injections,
+recompiles).  This module records exactly that, on monotonic clocks
+(``time.perf_counter_ns``; wall-clock jumps must never produce negative
+spans), thread-safely (listener threads, the serve engine loop and the
+training loop all record concurrently).
+
+Two export shapes from one event list:
+
+* :meth:`Tracer.chrome_trace` — the Chrome trace-event JSON Perfetto /
+  chrome://tracing load directly (``ph``/``ts``/``dur``/``pid``/``tid``,
+  ts in microseconds, sorted so ts is monotone within each track);
+* an append-only JSONL stream (``jsonl_path=``) — one event per line at
+  record time, so a crashed run still has its trace up to the crash.
+
+Disabled-path contract (the hot-path budget): module-level :func:`span`
+and :func:`instant` check ONE module global; when tracing is off,
+``span()`` returns a preallocated singleton no-op context manager and
+``instant()`` returns immediately — no allocation, no lock.  Call sites
+pay a function call and a branch, nothing else (benchmarked by
+``bench.py telemetry``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+# ---------------------------------------------------------------------------
+# the disabled path: one global, one branch, zero allocation
+# ---------------------------------------------------------------------------
+
+_tracer: Optional["Tracer"] = None  # None = tracing disabled
+
+
+class _NullSpan:
+    """Singleton no-op span: ``with span(...)`` costs two no-op calls when
+    tracing is disabled, and ``.set`` swallows attribute writes."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def set(self, key, value):
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def get_tracer() -> Optional["Tracer"]:
+    return _tracer
+
+
+def enable(jsonl_path=None, *, tracer: Optional["Tracer"] = None) -> "Tracer":
+    """Install (and return) the process tracer.  ``jsonl_path`` streams
+    every event as one JSON line at record time (append mode — a resumed
+    run extends its predecessor's stream)."""
+    global _tracer
+    if _tracer is not None:
+        _tracer.close()
+    _tracer = tracer if tracer is not None else Tracer(jsonl_path=jsonl_path)
+    return _tracer
+
+
+def disable() -> Optional["Tracer"]:
+    """Uninstall the process tracer; returns it (events stay readable —
+    export after the run ends is the common pattern)."""
+    global _tracer
+    t = _tracer
+    _tracer = None
+    if t is not None:
+        t.close()
+    return t
+
+
+def span(name: str, attrs: Optional[dict] = None, cat: str = "hetu"):
+    """Context manager timing a phase.  Nesting works naturally — Perfetto
+    stacks spans per (pid, tid) track by ts/dur containment."""
+    t = _tracer
+    if t is None:
+        return NULL_SPAN
+    return t.span(name, attrs, cat)
+
+
+def instant(name: str, attrs: Optional[dict] = None, cat: str = "hetu") -> None:
+    """A zero-duration marker (fault injected, recompile, retry)."""
+    t = _tracer
+    if t is None:
+        return
+    t.instant(name, attrs, cat)
+
+
+def now_us() -> float:
+    """Track-relative timestamp for retroactive spans (:func:`complete`);
+    0.0 when tracing is disabled (complete() then no-ops anyway)."""
+    t = _tracer
+    if t is None:
+        return 0.0
+    return t._now_us()
+
+
+def complete(name: str, start_us: float, attrs: Optional[dict] = None,
+             cat: str = "hetu") -> None:
+    """Record a span RETROACTIVELY from a ``now_us()`` taken earlier —
+    for phases only worth recording once the outcome is known (a guard
+    poll that actually repaired a shard, a retry envelope that actually
+    retried)."""
+    t = _tracer
+    if t is None:
+        return
+    t.complete(name, start_us, attrs, cat)
+
+
+# ---------------------------------------------------------------------------
+# the tracer
+# ---------------------------------------------------------------------------
+
+class _Span:
+    __slots__ = ("_tracer", "name", "cat", "attrs", "_start")
+
+    def __init__(self, tracer, name, attrs, cat):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs
+
+    def set(self, key, value):
+        """Attach an attribute discovered mid-span (batch size, repaired
+        count); shows up under ``args`` in Perfetto."""
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs[key] = value
+        return self
+
+    def __enter__(self):
+        self._start = self._tracer._now_us()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.set("error", exc_type.__name__)
+        self._tracer.complete(self.name, self._start, self.attrs, self.cat)
+        return False
+
+
+class Tracer:
+    """Thread-safe event recorder.  Events are Chrome-trace dicts from the
+    moment they are recorded; ``seq`` (a lock-ordered sequence number) is
+    an extra field Perfetto ignores but the determinism tests key on."""
+
+    def __init__(self, *, jsonl_path=None, pid: Optional[int] = None,
+                 process_name: str = "hetu_tpu"):
+        self._lock = threading.Lock()
+        self.events: list = []
+        self.pid = int(pid) if pid is not None else os.getpid()
+        self._t0 = time.perf_counter_ns()
+        self._seq = 0
+        self._jsonl = None
+        self.jsonl_path = None
+        if jsonl_path is not None:
+            from pathlib import Path
+            p = Path(jsonl_path)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            self._jsonl = open(p, "a")
+            self.jsonl_path = str(p)
+        self._record({"ph": "M", "name": "process_name", "ts": 0.0,
+                      "pid": self.pid, "tid": 0,
+                      "args": {"name": process_name}})
+
+    # ---- clocks ----
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._t0) / 1000.0
+
+    # ---- recording ----
+    def _record(self, ev: dict) -> None:
+        with self._lock:
+            ev["seq"] = self._seq
+            self._seq += 1
+            self.events.append(ev)
+            if self._jsonl is not None:
+                self._jsonl.write(json.dumps(ev) + "\n")
+                self._jsonl.flush()
+
+    def span(self, name, attrs=None, cat="hetu") -> _Span:
+        return _Span(self, name, attrs, cat)
+
+    def instant(self, name, attrs=None, cat="hetu") -> None:
+        self._record({"ph": "i", "name": name, "cat": cat,
+                      "ts": self._now_us(), "pid": self.pid,
+                      "tid": threading.get_ident(), "s": "t",
+                      "args": dict(attrs) if attrs else {}})
+
+    def complete(self, name, start_us, attrs=None, cat="hetu") -> None:
+        end = self._now_us()
+        self._record({"ph": "X", "name": name, "cat": cat,
+                      "ts": float(start_us),
+                      "dur": max(end - float(start_us), 0.0),
+                      "pid": self.pid, "tid": threading.get_ident(),
+                      "args": dict(attrs) if attrs else {}})
+
+    # ---- export ----
+    def chrome_trace(self) -> dict:
+        """Perfetto-loadable trace: events sorted so ``ts`` is monotone
+        within each (pid, tid) track, parents before their children
+        (same ts → longer dur first)."""
+        with self._lock:
+            evs = [dict(e) for e in self.events]
+        evs.sort(key=lambda e: (e["pid"], e["tid"], e["ts"],
+                                -e.get("dur", 0.0)))
+        return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+    def write_chrome(self, path) -> str:
+        from pathlib import Path
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(self.chrome_trace()))
+        return str(p)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._jsonl is not None:
+                self._jsonl.close()
+                self._jsonl = None
+
+
+def load_jsonl(path) -> list:
+    """Read a trace JSONL stream back into event dicts (blank lines and
+    trailing partial lines from a crash are skipped, not fatal)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn final line from a crashed writer
+    return out

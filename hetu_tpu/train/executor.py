@@ -36,6 +36,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from hetu_tpu import rng as hrng
 from hetu_tpu.optim.optimizer import Optimizer
 from hetu_tpu.parallel.mesh import AXIS_DP
+from hetu_tpu.telemetry import trace
+
+# span names cached per subexecutor: the disabled-tracing hot path must
+# not even pay the f-string allocation
+_STEP_SPAN: Dict[str, str] = {}
 
 
 def gradients(loss_fn: Callable, argnums=0, has_aux: bool = False):
@@ -238,9 +243,22 @@ class Executor:
         """Reference analog: Executor.run('train', feed_dict)
         (executor.py:524)."""
         if name not in self._compiled:
+            trace.instant("train.compile", {"subexecutor": name})
             self._compiled[name] = self._compile(name)
-        batch = _device_batch(batch, self.mesh, self.dp_axis)
-        return self._compiled[name](state, batch)
+        with trace.span("train.host_to_device"):
+            batch = _device_batch(batch, self.mesh, self.dp_axis)
+        sname = _STEP_SPAN.get(name)
+        if sname is None:
+            sname = _STEP_SPAN.setdefault(name, "train.step." + name)
+        with trace.span(sname):
+            out = self._compiled[name](state, batch)
+            if trace.enabled():
+                # jit dispatch is async: without a sync the span times the
+                # ~µs enqueue and the real step cost lands in whatever
+                # phase fetches a value next.  Only a TRACED run pays this
+                # barrier — tracing off keeps the async pipeline.
+                jax.block_until_ready(out)
+            return out
 
     def save(self, path, state: TrainState, *, extra=None) -> None:
         """Reference-parity convenience (executor.py:558): checkpoint the

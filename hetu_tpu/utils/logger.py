@@ -5,6 +5,14 @@ WandbLogger wired in executor.py:402-415).  Here scalar aggregation across
 shards already happened inside the jitted step (psum/pmean), so the logger
 is host-side bookkeeping: running means per key, step timing, optional
 wandb passthrough when the package + env are present.
+
+Since the telemetry tier landed, the logger is a thin facade over a
+:class:`~hetu_tpu.telemetry.registry.MetricsRegistry` — ``inc`` counters
+are typed :class:`Counter` objects and ``log`` scalars mirror into
+gauges, so a run's metrics come out EITHER the historical way
+(``means()``/``counters_snapshot()``/the JSONL log file) or as a
+Prometheus text exposition (``prometheus_text()``).  The public API is
+unchanged: every pre-telemetry call site keeps working.
 """
 
 from __future__ import annotations
@@ -15,16 +23,31 @@ from collections import defaultdict
 from pathlib import Path
 from typing import Optional
 
+from hetu_tpu.telemetry.registry import MetricsRegistry
+
 
 class MetricLogger:
     def __init__(self, log_path: Optional[str] = None, *,
-                 use_wandb: bool = False, wandb_kwargs: Optional[dict] = None):
+                 use_wandb: bool = False, wandb_kwargs: Optional[dict] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        # counters and log() scalars are SEPARATE namespaces (historically
+        # two dicts): the supervisor both inc()s "checkpoints" and log()s
+        # a "checkpoints" scalar in its final counter snapshot, so they
+        # get separate registries and prometheus_text() merges them
+        # (counters render with the _total suffix, so names never clash)
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._scalar_registry = MetricsRegistry()
         self.totals = defaultdict(float)
         self.counts = defaultdict(int)
-        self.counters = defaultdict(int)  # monotonic event counters
         self.step = 0
         self.t0 = time.perf_counter()
-        self.log_file = open(log_path, "a") if log_path else None
+        self.log_file = None
+        if log_path:
+            p = Path(log_path)
+            # a log path in a not-yet-created run directory must not crash
+            # the run it was supposed to observe
+            p.parent.mkdir(parents=True, exist_ok=True)
+            self.log_file = open(p, "a")
         self.wandb = None
         if use_wandb:  # pragma: no cover - optional dependency
             try:
@@ -37,8 +60,10 @@ class MetricLogger:
     def log(self, metrics: dict, step: Optional[int] = None) -> None:
         self.step = step if step is not None else self.step + 1
         for k, v in metrics.items():
-            self.totals[k] += float(v)
+            v = float(v)
+            self.totals[k] += v
             self.counts[k] += 1
+            self._scalar_registry.gauge(k).set(v)
         if self.wandb is not None:  # pragma: no cover
             self.wandb.log({k: float(v) for k, v in metrics.items()},
                            step=self.step)
@@ -53,19 +78,58 @@ class MetricLogger:
         """Bump a monotonic event counter (fault injected, retry, shard
         repair, ...) — unlike ``log`` scalars these are never averaged;
         ``counters_snapshot`` folds them into one loggable record."""
-        self.counters[name] += int(n)
-        return self.counters[name]
+        return self.registry.counter(name).inc(int(n))
 
     def counters_snapshot(self) -> dict:
-        return dict(self.counters)
+        from hetu_tpu.telemetry.registry import Counter
+        return {name: m.value for name, m in self.registry.metrics().items()
+                if isinstance(m, Counter)}
+
+    @property
+    def counters(self) -> dict:
+        """Historical attribute shape (was a defaultdict): the live
+        counter values by name."""
+        return self.counters_snapshot()
 
     def means(self) -> dict:
         return {k: self.totals[k] / max(self.counts[k], 1)
                 for k in self.totals}
 
-    def reset(self) -> None:
+    def reset(self, counters: bool = False) -> None:
+        """Clear the running means.  Monotonic counters survive by
+        default — chaos tests that deliberately zero them between phases
+        pass ``counters=True`` (an explicit choice, never a side effect
+        of resetting scalar means)."""
         self.totals.clear()
         self.counts.clear()
+        if counters:
+            from hetu_tpu.telemetry.registry import Counter
+            for m in self.registry.metrics().values():
+                if isinstance(m, Counter):
+                    m.reset()
+
+    def prometheus_text(self) -> str:
+        """Text exposition of everything this logger holds: counters
+        (``inc``, rendered with the conventional ``_total`` suffix, so an
+        inc()/log() name shared across the two namespaces stays unique)
+        plus gauges for the latest ``log`` scalars.  A SHARED registry
+        (``registry=`` at construction) may hold non-counter metrics from
+        other instrumentation — those render with their real types."""
+        from hetu_tpu.telemetry.registry import (
+            Counter, MetricsRegistry, _prom_name,
+        )
+        lines = []
+        others = MetricsRegistry()
+        for name, m in sorted(self.registry.metrics().items()):
+            if isinstance(m, Counter):
+                pname = _prom_name(name) + "_total"
+                lines.append(f"# TYPE {pname} counter")
+                lines.append(f"{pname} {m.value}")
+            else:
+                others._metrics[name] = m
+        return "\n".join(lines) + ("\n" if lines else "") \
+            + others.prometheus_text() \
+            + self._scalar_registry.prometheus_text()
 
     def close(self) -> None:
         if self.log_file:

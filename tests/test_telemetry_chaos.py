@@ -1,0 +1,175 @@
+"""Chaos-correlated trace, end to end: a seeded chaos run (real PS shard
+SIGKILL + elastic worker_loss) under tracing produces a Perfetto-loadable
+trace in which EVERY injected fault's instant event is paired with its
+recovery span, the reporter prints per-fault-kind detection/recovery
+percentiles, and two runs with the same seed emit byte-identical fault
+event ordering.
+
+Marked slow + chaos + telemetry (multi-process, wall-clock); the
+in-process telemetry tests live in tests/test_telemetry.py.
+"""
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos, pytest.mark.telemetry]
+
+from hetu_tpu.ps import available
+
+if not available():  # pragma: no cover
+    pytest.skip("native PS lib unavailable", allow_module_level=True)
+
+import jax
+import jax.numpy as jnp
+
+import hetu_tpu as ht
+from hetu_tpu import layers, optim, telemetry
+from hetu_tpu.parallel.mesh import MeshConfig
+from hetu_tpu.ps import van
+from hetu_tpu.resilience import (
+    ElasticSupervisor, FaultEvent, FaultInjector, FaultSchedule,
+    PSShardGuard,
+)
+from hetu_tpu.resilience.shardproc import free_port, spawn_shard_server
+from hetu_tpu.telemetry import timeline
+from hetu_tpu.train.executor import Executor
+
+REPO = Path(__file__).resolve().parent.parent
+ROWS, DIM = 16, 4
+W = 4          # nominal dp width (8 virtual cpu devices)
+B = 12         # divisible by 4 and by 3 (the post-loss width)
+STEPS = 50
+
+
+def _respawner(tmp_path, ports, procs, stop_evt):
+    while not stop_evt.is_set():
+        for i, p in enumerate(procs):
+            if p.poll() is not None and not stop_evt.is_set():
+                time.sleep(0.2)
+                procs[i] = spawn_shard_server(tmp_path, ports[i], f"r{i}")
+        time.sleep(0.1)
+
+
+def _run_chaos(tmp_path, tag, schedule):
+    """One traced elastic+PS chaos run; returns (tracer, report, guard)."""
+    ports = [free_port(), free_port()]
+    procs = [spawn_shard_server(tmp_path, p, f"{tag}{i}")
+             for i, p in enumerate(ports)]
+    stop_evt = threading.Event()
+    watcher = threading.Thread(target=_respawner,
+                               args=(tmp_path, ports, procs, stop_evt),
+                               daemon=True)
+    watcher.start()
+    try:
+        t = van.PartitionedPSTable(
+            [("127.0.0.1", p) for p in ports], rows=ROWS, dim=DIM,
+            init="zeros", optimizer="sgd", lr=0.1,
+            table_id=970 + (hash(tag) % 7), heartbeat_ms=100)
+        # shard 1 (rows 8..15) holds learned values training never touches
+        shard1 = np.arange(8, 16, dtype=np.int64)
+        learned = np.arange(8 * DIM, dtype=np.float32).reshape(8, DIM) + 1.0
+        t.sparse_set(shard1, learned)
+
+        model = layers.Sequential(layers.Linear(8, 16), layers.Relu(),
+                                  layers.Linear(16, 2))
+
+        def loss_fn(params, model_state, batch, rng, train):
+            out, new_state = model.apply(
+                {"params": params, "state": model_state}, batch["x"],
+                train=train, rng=rng)
+            loss = jnp.mean(ht.ops.softmax_cross_entropy_sparse(
+                out, batch["y"]))
+            return loss, ({}, new_state)
+
+        g = np.random.default_rng(0)
+        X = g.standard_normal((B, 8)).astype(np.float32)
+        Y = (X.sum(1) > 0).astype(np.int32)
+
+        def batch_fn(i):
+            time.sleep(0.1)  # real wall time: respawn + heartbeat land
+            return {"x": X, "y": Y}
+
+        ex = Executor(loss_fn, optim.AdamOptimizer(0.01), seed=0)
+        state = ex.init_state(model.init(jax.random.PRNGKey(0)))
+        guard = PSShardGuard(t, snapshot_path=tmp_path / f"{tag}.npz")
+        guard.snapshot()
+
+        tracer = telemetry.enable(
+            jsonl_path=tmp_path / f"{tag}.trace.jsonl")
+        injector = FaultInjector(schedule, shard_procs=procs)
+        sup = ElasticSupervisor(
+            ex, config=MeshConfig(dp=W), injector=injector, guards=[guard],
+            retries=40, backoff_base_s=0.05, backoff_max_s=0.5)
+        rep = sup.run(state, batch_fn, STEPS)
+        telemetry.disable()
+        t.close()
+        return tracer, rep, guard
+    finally:
+        telemetry.disable()
+        stop_evt.set()
+        watcher.join(10)
+        for p in procs:
+            p.kill()
+            p.wait()
+
+
+def test_chaos_trace_pairs_every_fault(tmp_path, capsys):
+    schedule = FaultSchedule([FaultEvent(5, "kill_shard", 1.0),
+                              FaultEvent(30, "worker_loss", 3.0)])
+    t1, rep1, guard1 = _run_chaos(tmp_path, "a", schedule)
+    assert rep1.step == STEPS
+    assert rep1.counters["shards_killed"] == 1
+    assert rep1.counters["shard_repairs"] == 1
+    assert rep1.counters["resizes"] == 1
+    assert rep1.counters["elastic_width"] == W - 1
+
+    # every injected fault pairs with its recovery span
+    pairs = timeline.correlate(t1.events)
+    assert len(pairs) == 2
+    by_kind = {p.kind: p for p in pairs}
+    ks = by_kind["kill_shard"]
+    assert ks.paired and ks.recovery_name == "recovery.shard_repair"
+    assert ks.recover_s > ks.detect_s > 0
+    wl = by_kind["worker_loss"]
+    assert wl.paired and wl.recovery_name == "elastic.reshard"
+    assert wl.recover_s > 0
+
+    # Perfetto-loadable export: valid JSON, required fields, monotone ts
+    chrome = t1.write_chrome(tmp_path / "a.trace.json")
+    doc = json.loads(Path(chrome).read_text())
+    by_track = {}
+    for e in doc["traceEvents"]:
+        assert e["ph"] in ("X", "i", "M")
+        assert "ts" in e and "pid" in e and "tid" in e
+        by_track.setdefault((e["pid"], e["tid"]), []).append(e["ts"])
+    for ts in by_track.values():
+        assert ts == sorted(ts)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"fault.kill_shard", "fault.worker_loss",
+            "recovery.shard_repair", "elastic.reshard",
+            "elastic.snapshot", "elastic.remesh", "elastic.replace",
+            "train.data_wait", "train.step.train_guarded"} <= names
+
+    # the reporter prints the per-fault-kind detection/recovery table
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", REPO / "tools" / "trace_report.py")
+    tr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tr)
+    assert tr.main([str(tmp_path / "a.trace.jsonl")]) == 0
+    out = capsys.readouterr().out
+    assert "kill_shard" in out and "worker_loss" in out
+    assert "UNPAIRED" not in out
+
+    # byte-identical fault-event ordering across two runs, same seed
+    t2, rep2, _ = _run_chaos(tmp_path, "b", schedule)
+    def fault_seq(tr_):
+        return json.dumps([(e["name"], e["args"]) for e in tr_.events
+                           if e["name"].startswith("fault.")])
+    assert fault_seq(t1) == fault_seq(t2)
+    assert rep2.counters["shard_repairs"] == 1

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Trace reporter: per-phase time breakdown, slowest spans, fault table.
+
+Reads a telemetry trace — the append-only JSONL stream
+(``telemetry.enable(jsonl_path=...)``) or an exported Chrome-trace JSON
+(``Tracer.write_chrome``) — and prints:
+
+  * per-phase breakdown: total/mean/max wall time per span name, share of
+    the trace's wall clock (where does a step's time go: data wait vs.
+    host-to-device vs. jitted compute vs. checkpoint);
+  * the slowest individual spans (the outliers worth opening in Perfetto);
+  * the fault → recovery table: per fault kind, injected/paired counts and
+    detection/recovery latency percentiles
+    (:mod:`hetu_tpu.telemetry.timeline` pairing).
+
+Usage:  python tools/trace_report.py RUN.trace.jsonl [--top 10] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from hetu_tpu.telemetry import timeline, trace  # noqa: E402
+
+
+def load_events(path) -> list:
+    """JSONL stream or a Chrome-trace JSON ({"traceEvents": [...]})."""
+    p = Path(path)
+    try:
+        # a Chrome-trace export is ONE json document; a JSONL stream is
+        # one document PER LINE and fails the whole-file parse
+        doc = json.loads(p.read_text())
+    except json.JSONDecodeError:
+        return trace.load_jsonl(p)
+    if isinstance(doc, dict):
+        # a one-line JSONL stream also whole-file-parses: a single event
+        # dict (has "ph") is a stream of one, not a chrome export
+        return doc.get("traceEvents", [doc] if "ph" in doc else [])
+    return doc if isinstance(doc, list) else []
+
+
+def phase_breakdown(events) -> list:
+    """[(name, count, total_s, mean_s, max_s, share)] sorted by total."""
+    spans = [e for e in events if e.get("ph") == "X"]
+    if not spans:
+        return []
+    t_lo = min(e["ts"] for e in spans)
+    t_hi = max(e["ts"] + e.get("dur", 0.0) for e in spans)
+    wall_us = max(t_hi - t_lo, 1e-9)
+    agg: dict = {}
+    for e in spans:
+        d = agg.setdefault(e["name"], [0, 0.0, 0.0])
+        d[0] += 1
+        d[1] += e.get("dur", 0.0)
+        d[2] = max(d[2], e.get("dur", 0.0))
+    rows = [(name, c, tot / 1e6, tot / c / 1e6, mx / 1e6, tot / wall_us)
+            for name, (c, tot, mx) in agg.items()]
+    rows.sort(key=lambda r: -r[2])
+    return rows
+
+
+def slowest_spans(events, top: int = 10) -> list:
+    spans = [e for e in events if e.get("ph") == "X"]
+    spans.sort(key=lambda e: -e.get("dur", 0.0))
+    return spans[:top]
+
+
+def _fmt_s(s) -> str:
+    if s is None:
+        return "-"
+    if s < 1e-3:
+        return f"{s * 1e6:.0f}us"
+    if s < 1.0:
+        return f"{s * 1e3:.2f}ms"
+    return f"{s:.3f}s"
+
+
+def render(events, *, top: int = 10) -> str:
+    lines = []
+    rows = phase_breakdown(events)
+    n_instants = sum(1 for e in events if e.get("ph") == "i")
+    lines.append(f"trace: {sum(1 for e in events if e.get('ph') == 'X')} "
+                 f"spans, {n_instants} instants")
+    lines.append("")
+    lines.append("== per-phase breakdown ==")
+    if rows:
+        w = max(len(r[0]) for r in rows)
+        lines.append(f"{'phase':<{w}}  {'count':>7} {'total':>10} "
+                     f"{'mean':>10} {'max':>10} {'share':>6}")
+        for name, c, tot, mean, mx, share in rows:
+            lines.append(f"{name:<{w}}  {c:>7} {_fmt_s(tot):>10} "
+                         f"{_fmt_s(mean):>10} {_fmt_s(mx):>10} "
+                         f"{share * 100:>5.1f}%")
+    else:
+        lines.append("(no spans)")
+    lines.append("")
+    lines.append(f"== slowest spans (top {top}) ==")
+    for e in slowest_spans(events, top):
+        args = e.get("args") or {}
+        extra = " ".join(f"{k}={v}" for k, v in sorted(args.items()))
+        lines.append(f"{_fmt_s(e.get('dur', 0.0) / 1e6):>10}  {e['name']}"
+                     + (f"  [{extra}]" if extra else ""))
+    pairs = timeline.correlate(events)
+    lines.append("")
+    lines.append("== fault -> recovery ==")
+    if pairs:
+        rep = timeline.report(pairs)
+        lines.append(f"{'kind':<14} {'inj':>4} {'paired':>6} "
+                     f"{'detect p50/p90/p99':>24} {'recover p50/p90/p99':>24}")
+        for kind, row in rep.items():
+            def pct(which):
+                d = row.get(which)
+                if not d:
+                    return "-"
+                return "/".join(_fmt_s(d[p]) for p in ("p50", "p90", "p99"))
+            lines.append(f"{kind:<14} {row['injected']:>4} "
+                         f"{row['paired']:>6} {pct('detect_s'):>24} "
+                         f"{pct('recover_s'):>24}")
+        unpaired = [p for p in pairs
+                    if not p.paired and timeline.RECOVERY_FOR.get(p.kind)]
+        if unpaired:
+            lines.append(f"WARNING: {len(unpaired)} fault(s) with an "
+                         "expected recovery left UNPAIRED:")
+            for p in unpaired:
+                lines.append(f"  fault.{p.kind} at step {p.step}")
+    else:
+        lines.append("(no injected faults in this trace)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="trace .jsonl stream or Chrome-trace .json")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many slowest spans to list")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the fault/phase report as JSON instead")
+    args = ap.parse_args(argv)
+    events = load_events(args.trace)
+    if args.json:
+        pairs = timeline.correlate(events)
+        print(json.dumps({
+            "phases": [{"name": n, "count": c, "total_s": t, "mean_s": m,
+                        "max_s": mx, "share": sh}
+                       for n, c, t, m, mx, sh in phase_breakdown(events)],
+            "faults": timeline.report(pairs),
+        }, default=float, indent=1))
+    else:
+        print(render(events, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
