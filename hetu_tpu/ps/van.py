@@ -733,6 +733,16 @@ class BlobChannel:
         self.fd = _connect_with_deadline(self.host, self.port,
                                          self._timeout_s)
 
+    def reconnect(self) -> None:
+        """Drop the connection and establish a fresh one.
+
+        Safe at ANY message boundary: all three wire ops are idempotent
+        under same-seq resend, so a caller that reconnects mid-stream
+        (or had its transport killed under it) simply resumes at the
+        seq it was on — the contract the chunked slot-migration transfer
+        (serve/migrate.py) and its kill-between-chunks tests lean on."""
+        self._reconnect()
+
     def put(self, data, seq: int, *, timeout_s: float = 60.0) -> None:
         buf = np.ascontiguousarray(data).tobytes() \
             if not isinstance(data, (bytes, bytearray, memoryview)) else \

@@ -27,6 +27,12 @@ Fault kinds
                    elastic supervisor reforms the mesh at the surviving
                    width instead of aborting (resilience/elastic.py)
 ``worker_join``    worker ``arg`` (re)joins — the mesh regrows
+``serve_preempt``  serving-pool member ``arg`` receives a preemption
+                   notice: the pool drains it PLANNED — live KV slots
+                   migrate to a peer (serve/pool.py, zero re-prefill)
+``serve_engine_kill``  serving-pool member ``arg``'s engine dies
+                   UNANNOUNCED (SIGKILL-alike, KV state lost); the pool
+                   fails its queue over to a peer via re-prefill
 
 The van hooks ride :func:`hetu_tpu.ps.van.set_fault_hook`; everything else
 is plain process/OS plumbing, so the harness needs no native lib to import.
@@ -58,7 +64,8 @@ class TransientDataError(RuntimeError):
 
 KINDS = ("van_error", "van_delay", "data_error", "nan_grad",
          "kill_shard", "suspend_shard", "preempt",
-         "worker_loss", "worker_join")
+         "worker_loss", "worker_join",
+         "serve_preempt", "serve_engine_kill")
 
 
 @dataclass(frozen=True, order=True)
@@ -102,7 +109,9 @@ class FaultSchedule:
                  n_shards: int = 1,
                  preempt_at: int | None = None,
                  worker_losses: int = 0, worker_joins: int = 0,
-                 n_workers: int = 1) -> "FaultSchedule":
+                 n_workers: int = 1,
+                 serve_preempts: int = 0, serve_engine_kills: int = 0,
+                 n_members: int = 1) -> "FaultSchedule":
         """Draw a schedule over training steps ``[1, steps)`` from ``seed``.
 
         Counts are clipped to the available steps.  Shard-targeted faults
@@ -117,6 +126,13 @@ class FaultSchedule:
         physically consistent (never joins a worker that is present).
         New draws consume the rng AFTER all pre-existing kinds, so
         schedules generated with the old kwargs are byte-identical.
+
+        Serving-pool faults: ``serve_preempts`` planned member
+        preemptions (the pool live-migrates the victim's KV slots) and
+        ``serve_engine_kills`` abrupt engine deaths (re-prefill
+        failover), each picking a victim member uniformly from
+        ``n_members``.  Drawn after everything above — same
+        byte-identity guarantee for pre-existing kwargs.
         """
         rng = np.random.default_rng(seed)
         hi = max(int(steps), 2)
@@ -169,6 +185,14 @@ class FaultSchedule:
                 join_s = int(rng.integers(loss_steps[i] + 1, hi))
                 events.append(FaultEvent(join_s, "worker_join",
                                          float(victims[i])))
+        for s in pick(serve_preempts):
+            events.append(FaultEvent(s, "serve_preempt",
+                                     float(rng.integers(max(n_members,
+                                                            1)))))
+        for s in pick(serve_engine_kills):
+            events.append(FaultEvent(s, "serve_engine_kill",
+                                     float(rng.integers(max(n_members,
+                                                            1)))))
         return cls(events)
 
     def at(self, step: int) -> list[FaultEvent]:
@@ -222,6 +246,10 @@ class FaultInjector:
         # worker_idx), drained via pop_worker_events() at the top of each
         # step — the injector records, the supervisor decides
         self.worker_events = deque()
+        # serving-pool events: (kind, member_idx), drained via
+        # pop_serve_events() by the pool's chaos driver (same record/
+        # decide split: the injector cannot reach into the pool's engines)
+        self.serve_events = deque()
         self._lock = threading.Lock()
         self._prev_hook = None
         self._installed = False
@@ -294,6 +322,19 @@ class FaultInjector:
                 self.counters["worker_joins_injected"] += 1
                 with self._lock:
                     self.worker_events.append(("join", int(ev.arg)))
+            elif k in ("serve_preempt", "serve_engine_kill"):
+                self.counters[k + "s_injected"] += 1
+                with self._lock:
+                    self.serve_events.append((k, int(ev.arg)))
+
+    def pop_serve_events(self) -> list:
+        """Drain pending serving-pool events as
+        ``[("serve_preempt"|"serve_engine_kill", member_idx)]`` — feed
+        them to ``ServingPool.run_fault_events``."""
+        with self._lock:
+            out = list(self.serve_events)
+            self.serve_events.clear()
+        return out
 
     def pop_worker_events(self) -> list:
         """Drain pending membership events as [("loss"|"join", worker)].

@@ -12,23 +12,32 @@ of the training-only models:
     decode step, evict on EOS/max_tokens/deadline, token-budget
     backpressure;
   * :mod:`server` — blob-channel front-end over the van transport with
-    per-request timeouts and graceful shutdown;
+    per-request timeouts, idempotent resubmission dedup, and graceful
+    shutdown;
   * :mod:`metrics` — TTFT / tokens-per-sec / queue depth / occupancy /
-    recompile counters, reportable through ``utils/logger.MetricLogger``.
+    recompile counters, reportable through ``utils/logger.MetricLogger``;
+  * :mod:`migrate` — live KV-cache slot migration: chunked CRC-checked
+    slot transfer over the van, scheduler hand-off with zero re-prefill;
+  * :mod:`pool` — :class:`ServingPool`: health-routed routing over N
+    members, planned drain (migrate-then-exit) and unplanned failover.
 
-See examples/gpt_serve.py for the end-to-end path.
+See examples/gpt_serve.py and examples/gpt_serve_pool.py for the
+end-to-end paths.
 """
 
 from hetu_tpu.serve.engine import ServeEngine
-from hetu_tpu.serve.kv_cache import KVCache, KVCacheSpec
+from hetu_tpu.serve.kv_cache import KVCache, KVCacheSpec, KVSlotSnapshot
 from hetu_tpu.serve.metrics import ServeMetrics
+from hetu_tpu.serve.migrate import MigrationError
+from hetu_tpu.serve.pool import ServingPool
 from hetu_tpu.serve.scheduler import ContinuousBatchingScheduler, Request
 from hetu_tpu.serve.server import (
     InferenceClient, InferenceServer, request_channel, response_channel,
 )
 
 __all__ = [
-    "ServeEngine", "KVCache", "KVCacheSpec", "ServeMetrics",
+    "ServeEngine", "KVCache", "KVCacheSpec", "KVSlotSnapshot",
+    "ServeMetrics", "MigrationError", "ServingPool",
     "ContinuousBatchingScheduler", "Request",
     "InferenceClient", "InferenceServer",
     "request_channel", "response_channel",

@@ -230,6 +230,65 @@ class ServeEngine:
         self.metrics.observe_decode(len(out))
         return out
 
+    # ---- live-slot migration ----
+    def export_slots(self, slot_ids) -> list:
+        """Snapshot mid-decode slots for hand-off to a peer engine: the
+        cache's truncated K/V rows plus this engine's per-slot decode
+        state (the last emitted token, which is NOT in the cache yet) in
+        ``meta`` — everything a peer needs to continue decoding
+        token-for-token with zero prefill.
+
+        Exported slots are SUSPENDED (allocated but excluded from
+        :meth:`decode`) until the caller either releases them (the
+        migration committed) or :meth:`resume_slots` them (rollback).
+        The wire transfer runs outside any lock, and a decode step
+        admitted in that window would otherwise silently advance the
+        exported slots past their requests' recorded tokens — tokens a
+        rollback could never recover."""
+        for slot in slot_ids:
+            if not self.active[int(slot)]:
+                raise ValueError(f"slot {int(slot)} is not mid-decode; "
+                                 f"nothing to migrate")
+        snaps = self.cache.export_slots(slot_ids)
+        for s in snaps:
+            s.meta["last_token"] = int(self.last_tokens[s.slot])
+        for slot in slot_ids:  # suspend LAST: any failure above leaves
+            self.active[int(slot)] = False  # every slot still decoding
+        return snaps
+
+    def resume_slots(self, slot_ids) -> None:
+        """Re-activate slots suspended by :meth:`export_slots` — the
+        rollback half of a failed migration: the source engine resumes
+        decoding them exactly where they stopped (``last_tokens`` was
+        kept through the suspension)."""
+        slots = [int(s) for s in slot_ids]
+        for slot in slots:  # validate-first: resume is all-or-nothing
+            if self.cache.lengths[slot] < 1:
+                raise ValueError(f"slot {slot} has no cached tokens to "
+                                 f"resume")
+        for slot in slots:
+            self.active[slot] = True
+
+    def adopt_slots(self, snapshots) -> dict:
+        """Adopt peer-exported slots; returns ``{source_slot: slot}``.
+        The next :meth:`decode` continues each adopted sequence exactly
+        where the source left off — no prefill step runs (the
+        ``serve.prefill`` span/metric stays flat, the zero-re-prefill
+        contract tests assert)."""
+        snaps = list(snapshots)
+        for s in snaps:
+            if "last_token" not in s.meta:
+                raise ValueError(
+                    f"slot snapshot {s.slot} has no last_token meta — "
+                    f"exported from a cache, not an engine?")
+        slot_map = self.cache.import_slots(snaps)
+        for s in snaps:
+            slot = slot_map[s.slot]
+            self.last_tokens[slot] = int(s.meta["last_token"])
+            self.active[slot] = True
+        self.metrics.inc("slots_adopted", len(slot_map))
+        return slot_map
+
     # ---- slot lifecycle (delegates; engine keeps its masks in sync) ----
     def alloc_slot(self) -> int:
         slot = self.cache.alloc()

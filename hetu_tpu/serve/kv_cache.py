@@ -20,7 +20,7 @@ the per-slot host-side lengths.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import jax.numpy as jnp
 import numpy as np
@@ -45,6 +45,30 @@ class KVCacheSpec:
         return KVCacheSpec(
             num_layers=c.num_layers, num_kv_heads=nkv,
             head_dim=c.hidden_size // c.num_heads, dtype=c.dtype)
+
+
+@dataclass
+class KVSlotSnapshot:
+    """One live cache slot lifted onto the host for migration.
+
+    ``k``/``v`` are ``[num_layers, length, kv_heads, head_dim]`` numpy
+    arrays truncated to the slot's live ``length`` (never ``max_len`` —
+    migration cost must scale with what is actually cached), in the
+    source cache's dtype.  ``slot`` is the SOURCE slot id (import
+    returns a mapping from it to the adopting cache's slot).  ``meta``
+    carries engine-level per-slot state (the last emitted token) and any
+    future sampler state — opaque to the cache itself.
+    """
+
+    slot: int
+    length: int
+    k: np.ndarray
+    v: np.ndarray
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def nbytes(self) -> int:
+        return self.k.nbytes + self.v.nbytes
 
 
 class KVCache:
@@ -75,6 +99,7 @@ class KVCache:
         # LIFO keeps hot slots hot (their pages are the ones most recently
         # touched by a jitted step)
         self._free = list(range(num_slots - 1, -1, -1))
+        self._import_fn = None  # lazily jitted slot writer (import_slots)
 
     # ---- slot lifecycle ----
     @property
@@ -108,6 +133,106 @@ class KVCache:
     def update(self, k, v) -> None:
         """Swap in the arrays a jitted step returned."""
         self.k, self.v = k, v
+
+    # ---- live-slot migration (serve/migrate.py rides on these) ----
+    def export_slots(self, slot_ids) -> list:
+        """Snapshot occupied slots for migration to a peer cache.
+
+        Each snapshot's K/V rows are truncated to the slot's live
+        ``lengths[slot]`` and fetched to the host — the slot itself stays
+        allocated and untouched, so a failed transfer rolls back to the
+        source simply by NOT freeing it.
+        """
+        snaps = []
+        for slot in slot_ids:
+            slot = int(slot)
+            if not 0 <= slot < self.num_slots:
+                raise ValueError(f"slot {slot} out of range")
+            if slot in self._free:
+                raise ValueError(f"slot {slot} is free; nothing to export")
+            n = int(self.lengths[slot])
+            if n < 1:
+                raise ValueError(f"slot {slot} has no cached tokens")
+            snaps.append(KVSlotSnapshot(
+                slot=slot, length=n,
+                k=np.asarray(self.k[:, slot, :n]),
+                v=np.asarray(self.v[:, slot, :n])))
+        return snaps
+
+    def import_slots(self, snapshots) -> dict:
+        """Adopt peer-exported snapshots; returns ``{source_slot: slot}``.
+
+        Validates EVERY snapshot against this cache's geometry before
+        allocating anything — a mismatched migration errors loudly and
+        adopts nothing (no partially-imported slots), which is what lets
+        the sender keep serving after a failed hand-off.
+        """
+        snaps = list(snapshots)
+        if len(snaps) > self.num_free:
+            raise RuntimeError(
+                f"cannot adopt {len(snaps)} slots: only {self.num_free} "
+                f"free")
+        spec = self.spec
+        dt = np.dtype(spec.dtype)
+        for s in snaps:
+            if s.length < 1 or s.length >= self.max_len:
+                raise ValueError(
+                    f"slot snapshot of {s.length} tokens does not leave "
+                    f"room to decode within max_len {self.max_len}")
+            want = (spec.num_layers, s.length, spec.num_kv_heads,
+                    spec.head_dim)
+            for name, arr in (("k", s.k), ("v", s.v)):
+                if tuple(arr.shape) != want:
+                    raise ValueError(
+                        f"{name} geometry mismatch: snapshot "
+                        f"{tuple(arr.shape)} vs cache spec {want} "
+                        f"(layers/kv_heads/head_dim must match exactly)")
+                if np.dtype(arr.dtype) != dt:
+                    raise ValueError(
+                        f"{name} dtype mismatch: snapshot "
+                        f"{np.dtype(arr.dtype).name} vs cache {dt.name}")
+        if self._import_fn is None:
+            import jax
+
+            def write(k, v, k_rows, v_rows, slot):
+                # rows padded to a power-of-two bucket: executables stay
+                # bounded (one per bucket, like the engine's prefill)
+                # while donation lets XLA update the cache in place — a
+                # slot adoption moves <= 2x its live bytes, never a
+                # whole-cache copy and never a full max_len row
+                k = jax.lax.dynamic_update_slice(k, k_rows,
+                                                 (0, slot, 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(v, v_rows,
+                                                 (0, slot, 0, 0, 0))
+                return k, v
+
+            self._import_fn = jax.jit(write, donate_argnums=(0, 1))
+        slot_map: dict = {}
+        allocated: list = []
+        try:
+            for s in snaps:
+                slot = self.alloc()
+                allocated.append(slot)
+                pad = 1
+                while pad < s.length:
+                    pad *= 2
+                pad = min(pad, self.max_len)
+                pad_shape = (spec.num_layers, 1, pad, spec.num_kv_heads,
+                             spec.head_dim)
+                k_rows = np.zeros(pad_shape, dt)
+                v_rows = np.zeros(pad_shape, dt)
+                k_rows[:, 0, :s.length] = s.k
+                v_rows[:, 0, :s.length] = s.v
+                self.k, self.v = self._import_fn(
+                    self.k, self.v, jnp.asarray(k_rows),
+                    jnp.asarray(v_rows), jnp.int32(slot))
+                self.lengths[slot] = s.length
+                slot_map[s.slot] = slot
+        except Exception:
+            for slot in allocated:
+                self.free(slot)
+            raise
+        return slot_map
 
     @property
     def active_tokens(self) -> int:

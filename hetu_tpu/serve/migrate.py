@@ -1,0 +1,413 @@
+"""Live KV-cache slot migration between serving engines.
+
+PR 3 taught one `InferenceServer` to survive engine death by requeueing
+in-flight requests and RE-PREFILLING them — correct, but the recovery
+cost grows with context length (a 10k-token conversation re-forwards 10k
+tokens).  This module is the other half the ROADMAP left open: hand the
+LIVE KV slots to a peer engine so decoding continues token-for-token
+with zero prefill — the difference between "recovers eventually" and
+"users never notice" on preemptible capacity.  The same pattern Hetu's
+PS tier already proves (state handed between processes over the van with
+deterministic replay) applied at the serve tier.
+
+Three layers, separable on purpose:
+
+* **slot payloads** — :func:`pack` / :func:`unpack` serialize
+  :class:`~hetu_tpu.serve.kv_cache.KVSlotSnapshot` lists (plus optional
+  request records) into one self-describing byte string: magic + JSON
+  header (cache geometry, per-slot metadata, body CRC) + raw K/V bytes.
+  ``unpack`` re-validates everything — magic, version, geometry, body
+  CRC — before any array is materialized, so a corrupt transfer fails
+  clean with nothing adopted;
+* **chunked wire** — :func:`send_payload` / :func:`recv_payload` move a
+  payload over an existing van :class:`~hetu_tpu.ps.van.BlobChannel` as
+  CRC-framed chunks at consecutive seqs.  Every frame is a single-slot
+  acked blob put, idempotent under same-seq resend, so a transport drop
+  mid-transfer reconnects and resumes at the unacked chunk instead of
+  restarting the payload (tests/test_van_blob.py kills the connection
+  between chunks);
+* **orchestration** — :func:`migrate_inflight` moves every in-flight
+  request from one scheduler to another: mid-decode requests carry
+  their live slots, queued ones re-queue, and ANY failure re-adopts
+  everything at the source and re-raises — migration either completes
+  or leaves the source serving.
+
+Request records (:func:`request_record` / :func:`request_from_record`)
+are the wire form of a mid-decode ``Request``: prompt, emitted tokens,
+fold watermark, deadline (as elapsed time — monotonic clocks do not
+compare across processes), requeue count.  Decoding is greedy argmax
+today, so there is no sampler/RNG state to carry; a sampling engine
+extends the record here.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from hetu_tpu.serve.kv_cache import KVSlotSnapshot
+
+MAGIC = b"HTMG"
+VERSION = 1
+DEFAULT_CHUNK_BYTES = 1 << 20
+
+# per-chunk frame header: magic, version, chunk index, total chunks,
+# crc32 of this chunk's payload
+_CHUNK_HDR = struct.Struct("<4sIIII")
+# payload prefix: magic, version, JSON header length
+_PAYLOAD_HDR = struct.Struct("<4sII")
+
+
+class MigrationError(RuntimeError):
+    """A slot transfer failed validation (geometry, CRC, framing).  The
+    receiving side adopts NOTHING when this raises — partial adoption is
+    the one outcome the wire format must make impossible."""
+
+
+class MigrationTargetError(MigrationError):
+    """The DESTINATION refused or failed the adoption (drained, killed,
+    incompatible geometry).  A pool catches this specifically to retry
+    the migration against a different peer — source-side and wire-layer
+    failures raise plain exceptions, where retrying with another target
+    would be futile."""
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bf16 etc. live in ml_dtypes, registered via jax
+        import jax.numpy as jnp
+        return np.dtype(jnp.dtype(name))
+
+
+# ---------------------------------------------------------------------------
+# request records
+# ---------------------------------------------------------------------------
+
+def request_record(req, *, now: float | None = None) -> dict:
+    """The wire form of a mid-decode ``Request`` — everything a peer
+    scheduler needs to continue it.  Deadlines travel as elapsed seconds
+    since submission (``time.monotonic`` values are process-local)."""
+    now = time.monotonic() if now is None else now
+    return {
+        "rid": int(req.rid),
+        "prompt": [int(t) for t in req.prompt],
+        "tokens": [int(t) for t in req.tokens],
+        "folded": int(req.folded),
+        "max_tokens": int(req.max_tokens),
+        "eos_id": None if req.eos_id is None else int(req.eos_id),
+        "timeout_s": req.timeout_s,
+        "elapsed_s": 0.0 if req.submitted_at is None
+        else max(now - req.submitted_at, 0.0),
+        "requeues": int(req.requeues),
+        "had_first_token": req.first_token_at is not None,
+    }
+
+
+def request_from_record(rec: dict, *, now: float | None = None):
+    """Rebuild a ``Request`` from :func:`request_record` output on the
+    adopting side (cross-process migration; the in-process pool hands
+    the live objects over instead so waiters keep their events)."""
+    from hetu_tpu.serve.scheduler import Request
+    now = time.monotonic() if now is None else now
+    req = Request(
+        prompt=list(rec["prompt"]), max_tokens=int(rec["max_tokens"]),
+        eos_id=rec.get("eos_id"), timeout_s=rec.get("timeout_s"))
+    req.rid = int(rec["rid"])
+    req.tokens = list(rec["tokens"])
+    req.folded = int(rec.get("folded", 0))
+    req.requeues = int(rec.get("requeues", 0))
+    req.submitted_at = now - float(rec.get("elapsed_s", 0.0))
+    if rec.get("had_first_token"):
+        # the migrated request already observed TTFT at the source; the
+        # adopter must not re-observe it (exact value is source-local)
+        req.first_token_at = req.submitted_at
+    return req
+
+
+# ---------------------------------------------------------------------------
+# payload pack/unpack
+# ---------------------------------------------------------------------------
+
+def pack(spec, snapshots, records=()) -> bytes:
+    """Serialize slot snapshots (+ optional request records) into one
+    migration payload.  ``spec`` is the source cache's ``KVCacheSpec`` —
+    the receiver validates it against its own before touching a slot."""
+    dt = np.dtype(spec.dtype)
+    slots_meta = []
+    blobs = []
+    for s in snapshots:
+        kb = np.ascontiguousarray(s.k).tobytes()
+        vb = np.ascontiguousarray(s.v).tobytes()
+        slots_meta.append({"slot": int(s.slot), "length": int(s.length),
+                           "meta": dict(s.meta),
+                           "k_bytes": len(kb), "v_bytes": len(vb)})
+        blobs.append(kb)
+        blobs.append(vb)
+    body = b"".join(blobs)
+    header = {
+        "version": VERSION,
+        "spec": {"num_layers": int(spec.num_layers),
+                 "num_kv_heads": int(spec.num_kv_heads),
+                 "head_dim": int(spec.head_dim),
+                 "dtype": dt.name},
+        "slots": slots_meta,
+        "records": list(records),
+        "body_bytes": len(body),
+        "body_crc": zlib.crc32(body),
+    }
+    hb = json.dumps(header, separators=(",", ":")).encode()
+    return _PAYLOAD_HDR.pack(MAGIC, VERSION, len(hb)) + hb + body
+
+
+def unpack(payload: bytes):
+    """Parse a :func:`pack` payload back into ``(spec_dict, snapshots,
+    records)``.  Raises :class:`MigrationError` on any framing/CRC
+    problem — before any snapshot is built."""
+    if len(payload) < _PAYLOAD_HDR.size:
+        raise MigrationError("migration payload shorter than its header")
+    magic, ver, hlen = _PAYLOAD_HDR.unpack_from(payload)
+    if magic != MAGIC:
+        raise MigrationError(f"bad migration magic {magic!r}")
+    if ver != VERSION:
+        raise MigrationError(f"migration payload version {ver}; this "
+                             f"build speaks {VERSION}")
+    off = _PAYLOAD_HDR.size
+    if len(payload) < off + hlen:
+        raise MigrationError("truncated migration header")
+    try:
+        header = json.loads(payload[off:off + hlen])
+    except json.JSONDecodeError as e:
+        raise MigrationError(f"corrupt migration header: {e}") from None
+    body = payload[off + hlen:]
+    if len(body) != int(header["body_bytes"]):
+        raise MigrationError(
+            f"migration body is {len(body)} bytes; header promised "
+            f"{header['body_bytes']}")
+    if zlib.crc32(body) != int(header["body_crc"]):
+        raise MigrationError("migration body CRC mismatch — refusing to "
+                             "adopt any slot from a corrupt transfer")
+    spec_d = header["spec"]
+    dt = _np_dtype(spec_d["dtype"])
+    shape_tail = (int(spec_d["num_layers"]), -1,
+                  int(spec_d["num_kv_heads"]), int(spec_d["head_dim"]))
+    snaps = []
+    pos = 0
+    for m in header["slots"]:
+        kb, vb = int(m["k_bytes"]), int(m["v_bytes"])
+        if pos + kb + vb > len(body):
+            raise MigrationError("slot byte ranges overrun the body")
+        try:
+            k = np.frombuffer(body, dt, count=kb // dt.itemsize,
+                              offset=pos).reshape(shape_tail)
+            v = np.frombuffer(body, dt, count=vb // dt.itemsize,
+                              offset=pos + kb).reshape(shape_tail)
+        except ValueError as e:
+            raise MigrationError(
+                f"slot {m['slot']}: K/V bytes do not factor into the "
+                f"declared geometry ({e})") from None
+        pos += kb + vb
+        if k.shape[1] != int(m["length"]) or v.shape[1] != int(m["length"]):
+            raise MigrationError(
+                f"slot {m['slot']}: {k.shape[1]} rows of K/V for a "
+                f"declared length of {m['length']}")
+        snaps.append(KVSlotSnapshot(slot=int(m["slot"]),
+                                    length=int(m["length"]),
+                                    k=k, v=v, meta=dict(m.get("meta", {}))))
+    return spec_d, snaps, list(header.get("records", []))
+
+
+def check_spec(spec, spec_dict: dict) -> None:
+    """Receiver-side geometry gate: the adopting cache's spec must match
+    the payload's exactly (layers/kv-heads/head-dim/dtype) — erroring
+    loudly beats adopting garbage rows."""
+    mine = {"num_layers": int(spec.num_layers),
+            "num_kv_heads": int(spec.num_kv_heads),
+            "head_dim": int(spec.head_dim),
+            "dtype": np.dtype(spec.dtype).name}
+    theirs = {k: spec_dict.get(k) for k in mine}
+    if mine != theirs:
+        raise MigrationError(
+            f"KV cache geometry mismatch: payload {theirs} vs local "
+            f"{mine} — slots can only migrate between engines serving "
+            f"the same model geometry")
+
+
+# ---------------------------------------------------------------------------
+# chunked wire over a van blob channel
+# ---------------------------------------------------------------------------
+
+def send_payload(channel, payload: bytes, *, seq0: int = 1,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 timeout_s: float = 60.0, stop=None) -> int:
+    """Send ``payload`` over a van blob channel as CRC-framed chunks at
+    seqs ``[seq0, seq0+n)``; returns the next free seq.  Each frame is a
+    single-slot acked put, idempotent under same-seq resend — a
+    connection drop mid-transfer reconnects and resends the in-flight
+    chunk, never restarting the payload.
+
+    ``stop`` (a ``threading.Event``): cooperative abort, checked between
+    SHORT put slices instead of one ``timeout_s``-long ack wait.  A
+    receiver that died mid-stream never acks, and the caller cannot
+    safely close the channel under a blocked native put — without the
+    slicing, an aborted transfer wedges the sender (and whoever joins
+    it) for the whole ack window.  Raises :class:`MigrationError` when
+    set."""
+    chunk_bytes = max(int(chunk_bytes), 1)
+    n = max((len(payload) + chunk_bytes - 1) // chunk_bytes, 1)
+    slice_s = 0.5 if stop is not None else timeout_s
+    for i in range(n):
+        part = payload[i * chunk_bytes:(i + 1) * chunk_bytes]
+        frame = _CHUNK_HDR.pack(MAGIC, VERSION, i, n,
+                                zlib.crc32(part)) + part
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if stop is not None and stop.is_set():
+                raise MigrationError(
+                    f"send aborted at chunk {i}/{n}: receiver gone")
+            remaining = deadline - time.monotonic()
+            try:
+                channel.put(frame, seq0 + i,
+                            timeout_s=max(min(slice_s, remaining), 0.001))
+                break
+            except TimeoutError:
+                # ack window still blocked: same-seq resend is idempotent
+                if time.monotonic() >= deadline:
+                    raise
+    return seq0 + n
+
+
+def recv_payload(channel, *, seq0: int = 1,
+                 timeout_s: float = 60.0) -> bytes:
+    """Receive a :func:`send_payload` stream.  Validates each chunk's
+    framing and CRC as it lands and raises :class:`MigrationError` on
+    the first mismatch — the caller adopts nothing from a bad stream."""
+    parts = []
+    i, n = 0, 1
+    while i < n:
+        frame = channel.get(seq0 + i, timeout_s=timeout_s)
+        if len(frame) < _CHUNK_HDR.size:
+            raise MigrationError(f"chunk {i}: frame shorter than header")
+        magic, ver, idx, total, crc = _CHUNK_HDR.unpack_from(frame)
+        if magic != MAGIC or ver != VERSION:
+            raise MigrationError(f"chunk {i}: bad magic/version")
+        if idx != i or total < 1 or (i > 0 and total != n):
+            raise MigrationError(
+                f"chunk sequence corrupt: got idx {idx}/{total} at "
+                f"position {i}/{n}")
+        part = frame[_CHUNK_HDR.size:]
+        if zlib.crc32(part) != crc:
+            raise MigrationError(f"chunk {i} CRC mismatch")
+        n = total
+        parts.append(part)
+        i += 1
+    return b"".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+def migrate_inflight(src, dst, *, wire=None,
+                     chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                     timeout_s: float = 60.0) -> dict:
+    """Move EVERY in-flight request from scheduler ``src`` to scheduler
+    ``dst``: mid-decode requests carry their live KV slots (the peer
+    continues with zero prefill); queued ones re-queue on the peer with
+    their deadlines intact.  Returns ``{source_slot: dest_slot}``.
+
+    ``wire``: a ``(tx, rx)`` pair of van blob channels the K/V payload
+    crosses as CRC-checked chunks (the sender runs in a helper thread —
+    blob puts block on the single-slot ack window); ``None`` hands the
+    host arrays over directly (same-process fast path, identical
+    validation via the engines).
+
+    Failure atomicity: any error re-adopts the requests AND their slots
+    at the source (the slots were never released) and re-raises —
+    migration either completes or leaves the source serving.  On the
+    destination, KV import and request attachment happen atomically
+    under its scheduler lock (``adopt_inflight``), so a live peer keeps
+    serving its own traffic safely throughout.
+    """
+    # export + KV snapshot atomically under the source scheduler lock: a
+    # decode step sneaking in between would advance the exported slots
+    # past the requests' recorded tokens (a silently dropped token on
+    # the adopter)
+    pairs, snaps = src.export_inflight_with_slots()
+    slots = [slot for _, slot in pairs if slot is not None]
+    try:
+        if wire is not None and snaps:
+            spec = src.engine.cache.spec
+            payload = pack(spec, snaps)
+            tx, rx = wire
+            send_exc: list = []
+            send_stop = threading.Event()
+
+            def _send():
+                try:
+                    send_payload(tx, payload, chunk_bytes=chunk_bytes,
+                                 timeout_s=timeout_s, stop=send_stop)
+                except Exception as e:  # surfaced after the join
+                    send_exc.append(e)
+
+            t = threading.Thread(target=_send, daemon=True)
+            t.start()
+            try:
+                got = recv_payload(rx, timeout_s=timeout_s)
+            except BaseException:
+                # the receive failed mid-stream (corrupt chunk/timeout):
+                # the sender would sit out its WHOLE ack window against
+                # a peer that will never ack — signal it down instead.
+                # The rollback below must run promptly: the exported
+                # requests are off both schedulers, burning their
+                # serving deadlines while we wait
+                send_stop.set()
+                t.join(timeout_s)
+                raise
+            t.join(timeout_s)
+            if send_exc:
+                raise send_exc[0]
+            spec_d, snaps, _ = unpack(got)
+            check_spec(dst.engine.cache.spec, spec_d)
+        try:
+            slot_map, n_adopted = dst.adopt_inflight(
+                pairs, snapshots=snaps or None, return_count=True)
+        except Exception as e:
+            raise MigrationTargetError(
+                f"destination failed the adoption: {e}") from e
+    except Exception:
+        try:
+            src.adopt_inflight(pairs)  # source resumes serving, slots
+        except Exception:              # intact
+            # the source is gone too (closed/drained mid-transfer): the
+            # requests must still RESOLVE — nothing will ever serve them,
+            # and a waiter blocked on done would sit out its whole
+            # backstop timeout undiagnosed
+            from hetu_tpu.serve.scheduler import (
+                finish_request, release_slot_best_effort,
+            )
+            for req, _ in pairs:
+                if not req.done.is_set():
+                    finish_request(req, req.status or "error",
+                                   getattr(src, "metrics", None))
+            for slot in slots:
+                release_slot_best_effort(src.engine, slot)
+        raise  # the ORIGINAL failure, not the rollback's
+    # the migration has COMMITTED: the hand-off is now real, so charge
+    # the source's requests_exported (deferred from the export — a
+    # rolled-back export must not count) with what the destination
+    # ACTUALLY attached (requests that finished in transit were skipped
+    # there and never handed off).  Releasing the source's now-dead
+    # slots is best-effort (a source engine dying right here must not
+    # turn a successful hand-off into a raised error)
+    src.metrics.inc("requests_exported", n_adopted)
+    from hetu_tpu.serve.scheduler import release_slot_best_effort
+    for slot in slots:
+        release_slot_best_effort(src.engine, slot)
+    return slot_map

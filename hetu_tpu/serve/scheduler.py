@@ -28,9 +28,78 @@ from hetu_tpu.telemetry import trace
 _ids = itertools.count(1)
 
 
-@dataclass
+def finish_request(req: "Request", status: str, metrics=None) -> bool:
+    """Terminal-resolve a request — the ONE way a request reaches
+    ``done`` everywhere (scheduler ``_finish``, pool rejects/cancels,
+    migration double-failure): status, state, timestamp, the
+    ``requests_<status>`` / ``generated_tokens`` counters against
+    whatever metrics sink is in scope, then the waiter's event.
+
+    Guarded per-request: of racing finishers (a pool backstop cancel vs
+    the owning engine loop completing the same request) exactly ONE
+    wins — returns True to it — and the losers are no-ops, so a settled
+    status is never rewritten and terminal counters never double-charge.
+    """
+    with req._term_lock:
+        if req.done.is_set():
+            return False
+        req.status = status
+        req.state = "done"
+        req.finished_at = time.monotonic()
+        if metrics is not None:
+            metrics.inc(f"requests_{status}")
+            metrics.inc("generated_tokens", len(req.tokens))
+        req.done.set()
+        return True
+
+
+def cancel_detached(scheduler, req: "Request", status: str,
+                    metrics=None) -> None:
+    """Backstop cancel that can NEVER block on the scheduler lock:
+    resolve the waiter immediately (:func:`finish_request` needs only
+    the request's terminal lock), then run the owner-side cleanup
+    (dequeue + slot release via :meth:`ContinuousBatchingScheduler.
+    cancel`) in a detached daemon thread.  The backstop exists
+    precisely for a WEDGED member — engine stuck mid-step, scheduler
+    lock held indefinitely — and a plain ``scheduler.cancel`` would
+    hang the caller on exactly that lock.  A healthy owner completes
+    the detached cleanup promptly; a wedged one strands only the
+    daemon thread, and the slot is reclaimed anyway by the next
+    healthy step's deadline eviction."""
+    finish_request(req, status,
+                   metrics if metrics is not None else scheduler.metrics)
+
+    def _cleanup():
+        try:
+            scheduler.cancel(req, status)
+        except Exception:
+            pass  # cleanup is best-effort; the waiter is already resolved
+
+    threading.Thread(target=_cleanup, daemon=True).start()
+
+
+def release_slot_best_effort(engine, slot) -> None:
+    """Release a cache slot through the engine, falling back to the raw
+    cache when the engine is too broken to do it — else a dead engine's
+    slots stay allocated forever.  The ONE slot-freeing idiom shared by
+    the scheduler (under its lock) and migration commit/rollback."""
+    try:
+        engine.release(slot)
+    except Exception:
+        try:
+            engine.cache.free(slot)
+        except Exception:
+            pass  # restart replaces the whole engine+cache
+
+
+@dataclass(eq=False)
 class Request:
-    """One generation request and its lifecycle record."""
+    """One generation request and its lifecycle record.
+
+    ``eq=False``: requests compare (and hash) by IDENTITY — queue
+    membership scans (``owns``, adoption rollback) mean "this object",
+    and a field-wise ``__eq__`` would deep-compare full prompt/token
+    lists against every queued request on the serving path."""
 
     prompt: list
     max_tokens: int = 16
@@ -44,6 +113,13 @@ class Request:
     status: str = ""          # ok|timeout|cancelled|overflow|shutdown
     slot: Optional[int] = None
     requeues: int = 0         # engine-failover requeue count (bounded)
+    rejected: bool = False    # intake-closed reject: the pool re-routes
+    # scheduler currently holding this request (None in transit) — a
+    # pool cancels straight through it instead of scanning every
+    # member's lock; and the terminal-resolution guard (finish_request)
+    owner: object = field(default=None, repr=False)
+    _term_lock: threading.Lock = field(default_factory=threading.Lock,
+                                       repr=False)
     folded: int = 0           # tokens already folded into prompt on requeue
     submitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
@@ -78,18 +154,37 @@ class ContinuousBatchingScheduler:
         self._reject_status = "shutdown"  # status for post-drain submits
 
     # ---- request intake ----
-    def submit(self, request: Request) -> Request:
+    def submit(self, request: Request, *,
+               resolve_on_reject: bool = True) -> Request:
         request.submitted_at = time.monotonic()
         with self._lock:
             if not self._accepting:
-                # a drain already stopped intake and the engine loop is
-                # gone — complete immediately with that drain's status
-                # ('shutdown', or 'error' for a dead engine) so the
-                # submitting listener doesn't park on a request nothing
-                # will serve
-                self._finish(request, self._reject_status)
+                # a drain/stop_intake closed the front door — complete
+                # immediately with that drain's status ('shutdown', or
+                # 'error' for a dead engine) so the submitting listener
+                # doesn't park on a request nothing will serve.  Counted
+                # as a REJECT, not a requests_<status> completion: the
+                # request was never accepted (a pool re-routes it to a
+                # live peer), and charging requests_shutdown here would
+                # make the per-member terminal counters sum past the
+                # real request count on every drain/failover.  The
+                # `rejected` flag (set before `done`) is the pool's
+                # EXPLICIT re-route signal — inferring a reject from the
+                # terminal state would also match a genuinely accepted
+                # request that failed with zero tokens.
+                # ``resolve_on_reject=False`` (the pool's routing path)
+                # flags the reject WITHOUT touching done/status: the
+                # pool retries another member, and a waiter already
+                # parked on request.done must sleep through the re-route
+                # — a transient terminal state here would wake it into
+                # reading a half-routed request as an empty success
+                request.rejected = True
+                if resolve_on_reject:
+                    finish_request(request, self._reject_status, None)
+                self.metrics.inc("requests_rejected")
                 return request
             request.state = "queued"
+            request.owner = self
             self._queue.append(request)
             self.metrics.inc("requests_submitted")
             self.metrics.set_gauge("queue_depth", len(self._queue))
@@ -118,30 +213,22 @@ class ContinuousBatchingScheduler:
                     self._running.items(), reverse=True,
                     key=lambda kv: (kv[1].submitted_at or 0.0, kv[1].rid)):
                 del self._running[slot]
-                try:
-                    self.engine.release(slot)
-                except Exception:
-                    # engine too broken to release: free the cache slot
-                    # directly, else the next step() "succeeds" doing
-                    # nothing (queue full, zero free slots, zero running)
-                    # and the loop never accumulates to dead
-                    try:
-                        self.engine.cache.free(slot)
-                    except Exception:
-                        pass  # restart replaces the whole engine+cache
+                self._release_slot_locked(slot)
                 if self._requeue_locked(req, cap):
                     requeued += 1
             self.metrics.set_gauge("queue_depth", len(self._queue))
             return requeued
 
-    def _requeue_locked(self, req: Request, cap: int, *,
-                        tail: bool = False) -> bool:
-        """Fold emitted tokens into the prompt and put ``req`` back in the
-        queue (caller holds the lock) — at the head for engine-crash
-        failover (preserves admission order), at the ``tail`` for a
-        request whose own prefill failed (everyone else goes first).
-        Over-``cap`` requests finish with 'error' instead.  Returns True
-        if requeued."""
+    def _release_slot_locked(self, slot: int) -> None:
+        """:func:`release_slot_best_effort` against this engine (caller
+        holds the lock)."""
+        release_slot_best_effort(self.engine, slot)
+
+    def _fold_locked(self, req: Request, cap: int) -> bool:
+        """Fold emitted tokens into the prompt and charge one requeue
+        (caller holds the lock) — the re-prefill hand-off shared by
+        engine-crash requeue and pool failover.  Past ``cap`` the request
+        finishes 'error' and False is returned."""
         req.slot = None
         req.requeues += 1
         if req.requeues > cap:
@@ -151,12 +238,264 @@ class ContinuousBatchingScheduler:
         req.prompt = list(req.prompt) + list(fresh)
         req.folded += len(fresh)
         req.state = "queued"
+        return True
+
+    def _requeue_locked(self, req: Request, cap: int, *,
+                        tail: bool = False) -> bool:
+        """Fold emitted tokens into the prompt and put ``req`` back in the
+        queue (caller holds the lock) — at the head for engine-crash
+        failover (preserves admission order), at the ``tail`` for a
+        request whose own prefill failed (everyone else goes first).
+        Over-``cap`` requests finish with 'error' instead.  Returns True
+        if requeued."""
+        if not self._fold_locked(req, cap):
+            return False
         if tail:
             self._queue.append(req)
         else:
             self._queue.appendleft(req)
         self.metrics.inc("requests_requeued")
         return True
+
+    # ---- migration hand-off (serve/migrate.py + serve/pool.py) ----
+    def export_inflight(self, *, fold: bool = False) -> list:
+        """Atomically remove EVERY running and queued request and return
+        them as ``[(request, slot)]`` in admission order (queued requests
+        carry ``slot=None``) — the scheduler half of a live hand-off to a
+        peer (:meth:`adopt_inflight` on the receiving side).
+
+        ``fold=False`` (planned migration): running requests KEEP their
+        cache slots; the caller exports those slots' K/V
+        (``engine.export_slots``) and the peer continues decoding
+        token-for-token with zero re-prefill.  The slots stay allocated
+        on this engine until the caller releases them — a failed transfer
+        rolls back by re-adopting the same pairs here.
+
+        ``fold=True`` (unplanned failover: the KV state died with the
+        engine): emitted tokens fold into each running request's prompt,
+        the slot is freed, and a requeue is charged — over-``cap``
+        requests finish 'error' here, exactly like
+        :meth:`requeue_inflight` — so the peer re-prefills from
+        (prompt + tokens so far).
+
+        Intake stays open: the caller decides when/whether to stop it
+        (a pool stops routing first; a drain-to-exit closes the server
+        afterwards).  For the fold=False path prefer
+        :meth:`export_inflight_with_slots`, which also SNAPSHOTS the
+        slots under the same lock hold — between a bare export and a
+        later ``engine.export_slots`` call, a concurrent ``step()``
+        admitting new work would decode the still-active exported slots
+        and silently advance them past the requests' recorded tokens.
+        """
+        with self._lock:
+            pairs = self._export_locked(fold)
+            self.metrics.inc("requests_exported", len(pairs))
+            return pairs
+
+    def export_inflight_with_slots(self) -> tuple:
+        """:meth:`export_inflight` (fold=False) plus the exported slots'
+        KV snapshots (``engine.export_slots``), taken atomically under
+        the scheduler lock — no decode step can run between the requests
+        leaving ``_running`` and their K/V rows being captured, so the
+        snapshot and each request's token list always agree.  Returns
+        ``(pairs, snapshots)``."""
+        with self._lock:
+            pairs = self._export_locked(fold=False)
+            slots = [slot for _, slot in pairs if slot is not None]
+            try:
+                snaps = self.engine.export_slots(slots) if slots else []
+            except Exception:
+                # the engine died mid-export: put everything straight
+                # back (same lock hold) — the requests must never end up
+                # in neither the queue nor _running, or they strand with
+                # done never set while the failover path exports an
+                # empty scheduler
+                for req, slot in pairs:
+                    if req.done.is_set():
+                        # done-in-transit (a backstop cancel resolved it
+                        # under the request's terminal lock, which this
+                        # lock hold does not exclude): nothing re-attaches
+                        # the slot, so it must be released here or it
+                        # keeps decoding ownerless until max_len wedges
+                        # the engine — same rule as adopt_inflight's
+                        # done-in-transit branch
+                        if slot is not None:
+                            self._release_slot_locked(slot)
+                        continue
+                    req.owner = self
+                    if slot is None:
+                        req.state = "queued"
+                        self._queue.append(req)
+                    else:
+                        req.slot = slot
+                        req.state = "running"
+                        self._running[slot] = req
+                self.metrics.set_gauge("queue_depth", len(self._queue))
+                raise
+            # requests_exported is NOT charged here: a wire failure can
+            # still roll this export back (migrate_inflight re-adopts at
+            # the source), and the counter must only ever count hand-offs
+            # that committed — migrate_inflight charges it on commit
+            return pairs, snaps
+
+    def _export_locked(self, fold: bool) -> list:
+        out = []
+        for slot, req in sorted(
+                self._running.items(),
+                key=lambda kv: (kv[1].submitted_at or 0.0, kv[1].rid)):
+            del self._running[slot]
+            if fold:
+                self._release_slot_locked(slot)
+                if self._fold_locked(req, self.max_requeues):
+                    out.append((req, None))
+            else:
+                req.state = "migrating"
+                out.append((req, slot))
+        while self._queue:
+            out.append((self._queue.popleft(), None))
+        for req, _ in out:
+            req.owner = None  # in transit until a peer adopts (or we do)
+        self.metrics.set_gauge("queue_depth", 0)
+        # requests_exported is charged by the CALLERS once the export is
+        # final — export_inflight_with_slots can still roll this back
+        # when the engine dies under it, and a rolled-back export must
+        # not count (the counter would sum past real hand-offs)
+        return out
+
+    def adopt_inflight(self, pairs, snapshots=None, *,
+                       return_count: bool = False):
+        """Adopt requests exported from a peer (:meth:`export_inflight`).
+
+        ``pairs``: ``[(request, slot)]``; ``slot=None`` requests queue
+        (admitted through the normal prefill path, original submission
+        time and deadline preserved).  With ``snapshots`` (peer KV
+        exports), a pair's ``slot`` is the SOURCE slot id of its
+        snapshot — the KV rows import here and the request resumes
+        mid-decode, zero prefill.  Without snapshots, a non-None
+        ``slot`` is a slot THIS engine already owns — the
+        re-adopt-after-failed-transfer rollback path.
+
+        KV adoption (``engine.adopt_slots``) and request attachment
+        happen together UNDER THE SCHEDULER LOCK: this scheduler's live
+        engine loop holds the same lock for every ``step()``, so a
+        concurrent decode can neither swap the cache arrays out from
+        under the import (discarding the imported rows) nor advance an
+        adopted slot before its request is attached (losing a token).
+
+        Requests that finished in transit (a cancel/timeout race) are
+        skipped and their adopted slot released.  Returns the
+        ``{source_slot: local_slot}`` map (empty without snapshots);
+        with ``return_count=True`` returns ``(map, n_attached)`` —
+        counted under the same lock as the attachments, so callers
+        charging hand-off metrics see exactly what stuck (an outside
+        read of ``requests_adopted`` deltas would race concurrent
+        adoptions onto this scheduler).
+        """
+        pairs = list(pairs)
+        n = 0
+        with self._lock:
+            if not self._accepting:
+                raise RuntimeError(
+                    "scheduler is drained; cannot adopt migrated requests")
+            if snapshots:
+                slot_map = self.engine.adopt_slots(snapshots)
+            else:
+                slot_map = None
+                # local re-adoption: validate-first so attachment below
+                # cannot fail halfway (all-or-nothing)
+                want = [s for _, s in pairs if s is not None]
+                taken = [s for s in want
+                         if self._running.get(s) is not None]
+                if taken or len(set(want)) != len(want):
+                    raise RuntimeError(
+                        f"cannot re-adopt slots {taken or want}: already "
+                        f"running or duplicated")
+                if want:
+                    # the export SUSPENDED these slots on the engine so
+                    # in-window decode steps could not advance them.
+                    # Resume BEFORE attaching anything: resume can raise
+                    # (the source engine died mid-rollback) and the
+                    # attachment below must stay all-or-nothing — a
+                    # raise here leaves the scheduler empty, so the
+                    # caller's double-failure handler resolves requests
+                    # that are attached NOWHERE (done-in-transit slots
+                    # are resumed too, then released in the loop below)
+                    self.engine.resume_slots(want)
+            try:
+                for req, src_slot in pairs:
+                    if src_slot is None:
+                        slot = None
+                    elif slot_map is not None:
+                        slot = slot_map.get(src_slot)
+                        if slot is None:
+                            raise RuntimeError(
+                                f"no imported snapshot for source slot "
+                                f"{src_slot}")
+                    else:
+                        slot = src_slot
+                    if req.done.is_set():
+                        if slot is not None:
+                            self._release_slot_locked(slot)
+                            if slot_map is not None:
+                                del slot_map[src_slot]
+                        continue
+                    if slot is None:
+                        req.slot = None
+                        req.state = "queued"
+                        self._queue.append(req)
+                    else:
+                        req.slot = slot
+                        req.state = "running"
+                        self._running[slot] = req
+                    req.owner = self
+                    n += 1
+            except Exception:
+                # all-or-nothing for the imported case: free every
+                # imported slot and detach whatever was attached
+                if slot_map is not None:
+                    for slot in slot_map.values():
+                        if self._running.get(slot) is not None:
+                            del self._running[slot]
+                        self._release_slot_locked(slot)
+                    for req, _ in pairs:
+                        if req in self._queue:
+                            self._queue.remove(req)
+                raise
+            self.metrics.inc("requests_adopted", n)
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+        if return_count:
+            return slot_map or {}, n
+        return slot_map or {}
+
+    @property
+    def load(self) -> int:
+        """Queued + running request count (the pool's routing signal).
+
+        Deliberately LOCK-FREE (``len()`` is atomic under the GIL, and a
+        slightly stale count only nudges routing): the pool reads every
+        member's load on the routing path, and taking the scheduler lock
+        here would stall all routing behind any one member's in-flight
+        decode step — and deadlock failover DETECTION behind a wedged
+        one."""
+        return len(self._queue) + len(self._running)
+
+    @property
+    def running_count(self) -> int:
+        """Running-slot count, lock-free like :attr:`load` (the pool's
+        drain gates wire setup on it — a queued-only member has no K/V
+        to ship)."""
+        return len(self._running)
+
+    def owns(self, request: Request) -> bool:
+        """True while this scheduler holds ``request`` (queued or
+        running).  Takes the scheduler lock — latency-sensitive callers
+        (the pool's backstop cancel) follow ``request.owner`` into
+        :func:`cancel_detached` instead, which a wedged engine step
+        cannot block."""
+        with self._lock:
+            return request in self._queue or (
+                request.slot is not None and
+                self._running.get(request.slot) is request)
 
     def replace_engine(self, engine) -> None:
         """Swap in a (restarted) engine and reopen intake.  Any requests
@@ -169,18 +508,28 @@ class ContinuousBatchingScheduler:
         with self._lock:
             self.engine = engine
 
-    def cancel(self, request: Request) -> None:
-        """Abandon a request wherever it is (listener timeout path)."""
+    def cancel(self, request: Request, status: str = "cancelled") -> None:
+        """Abandon a request wherever it is, resolving it ``status``
+        (clients cancelling pass the default; a caller whose WAIT
+        expired passes 'timeout' — the dashboards must tell a
+        server-side timeout from a client's change of mind).
+
+        An ALREADY-resolved request still gets its queue/slot cleanup
+        (without touching the settled status): :func:`cancel_detached`
+        resolves the waiter first and hands this call the dequeue + slot
+        release afterwards."""
         with self._lock:
-            if request.done.is_set():
-                return
+            already = request.done.is_set()
             if request in self._queue:
                 self._queue.remove(request)
             if request.slot is not None and \
                     self._running.get(request.slot) is request:
                 del self._running[request.slot]
-                self.engine.release(request.slot)
-            self._finish(request, "cancelled")
+                # a dead engine must not abort the cancel: the caller's
+                # whole point is resolving the request
+                self._release_slot_locked(request.slot)
+            if not already:
+                self._finish(request, status)
 
     # ---- the continuous-batching step ----
     def step(self) -> list:
@@ -323,12 +672,7 @@ class ContinuousBatchingScheduler:
         return False
 
     def _finish(self, req: Request, status: str) -> None:
-        req.status = status
-        req.state = "done"
-        req.finished_at = time.monotonic()
-        self.metrics.inc(f"requests_{status}")
-        self.metrics.inc("generated_tokens", len(req.tokens))
-        req.done.set()
+        finish_request(req, status, self.metrics)
 
     # ---- convenience driver (tests / offline batch use) ----
     def run(self, requests, *, max_steps: int = 100_000) -> dict:
@@ -340,6 +684,21 @@ class ContinuousBatchingScheduler:
                 break
             self.step()
         return {r.rid: list(r.tokens) for r in requests}
+
+    def stop_intake(self, status: str = "shutdown") -> None:
+        """Stop accepting new submits (they finish immediately as
+        rejects with ``status``) WITHOUT touching queued/running work.
+
+        The pool closes a member's front door with this BEFORE exporting
+        its queue, so a submit that raced the routing decision can only
+        ever be rejected-and-rerouted — never admitted into a queue that
+        is about to be handed away (and then terminally drained by the
+        member's close).  ``drain(stop_accepting=True)`` is this plus
+        resolving everything in flight; ``replace_engine`` reopens
+        intake."""
+        with self._lock:
+            self._accepting = False
+            self._reject_status = status
 
     def drain(self, status: str = "shutdown", *,
               stop_accepting: bool = False) -> None:
@@ -354,6 +713,8 @@ class ContinuousBatchingScheduler:
             while self._queue:
                 self._finish(self._queue.popleft(), status)
             for slot, req in list(self._running.items()):
-                self.engine.release(slot)
+                # a dead engine must not abort the drain halfway — every
+                # running request still gets its terminal status
+                self._release_slot_locked(slot)
                 self._finish(req, status)
             self._running.clear()

@@ -27,7 +27,9 @@ import json
 import threading
 import time
 
-from hetu_tpu.serve.scheduler import ContinuousBatchingScheduler, Request
+from hetu_tpu.serve.scheduler import (
+    ContinuousBatchingScheduler, Request, cancel_detached,
+)
 
 # channel namespace: far above the table/mailbox ids the tests use
 SERVE_CHANNEL_BASE = 0x53525645  # 'SRVE'
@@ -135,6 +137,8 @@ class InferenceServer:
         intact.  Hold it for ``failover_grace_s`` awaiting restart_engine;
         expire into the fail-fast drain so clients are never wedged on a
         restart that will not come."""
+        if self._stop.is_set():
+            return  # closing: close() drains with 'shutdown' itself
         if self._failover_grace_s <= 0:
             self._expire_failover()
             return
@@ -150,11 +154,39 @@ class InferenceServer:
 
     def _expire_failover(self) -> None:
         import traceback
+        if self._stop.is_set():
+            # a close() raced the grace window: the scheduler already
+            # drained 'shutdown' — an expiry drain here would flip the
+            # reject status under the closed server (regression-tested
+            # in tests/test_serve_server.py)
+            return
         try:
             self.scheduler.drain("error", stop_accepting=True)
             self.metrics.inc("failover_expired")
         except Exception:
             traceback.print_exc()
+
+    def cancel_failover_grace(self, timeout_s: float = 5.0) -> None:
+        """Disarm a pending failover-grace timer without restarting.
+
+        The pool's unplanned-failover path calls this after it has taken
+        the dead member's queue — a later expiry drain would otherwise
+        finish already-migrated bookkeeping with 'error' and flip the
+        reject status under the new owner.  ``close()`` uses the same
+        path so a closed server can never have the grace thread fire
+        afterwards."""
+        self._restart_evt.set()
+        t = self._grace_thread
+        if t is not None:
+            try:
+                t.join(timeout_s)
+            except RuntimeError:
+                # armed-but-not-yet-started: _arm_failover_grace assigns
+                # the thread before start(), and a pool failover can land
+                # in that window.  The event above is the one the thread
+                # waits on, so it exits immediately once started — the
+                # disarm already happened; there is nothing to wait for
+                pass
 
     # ---- engine restart (request failover) ----
     def restart_engine(self, engine) -> None:
@@ -172,9 +204,7 @@ class InferenceServer:
             # with the CURRENT event (cancellable below) and is_alive()
             # below reads the settled state.
             self._loop.join(timeout=10.0)
-        self._restart_evt.set()           # cancel the pending grace timer
-        if self._grace_thread is not None:
-            self._grace_thread.join(timeout=5.0)
+        self.cancel_failover_grace()      # cancel the pending grace timer
         self._restart_evt = threading.Event()
         self.scheduler.replace_engine(engine)
         self.last_loop_error = None
@@ -193,6 +223,12 @@ class InferenceServer:
                                         response_channel(cid))
         seq = 1
         sent_seq = 0  # last response seq that reached the slot
+        # idempotent-resubmission dedup: the client protocol is one
+        # request in flight per channel pair, so remembering the LAST
+        # request id per listener is sufficient — a timed-out client
+        # that re-puts the same id gets the original request's result,
+        # never a second generation (or a second token-budget charge)
+        dedup: dict = {}
         try:
             while not self._stop.is_set():
                 try:
@@ -215,7 +251,7 @@ class InferenceServer:
                         continue
                 except RuntimeError:
                     break  # van stopped under us
-                resp = self._handle(raw)
+                resp = self._handle(raw, dedup)
                 payload = json.dumps(resp).encode()
                 for attempt in range(2):
                     try:
@@ -244,7 +280,7 @@ class InferenceServer:
             req_ch.close()
             resp_ch.close()
 
-    def _handle(self, raw: bytes) -> dict:
+    def _handle(self, raw: bytes, dedup: dict | None = None) -> dict:
         try:
             msg = json.loads(raw)
             if not msg["prompt"]:
@@ -259,23 +295,44 @@ class InferenceServer:
         except (KeyError, TypeError, ValueError) as e:
             return {"id": None, "status": "bad_request", "error": str(e),
                     "tokens": []}
-        self.scheduler.submit(req)
+        # dedup key includes the client's per-incarnation nonce: a
+        # RESTARTED client reusing id 1 with a new prompt must not be
+        # served the previous incarnation's answer.  A message WITHOUT a
+        # nonce is undedupable for the same reason — (None, 1) would
+        # collide across incarnations of a raw-JSON client.
+        rid = None if msg.get("id") is None or msg.get("cn") is None \
+            else (msg["cn"], msg["id"])
+        if dedup is not None and rid is not None \
+                and dedup.get("id") == rid:
+            # a retried submit of the in-flight (or just-finished)
+            # request: attach to the original instead of generating twice
+            req = dedup["req"]
+            self.metrics.inc("requests_deduped")
+        else:
+            self.scheduler.submit(req)
+            if dedup is not None:
+                dedup["id"], dedup["req"] = rid, req
         # event wait (not scheduler polling): the engine loop completes the
         # request and sets the event; the deadline here backstops a wedged
         # loop so the client always gets a response frame
         if not req.done.wait(timeout=req.timeout_s + self._poll_s + 5.0):
-            self.scheduler.cancel(req)
-            req.status = req.status or "timeout"
+            # resolve 'timeout', not 'cancelled' — unless the request
+            # finished in the race, in which case the finish guard keeps
+            # its real terminal status.  Detached: this deadline exists
+            # to backstop a WEDGED engine loop, which holds the
+            # scheduler lock across the stuck step — a plain
+            # scheduler.cancel would hang this handler on that lock and
+            # the client would never get its response frame
+            cancel_detached(self.scheduler, req, "timeout")
         return {"id": msg.get("id"), "status": req.status or "ok",
                 "tokens": list(req.tokens),
                 "ttft_s": req.ttft_s}
 
     # ---- lifecycle ----
     def close(self, timeout_s: float = 10.0) -> None:
-        self._stop.set()
-        self._restart_evt.set()  # a pending grace timer must not outlive us
-        if self._grace_thread is not None:
-            self._grace_thread.join(timeout_s)
+        self._stop.set()  # set BEFORE the cancel: _expire_failover checks it
+        self.cancel_failover_grace(timeout_s)  # a grace timer must not
+        # outlive us; bounded by the CALLER's close budget
         self.scheduler.drain("shutdown", stop_accepting=True)
         self._loop.join(timeout_s)
         for t in self._listeners:
@@ -299,29 +356,71 @@ class InferenceClient:
         self._resp = van.BlobChannel(host, port, response_channel(client_id),
                                      connect_timeout_s=connect_timeout_s)
         self._seq = 0
+        self._rid = 0  # request id: stable across retries of one generate
+        import os as _os
+        self._nonce = _os.urandom(4).hex()  # distinguishes incarnations
 
     def generate(self, prompt, *, max_tokens: int = 16, eos_id=None,
-                 timeout_s: float = 120.0, deadline_s=None) -> dict:
+                 timeout_s: float = 120.0, deadline_s=None,
+                 wire_retries: int = 1) -> dict:
         """prompt: token ids in → {'tokens': [...], 'status': ...} out.
 
-        ``timeout_s`` bounds the WIRE wait (put + blocking get);
-        ``deadline_s`` is the per-request serving deadline enforced by the
-        scheduler (queue wait + decode), defaulting to ``timeout_s``."""
-        self._seq += 1
-        msg = {"id": self._seq, "prompt": [int(t) for t in prompt],
+        ``timeout_s`` bounds the WIRE wait (put + blocking get) of each
+        attempt; ``deadline_s`` is the per-request serving deadline
+        enforced by the scheduler (queue wait + decode), defaulting to
+        ``timeout_s``.
+
+        Idempotent resubmission: a timed-out attempt retries (up to
+        ``wire_retries`` times) with the SAME request id — the server
+        dedups on id, so a retry after a slow ack attaches to the
+        original request instead of generating (and billing the token
+        budget) twice.  A timed-out put reuses its seq (the frame never
+        landed); a timed-out response re-puts at the next seq.
+        """
+        self._rid += 1
+        msg = {"id": self._rid, "cn": self._nonce,
+               "prompt": [int(t) for t in prompt],
                "max_tokens": int(max_tokens),
                "timeout_s": timeout_s if deadline_s is None
                else float(deadline_s)}
         if eos_id is not None:
             msg["eos_id"] = int(eos_id)
-        self._req.put(json.dumps(msg).encode(), self._seq,
-                      timeout_s=timeout_s)
+        payload = json.dumps(msg).encode()
+        last_exc: Exception = TimeoutError("generate: no attempts ran")
+        for _attempt in range(max(int(wire_retries), 0) + 1):
+            self._seq += 1
+            try:
+                self._req.put(payload, self._seq, timeout_s=timeout_s)
+            except TimeoutError as e:
+                # the frame never reached the slot (previous one unread):
+                # this seq is still ours — reuse it on the next attempt
+                self._seq -= 1
+                last_exc = e
+                continue
+            try:
+                return self._get_response(self._seq, timeout_s)
+            except TimeoutError as e:
+                last_exc = e
+                # grace drain before resubmitting: the response may land
+                # moments late — if so it IS our answer (ids are unique
+                # per client incarnation); otherwise the drain attempt
+                # leaves the slot for the listener's dedup response
+                try:
+                    resp = self._get_response(self._seq, 0.2)
+                    if resp.get("id") == self._rid:
+                        return resp
+                except (TimeoutError, RuntimeError):
+                    pass
+                # else: resubmit the same id at the next seq; the server
+                # dedups and answers there
+        raise last_exc
+
+    def _get_response(self, seq: int, timeout_s: float) -> dict:
         deadline = time.monotonic() + timeout_s
         while True:
             try:
                 return json.loads(self._resp.get(
-                    self._seq, timeout_s=max(deadline - time.monotonic(),
-                                             0.05)))
+                    seq, timeout_s=max(deadline - time.monotonic(), 0.05)))
             except RuntimeError as e:
                 # rc=-5: the slot still holds a PREVIOUS incarnation's
                 # response (this client restarted with a reused id); the

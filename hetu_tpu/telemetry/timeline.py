@@ -6,7 +6,7 @@ the recoveries MEASURABLE.  Every injected fault leaves an instant event
 ``fault.<kind>`` in the trace (args: step, kind, arg, schedule); every
 recovery mechanism leaves a span (``recovery.shard_repair``,
 ``recovery.retry``, ``recovery.nonfinite_skip``, ``elastic.reshard``,
-``supervisor.checkpoint``).  :func:`correlate` pairs them, and
+``supervisor.checkpoint``, ``serve.migrate``, ``serve.failover``).  :func:`correlate` pairs them, and
 :func:`recovery_histograms` folds the pairs into per-fault-kind
 detection/recovery latency histograms — a chaos run's output becomes a
 recovery SLO, not a pass/fail bit.
@@ -18,13 +18,22 @@ Latency definitions (per pair):
 * ``recover_s`` — fault injection → recovery span END (total time to
   repaired).
 
-Pairing is first-match by time: each fault claims the earliest matching
-recovery event (name in :data:`RECOVERY_FOR` for its kind) whose END is
-at-or-after the injection instant and which no earlier fault claimed —
-except that several faults may share ONE recovery event when no
+Pairing is time-first: each fault claims the earliest-ending unclaimed
+recovery carrying any of its :data:`RECOVERY_FOR` names whose END is
+at-or-after the injection instant (a ``suspend_shard`` answered by a
+quick ``recovery.retry`` must not steal an unrelated later
+``recovery.shard_repair``).  Kinds in :data:`PREFERENCE_ORDERED` are the
+exception — their name tuple is a strict preference, earlier names
+exhausted before later ones are considered (a ``serve_preempt`` prefers
+its ``serve.migrate`` drain even when an unrelated ``serve.failover``
+happened to end first, because the migrate IS the recovery the
+preemption directly invokes and the failover only its fallback).
+Either way several faults may share ONE recovery event when no
 unclaimed one exists (an elastic loss+join drained in the same step is
-repaired by one reshard).  Faults whose kind needs no recovery
-(``van_delay`` just sleeps) pair with nothing by design.
+repaired by one reshard), and a recovery attempt that itself FAILED
+(the tracer tags aborted spans ``args.error``) is never a candidate —
+it repaired nothing.  Faults whose kind needs no recovery (``van_delay``
+just sleeps) pair with nothing by design.
 """
 
 from __future__ import annotations
@@ -34,7 +43,10 @@ from typing import Optional
 
 FAULT_PREFIX = "fault."
 
-# fault kind -> recovery event names that close it, in preference order
+# fault kind -> recovery event names that can close it.  By default any
+# listed name is an equally valid recovery and the earliest-ending
+# candidate wins; kinds in PREFERENCE_ORDERED treat the tuple as strict
+# preference instead.
 RECOVERY_FOR = {
     "kill_shard": ("recovery.shard_repair",),
     "suspend_shard": ("recovery.shard_repair", "recovery.retry"),
@@ -45,7 +57,20 @@ RECOVERY_FOR = {
     "worker_loss": ("elastic.reshard",),
     "worker_join": ("elastic.reshard",),
     "van_delay": (),  # a delay needs no recovery — unpaired by design
+    # serving pool (serve/pool.py): a planned preemption is answered by
+    # the live-migration drain (or, if the member was too broken to
+    # export, the fold/re-prefill failover); an engine kill only ever by
+    # the failover
+    "serve_preempt": ("serve.migrate", "serve.failover"),
+    "serve_engine_kill": ("serve.failover",),
 }
+
+# kinds whose RECOVERY_FOR tuple is a strict preference order: the first
+# name is the recovery the fault DIRECTLY invokes, later names only
+# fallbacks.  For every other multi-name kind any listed name can be the
+# real recovery (a suspend_shard is repaired by whichever of
+# shard_repair/retry actually ran), so time decides, not the tuple.
+PREFERENCE_ORDERED = frozenset({"serve_preempt"})
 
 # fault kind -> args a candidate recovery event must carry.  A preempt
 # must claim the checkpoint the SIGTERM caused (reason="preempt"), not a
@@ -118,19 +143,36 @@ def correlate(events) -> list:
         need_attrs = RECOVERY_ATTRS.get(kind, {})
         best = None
         fallback = None  # already-claimed candidate (shared recovery)
-        for i, r in enumerate(recoveries):
-            if r.get("name") not in want or _end_ts(r) < ts:
-                continue
-            if need_attrs:
-                rargs = r.get("args") or {}
-                if any(rargs.get(k) != v for k, v in need_attrs.items()):
+        # recoveries are end-time sorted, so the first unclaimed hit in
+        # a group is the earliest-ending one; preference-ordered kinds
+        # scan singleton groups in tuple order, everyone else one group
+        # spanning all names (earliest end across names wins)
+        groups = [(n,) for n in want] \
+            if kind in PREFERENCE_ORDERED else ([want] if want else [])
+        for group in groups:
+            for i, r in enumerate(recoveries):
+                if r.get("name") not in group or _end_ts(r) < ts:
                     continue
-            if i in claimed:
-                if fallback is None:
-                    fallback = (i, r)
-                continue
-            best = (i, r)
-            break
+                rargs = r.get("args") or {}
+                if rargs.get("error"):
+                    # a recovery attempt that itself FAILED (the tracer
+                    # tags aborted spans args.error) repaired nothing —
+                    # pairing with it would report e.g. a rolled-back
+                    # migrate as the preemption's recovery and hide the
+                    # real failover (or the fault going unrecovered)
+                    continue
+                if need_attrs:
+                    if any(rargs.get(k) != v
+                           for k, v in need_attrs.items()):
+                        continue
+                if i in claimed:
+                    if fallback is None:
+                        fallback = (i, r)
+                    continue
+                best = (i, r)
+                break
+            if best is not None:
+                break
         if best is None and fallback is not None:
             # e.g. one reshard answering a same-step loss+join batch
             best = fallback

@@ -41,6 +41,8 @@ CASES = [
     ("ps_multiserver_embedding", [], "done"),
     ("mpmd_unequal_dp", ["--steps", "1"], "MPMD 3-stage"),
     ("gpt_serve", ["--requests", "4", "--max-tokens", "8"], "serve: OK"),
+    ("gpt_serve_pool", ["--requests", "6", "--max-tokens", "8"],
+     "serve pool: OK"),
     ("resilient_train", ["--steps", "30"], "resilient train: OK"),
     ("elastic_train", ["--steps", "24"], "elastic train: OK"),
 ]

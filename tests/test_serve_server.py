@@ -23,7 +23,7 @@ from hetu_tpu.models.gpt import GPTConfig, GPTModel
 from hetu_tpu.ps import van
 from hetu_tpu.serve import (
     ContinuousBatchingScheduler, InferenceClient, InferenceServer,
-    ServeEngine, request_channel, response_channel,
+    Request, ServeEngine, request_channel, response_channel,
 )
 
 
@@ -392,6 +392,124 @@ def test_poisoned_request_fails_alone_server_stays_healthy(gpt):
         good.close()
         bad.close()
         srv.close()
+
+
+def test_close_mid_grace_cannot_flip_state_after_shutdown():
+    """Regression (ISSUE 5 satellite): close() while the failover-grace
+    timer is armed must CANCEL it — a drained/closed server must never
+    have the grace thread fire later and 'error'-drain (flipping the
+    reject status) on the dead scheduler."""
+    sched = ContinuousBatchingScheduler(_BoomEngine())
+    srv = InferenceServer(sched, max_clients=0, poll_s=0.05,
+                          max_loop_errors=1, failover_grace_s=0.6)
+    try:
+        sched.submit(Request(prompt=[1, 2], max_tokens=4, timeout_s=30.0))
+        deadline = time.monotonic() + 30
+        while srv.healthy and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not srv.healthy  # engine dead, grace timer armed
+    finally:
+        srv.close()         # mid-grace
+    time.sleep(1.0)         # past the grace expiry
+    assert sched._reject_status == "shutdown"  # not flipped to 'error'
+    assert srv.metrics.count("failover_expired") == 0
+    late = sched.submit(Request(prompt=[3], max_tokens=2))
+    assert late.status == "shutdown"
+
+
+def test_cancel_grace_tolerates_armed_but_unstarted_thread():
+    """Regression: _arm_failover_grace assigns the grace thread BEFORE
+    start(), and a pool failover can call cancel_failover_grace inside
+    that window — join() on a not-yet-started thread raises
+    RuntimeError, which used to abort the whole failover with the dead
+    member's queue stranded.  The disarm (the event set) must still
+    happen and the cancel must not raise."""
+    import threading
+    sched = ContinuousBatchingScheduler(_BoomEngine())
+    srv = InferenceServer(sched, max_clients=0, poll_s=0.05,
+                          max_loop_errors=1, failover_grace_s=30.0)
+    try:
+        evt = srv._restart_evt
+        srv._grace_thread = threading.Thread(target=lambda: None,
+                                             daemon=True)
+        srv.cancel_failover_grace()  # must not raise
+        assert evt.is_set()          # the disarm still happened
+    finally:
+        srv.close()
+
+
+def test_close_before_loop_death_sync_expiry_guarded():
+    """The grace_s<=0 SYNC expiry path: a loop dying after close() began
+    must not 'error'-drain over the shutdown drain."""
+    sched = ContinuousBatchingScheduler(_BoomEngine())
+    srv = InferenceServer(sched, max_clients=0, poll_s=0.05,
+                          max_loop_errors=1, failover_grace_s=0.0)
+    srv._stop.set()  # close() has begun; the loop may still be striking
+    srv._arm_failover_grace()
+    assert srv.metrics.count("failover_expired") == 0
+    srv.close()
+
+
+def test_duplicate_submit_same_id_dedups(server):
+    """Idempotent resubmission (ISSUE 5 satellite): a client retrying a
+    timed-out submit with the same request id must NOT double-generate —
+    the server attaches the retry to the original request."""
+    srv, model, variables = server
+    ch_req = van.BlobChannel("127.0.0.1", srv.port, request_channel(2))
+    ch_resp = van.BlobChannel("127.0.0.1", srv.port, response_channel(2))
+    before = srv.metrics.count("requests_submitted")
+    try:
+        msg = json.dumps({"id": 7, "cn": "abc", "prompt": [1, 2, 3],
+                          "max_tokens": 5}).encode()
+        ch_req.put(msg, 1)
+        ch_req.put(msg, 2)  # the retry: same id+nonce, next seq
+        r1 = json.loads(ch_resp.get(1, timeout_s=60))
+        r2 = json.loads(ch_resp.get(2, timeout_s=60))
+        ref = _ref_greedy(model, variables, [1, 2, 3], 5)
+        assert r1["status"] == "ok" and r1["tokens"] == ref
+        assert r2["status"] == "ok" and r2["tokens"] == ref
+        # ONE generation, ONE token-budget charge
+        assert srv.metrics.count("requests_submitted") - before == 1
+        assert srv.metrics.count("requests_deduped") == 1
+        # a DIFFERENT id (or a restarted client's new nonce) is fresh
+        ch_req.put(json.dumps({"id": 7, "cn": "xyz", "prompt": [4, 5],
+                               "max_tokens": 3}).encode(), 3)
+        r3 = json.loads(ch_resp.get(3, timeout_s=60))
+        assert r3["tokens"] == _ref_greedy(model, variables, [4, 5], 3)
+        assert srv.metrics.count("requests_submitted") - before == 2
+    finally:
+        ch_req.close()
+        ch_resp.close()
+
+
+def test_client_retries_timed_out_response_without_regenerating(server):
+    """The client half: a response-wait timeout retries the SAME id at
+    the next seq; the server dedups and the client still gets exactly
+    the original answer."""
+    srv, model, variables = server
+    client = InferenceClient("127.0.0.1", srv.port, 1)
+    try:
+        calls = [0]
+        orig_get = client._resp.get
+
+        def flaky_get(seq, *, timeout_s=60.0):
+            calls[0] += 1
+            if calls[0] == 1:  # first wait "times out" on the wire
+                raise TimeoutError("injected response timeout")
+            return orig_get(seq, timeout_s=timeout_s)
+
+        client._resp.get = flaky_get
+        before = srv.metrics.count("requests_submitted")
+        resp = client.generate([6, 5, 4], max_tokens=4, timeout_s=30.0,
+                               wire_retries=2)
+        assert resp["status"] == "ok"
+        assert resp["tokens"] == _ref_greedy(model, variables,
+                                             [6, 5, 4], 4)
+        # exactly one generation, however the retry resolved (the grace
+        # drain may catch the late answer before a resubmit is needed)
+        assert srv.metrics.count("requests_submitted") - before == 1
+    finally:
+        client.close()
 
 
 def test_van_stats_reset_across_serve_incarnations():

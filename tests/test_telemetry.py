@@ -423,6 +423,63 @@ def test_timeline_synthetic_pairing_rules():
     assert reg.metrics()["recovery.van_delay.unpaired"].value == 1
 
 
+def test_timeline_suspend_takes_its_own_retry_not_a_later_repair():
+    """Multi-name kinds pair time-first: a suspend_shard answered by a
+    quick retry must NOT claim an unrelated later kill_shard's
+    shard_repair (which would skew both kinds' SLO histograms)."""
+    evs = [
+        {"ph": "i", "name": "fault.suspend_shard", "ts": 100.0, "seq": 0,
+         "args": {"kind": "suspend_shard", "step": 1}},
+        {"ph": "X", "name": "recovery.retry", "ts": 110.0, "dur": 10.0,
+         "seq": 1, "args": {}},
+        {"ph": "i", "name": "fault.kill_shard", "ts": 300.0, "seq": 2,
+         "args": {"kind": "kill_shard", "step": 3}},
+        {"ph": "X", "name": "recovery.shard_repair", "ts": 350.0,
+         "dur": 50.0, "seq": 3, "args": {}},
+    ]
+    by_kind = {p.kind: p for p in timeline.correlate(evs)}
+    assert by_kind["suspend_shard"].recovery_name == "recovery.retry"
+    assert by_kind["suspend_shard"].recovery_end_us == 120.0
+    assert by_kind["kill_shard"].recovery_name == "recovery.shard_repair"
+    assert by_kind["kill_shard"].recovery_end_us == 400.0
+
+
+def test_timeline_serve_preempt_prefers_migrate_over_earlier_failover():
+    """serve_preempt is PREFERENCE_ORDERED: its migrate drain wins even
+    when an unrelated failover (here answering an engine kill) ended
+    first — and the kill still gets that failover."""
+    evs = [
+        {"ph": "i", "name": "fault.serve_preempt", "ts": 100.0, "seq": 0,
+         "args": {"kind": "serve_preempt", "step": 1}},
+        {"ph": "i", "name": "fault.serve_engine_kill", "ts": 105.0,
+         "seq": 1, "args": {"kind": "serve_engine_kill", "step": 1}},
+        {"ph": "X", "name": "serve.failover", "ts": 110.0, "dur": 10.0,
+         "seq": 2, "args": {}},
+        {"ph": "X", "name": "serve.migrate", "ts": 150.0, "dur": 30.0,
+         "seq": 3, "args": {}},
+    ]
+    by_kind = {p.kind: p for p in timeline.correlate(evs)}
+    assert by_kind["serve_preempt"].recovery_name == "serve.migrate"
+    assert by_kind["serve_engine_kill"].recovery_name == "serve.failover"
+
+
+def test_timeline_failed_recovery_span_is_never_claimed():
+    """A serve.migrate span whose drain FAILED (tracer tags args.error)
+    repaired nothing: the preemption must pair with the real failover
+    that followed, not the rolled-back migrate."""
+    evs = [
+        {"ph": "i", "name": "fault.serve_preempt", "ts": 100.0, "seq": 0,
+         "args": {"kind": "serve_preempt", "step": 1}},
+        {"ph": "X", "name": "serve.migrate", "ts": 110.0, "dur": 10.0,
+         "seq": 1, "args": {"error": "RuntimeError"}},
+        {"ph": "X", "name": "serve.failover", "ts": 200.0, "dur": 20.0,
+         "seq": 2, "args": {}},
+    ]
+    (p,) = timeline.correlate(evs)
+    assert p.recovery_name == "serve.failover"
+    assert p.recovery_start_us == 200.0
+
+
 def test_timeline_preempt_claims_the_preempt_checkpoint():
     """A cadence checkpoint landing between the SIGTERM and the preempt
     checkpoint must NOT be claimed as the preempt's recovery — the
