@@ -9,5 +9,5 @@ from hetu_tpu.embedding_compress.layers import (
 )
 from hetu_tpu.embedding_compress.scheduler import CompressionScheduler
 from hetu_tpu.embedding_compress.recipes import (
-    AutoDimBiLevelTrainer, MultiStageFlow, OptEmbedFlow,
+    AutoDimBiLevelTrainer, MultiStageFlow, OptEmbedFlow, ServingRowCodec,
 )

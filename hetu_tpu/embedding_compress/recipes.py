@@ -249,3 +249,37 @@ class OptEmbedFlow:
         if cand is not None:
             out["state"]["field_dims"] = jnp.asarray(cand)
         return out
+
+
+class ServingRowCodec:
+    """Lossy per-row compression for the SERVING cache's eviction tier.
+
+    The training-time zoo above compresses the TABLE (hashing, TT, masks);
+    online serving needs something different — rows evicted from the hot
+    f32 tier of :class:`hetu_tpu.serve.recsys.ServingEmbeddingCache` kept
+    around cheaply WITH their PS versions, so a re-access within the
+    staleness bound decompresses locally instead of re-pulling the row
+    (and a degraded cache still has something stale to serve).  Same
+    trade as :class:`~hetu_tpu.embedding_compress.layers.QuantizedEmbedding`
+    rows: int8 + one f32 scale per row, 4x smaller, ~1e-2 relative error.
+
+    Stateless + vectorized: ``compress``/``decompress`` take/return
+    ``[n, dim]`` f32 batches (the cache evicts and promotes per batch).
+    """
+
+    bytes_per_value = 1
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def compress(self, rows: np.ndarray) -> tuple:
+        rows = np.ascontiguousarray(rows, np.float32).reshape(-1, self.dim)
+        scale = np.abs(rows).max(axis=1) / 127.0
+        q = np.where(scale[:, None] > 0.0,
+                     np.round(rows / np.maximum(scale, 1e-30)[:, None]),
+                     0.0).astype(np.int8)
+        return q, scale.astype(np.float32)
+
+    def decompress(self, blob: tuple) -> np.ndarray:
+        q, scale = blob
+        return q.astype(np.float32) * scale[:, None]

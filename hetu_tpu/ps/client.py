@@ -115,6 +115,34 @@ class PSTable:
         _check(lib.ps_sparse_set(self.id, _i64p(idx), _f32p(v),
                                  idx.shape[0]), "sparse_set")
 
+    def sync_pull(self, indices, cached_versions, bound: int = 0):
+        """Version-bounded sync (HET kSyncEmbedding, in-process): returns
+        ``(positions, versions, rows)`` for the requested rows whose server
+        version exceeds ``cached_versions + bound`` (or regressed — the
+        cross-incarnation safety net).  ``np.uint64(-1)`` = "not cached,
+        always send".  Same contract as
+        ``van.PartitionedPSTable.sync_pull``, so a bounded-staleness cache
+        (``serve.recsys.ServingEmbeddingCache``) runs unchanged over the
+        local and remote tiers.  Versions are OPAQUE monotonic counters."""
+        idx = _as_idx(indices)
+        vers = np.ascontiguousarray(cached_versions, np.uint64).reshape(-1)
+        if vers.shape[0] != idx.shape[0]:
+            raise ValueError("cached_versions must match indices length")
+        n = idx.shape[0]
+        sel = np.empty(n, np.uint32)
+        vout = np.empty(n, np.uint64)
+        rout = np.empty((n, self.dim), np.float32)
+        m = lib.ps_sync_pull(
+            self.id, _i64p(idx),
+            vers.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n, bound,
+            sel.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            vout.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            _f32p(rout))
+        if m < 0:
+            raise RuntimeError(f"hetu_ps sync_pull failed rc={m}")
+        m = int(m)
+        return sel[:m].copy(), vout[:m].copy(), rout[:m].copy()
+
     def clear(self) -> None:
         """Zero the table (reference ParamClear); bumps versions so caches
         re-pull."""
@@ -163,9 +191,55 @@ class PSTable:
 _POLICIES = {"lru": 0, "lfu": 1, "lfuopt": 2}
 
 
+_cache_stat_metrics = None  # resolved once: this runs per training pull
+
+
+def export_cache_stats(lookups_delta: int, misses_delta: int,
+                       total_lookups: int, total_misses: int,
+                       size: int) -> None:
+    """Fold one cache lookup's accounting into
+    ``telemetry.default_registry`` — ``ps.cache.*`` counters/gauges next
+    to the existing ``van.*`` wire metrics, so a Prometheus scrape sees
+    the HET tier's hit rate without reaching into cache objects.  Shared
+    by ``CacheSparseTable``, ``van.RemoteCacheTable`` and the serving
+    cache (``serve.recsys``).  The metric objects resolve ONCE — this is
+    on the training pull hot path, where four by-name registry lookups
+    per batch would be real overhead."""
+    global _cache_stat_metrics
+    if _cache_stat_metrics is None:
+        from hetu_tpu.telemetry import default_registry as reg
+        _cache_stat_metrics = (
+            reg.counter("ps.cache.lookups",
+                        help="HET-cache rows looked up"),
+            reg.counter("ps.cache.misses",
+                        help="HET-cache rows missed/re-pulled"),
+            reg.gauge("ps.cache.hit_rate",
+                      help="lifetime hit rate of the last-updated cache"),
+            reg.gauge("ps.cache.size",
+                      help="entries held by the last-updated cache"))
+    lookups, misses, hit_rate, sz = _cache_stat_metrics
+    lookups.inc(lookups_delta)
+    misses.inc(misses_delta)
+    hit_rate.set(1.0 - total_misses / max(total_lookups, 1))
+    sz.set(size)
+
+
 class CacheSparseTable:
     """Worker-side versioned embedding cache over a PSTable (HET tier;
-    reference python/hetu/cstable.py:19 + src/hetu_cache)."""
+    reference python/hetu/cstable.py:19 + src/hetu_cache).
+
+    This is the TRAINING tier (read-write: lookups pull, updates
+    accumulate + optimistically apply locally).  The read-mostly SERVING
+    sibling — same bounded-staleness versions, plus negative-row policy,
+    compressed eviction and degraded-stale serving — is
+    :class:`hetu_tpu.serve.recsys.ServingEmbeddingCache`.
+
+    Thread safety: the native lookup/update hold the cache's own mutex;
+    the Python-side ``misses``/``lookups`` accounting takes ``_stats_lock``
+    (concurrent serving threads share one cache — unlocked ``+=`` would
+    drop counts).  Every lookup also exports ``ps.cache.*`` into
+    ``telemetry.default_registry`` (:func:`export_cache_stats`).
+    """
 
     def __init__(self, table: PSTable, capacity: int,
                  policy: str = "lfuopt", *, pull_bound: int = 0):
@@ -175,6 +249,7 @@ class CacheSparseTable:
         self.id = next(_cache_ids)
         _check(lib.ps_cache_create(self.id, table.id, capacity,
                                    _POLICIES[policy]), "cache_create")
+        self._stats_lock = threading.Lock()
         self.misses = 0
         self.lookups = 0
 
@@ -186,8 +261,12 @@ class CacheSparseTable:
                                 self.pull_bound, _f32p(out))
         if m < 0:
             raise RuntimeError(f"hetu_ps cache_lookup failed with rc={m}")
-        self.misses += int(m)
-        self.lookups += flat.shape[0]
+        with self._stats_lock:
+            self.misses += int(m)
+            self.lookups += flat.shape[0]
+            misses, lookups = self.misses, self.lookups
+        export_cache_stats(flat.shape[0], int(m), lookups, misses,
+                           self.size)
         return out.reshape(*idx.shape, self.dim)
 
     def embedding_update(self, indices, grads) -> None:
@@ -206,7 +285,16 @@ class CacheSparseTable:
 
     @property
     def hit_rate(self) -> float:
-        return 1.0 - self.misses / max(self.lookups, 1)
+        with self._stats_lock:
+            return 1.0 - self.misses / max(self.lookups, 1)
+
+    def reset_stats(self) -> None:
+        """Zero the Python-side hit accounting (e.g. after a checkpoint
+        load bumped every version — the old ratios describe a dead
+        epoch).  The native entries are untouched."""
+        with self._stats_lock:
+            self.misses = 0
+            self.lookups = 0
 
 
 class SSPController:

@@ -25,6 +25,13 @@ from hetu_tpu.ps.client import CacheSparseTable, PSTable
 class PSEmbedding:
     """num_embeddings x dim table on the PS, with optional HET cache tier.
 
+    This is the TRAINING-side embedding front-end (pull → step → push);
+    the ONLINE-SERVING counterpart over the same PS tables — read-mostly,
+    bounded-staleness, degrade-capable — is
+    :class:`hetu_tpu.serve.recsys.ServingEmbeddingCache`, and a trainer
+    using this class can serve concurrently from the same ``table``
+    (the serving cache observes every ``push`` within its ``pull_bound``).
+
     Tiers (same pull/push/prefetch surface for all three):
       * default — in-process C++ table (single TPU-VM host);
       * ``endpoints=`` — the table key-range-partitioned over remote van
@@ -191,7 +198,6 @@ class PSEmbedding:
     def load(self, path) -> None:
         self.table.load(path)
         # server bumped row versions on load, so bounded-staleness lookups
-        # re-pull; drop pending local updates that predate the checkpoint
+        # re-pull; the old hit ratios describe a dead epoch
         if self.cache is not None:
-            self.cache.misses = 0
-            self.cache.lookups = 0
+            self.cache.reset_stats()

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 import time
 from typing import Optional
 
@@ -580,7 +581,13 @@ class RemoteCacheTable:
 
     Same surface as the in-process ``CacheSparseTable`` so models swap
     between the local and remote tiers freely; here misses/outdated rows
-    cross the wire in one fused push+sync round trip per shard.
+    cross the wire in one fused push+sync round trip per shard.  The
+    read-mostly serving sibling over either tier is
+    ``serve.recsys.ServingEmbeddingCache``.
+
+    Thread safety matches ``CacheSparseTable``: native ops hold their own
+    mutex, the hit accounting holds ``_stats_lock``, and every lookup
+    exports ``ps.cache.*`` into ``telemetry.default_registry``.
     """
 
     def __init__(self, table: PartitionedPSTable, capacity: int,
@@ -594,10 +601,12 @@ class RemoteCacheTable:
         if cid <= 0:
             raise RuntimeError(f"hetu_ps rcache_create failed rc={cid}")
         self.id = cid
+        self._stats_lock = threading.Lock()
         self.misses = 0
         self.lookups = 0
 
     def embedding_lookup(self, indices) -> np.ndarray:
+        from hetu_tpu.ps.client import export_cache_stats
         idx = np.ascontiguousarray(indices, np.int64)
         flat = idx.reshape(-1)
         out = np.empty((flat.shape[0], self.dim), np.float32)
@@ -605,8 +614,12 @@ class RemoteCacheTable:
                                  self.pull_bound, _f32p(out))
         if m < 0:
             raise RuntimeError(f"hetu_ps rcache_lookup failed rc={m}")
-        self.misses += int(m)
-        self.lookups += flat.shape[0]
+        with self._stats_lock:
+            self.misses += int(m)
+            self.lookups += flat.shape[0]
+            misses, lookups = self.misses, self.lookups
+        export_cache_stats(flat.shape[0], int(m), lookups, misses,
+                           self.size)
         return out.reshape(*idx.shape, self.dim)
 
     def embedding_update(self, indices, grads) -> None:
@@ -624,7 +637,13 @@ class RemoteCacheTable:
 
     @property
     def hit_rate(self) -> float:
-        return 1.0 - self.misses / max(self.lookups, 1)
+        with self._stats_lock:
+            return 1.0 - self.misses / max(self.lookups, 1)
+
+    def reset_stats(self) -> None:
+        with self._stats_lock:
+            self.misses = 0
+            self.lookups = 0
 
     def close(self) -> None:
         if getattr(self, "id", 0) > 0:

@@ -19,10 +19,14 @@ of the training-only models:
   * :mod:`migrate` — live KV-cache slot migration: chunked CRC-checked
     slot transfer over the van, scheduler hand-off with zero re-prefill;
   * :mod:`pool` — :class:`ServingPool`: health-routed routing over N
-    members, planned drain (migrate-then-exit) and unplanned failover.
+    members, planned drain (migrate-then-exit) and unplanned failover;
+  * :mod:`recsys` — the SECOND serving workload: online CTR inference
+    (WideDeep/DeepFM/DCN) behind the same van front-end and pool
+    machinery, with a staleness-bounded hot-embedding serving cache
+    over the PS (HET) and a micro-batching scheduler.
 
-See examples/gpt_serve.py and examples/gpt_serve_pool.py for the
-end-to-end paths.
+See examples/gpt_serve.py, examples/gpt_serve_pool.py and
+examples/ctr_serve.py for the end-to-end paths.
 """
 
 from hetu_tpu.serve.engine import ServeEngine
@@ -31,6 +35,10 @@ from hetu_tpu.serve.metrics import ServeMetrics
 from hetu_tpu.serve.migrate import MigrationError
 from hetu_tpu.serve.pool import ServingPool
 from hetu_tpu.serve.scheduler import ContinuousBatchingScheduler, Request
+from hetu_tpu.serve.recsys import (
+    RecsysBatcher, RecsysClient, RecsysEngine, RecsysPool, RecsysRequest,
+    RecsysServer, ServingEmbeddingCache,
+)
 from hetu_tpu.serve.server import (
     InferenceClient, InferenceServer, request_channel, response_channel,
 )
@@ -41,4 +49,6 @@ __all__ = [
     "ContinuousBatchingScheduler", "Request",
     "InferenceClient", "InferenceServer",
     "request_channel", "response_channel",
+    "ServingEmbeddingCache", "RecsysEngine", "RecsysBatcher",
+    "RecsysRequest", "RecsysServer", "RecsysClient", "RecsysPool",
 ]

@@ -280,21 +280,39 @@ class InferenceServer:
             req_ch.close()
             resp_ch.close()
 
+    # ---- wire-format hooks (overridden by e.g. recsys.RecsysServer) ----
+    def _build_request(self, msg: dict) -> Request:
+        """Parse one request message into a scheduler Request.  Raise
+        KeyError/TypeError/ValueError for a malformed message — the
+        listener answers 'bad_request' without touching the scheduler.
+        Subclasses serving a different workload (the CTR front-end)
+        override this and :meth:`_build_response`; the listener/dedup/
+        engine-loop machinery is shared."""
+        if not msg["prompt"]:
+            raise ValueError("empty prompt")
+        return Request(
+            prompt=[int(t) for t in msg["prompt"]],
+            max_tokens=int(msg.get("max_tokens", 16)),
+            eos_id=msg.get("eos_id"),
+            timeout_s=min(float(msg.get("timeout_s",
+                                        self.request_timeout_s)),
+                          self.request_timeout_s))
+
+    def _build_response(self, msg: dict, req: Request) -> dict:
+        return {"id": msg.get("id"), "status": req.status or "ok",
+                "tokens": list(req.tokens),
+                "ttft_s": req.ttft_s}
+
+    def _bad_request(self, err: Exception) -> dict:
+        return {"id": None, "status": "bad_request", "error": str(err),
+                "tokens": []}
+
     def _handle(self, raw: bytes, dedup: dict | None = None) -> dict:
         try:
             msg = json.loads(raw)
-            if not msg["prompt"]:
-                raise ValueError("empty prompt")
-            req = Request(
-                prompt=[int(t) for t in msg["prompt"]],
-                max_tokens=int(msg.get("max_tokens", 16)),
-                eos_id=msg.get("eos_id"),
-                timeout_s=min(float(msg.get("timeout_s",
-                                            self.request_timeout_s)),
-                              self.request_timeout_s))
+            req = self._build_request(msg)
         except (KeyError, TypeError, ValueError) as e:
-            return {"id": None, "status": "bad_request", "error": str(e),
-                    "tokens": []}
+            return self._bad_request(e)
         # dedup key includes the client's per-incarnation nonce: a
         # RESTARTED client reusing id 1 with a new prompt must not be
         # served the previous incarnation's answer.  A message WITHOUT a
@@ -324,9 +342,7 @@ class InferenceServer:
             # scheduler.cancel would hang this handler on that lock and
             # the client would never get its response frame
             cancel_detached(self.scheduler, req, "timeout")
-        return {"id": msg.get("id"), "status": req.status or "ok",
-                "tokens": list(req.tokens),
-                "ttft_s": req.ttft_s}
+        return self._build_response(msg, req)
 
     # ---- lifecycle ----
     def close(self, timeout_s: float = 10.0) -> None:
@@ -385,6 +401,13 @@ class InferenceClient:
                else float(deadline_s)}
         if eos_id is not None:
             msg["eos_id"] = int(eos_id)
+        return self._roundtrip(msg, timeout_s, wire_retries)
+
+    def _roundtrip(self, msg: dict, timeout_s: float,
+                   wire_retries: int = 1) -> dict:
+        """One idempotent request/response exchange for an already-built,
+        already-id-stamped message (the retry/dedup dance shared with the
+        CTR client in serve/recsys.py)."""
         payload = json.dumps(msg).encode()
         last_exc: Exception = TimeoutError("generate: no attempts ran")
         for _attempt in range(max(int(wire_retries), 0) + 1):
@@ -407,7 +430,7 @@ class InferenceClient:
                 # leaves the slot for the listener's dedup response
                 try:
                     resp = self._get_response(self._seq, 0.2)
-                    if resp.get("id") == self._rid:
+                    if resp.get("id") == msg["id"]:
                         return resp
                 except (TimeoutError, RuntimeError):
                     pass

@@ -48,8 +48,13 @@ FAULT_PREFIX = "fault."
 # candidate wins; kinds in PREFERENCE_ORDERED treat the tuple as strict
 # preference instead.
 RECOVERY_FOR = {
-    "kill_shard": ("recovery.shard_repair",),
-    "suspend_shard": ("recovery.shard_repair", "recovery.retry"),
+    # a killed PS shard is answered by the guard's repair (training) or,
+    # on the online-serving side, by the serving cache's degraded-stale
+    # window (serve/recsys.py: serve stale from cache until pulls succeed
+    # again) — whichever actually ran ends the outage, so time decides
+    "kill_shard": ("recovery.shard_repair", "serve.recsys_degrade"),
+    "suspend_shard": ("recovery.shard_repair", "recovery.retry",
+                      "serve.recsys_degrade"),
     "van_error": ("recovery.retry",),
     "data_error": ("recovery.retry",),
     "nan_grad": ("recovery.nonfinite_skip",),

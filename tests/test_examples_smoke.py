@@ -43,6 +43,7 @@ CASES = [
     ("gpt_serve", ["--requests", "4", "--max-tokens", "8"], "serve: OK"),
     ("gpt_serve_pool", ["--requests", "6", "--max-tokens", "8"],
      "serve pool: OK"),
+    ("ctr_serve", ["--steps", "40", "--requests", "16"], "ctr serve: OK"),
     ("resilient_train", ["--steps", "30"], "resilient train: OK"),
     ("elastic_train", ["--steps", "24"], "elastic train: OK"),
 ]

@@ -1,0 +1,705 @@
+"""Online CTR recommendation serving (serve/recsys.py): staleness-bound
+semantics of the serving cache, bitwise parity at ``pull_bound=0``,
+bounded staleness under a CONCURRENT trainer, micro-batching, the van
+wire, pool failover, and the shard-kill degrade span — ISSUE 6.
+
+Fast lane: in-process PSTable tier.  The PS-backed multi-process chaos
+run (real van shard servers SIGKILLed under live serving traffic) is
+slow+chaos.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import available
+
+if not available():  # pragma: no cover
+    pytest.skip("native PS lib unavailable", allow_module_level=True)
+
+import jax
+
+from hetu_tpu.embedding_compress import ServingRowCodec
+from hetu_tpu.models.ctr_zoo import DeepFM
+from hetu_tpu.models.wdl import WideDeep
+from hetu_tpu.ps.client import CacheSparseTable, PSTable
+from hetu_tpu.serve.recsys import (
+    RecsysBatcher, RecsysClient, RecsysEngine, RecsysPool, RecsysRequest,
+    RecsysServer, ServingEmbeddingCache,
+)
+from hetu_tpu.telemetry import timeline, trace
+from hetu_tpu.telemetry.registry import MetricsRegistry
+
+pytestmark = pytest.mark.recsys
+
+
+def _table(rows=64, dim=4, **kw):
+    kw.setdefault("init", "zeros")
+    kw.setdefault("optimizer", "sgd")
+    kw.setdefault("lr", 1.0)
+    return PSTable(rows, dim, **kw)
+
+
+# ---------------------------------------------------------------------------
+# staleness-bound semantics
+# ---------------------------------------------------------------------------
+
+def test_pull_bound_zero_sees_every_push():
+    t = _table()
+    c = ServingEmbeddingCache(t, capacity=16, pull_bound=0,
+                              registry=MetricsRegistry())
+    np.testing.assert_array_equal(c.lookup([3])[0], np.zeros(4))
+    t.sparse_push([3], np.ones((1, 4), np.float32))  # sgd lr=1: row -> -1
+    np.testing.assert_array_equal(c.lookup([3])[0], -np.ones(4))
+    t.sparse_set([3], np.full((1, 4), 7.0, np.float32))
+    np.testing.assert_array_equal(c.lookup([3])[0], np.full(4, 7.0))
+
+
+def test_pull_bound_k_serves_stale_within_k_and_refreshes_past_k():
+    t = _table()
+    c = ServingEmbeddingCache(t, capacity=16, pull_bound=2,
+                              registry=MetricsRegistry())
+    c.lookup([5])  # cached at v0 (zeros)
+    for i in range(2):
+        t.sparse_set([5], np.full((1, 4), float(i + 1), np.float32))
+        # lag i+1 <= bound: the cached (stale) copy is still served
+        np.testing.assert_array_equal(c.lookup([5])[0], np.zeros(4))
+    t.sparse_set([5], np.full((1, 4), 3.0, np.float32))
+    # lag 3 > bound 2: refreshed to the CURRENT row (not an intermediate)
+    np.testing.assert_array_equal(c.lookup([5])[0], np.full(4, 3.0))
+    st = c.stats()
+    assert st["stale_refreshes"] == 1
+    assert st["staleness"]["max"] == 3.0  # the observed version lag
+
+
+def test_clear_version_bump_invalidates_cached_rows():
+    """`PSTable.clear()` bumps every row version — a bound-0 cache must
+    re-pull (and see the zeroed table), never serve the dead copy."""
+    t = _table()
+    t.sparse_set([2], np.full((1, 4), 9.0, np.float32))
+    c = ServingEmbeddingCache(t, capacity=16, pull_bound=0,
+                              registry=MetricsRegistry())
+    np.testing.assert_array_equal(c.lookup([2])[0], np.full(4, 9.0))
+    t.clear()
+    np.testing.assert_array_equal(c.lookup([2])[0], np.zeros(4))
+    # bound=1 tolerates exactly the one clear-bump: the stale copy is
+    # within contract (bounded staleness, not TTL)
+    t2 = _table()
+    t2.sparse_set([2], np.full((1, 4), 9.0, np.float32))
+    c2 = ServingEmbeddingCache(t2, capacity=16, pull_bound=1,
+                               registry=MetricsRegistry())
+    c2.lookup([2])
+    t2.clear()
+    np.testing.assert_array_equal(c2.lookup([2])[0], np.full(4, 9.0))
+    t2.clear()  # second bump exceeds the bound
+    np.testing.assert_array_equal(c2.lookup([2])[0], np.zeros(4))
+
+
+def test_concurrent_trainer_staleness_within_bound():
+    """The freshness contract under a LIVE writer: rows encode their
+    version (row r == v after the v-th set), a trainer thread keeps
+    setting, serving threads keep looking up — every served row must be
+    at most ``pull_bound`` versions behind the sets already completed
+    when its lookup started."""
+    t = _table(rows=4, dim=4)
+    published = [0]
+    stop = threading.Event()
+
+    def trainer():
+        v = 0
+        while not stop.is_set():
+            v += 1
+            t.sparse_set([1], np.full((1, 4), float(v), np.float32))
+            published[0] = v  # AFTER the set: a reader seeing c0 knows
+            # at least c0 sets (and version bumps) completed
+
+    for bound in (0, 3):
+        c = ServingEmbeddingCache(t, capacity=8, pull_bound=bound,
+                                  registry=MetricsRegistry())
+        published[0] = 0
+        stop.clear()
+        th = threading.Thread(target=trainer, daemon=True)
+        th.start()
+        worst = 0
+        try:
+            deadline = time.monotonic() + 1.5
+            while time.monotonic() < deadline:
+                c0 = published[0]
+                row = c.lookup([1])[0]
+                v_read = int(row[0])
+                assert np.all(row == row[0])  # a torn row would mix versions
+                lag = c0 - v_read
+                worst = max(worst, lag)
+                assert lag <= bound, (bound, c0, v_read)
+        finally:
+            stop.set()
+            th.join(5)
+        assert published[0] > 10  # the trainer actually raced us
+
+
+def test_pull_bound_zero_bitwise_parity_with_cacheless():
+    """Acceptance: cached serving at bound 0 == cache-less PS pulls,
+    bitwise, including across interleaved trainer pushes."""
+    rng = np.random.default_rng(0)
+    t = _table(rows=128, dim=8, init="normal", init_b=0.5, seed=3)
+    cached = ServingEmbeddingCache(t, capacity=32, pull_bound=0,
+                                   registry=MetricsRegistry())
+    for it in range(20):
+        ids = rng.zipf(1.3, size=(16, 3)).astype(np.int64) % 128
+        got = cached.lookup(ids)
+        ref = t.sparse_pull(ids.reshape(-1)).reshape(16, 3, 8)
+        assert np.array_equal(got, ref), it
+        t.sparse_push(rng.integers(0, 128, 8),
+                      rng.standard_normal((8, 8)).astype(np.float32))
+    assert cached.stats()["hits"] > 0  # the parity run actually hit
+
+
+def test_negative_and_cold_row_policy():
+    t = _table()
+    reg = MetricsRegistry()
+    c = ServingEmbeddingCache(t, capacity=8, registry=reg)
+    out = c.lookup([-1, 2, 64, 9999])
+    np.testing.assert_array_equal(out[0], np.zeros(4))
+    np.testing.assert_array_equal(out[2], np.zeros(4))
+    np.testing.assert_array_equal(out[3], np.zeros(4))
+    assert c.stats()["negative_rows"] == 3
+    c_err = ServingEmbeddingCache(t, capacity=8, negative="error",
+                                  registry=MetricsRegistry())
+    with pytest.raises(KeyError):
+        c_err.lookup([0, -5])
+
+
+def test_compressed_eviction_tier():
+    """Rows evicted from the hot f32 tier live int8-compressed with
+    their version: a re-access within the bound decompresses locally
+    (l2_hits, bytes saved) instead of re-pulling; a version bump past
+    the bound still refreshes exactly."""
+    t = _table(rows=16, dim=8, init="normal", init_b=1.0, seed=5)
+    c = ServingEmbeddingCache(t, capacity=2, pull_bound=0,
+                              codec=ServingRowCodec(8),
+                              registry=MetricsRegistry())
+    ref = {k: t.sparse_pull([k])[0] for k in range(6)}
+    for k in range(6):   # capacity 2: most rows spill to L2
+        c.lookup([k])
+    st0 = c.stats()
+    assert st0["l2_size"] >= 3
+    out = c.lookup([0])[0]      # 0 was evicted; no version change since
+    st = c.stats()
+    assert st["l2_hits"] >= 1
+    assert st["ps_bytes_saved"] > st0["ps_bytes_saved"]
+    np.testing.assert_allclose(out, ref[0], rtol=0.02, atol=0.02)  # lossy
+    t.sparse_set([0], np.full((1, 8), 5.0, np.float32))
+    np.testing.assert_array_equal(c.lookup([0])[0], np.full(8, 5.0))
+
+
+def test_capacity_zero_is_cacheless_baseline():
+    t = _table()
+    c = ServingEmbeddingCache(t, capacity=0, registry=MetricsRegistry())
+    for _ in range(3):
+        c.lookup([1, 2, 3])
+    st = c.stats()
+    assert st["hits"] == 0 and st["size"] == 0
+    assert st["cold_misses"] == 9
+
+
+def test_wrapping_training_cache_shares_table():
+    t = _table()
+    train_tier = CacheSparseTable(t, 8)
+    c = ServingEmbeddingCache(train_tier, capacity=8, pull_bound=0,
+                              registry=MetricsRegistry())
+    assert c.table is t
+    np.testing.assert_array_equal(c.lookup([1])[0], np.zeros(4))
+
+
+# ---------------------------------------------------------------------------
+# thread-safe training-cache counters (satellite)
+# ---------------------------------------------------------------------------
+
+def test_cache_sparse_table_counters_thread_safe_and_exported():
+    from hetu_tpu.telemetry import default_registry
+    t = _table(rows=256, dim=4)
+    c = CacheSparseTable(t, 64)
+    before = default_registry.counter("ps.cache.lookups").value
+    N_THREADS, N_CALLS, B = 8, 50, 16
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(N_CALLS):
+            c.embedding_lookup(rng.integers(0, 256, B))
+
+    ts = [threading.Thread(target=worker, args=(i,))
+          for i in range(N_THREADS)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    assert c.lookups == N_THREADS * N_CALLS * B  # no lost increments
+    assert 0.0 <= c.hit_rate <= 1.0
+    delta = default_registry.counter("ps.cache.lookups").value - before
+    assert delta == N_THREADS * N_CALLS * B
+    assert default_registry.gauge("ps.cache.size").value == c.size
+
+
+# ---------------------------------------------------------------------------
+# engine + micro-batching
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def wdl():
+    model = WideDeep(3, 8, 4, hidden=(16,))
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _engine(model, variables, table, **kw):
+    kw.setdefault("max_batch", 32)
+    kw.setdefault("min_bucket", 4)
+    cache = ServingEmbeddingCache(table, capacity=64, pull_bound=0,
+                                  registry=MetricsRegistry())
+    return RecsysEngine(model, variables, cache, **kw)
+
+
+def test_engine_bucketed_bounded_executables(wdl):
+    model, variables = wdl
+    t = _table(rows=100, dim=8, init="normal", seed=1)
+    eng = _engine(model, variables, t)
+    rng = np.random.default_rng(0)
+    for b in (1, 3, 4, 5, 9, 30):
+        probs = eng.score(rng.standard_normal((b, 4)).astype(np.float32),
+                          rng.integers(0, 100, (b, 3)))
+        assert probs.shape == (b,)
+        assert np.all((probs > 0) & (probs < 1))
+    # sizes 1,3,4 -> bucket 4; 5,9 -> 8,16; 30 -> 32: four executables
+    assert eng.compiled_executables() == 4
+    assert eng.compiled_executables() <= eng.max_executables
+    with pytest.raises(ValueError):
+        eng.score(np.zeros((33, 4), np.float32), np.zeros((33, 3), np.int64))
+
+
+def test_engine_cached_scores_bitwise_equal_cacheless(wdl):
+    """Acceptance, end to end: same model, one engine over a bound-0
+    cache, one over the cache-less baseline — identical traffic +
+    interleaved pushes give bitwise-identical CTR scores."""
+    model, variables = wdl
+    t = _table(rows=100, dim=8, init="normal", seed=2)
+    cached = RecsysEngine(model, variables, ServingEmbeddingCache(
+        t, capacity=64, pull_bound=0, registry=MetricsRegistry()),
+        max_batch=32, min_bucket=4)
+    bare = RecsysEngine(model, variables, ServingEmbeddingCache(
+        t, capacity=0, registry=MetricsRegistry()),
+        max_batch=32, min_bucket=4)
+    rng = np.random.default_rng(1)
+    for _ in range(10):
+        dense = rng.standard_normal((8, 4)).astype(np.float32)
+        ids = (rng.zipf(1.5, size=(8, 3)) % 100).astype(np.int64)
+        assert np.array_equal(cached.score(dense, ids),
+                              bare.score(dense, ids))
+        t.sparse_push(rng.integers(0, 100, 4),
+                      rng.standard_normal((4, 8)).astype(np.float32))
+    assert cached.caches[0].hit_rate > 0.5
+
+
+def test_engine_two_sparse_inputs_deepfm():
+    model = DeepFM(3, 8, 4, hidden=(16,))
+    variables = model.init(jax.random.PRNGKey(0))
+    emb = _table(rows=100, dim=8, init="normal", seed=1)
+    lin = _table(rows=100, dim=1, init="normal", seed=2)
+    caches = (ServingEmbeddingCache(emb, capacity=32,
+                                    registry=MetricsRegistry()),
+              ServingEmbeddingCache(lin, capacity=32,
+                                    registry=MetricsRegistry()))
+    eng = RecsysEngine(model, variables, caches, max_batch=16, min_bucket=4)
+    probs = eng.score(np.zeros((5, 4), np.float32),
+                      np.arange(15).reshape(5, 3) % 100)
+    assert probs.shape == (5,) and np.all((probs > 0) & (probs < 1))
+
+
+def test_batcher_coalesces_single_requests(wdl):
+    model, variables = wdl
+    t = _table(rows=100, dim=8, init="normal", seed=1)
+    eng = _engine(model, variables, t)
+    b = RecsysBatcher(eng, max_delay_s=0.01)
+    rng = np.random.default_rng(0)
+    reqs = [RecsysRequest(dense=rng.standard_normal(4).astype(np.float32),
+                          sparse=rng.integers(0, 100, 3))
+            for _ in range(12)]
+    out = b.run(reqs)
+    assert all(r.status == "ok" for r in reqs)
+    # one coalesced forward, not 12 single-row ones
+    assert eng.metrics.count("recsys_batches") < len(reqs)
+    ref = eng.score(np.stack([r.dense for r in reqs]),
+                    np.stack([r.sparse for r in reqs]))
+    np.testing.assert_array_equal(
+        np.array([out[r.rid] for r in reqs], np.float32),
+        ref.astype(np.float32))
+    assert all(r.ttfr_s is not None and r.ttfr_s >= 0 for r in reqs)
+
+
+def test_batcher_deadline_and_cancel(wdl):
+    model, variables = wdl
+    t = _table(rows=100, dim=8, init="normal", seed=1)
+    eng = _engine(model, variables, t)
+    b = RecsysBatcher(eng)
+    expired = RecsysRequest(dense=np.zeros(4, np.float32),
+                            sparse=np.zeros(3, np.int64), timeout_s=0.0)
+    b.submit(expired)
+    time.sleep(0.01)
+    cancelled = RecsysRequest(dense=np.zeros(4, np.float32),
+                              sparse=np.zeros(3, np.int64))
+    b.submit(cancelled)
+    b.cancel(cancelled)
+    ok = RecsysRequest(dense=np.zeros(4, np.float32),
+                       sparse=np.zeros(3, np.int64))
+    b.submit(ok)
+    while b.has_work():
+        b.step()
+    assert expired.status == "timeout"
+    assert cancelled.status == "cancelled" and cancelled.score is None
+    assert ok.status == "ok" and ok.score is not None
+
+
+def test_batcher_resolve_failure_requeues_launched_batch():
+    """A finish() blow-up lands AFTER the next batch already launched:
+    both the in-flight batch AND the just-launched one must requeue —
+    neither may strand outside queue+inflight with done never set."""
+    from hetu_tpu.serve.metrics import ServeMetrics
+
+    class StubEngine:
+        max_batch = 4
+        metrics = ServeMetrics()
+
+        def __init__(self):
+            self.fail_next_finish = False
+
+        def gather_launch(self, dense, sparse):
+            return ("h", len(dense))
+
+        def finish(self, handle):
+            if self.fail_next_finish:
+                self.fail_next_finish = False
+                raise RuntimeError("boom")
+            return np.full(handle[1], 0.5, np.float32)
+
+    eng = StubEngine()
+    b = RecsysBatcher(eng, max_batch=1, max_delay_s=0.0)
+    r1 = RecsysRequest(dense=np.zeros(2, np.float32),
+                       sparse=np.zeros(2, np.int64))
+    r2 = RecsysRequest(dense=np.zeros(2, np.float32),
+                       sparse=np.zeros(2, np.int64))
+    b.submit(r1)
+    b.submit(r2)
+    b.step()                      # launches r1, nothing to resolve
+    eng.fail_next_finish = True
+    with pytest.raises(RuntimeError):
+        b.step()                  # launches r2, r1's resolve blows up
+    assert b.load == 2            # both requeued, neither stranded
+    while b.has_work():
+        b.step()
+    assert r1.status == "ok" and r2.status == "ok"
+    assert r1.requeues == 1 and r2.requeues == 1
+
+
+def test_batcher_export_adopt_roundtrip(wdl):
+    model, variables = wdl
+    t = _table(rows=100, dim=8, init="normal", seed=1)
+    b1 = RecsysBatcher(_engine(model, variables, t))
+    b2 = RecsysBatcher(_engine(model, variables, t))
+    reqs = [RecsysRequest(dense=np.zeros(4, np.float32),
+                          sparse=np.array([1, 2, 3])) for _ in range(3)]
+    for r in reqs:
+        b1.submit(r)
+    pairs = b1.export_inflight(fold=True)
+    assert len(pairs) == 3 and all(s is None for _, s in pairs)
+    _, n = b2.adopt_inflight(pairs, return_count=True)
+    assert n == 3
+    while b2.has_work():
+        b2.step()
+    assert all(r.status == "ok" for r in reqs)
+    with pytest.raises(RuntimeError):
+        b2.adopt_inflight([], snapshots=[object()])
+
+
+# ---------------------------------------------------------------------------
+# wire front-end + pool
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_server_wire_roundtrip(wdl):
+    model, variables = wdl
+    t = _table(rows=100, dim=8, init="normal", seed=1)
+    eng = _engine(model, variables, t)
+    srv = RecsysServer(RecsysBatcher(eng), max_clients=2,
+                       request_timeout_s=30.0)
+    cl = RecsysClient("127.0.0.1", srv.port, 0)
+    try:
+        dense, sp = np.ones(4, np.float32), np.array([1, 2, 3])
+        resp = cl.score(dense, sp, timeout_s=30.0)
+        assert resp["status"] == "ok"
+        assert abs(resp["score"] - float(eng.score(dense[None], sp[None])[0])
+                   ) < 1e-6
+        bad = cl.score(dense, [], timeout_s=30.0)
+        assert bad["status"] == "bad_request" and bad["score"] is None
+    finally:
+        cl.close()
+        srv.close()
+
+
+@pytest.mark.slow
+def test_pool_routes_kills_fails_over_and_revives(wdl):
+    model, variables = wdl
+    t = _table(rows=100, dim=8, init="normal", seed=1)
+
+    def factory():
+        return RecsysEngine(
+            model, variables,
+            ServingEmbeddingCache(t, capacity=64, pull_bound=1,
+                                  registry=MetricsRegistry()),
+            max_batch=16, min_bucket=4)
+
+    # live health poll: the kill switch only STRIKES the engine loop out
+    # under traffic, and the poll thread then fails the member over while
+    # the victim request waits — zero accepted-request loss
+    pool = RecsysPool({"a": factory, "b": factory},
+                      failover_grace_s=10.0)
+    dense, sp = np.ones(4, np.float32), np.array([1, 2, 3])
+    try:
+        ref = None
+        for _ in range(4):
+            r = pool.score(dense, sp, timeout_s=30.0)
+            assert r["status"] == "ok"
+            ref = r["score"] if ref is None else ref
+            assert r["score"] == ref  # same params+rows: same score
+        pool.kill_member("a")
+        for _ in range(3):
+            r = pool.score(dense, sp, timeout_s=30.0)
+            assert r["status"] == "ok" and r["score"] == ref
+        deadline = time.monotonic() + 10
+        while pool.metrics.count("pool_failovers") == 0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert pool.metrics.count("pool_failovers") == 1
+        assert not pool.members["a"].available
+        pool.revive_member("a")
+        assert pool.members["a"].available
+        r = pool.score(dense, sp, timeout_s=30.0)
+        assert r["status"] == "ok"
+    finally:
+        pool.close()
+
+
+def test_wrong_shape_request_rejected_not_engine_killing(wdl):
+    """One request with a wrong-length feature vector must be rejected
+    at intake ('overflow') — never admitted into a jitted batch where
+    its shape error would strike out the member's engine loop (and,
+    under a pool, poison every surviving peer in turn)."""
+    model, variables = wdl  # WideDeep: dense_dim=4, fields=3
+    t = _table(rows=100, dim=8, init="normal", seed=1)
+    eng = _engine(model, variables, t)
+    assert eng.dense_dim == 4 and eng.fields == 3  # from model attrs
+    b = RecsysBatcher(eng)
+    bad = RecsysRequest(dense=np.zeros(7, np.float32),
+                        sparse=np.zeros(3, np.int64))
+    b.submit(bad)
+    assert bad.status == "overflow" and bad.done.is_set()
+    bad2 = RecsysRequest(dense=np.zeros(4, np.float32),
+                         sparse=np.zeros(9, np.int64))
+    b.submit(bad2)
+    assert bad2.status == "overflow"
+    ok = RecsysRequest(dense=np.zeros(4, np.float32),
+                       sparse=np.zeros(3, np.int64))
+    b.submit(ok)
+    while b.has_work():
+        b.step()
+    assert ok.status == "ok" and ok.score is not None
+
+
+@pytest.mark.slow
+def test_wire_wrong_shape_answers_bad_request(wdl):
+    model, variables = wdl
+    t = _table(rows=100, dim=8, init="normal", seed=1)
+    srv = RecsysServer(RecsysBatcher(_engine(model, variables, t)),
+                       max_clients=1, request_timeout_s=30.0)
+    cl = RecsysClient("127.0.0.1", srv.port, 0)
+    try:
+        resp = cl.score(np.zeros(9, np.float32), [1, 2, 3], timeout_s=30.0)
+        assert resp["status"] == "bad_request", resp
+        resp = cl.score(np.zeros(4, np.float32), [1, 2, 3], timeout_s=30.0)
+        assert resp["status"] == "ok"
+    finally:
+        cl.close()
+        srv.close()
+
+
+@pytest.mark.slow
+def test_pool_frontend_serves_over_the_wire(wdl):
+    model, variables = wdl
+    t = _table(rows=100, dim=8, init="normal", seed=1)
+
+    def factory():
+        return RecsysEngine(
+            model, variables,
+            ServingEmbeddingCache(t, capacity=64,
+                                  registry=MetricsRegistry()),
+            max_batch=16, min_bucket=4)
+
+    pool = RecsysPool([factory, factory], start_poll=False)
+    front = pool.frontend(max_clients=2)
+    cl = RecsysClient("127.0.0.1", pool.port, 0)
+    try:
+        resp = cl.score(np.ones(4, np.float32), [1, 2, 3], timeout_s=30.0)
+        assert resp["status"] == "ok" and resp["score"] is not None
+        assert pool.metrics.count("pool_requests") == 1
+    finally:
+        cl.close()
+        front.close()
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# degrade-and-recover + chaos pairing
+# ---------------------------------------------------------------------------
+
+def test_degrade_serves_stale_and_pairs_with_kill_shard_fault():
+    """PS becomes unreachable mid-serving: the cache keeps answering
+    (hot rows at any staleness, zeros for unknown), and the outage is a
+    ``serve.recsys_degrade`` span the timeline pairs with the injected
+    ``fault.kill_shard`` instant."""
+    from hetu_tpu.resilience.faults import (
+        FaultEvent, FaultInjector, FaultSchedule,
+    )
+    t = _table(rows=16, dim=4, init="normal", seed=7)
+    tracer = trace.enable()
+    try:
+        c = ServingEmbeddingCache(t, capacity=8, pull_bound=0,
+                                  probe_interval_s=0.0,
+                                  registry=MetricsRegistry())
+        warm = c.lookup([1, 2])  # hot rows to serve stale later
+        inj = FaultInjector(FaultSchedule([FaultEvent(1, "kill_shard", 0)]),
+                            shard_procs=[])  # instant only: the "shard"
+        inj.on_step(1)           # here is the monkeypatched table below
+        real = t.sync_pull
+
+        def dead(*a, **kw):
+            raise ConnectionError("injected shard death")
+
+        t.sync_pull = dead
+        out = c.lookup([1, 2, 9])
+        np.testing.assert_array_equal(out[:2], warm)  # stale-but-served
+        np.testing.assert_array_equal(out[2], np.zeros(4))  # never seen
+        assert c.degraded
+        assert c.stats()["degraded_lookups"] == 3
+        t.sync_pull = real
+        c.lookup([1])            # first success closes the window
+        assert not c.degraded
+        pairs = timeline.correlate(tracer.events)
+        ks = [p for p in pairs if p.kind == "kill_shard"]
+        assert len(ks) == 1 and ks[0].paired
+        assert ks[0].recovery_name == "serve.recsys_degrade"
+        assert ks[0].recover_s >= 0
+    finally:
+        trace.disable()
+
+
+def test_unrecovered_degrade_span_is_not_a_recovery():
+    t = _table(rows=8, dim=4)
+    tracer = trace.enable()
+    try:
+        c = ServingEmbeddingCache(t, capacity=8,
+                                  registry=MetricsRegistry())
+        c.lookup([1])
+        t.sync_pull = lambda *a, **kw: (_ for _ in ()).throw(
+            ConnectionError("down"))
+        c.lookup([1])
+        assert c.degraded
+        c.close()  # still degraded: the span must record as FAILED
+        evs = [e for e in tracer.events
+               if e.get("name") == "serve.recsys_degrade"]
+        assert len(evs) == 1 and evs[0]["args"].get("error")
+    finally:
+        trace.disable()
+
+
+# ---------------------------------------------------------------------------
+# PS-backed chaos: real shard SIGKILL under live serving traffic
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_recsys_chaos_shard_kill_serves_degraded_then_recovers(
+        tmp_path, wdl):
+    """The acceptance chaos run: a 2-shard PS group backs a 2-member
+    CTR pool; a seeded ``kill_shard`` SIGKILLs one van shard server
+    mid-traffic.  The pool must KEEP ANSWERING (degraded-stale), the
+    shard restart must recover the cache, and the fault instant must
+    pair with the ``serve.recsys_degrade`` recovery span."""
+    from hetu_tpu.ps import van
+    from hetu_tpu.resilience.faults import FaultInjector, FaultSchedule
+    from hetu_tpu.resilience.shardproc import free_port, spawn_shard_server
+
+    model, variables = wdl
+    ports = [free_port(), free_port()]
+    procs = [spawn_shard_server(tmp_path, p, f"rc{i}")
+             for i, p in enumerate(ports)]
+    tracer = trace.enable()
+    table = None
+    pool = None
+    try:
+        table = van.PartitionedPSTable(
+            [("127.0.0.1", p) for p in ports], rows=64, dim=8,
+            init="normal", seed=3, optimizer="sgd", lr=0.5,
+            heartbeat_ms=50)
+        caches = []
+
+        def factory():
+            c = ServingEmbeddingCache(table, capacity=32, pull_bound=1,
+                                      registry=MetricsRegistry())
+            caches.append(c)
+            return RecsysEngine(model, variables, c, max_batch=16,
+                                min_bucket=4)
+
+        pool = RecsysPool({"a": factory, "b": factory},
+                          failover_grace_s=5.0)
+        schedule = FaultSchedule.generate(steps=8, seed=1234,
+                                          kill_shards=1, n_shards=2)
+        (kill_ev,) = schedule.events
+        inj = FaultInjector(schedule, shard_procs=procs)
+        rng = np.random.default_rng(0)
+        statuses = []
+        restarted = False
+        for step in range(1, 12):
+            inj.on_step(step)
+            for _ in range(2):
+                r = pool.score(
+                    rng.standard_normal(4).astype(np.float32),
+                    rng.integers(0, 64, 3), timeout_s=60.0)
+                statuses.append(r["status"])
+            if step > kill_ev.step and not restarted:
+                # serving survived the dead-shard window: restart it
+                victim = int(kill_ev.arg)
+                procs[victim] = spawn_shard_server(
+                    tmp_path, ports[victim], f"rc{victim}-re")
+                restarted = True
+                deadline = time.monotonic() + 30
+                while not all(table.alive) and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.1)
+                assert all(table.alive), "shard never reconnected"
+        assert inj.counters["shards_killed"] == 1
+        # every request answered ok — degraded-stale counts as answering
+        assert statuses and all(s == "ok" for s in statuses), statuses
+        assert any(c.stats()["degraded_lookups"] > 0 for c in caches)
+        assert not any(c.degraded for c in caches), "never recovered"
+        pairs = timeline.correlate(tracer.events)
+        ks = [p for p in pairs if p.kind == "kill_shard"]
+        assert len(ks) == 1 and ks[0].paired, ks
+        assert ks[0].recovery_name == "serve.recsys_degrade"
+    finally:
+        trace.disable()
+        if pool is not None:
+            pool.close()
+        if table is not None:
+            table.close()
+        for p in procs:
+            p.kill()
+            p.wait()
