@@ -619,6 +619,17 @@ def bench_migrate():
     context is where keeping live KV beats recomputing it, the number an
     operator needs to pick between `ServingPool.drain_member` (migrate)
     and plain requeue.
+
+    ``bench.py migrate --quant`` additionally runs the migrate arm with
+    the int8 block-scaled KV codec (`migrate.pack(codec="int8")`) and
+    crosses BOTH migrate arms over an emulated bandwidth-constrained DCN
+    link (deterministic perf_counter spin per payload byte, the
+    `_EmulatedLinkTable` technique — loopback moves bytes for free, which
+    hides exactly the cost the codec removes; the byte counts are real,
+    only their transport cost is modeled and the link speed is stated in
+    the emitted record).  ~2-4x smaller drain payloads then move the
+    migrate-vs-re-prefill crossover to SHORTER contexts than the
+    uncompressed baseline measured in the same run.
     """
     import os
     import threading
@@ -629,16 +640,29 @@ def bench_migrate():
     from hetu_tpu.serve import migrate as mg
 
     smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
+    quant = "--quant" in sys.argv[2:]
     if smoke:  # CI/CPU: same code path, toy sizes
         V, H, L, NH, MAXLEN = 512, 64, 2, 4, 128
         CTXS, REPS = (16, 48, 96), 3
+        DTYPE, LINK_MBPS = jnp.bfloat16, 480.0
+        if quant:
+            # the --quant A/B only: f32 cache (int8 codec = 4x, not
+            # bf16's 2x) over longer contexts, with a link sized so the
+            # toy model's per-token transfer brackets its CPU re-prefill
+            # cost with margin against box noise.  The PLAIN smoke
+            # config above stays untouched — the watcher's baseline
+            # `migrate` metric must remain comparable across runs.
+            MAXLEN, CTXS = 256, (16, 96, 224)
+            DTYPE = jnp.float32
     else:
         V, H, L, NH, MAXLEN = 50304, 768, 12, 12, 1024
         CTXS, REPS = (64, 256, 896), 5
+        DTYPE, LINK_MBPS = jnp.bfloat16, 10_000.0  # one 10GbE-class DCN
+        # share per drain
     cfg = models.GPTConfig(
         vocab_size=V, hidden_size=H, num_layers=L, num_heads=NH,
         ffn_size=4 * H, max_position=MAXLEN, dropout_rate=0.0,
-        dtype=jnp.bfloat16)
+        dtype=DTYPE)
     model = models.GPTModel(cfg)
     variables = model.init(jax.random.PRNGKey(0))
     src = ServeEngine(model, variables, num_slots=2, max_len=MAXLEN)
@@ -646,7 +670,7 @@ def bench_migrate():
     port = van.serve(0)
     g = np.random.default_rng(0)
 
-    def one_migrate(prompt, ch_id):
+    def one_migrate(prompt, ch_id, codec="none"):
         """Prefill+decode on src, migrate the live slot to dst over the
         wire; returns (migrate_s, payload_bytes)."""
         slot = src.alloc_slot()
@@ -657,12 +681,19 @@ def bench_migrate():
         try:
             t0 = time.perf_counter()
             snaps = src.export_slots([slot])
-            payload = mg.pack(src.cache.spec, snaps)
+            payload = mg.pack(src.cache.spec, snaps, codec=codec)
             t = threading.Thread(target=mg.send_payload, args=(tx, payload),
                                  daemon=True)
             t.start()
             got = mg.recv_payload(rx)
             t.join(60)
+            if quant:
+                # the payload's emulated DCN crossing (spin, not sleep:
+                # scheduler overshoot would flatten the codec's delta)
+                end = time.perf_counter() + \
+                    len(payload) / (LINK_MBPS * 125_000.0)
+                while time.perf_counter() < end:
+                    pass
             spec_d, snaps2, _ = mg.unpack(got)
             mg.check_spec(dst.cache.spec, spec_d)
             slot_map = dst.adopt_slots(snaps2)
@@ -695,35 +726,58 @@ def bench_migrate():
         one_migrate(prompt, next(ch_ids))  # warm the bucket + wire path
         one_reprefill(prompt)
         mig = []
+        mig_q = []
         pre = []
-        nbytes = 0
+        nbytes = nbytes_q = 0
         for _ in range(REPS):
             dt, nbytes = one_migrate(prompt, next(ch_ids))
             mig.append(dt)
+            if quant:
+                dt, nbytes_q = one_migrate(prompt, next(ch_ids),
+                                           codec="int8")
+                mig_q.append(dt)
             pre.append(one_reprefill(prompt))
-        rows.append({"ctx": ctx,
-                     "migrate_ms": round(float(np.median(mig)) * 1e3, 3),
-                     "reprefill_ms": round(float(np.median(pre)) * 1e3, 3),
-                     "payload_kb": round(nbytes / 1024.0, 1)})
+        row = {"ctx": ctx,
+               "migrate_ms": round(float(np.median(mig)) * 1e3, 3),
+               "reprefill_ms": round(float(np.median(pre)) * 1e3, 3),
+               "payload_kb": round(nbytes / 1024.0, 1)}
+        if quant:
+            row["migrate_q_ms"] = round(float(np.median(mig_q)) * 1e3, 3)
+            row["payload_q_kb"] = round(nbytes_q / 1024.0, 1)
+        rows.append(row)
     van.stop()
     crossover = next((r["ctx"] for r in rows
                       if r["migrate_ms"] < r["reprefill_ms"]), None)
+    crossover_q = next((r["ctx"] for r in rows
+                        if quant and r["migrate_q_ms"] < r["reprefill_ms"]),
+                       None)
     last = rows[-1]
-    speedup = last["reprefill_ms"] / max(last["migrate_ms"], 1e-9)
+    mig_key = "migrate_q_ms" if quant else "migrate_ms"
+    speedup = last["reprefill_ms"] / max(last[mig_key], 1e-9)
     for r in rows:
+        q = (f"  migrate(int8) {r['migrate_q_ms']:8.2f} ms "
+             f"({r['payload_q_kb']:.1f} KB)" if quant else "")
         print(f"# ctx {r['ctx']:>5}: migrate {r['migrate_ms']:8.2f} ms  "
               f"re-prefill {r['reprefill_ms']:8.2f} ms  "
-              f"payload {r['payload_kb']:8.1f} KB", file=sys.stderr)
-    print(f"# crossover (migration wins) at ctx: {crossover}",
+              f"payload {r['payload_kb']:8.1f} KB{q}", file=sys.stderr)
+    print(f"# crossover (migration wins) at ctx: {crossover}"
+          + (f"  int8-compressed: {crossover_q}" if quant else ""),
           file=sys.stderr)
+    extra = {"rows": rows, "crossover_ctx": crossover,
+             "ab": {"optimized": "live_kv_slot_migration_over_van",
+                    "baseline": "reprefill_from_prompt_plus_tokens"}}
+    if quant:
+        extra["crossover_ctx_int8"] = crossover_q
+        extra["kv_payload_reduction_int8"] = round(
+            last["payload_kb"] / max(last["payload_q_kb"], 1e-9), 3)
+        extra["emulated_dcn_mbps"] = LINK_MBPS
+        extra["ab"]["optimized"] = "live_kv_slot_migration_int8_codec"
     _emit({
         "metric": "serve_migrate_speedup_vs_reprefill_longest_ctx",
         "value": round(speedup, 3),
         "unit": "reprefill_over_migrate_latency_ratio",
         "vs_baseline": round(speedup, 3),
-        "extra": {"rows": rows, "crossover_ctx": crossover,
-                  "ab": {"optimized": "live_kv_slot_migration_over_van",
-                         "baseline": "reprefill_from_prompt_plus_tokens"}},
+        "extra": extra,
     })
 
 
@@ -1262,6 +1316,179 @@ def bench_ctr_serve():
     })
 
 
+def bench_quant():
+    """Quantized wire A/B across the three bandwidth-bound paths.
+
+    (1) **PS gradient wire**: a tiny CTR model (logistic regression over
+        sum-pooled embeddings) trains twice over a REAL van server with
+        identical seeds/data — once on the legacy f32 gradient wire, once
+        with ``wire="int8"`` (per-row scales + client-side error
+        feedback).  Measured: wire bytes both arms (telemetry
+        ``van.*.bytes`` and the shared ``bytes_logical``/``bytes_wire``
+        pair), per-step push+pull p99, and the final-loss delta (the
+        convergence-parity claim).
+    (2) **KV migration**: one live GPT slot packed with codec none /
+        bf16 / int8 — payload bytes + pack+unpack round-trip p99.
+    (3) **Collectives**: ``quantized_psum`` vs exact ``lax.psum`` over
+        all local devices — max relative error and wire bytes/element.
+
+    vs_baseline: measured f32-arm wire bytes over int8-arm wire bytes on
+    the PS gradient path (the ≥3x acceptance number).
+    """
+    import os
+    from functools import partial
+
+    from hetu_tpu.parallel import collectives as coll
+    from hetu_tpu.ps import van
+    from hetu_tpu.quantwire import block_wire_bytes
+    from hetu_tpu.telemetry import default_registry as reg
+
+    smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
+    if smoke:
+        V, D, F, B, STEPS = 2000, 32, 8, 128, 120
+        CTX, REPS = 96, 3
+    else:
+        V, D, F, B, STEPS = 100_000, 64, 8, 512, 300
+        CTX, REPS = 896, 5
+
+    # --- (1) PS gradient wire: f32 vs int8 push-pull -------------------
+    # the CTR model + training loop are the EXAMPLE's (one
+    # implementation: the example's parity assertion and this bench's
+    # parity claim measure the same model by construction)
+    import importlib.util as _ilu
+    import pathlib as _pl
+    _spec = _ilu.spec_from_file_location(
+        "hetu_quant_train_example",
+        _pl.Path(__file__).resolve().parent / "examples" / "quant_train.py")
+    qt = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(qt)
+
+    port = van.serve(0)
+
+    def _wire_counters():
+        out = {}
+        for name, m in reg.metrics().items():
+            if name.startswith("van.") and ".bytes" in name and \
+                    hasattr(m, "value"):
+                out[name] = m.value
+        return out
+
+    def train_arm(wire):
+        c0 = _wire_counters()
+        final_loss, step_s = qt.train(wire, port, vocab=V, dim=D, fields=F,
+                                      batch=B, steps=STEPS, verbose=False)
+        c1 = _wire_counters()
+        delta = {k: c1.get(k, 0) - c0.get(k, 0) for k in c1}
+        # gradient-wire bytes this arm moved (push both planes + the
+        # dense pull; sparse_pull stays storage-dtype-driven, same both
+        # arms, so it is excluded from the A/B)
+        moved = sum(delta.get(f"van.{op}.bytes", 0)
+                    for op in ("van_dense_push", "van_sparse_push",
+                               "van_dense_pull"))
+        return {"final_loss": final_loss,
+                "p99_step_ms": round(
+                    float(np.percentile(step_s, 99)) * 1e3, 3),
+                "wire_bytes": int(moved),
+                "counters": {k: int(v) for k, v in delta.items()
+                             if "logical" in k or "wire" in k or
+                             "saved" in k}}
+
+    arm_f32 = train_arm(None)
+    arm_int8 = train_arm("int8")
+    van.stop()
+    ps_ratio = arm_f32["wire_bytes"] / max(arm_int8["wire_bytes"], 1)
+    loss_delta = abs(arm_int8["final_loss"] - arm_f32["final_loss"]) / \
+        max(abs(arm_f32["final_loss"]), 1e-9)
+
+    # --- (2) KV migration payload: none / bf16 / int8 ------------------
+    from hetu_tpu import models
+    from hetu_tpu.serve import ServeEngine
+    from hetu_tpu.serve import migrate as mg
+
+    cfg = models.GPTConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+        ffn_size=256, max_position=max(2 * CTX, 128), dropout_rate=0.0)
+    model = models.GPTModel(cfg)
+    eng = ServeEngine(model, model.init(jax.random.PRNGKey(0)),
+                      num_slots=1, max_len=max(2 * CTX, 128))
+    slot = eng.alloc_slot()
+    eng.prefill(slot, [int(t) for t in
+                       np.random.default_rng(0).integers(0, 512, CTX)])
+    snaps = eng.export_slots([slot])
+    kv = {}
+    for codec in ("none", "bf16", "int8"):
+        ts = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            payload = mg.pack(eng.cache.spec, snaps, codec=codec)
+            mg.unpack(payload)
+            ts.append(time.perf_counter() - t0)
+        kv[codec] = {"payload_kb": round(len(payload) / 1024.0, 1),
+                     "roundtrip_p99_ms": round(
+                         float(np.percentile(ts, 99)) * 1e3, 3)}
+    eng.release(slot)
+    kv_ratio_int8 = kv["none"]["payload_kb"] / \
+        max(kv["int8"]["payload_kb"], 1e-9)
+    kv_ratio_bf16 = kv["none"]["payload_kb"] / \
+        max(kv["bf16"]["payload_kb"], 1e-9)
+
+    # --- (3) quantized_psum numerics vs exact --------------------------
+    from jax.sharding import Mesh, PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    n_elems = 1 << 16
+    xs = np.random.default_rng(1).normal(
+        0, 0.02, n_elems).astype(np.float32)
+
+    @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+             check_rep=False)
+    def _q(x):
+        return coll.quantized_psum(x, "dp", wire="int8")
+
+    @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P())
+    def _e(x):
+        return jax.lax.psum(x, "dp")
+
+    exact = np.asarray(jax.jit(_e)(xs))
+    approx = np.asarray(jax.jit(_q)(xs))
+    psum_rel_err = float(np.max(np.abs(approx - exact))
+                         / max(float(np.max(np.abs(exact))), 1e-9))
+    psum_wire_ratio = (n_elems * 4) / block_wire_bytes(n_elems, "int8", 256)
+
+    print(f"# PS gradient wire: f32 {arm_f32['wire_bytes']} B vs int8 "
+          f"{arm_int8['wire_bytes']} B -> {ps_ratio:.2f}x; "
+          f"loss f32 {arm_f32['final_loss']:.4f} vs int8 "
+          f"{arm_int8['final_loss']:.4f} (delta {loss_delta:.2%}); "
+          f"step p99 {arm_f32['p99_step_ms']:.1f} -> "
+          f"{arm_int8['p99_step_ms']:.1f} ms", file=sys.stderr)
+    print(f"# KV migration payload: {kv['none']['payload_kb']} KB -> "
+          f"bf16 {kv['bf16']['payload_kb']} KB ({kv_ratio_bf16:.2f}x), "
+          f"int8 {kv['int8']['payload_kb']} KB ({kv_ratio_int8:.2f}x)",
+          file=sys.stderr)
+    print(f"# quantized_psum over {len(jax.devices())} devices: max rel "
+          f"err {psum_rel_err:.2e}, wire {psum_wire_ratio:.2f}x smaller",
+          file=sys.stderr)
+    _emit({
+        "metric": "quant_int8_ps_gradient_wire_reduction",
+        "value": round(ps_ratio, 3),
+        "unit": "f32_over_int8_wire_bytes_ratio",
+        "vs_baseline": round(ps_ratio, 3),
+        "extra": {
+            "ps": {"f32": arm_f32, "int8": arm_int8,
+                   "final_loss_rel_delta": round(loss_delta, 4)},
+            "kv_migration": dict(kv, reduction_int8=round(kv_ratio_int8, 3),
+                                 reduction_bf16=round(kv_ratio_bf16, 3)),
+            "quantized_psum": {"max_rel_err": psum_rel_err,
+                               "wire_reduction": round(psum_wire_ratio, 3),
+                               "devices": len(jax.devices())},
+            "ab": {"optimized": "int8_wire_with_error_feedback",
+                   "baseline": "f32_gradient_wire"}},
+    })
+
+
 def _measure_shard_recovery():
     """Kill one of two PS shard servers, restart it, and time from the
     kill to the guard's snapshot replay completing."""
@@ -1328,6 +1555,7 @@ _METRIC_BY_CMD = {
     "serve": "gpt_serve_decode_tokens_per_sec_1chip",
     "ctr_serve": "ctr_serve_p99_speedup_vs_cacheless",
     "migrate": "serve_migrate_speedup_vs_reprefill_longest_ctx",
+    "quant": "quant_int8_ps_gradient_wire_reduction",
     "resilience": "resilience_supervisor_overhead_pct",
     "elastic": "elastic_supervisor_overhead_pct",
     "telemetry": "telemetry_tracing_overhead_pct",
@@ -1367,6 +1595,7 @@ def main():
      "gpt_sweep": bench_gpt_sweep, "serve": bench_serve,
      "ctr_serve": bench_ctr_serve,
      "migrate": bench_migrate,
+     "quant": bench_quant,
      "resilience": bench_resilience,
      "elastic": bench_elastic,
      "telemetry": bench_telemetry}.get(cmd, bench_gpt)()

@@ -339,6 +339,30 @@ int ps_sparse_pull_q8(int id, const int64_t* idx, int64_t n, int8_t* q,
   return 0;
 }
 
+// Direct q8 codec ABI (no table involved): the SAME symmetric per-row
+// scheme every wire/storage path uses (hetu_ps_dtype.h), exported so the
+// Python side can (a) test the codec's roundtrip/NaN/Inf behavior head-on
+// and (b) compute error-feedback residuals against the exact values a
+// server will decode.
+int ps_q8_encode(const float* v, int64_t n, int64_t dim, int8_t* q,
+                 float* scales) {
+  if (n < 0 || dim <= 0) return -3;
+  for (int64_t r = 0; r < n; r++) {
+    float sc = q8_scale(v + r * dim, dim);
+    scales[r] = sc;
+    q8_quantize(v + r * dim, dim, sc, q + r * dim);
+  }
+  return 0;
+}
+
+int ps_q8_decode(const int8_t* q, const float* scales, int64_t n,
+                 int64_t dim, float* out) {
+  if (n < 0 || dim <= 0) return -3;
+  for (int64_t r = 0; r < n; r++)
+    q8_dequantize(q + r * dim, dim, scales[r], out + r * dim);
+  return 0;
+}
+
 int ps_sparse_push(int id, const int64_t* idx, const float* grads,
                    int64_t n) {
   Table* t = get_table(id);

@@ -36,18 +36,38 @@ inline uint16_t f32_to_bf16(float f) {
   return (uint16_t)(u >> 16);
 }
 
-// symmetric per-row int8: scale maps the row's max magnitude onto 127
+// symmetric per-row int8: scale maps the row's max FINITE magnitude onto
+// 127.  Non-finite elements must not poison the whole row: an Inf feeding
+// the max would drive scale to Inf (inv 0) and zero every finite value,
+// and a NaN would make the scale NaN.  Inf/NaN are handled per element in
+// q8_quantize instead.
 inline float q8_scale(const float* v, int64_t d) {
   float mx = 0.f;
-  for (int64_t i = 0; i < d; i++) mx = std::max(mx, std::fabs(v[i]));
+  for (int64_t i = 0; i < d; i++) {
+    float a = std::fabs(v[i]);
+    if (std::isfinite(a) && a > mx) mx = a;
+  }
   return mx > 0.f ? mx / 127.f : 0.f;
 }
 
+// NaN/Inf clamp: NaN quantizes to 0 (lround(NaN) is UB, and the min/max
+// clamp below would otherwise silently turn it into +127 — a large FAKE
+// gradient out of a poisoned one); +/-Inf saturates to +/-127, the same
+// value the largest finite element maps to.  An all-zero row keeps
+// scale 0 and decodes back to exact zeros.
 inline void q8_quantize(const float* v, int64_t d, float s, int8_t* out) {
   float inv = s > 0.f ? 1.f / s : 0.f;
-  for (int64_t i = 0; i < d; i++)
-    out[i] = (int8_t)std::lround(
-        std::max(-127.f, std::min(127.f, v[i] * inv)));
+  for (int64_t i = 0; i < d; i++) {
+    float x = v[i];
+    if (std::isnan(x)) {
+      out[i] = 0;
+    } else if (std::isinf(x)) {
+      out[i] = x > 0.f ? 127 : -127;
+    } else {
+      out[i] = (int8_t)std::lround(
+          std::max(-127.f, std::min(127.f, x * inv)));
+    }
+  }
 }
 
 inline void q8_dequantize(const int8_t* q, int64_t d, float s, float* out) {

@@ -120,6 +120,14 @@ enum VanOp : uint8_t {
   // the weights so accumulators resume bitwise-exact.  Always f32 on the
   // wire — slots never quantize whatever the row dtype.
   OP_SLOTS_GET = 29, OP_SLOTS_SET = 30,
+  // negotiated quantized wire (gradient push-pull): rows travel in an
+  // EXPLICIT per-message wire dtype (f32/bf16/int8+per-row-scale) chosen
+  // by the client, independent of the table's STORAGE dtype — int8
+  // gradients converge via client-side error feedback, so the server
+  // just decodes and applies.  An old server answers these ops with
+  // rc=-100 (unknown op); the client treats that as "speak f32" — that
+  // single round trip IS the negotiation, no capability handshake op.
+  OP_DENSE_PUSH_W = 31, OP_DENSE_PULL_W = 32, OP_SPARSE_PUSH_W = 33,
 };
 
 // Per-table bounded set of recently applied push request-ids.  A repeated
@@ -483,7 +491,8 @@ void handle_conn(int fd) {
     static const uint32_t kMinBody[] = {
         0, 48, 28, 4, 4, 13, 12, 12, 8, 8, 0, 12, 20,
         20, 36, 12, 12, 8, 16, 8, 0, 8, 4,
-        24, 20, 16, 16, 0, 4, 12, 12};
+        24, 20, 16, 16, 0, 4, 12, 12,
+        13, 5, 21};
     if (op < sizeof(kMinBody) / sizeof(uint32_t) &&
         blen < 1 + kMinBody[op]) {
       send_resp(fd, -3, nullptr, 0);
@@ -1042,6 +1051,96 @@ void handle_conn(int fd) {
         send_resp(fd, rc, nullptr, 0);
         break;
       }
+      case OP_DENSE_PUSH_W: {
+        // [i32 id][u8 wdt][u64 req][rows*dim in wdt] — req != 0 dedups
+        // (same exactly-once window as OP_DENSE_PUSH_ID)
+        int id = rd<int32_t>(p);
+        int wdt = rd<uint8_t>(p);
+        uint64_t req = rd<uint64_t>(p);
+        if (wdt > WDT_INT8) { send_resp(fd, -3, nullptr, 0); break; }
+        bool dedup = req != 0;
+        if (dedup && g_push_dedup.begin(id, req) == DedupSet::DUPLICATE) {
+          send_resp(fd, 0, nullptr, 0);
+          break;
+        }
+        int64_t rows = ps_table_rows(id), dim = ps_table_dim(id);
+        int64_t have = body.data() + blen - p;
+        int rc;
+        if (rows < 0 || dim < 0) {
+          rc = -1;  // no such table: group recovery cue
+        } else if (rows * dim <= 0 ||
+                   have < rows * wire_row_bytes(wdt, dim)) {
+          rc = -3;
+        } else if (wdt == WDT_F32) {
+          rc = ps_dense_push(id, (const float*)p);
+        } else {
+          fbuf.resize(rows * dim);
+          decode_rows(wdt, p, rows, dim, fbuf.data());
+          rc = ps_dense_push(id, fbuf.data());
+        }
+        if (dedup) g_push_dedup.finish(id, req, rc == 0);
+        send_resp(fd, rc, nullptr, 0);
+        break;
+      }
+      case OP_DENSE_PULL_W: {
+        // [i32 id][u8 wdt] -> resp: rows*dim encoded in wdt
+        int id = rd<int32_t>(p);
+        int wdt = rd<uint8_t>(p);
+        if (wdt > WDT_INT8) { send_resp(fd, -3, nullptr, 0); break; }
+        int64_t rows = ps_table_rows(id), dim = ps_table_dim(id);
+        int64_t n = rows * dim;
+        if (rows <= 0 || dim <= 0) { send_resp(fd, -1, nullptr, 0); break; }
+        if (rows * wire_row_bytes(wdt, dim) > (int64_t)(1u << 30)) {
+          send_resp(fd, -3, nullptr, 0); break;
+        }
+        fbuf.resize(n);
+        int rc = ps_dense_pull(id, fbuf.data());
+        if (rc != 0) { send_resp(fd, rc, nullptr, 0); break; }
+        if (wdt == WDT_F32) {  // zero-copy like OP_DENSE_PULL
+          send_resp(fd, 0, fbuf.data(), (uint32_t)(n * sizeof(float)));
+        } else {
+          std::vector<char> enc;
+          encode_rows(wdt, fbuf.data(), rows, dim, enc);
+          send_resp(fd, 0, enc.data(), (uint32_t)enc.size());
+        }
+        break;
+      }
+      case OP_SPARSE_PUSH_W: {
+        // [i32 id][u8 wdt][u64 req][i64 n][idx x n][rows x n in wdt]
+        int id = rd<int32_t>(p);
+        int wdt = rd<uint8_t>(p);
+        uint64_t req = rd<uint64_t>(p);
+        int64_t n = rd<int64_t>(p);
+        if (wdt > WDT_INT8) { send_resp(fd, -3, nullptr, 0); break; }
+        bool dedup = req != 0;
+        if (dedup && g_push_dedup.begin(id, req) == DedupSet::DUPLICATE) {
+          send_resp(fd, 0, nullptr, 0);
+          break;
+        }
+        int64_t dim = ps_table_dim(id);
+        int64_t have = body.data() + blen - p;
+        int rc;
+        if (dim < 0) {
+          rc = -1;
+        } else if (dim == 0 || n < 0 || n > (1 << 24) ||
+                   have < n * ((int64_t)sizeof(int64_t) +
+                               wire_row_bytes(wdt, dim))) {
+          rc = -3;
+        } else {
+          const auto* idx = (const int64_t*)p;
+          const char* dat = p + n * sizeof(int64_t);
+          if (wdt == WDT_F32) {
+            rc = ps_sparse_push(id, idx, (const float*)dat, n);
+          } else {
+            fbuf.resize(n * dim);
+            decode_rows(wdt, dat, n, dim, fbuf.data());
+            rc = ps_sparse_push(id, idx, fbuf.data(), n);
+          }
+        }
+        if (dedup) g_push_dedup.finish(id, req, rc == 0);
+        send_resp(fd, rc, nullptr, 0);
+        break;
+      }
       case OP_STATS: {
         uint64_t stats[3] = {
             g_frames_handled.load(std::memory_order_relaxed),
@@ -1472,6 +1571,57 @@ int ps_van_sparse_push_id_dt(int fd, int id, const int64_t* idx,
                              int dtype, uint64_t req) {
   return van_sparse_write_dt(OP_SPARSE_PUSH_ID, fd, id, idx, grads, n,
                              dim, dtype, req);
+}
+
+// ---- negotiated quantized wire (explicit per-message wire dtype) ----
+//
+// `roundtrip_out` (nullable) receives the values the SERVER will decode —
+// the payload encoded then decoded through the same codec — so a client
+// computes its error-feedback residual (intended - roundtrip) without a
+// second encode pass or any bit-exactness assumption about a separate
+// Python reimplementation.  rc=-100 (old server, unknown op) is the
+// negotiation signal: the caller falls back to the f32 legacy ops.
+
+int ps_van_dense_push_w(int fd, int id, const float* grad, int64_t rows,
+                        int64_t dim, int wdt, uint64_t req,
+                        float* roundtrip_out) {
+  std::vector<char> enc;
+  encode_rows(wdt, grad, rows, dim, enc);
+  if (roundtrip_out) decode_rows(wdt, enc.data(), rows, dim, roundtrip_out);
+  std::vector<char> b{(char)OP_DENSE_PUSH_W}, pay;
+  put<int32_t>(b, id); put<uint8_t>(b, (uint8_t)wdt); put<uint64_t>(b, req);
+  b.insert(b.end(), enc.begin(), enc.end());
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
+}
+
+int ps_van_dense_pull_w(int fd, int id, float* out, int64_t rows,
+                        int64_t dim, int wdt) {
+  std::vector<char> b{(char)OP_DENSE_PULL_W}, pay;
+  put<int32_t>(b, id); put<uint8_t>(b, (uint8_t)wdt);
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
+  if ((int64_t)pay.size() != rows * wire_row_bytes(wdt, dim)) return -5;
+  decode_rows(wdt, pay.data(), rows, dim, out);
+  return 0;
+}
+
+int ps_van_sparse_push_w(int fd, int id, const int64_t* idx,
+                         const float* grads, int64_t n, int64_t dim,
+                         int wdt, uint64_t req, float* roundtrip_out) {
+  std::vector<char> enc;
+  encode_rows(wdt, grads, n, dim, enc);
+  if (roundtrip_out) decode_rows(wdt, enc.data(), n, dim, roundtrip_out);
+  std::vector<char> b{(char)OP_SPARSE_PUSH_W}, pay;
+  put<int32_t>(b, id); put<uint8_t>(b, (uint8_t)wdt); put<uint64_t>(b, req);
+  put<int64_t>(b, n);
+  size_t o = b.size();
+  b.resize(o + n * sizeof(int64_t) + enc.size());
+  std::memcpy(b.data() + o, idx, n * sizeof(int64_t));
+  std::memcpy(b.data() + o + n * sizeof(int64_t), enc.data(), enc.size());
+  int32_t rc = kTransportErr;
+  return request(fd, b, &rc, &pay) ? rc : kTransportErr;
 }
 
 // ---- bulk-blob channel + barrier + stats ----

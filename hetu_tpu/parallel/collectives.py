@@ -89,6 +89,55 @@ def hierarchical_all_to_all(x, outer_axis: str, inner_axis: str,
                           concat_axis=concat_dim, tiled=True)
 
 
+def quantized_psum(x, axis, *, wire: str = "int8", block: int = 256):
+    """Block-scaled quantized AllReduce(sum) over a mesh axis — the
+    EQuARX scheme (PAPERS.md, arXiv 2506.17615) built from jax
+    primitives so it stays INSIDE jit and XLA fuses quantize →
+    collective → dequantize:
+
+      flatten → blocks of ``block`` elts → symmetric int8 with one f32
+      scale per block → ``all_gather`` of codes+scales in low precision
+      → dequantize + sum in f32 → reshape back.
+
+    Wire bytes per element: 1 + 4/block (int8) or 2 (bf16) vs 4 for the
+    exact f32 path — ``wire="f32"`` IS the exact path (plain
+    ``lax.psum``), so call sites can select precision per op with no
+    structural change.  Per-replica quantization error is bounded by
+    half a quantum: |err| <= max|block| / 254 per element per replica
+    (asserted in tests/test_quant_wire.py); gradient call sites that
+    need the bias removed over time pair this with error feedback the
+    same way the PS wire does.
+
+    Only valid where ``lax.psum`` is (inside ``shard_map``/``pmap`` over
+    ``axis``).  Byte accounting happens at the call site (the executor's
+    gradient-sync path records ``train.grad_sync.bytes_*``) — a traced
+    function cannot touch host counters.
+    """
+    if wire in (None, "f32", "exact"):
+        return lax.psum(x, axis)
+    if wire == "bf16":
+        g = lax.all_gather(x.astype(jnp.bfloat16), axis)
+        return jnp.sum(g.astype(jnp.float32), axis=0).astype(x.dtype)
+    if wire != "int8":
+        raise ValueError(f"unknown wire dtype {wire!r}; expected "
+                         f"'f32'/'bf16'/'int8'")
+    from hetu_tpu.quantwire import jnp_block_encode
+    q, scale = jnp_block_encode(x, block)
+    qg = lax.all_gather(q, axis)          # [n_dev, nblk, block] int8
+    sg = lax.all_gather(scale, axis)      # [n_dev, nblk, 1] f32
+    out = jnp.sum(qg.astype(jnp.float32) * sg, axis=0)
+    return (out.reshape(-1)[:x.size].reshape(x.shape)).astype(x.dtype)
+
+
+def quantized_pmean(x, axis, *, wire: str = "int8", block: int = 256):
+    """AllReduce(mean) counterpart of :func:`quantized_psum` (the
+    gradient-sync shape: data-parallel gradients average over dp)."""
+    if wire in (None, "f32", "exact"):
+        return lax.pmean(x, axis)
+    return quantized_psum(x, axis, wire=wire, block=block) / \
+        lax.psum(1, axis)
+
+
 def ppermute_shift(x, axis, shift: int = 1):
     """Ring shift over a mesh axis (PipelineSend/Receive analog and the ring-
     attention building block)."""

@@ -49,6 +49,7 @@ def _load():
             return None
         c = ctypes
         i64p = c.POINTER(c.c_int64)
+        i8p = c.POINTER(c.c_int8)
         f32p = c.POINTER(c.c_float)
         u64p = c.POINTER(c.c_uint64)
         u32p = c.POINTER(c.c_uint32)
@@ -219,6 +220,19 @@ def _load():
                                           c.c_int64, c.c_int64, c.c_int,
                                           c.c_uint64], c.c_int),
             "ps_van_stats": ([c.c_int, u64p, u64p, u64p], c.c_int),
+            # direct q8 codec + negotiated quantized wire (round 8)
+            "ps_q8_encode": ([f32p, c.c_int64, c.c_int64, i8p, f32p],
+                             c.c_int),
+            "ps_q8_decode": ([i8p, f32p, c.c_int64, c.c_int64, f32p],
+                             c.c_int),
+            "ps_van_dense_push_w": ([c.c_int, c.c_int, f32p, c.c_int64,
+                                     c.c_int64, c.c_int, c.c_uint64, f32p],
+                                    c.c_int),
+            "ps_van_dense_pull_w": ([c.c_int, c.c_int, f32p, c.c_int64,
+                                     c.c_int64, c.c_int], c.c_int),
+            "ps_van_sparse_push_w": ([c.c_int, c.c_int, i64p, f32p,
+                                      c.c_int64, c.c_int64, c.c_int,
+                                      c.c_uint64, f32p], c.c_int),
             # bulk-blob channel + barrier + frame stats (round 5)
             "ps_van_blob_put": ([c.c_int, c.c_int64, c.c_uint64, c.c_void_p,
                                  c.c_int64, c.c_int], c.c_int),

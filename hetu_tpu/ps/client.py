@@ -50,8 +50,113 @@ def _check(rc, what: str):
 
 _INIT_KINDS = {"zeros": 0, "constant": 1, "uniform": 2, "normal": 3}
 TABLE_DTYPES = {"f32": 0, "bf16": 1, "int8": 2}  # row STORAGE dtypes
+# WIRE dtypes for the negotiated gradient push-pull wire: the single
+# Python source is hetu_tpu.quantwire (same numbering as csrc WireDtype);
+# "f32" means "speak the legacy ops"
+from hetu_tpu.quantwire import WIRE_CODES as WIRE_DTYPES  # noqa: E402
 _OPT_KINDS = {"sgd": 0, "momentum": 1, "adagrad": 2, "adam": 3,
               "nesterov": 4}
+
+
+def q8_encode(rows) -> tuple:
+    """Symmetric per-row int8 quantization through the NATIVE codec
+    (csrc/hetu_ps_dtype.h) — the exact scheme every storage and wire path
+    uses, including the NaN→0 / ±Inf→±127 clamp.  Returns ``(q int8
+    [n, dim], scales f32 [n])``."""
+    import ctypes as c
+    v = np.ascontiguousarray(rows, np.float32)
+    if v.ndim != 2:
+        raise ValueError(f"q8_encode wants [n, dim] rows, got {v.shape}")
+    n, dim = v.shape
+    q = np.empty((n, dim), np.int8)
+    s = np.empty(n, np.float32)
+    _check(lib.ps_q8_encode(_f32p(v), n, dim,
+                            q.ctypes.data_as(c.POINTER(c.c_int8)),
+                            _f32p(s)), "q8_encode")
+    return q, s
+
+
+def q8_decode(q, scales) -> np.ndarray:
+    """Inverse of :func:`q8_encode` (f32 rows)."""
+    import ctypes as c
+    q = np.ascontiguousarray(q, np.int8)
+    if q.ndim != 2:
+        raise ValueError(f"q8_decode wants [n, dim] codes, got {q.shape}")
+    n, dim = q.shape
+    s = np.ascontiguousarray(scales, np.float32).reshape(n)
+    out = np.empty((n, dim), np.float32)
+    _check(lib.ps_q8_decode(q.ctypes.data_as(c.POINTER(c.c_int8)),
+                            _f32p(s), n, dim, _f32p(out)), "q8_decode")
+    return out
+
+
+class ErrorFeedback:
+    """Client-side error-feedback residual accumulation for lossy (int8)
+    gradient wires (the 1-bit-SGD / EF-SGD mechanism): each push sends
+    ``grad + residual`` and keeps ``residual = sent_intent - what the
+    server decoded``, so quantization error is re-applied on later steps
+    instead of lost — int8 push-pull then tracks the f32-wire trajectory
+    (loss parity asserted in tests/test_quant_wire.py).
+
+    The wire stubs return the server-side decode (``roundtrip``) of the
+    exact payload sent, so the residual needs no bit-exact Python
+    re-implementation of the codec.  Sparse residuals are per-row, keyed
+    by index and bounded by ``max_rows`` (oldest rows are dropped beyond
+    it — a dropped residual loses a sub-quantum of gradient mass, the
+    same loss a plain quantized push takes on every step).
+    """
+
+    def __init__(self, dim: int, *, max_rows: int = 1 << 20):
+        self.dim = int(dim)
+        self.max_rows = int(max_rows)
+        self._dense = None           # [rows, dim] f32
+        self._sparse: dict = {}      # index -> [dim] f32 residual
+
+    # ---- dense plane ----
+    def fold_dense(self, grad: np.ndarray) -> np.ndarray:
+        """grad + carried residual (fresh array; the caller's grad is
+        untouched)."""
+        if self._dense is None:
+            return np.array(grad, np.float32, copy=True)
+        return grad + self._dense
+
+    def absorb_dense(self, intended: np.ndarray,
+                     roundtrip: np.ndarray) -> None:
+        self._dense = intended - roundtrip
+
+    # ---- sparse plane ----
+    # Both sparse methods sit on the embedding-push hot path, so the
+    # per-ROW work is vectorized (np.unique / np.add.at); only one
+    # Python dict access per UNIQUE index remains — the dict is the
+    # right store for a sparse residual set, and unique counts are far
+    # below row counts on skewed CTR traffic.
+
+    def fold_sparse(self, idx: np.ndarray, grads: np.ndarray) -> np.ndarray:
+        """Add each row's carried residual to its gradient.  An index
+        repeated within one push receives its residual ONCE (on the first
+        occurrence) — the server sums duplicate rows, so folding it into
+        every occurrence would multiply the correction."""
+        out = np.array(grads, np.float32, copy=True)
+        if self._sparse:
+            uniq, first = np.unique(np.asarray(idx), return_index=True)
+            get = self._sparse.get
+            for j, ii in zip(first, uniq.tolist()):
+                r = get(ii)
+                if r is not None:
+                    out[j] += r
+        return out
+
+    def absorb_sparse(self, idx: np.ndarray, intended: np.ndarray,
+                      roundtrip: np.ndarray) -> None:
+        uniq, inv = np.unique(np.asarray(idx), return_inverse=True)
+        acc = np.zeros((uniq.shape[0], intended.shape[1]), np.float32)
+        np.add.at(acc, inv, intended - roundtrip)
+        sp = self._sparse
+        for j, ii in enumerate(uniq.tolist()):
+            sp.pop(ii, None)  # re-insert: recently-touched rows live longest
+            sp[ii] = acc[j]
+        while len(sp) > self.max_rows:
+            sp.pop(next(iter(sp)))
 
 
 class PSTable:

@@ -61,11 +61,16 @@ def _op_metrics(op: str):
 
 
 class _OpSpan:
-    __slots__ = ("op", "nbytes", "_t0", "_tr0", "_traced")
+    __slots__ = ("op", "nbytes", "logical_nbytes", "_t0", "_tr0", "_traced")
 
-    def __init__(self, op: str, nbytes: int = 0):
+    def __init__(self, op: str, nbytes: int = 0, logical_nbytes: int = 0):
         self.op = op
         self.nbytes = int(nbytes)
+        # set (nonzero) only by compressed ops: nbytes is then the WIRE
+        # byte count and logical_nbytes the f32-equivalent payload — the
+        # pair lands in van.<op>.bytes_logical/.bytes_wire/.bytes_saved so
+        # a single Prometheus snapshot shows the savings
+        self.logical_nbytes = int(logical_nbytes)
 
     def __enter__(self):
         _maybe_inject(self.op)
@@ -89,6 +94,9 @@ class _OpSpan:
             return False
         if self.nbytes:
             nbytes.inc(self.nbytes)
+        if self.logical_nbytes:
+            from hetu_tpu.quantwire import record_wire_bytes
+            record_wire_bytes(span_name, self.logical_nbytes, self.nbytes)
         lat.observe(dt)
         if self._traced and _trace.enabled():
             _trace.complete(span_name, self._tr0,
@@ -97,8 +105,15 @@ class _OpSpan:
         return False
 
 
-def _op_span(op: str, nbytes: int = 0) -> _OpSpan:
-    return _OpSpan(op, nbytes)
+def _op_span(op: str, nbytes: int = 0, logical_nbytes: int = 0) -> _OpSpan:
+    return _OpSpan(op, nbytes, logical_nbytes)
+
+
+class _WireUnsupported(Exception):
+    """rc=-100 from a quantized wire op (old server).  Raised INSIDE the
+    op span so the rejected attempt records a call + error only — its
+    bytes/latency/savings must not land in the registry (nothing was
+    applied, and the legacy retry accounts the real transfer)."""
 
 
 def op_stats() -> dict:
@@ -232,7 +247,22 @@ class RemotePSTable:
     pulls/sets of a bf16 table move half the bytes, int8 a quarter (plus a
     per-row scale); gradients push bf16 for bf16 tables and f32 otherwise.
     Callers always see f32 arrays — codecs live in the C client stubs.
-    BOTH endpoints of a shared table id must agree on its dtype."""
+    BOTH endpoints of a shared table id must agree on its dtype.
+
+    ``wire`` ("bf16"/"int8", default None = legacy f32 gradient wire)
+    additionally quantizes the GRADIENT push-pull plane —
+    ``dense_push``/``sparse_push``/``dense_pull`` — independent of the
+    storage dtype: bf16 halves gradient bytes losslessly-ish (8 mantissa
+    bits), int8 quarters them with one f32 scale per row, paired with
+    client-side error feedback (``error_feedback=True``) so quantization
+    error is carried into the next push instead of lost — int8 push-pull
+    then converges at loss parity with the f32 wire.  The format is
+    NEGOTIATED: each message names its wire dtype, and an old server that
+    doesn't speak the quantized ops answers rc=-100 once, after which this
+    client silently falls back to the f32 legacy ops.  Wire savings are
+    visible in ``telemetry.default_registry`` as
+    ``van.<op>.bytes_logical`` / ``.bytes_wire`` / ``.bytes_saved``.
+    """
 
     def __init__(self, host: str, port: int, rows: int, dim: int, *,
                  table_id: Optional[int] = None, create: bool = True,
@@ -241,12 +271,23 @@ class RemotePSTable:
                  optimizer: str = "sgd", lr: float = 0.01,
                  momentum: float = 0.9, eps: float = 1e-7,
                  beta1: float = 0.9, beta2: float = 0.999,
-                 dtype: str = "f32",
+                 dtype: str = "f32", wire: Optional[str] = None,
+                 error_feedback: bool = True,
                  connect_timeout_s: float = 10.0):
-        from hetu_tpu.ps.client import TABLE_DTYPES, _INIT_KINDS, _OPT_KINDS
+        from hetu_tpu.ps.client import (
+            TABLE_DTYPES, WIRE_DTYPES, _INIT_KINDS, _OPT_KINDS,
+            ErrorFeedback,
+        )
         self.rows, self.dim = rows, dim
         self.dtype = dtype
         self._dt = TABLE_DTYPES[dtype]
+        if wire is not None and wire not in WIRE_DTYPES:
+            raise ValueError(f"unknown wire dtype {wire!r}; expected one "
+                             f"of {sorted(WIRE_DTYPES)}")
+        self.wire = None if wire == "f32" else wire
+        self._wdt = WIRE_DTYPES[wire] if self.wire else 0
+        self._ef = ErrorFeedback(dim) if (
+            self.wire == "int8" and error_feedback) else None
         self.fd = _connect_with_deadline(host, port, connect_timeout_s)
         self.id = table_id if table_id is not None else _fresh_remote_id()
         if create:
@@ -264,6 +305,21 @@ class RemotePSTable:
     def ping(self) -> bool:
         return lib.ps_van_ping(self.fd) == 0
 
+    def _wire_unsupported(self) -> None:
+        """rc=-100 from a quantized op: the server predates the wire —
+        negotiate DOWN to the legacy f32 ops for the connection's life
+        (and count the downgrade, once, where an operator will see it)."""
+        from hetu_tpu.telemetry import default_registry as _reg
+        _reg.counter("van.wire_negotiation.fallbacks",
+                     help="quantized-wire clients downgraded to f32 by an "
+                          "old server").inc()
+        self.wire = None
+        self._ef = None
+
+    def _row_wire_bytes(self, n: int) -> int:
+        from hetu_tpu.quantwire import row_wire_bytes
+        return row_wire_bytes(self.wire, n, self.dim)
+
     def sparse_pull(self, indices) -> np.ndarray:
         idx = _as_idx(indices)
         out = np.empty((idx.shape[0], self.dim), np.float32)
@@ -277,6 +333,27 @@ class RemotePSTable:
     def sparse_push(self, indices, grads) -> None:
         idx = _as_idx(indices)
         g = _as_mat(grads, idx.shape[0], self.dim)
+        if self.wire:
+            logical = g.nbytes
+            if self._ef is not None:
+                g = self._ef.fold_sparse(idx, g)
+            rt = np.empty_like(g) if self._ef is not None else None
+            n = idx.shape[0]
+            try:
+                with _op_span("van_sparse_push", self._row_wire_bytes(n),
+                              logical_nbytes=logical):
+                    rc = lib.ps_van_sparse_push_w(
+                        self.fd, self.id, _i64p(idx), _f32p(g), n, self.dim,
+                        self._wdt, 0, None if rt is None else _f32p(rt))
+                    if rc == -100:
+                        raise _WireUnsupported
+                    _check(rc, "van_sparse_push_w")
+            except _WireUnsupported:
+                self._wire_unsupported()
+                return self.sparse_push(idx, g)
+            if self._ef is not None:
+                self._ef.absorb_sparse(idx, g, rt)
+            return
         with _op_span("van_sparse_push", g.nbytes):
             _check(lib.ps_van_sparse_push_dt(self.fd, self.id, _i64p(idx),
                                              _f32p(g), idx.shape[0],
@@ -285,6 +362,21 @@ class RemotePSTable:
 
     def dense_pull(self) -> np.ndarray:
         out = np.empty((self.rows, self.dim), np.float32)
+        if self.wire:
+            try:
+                with _op_span("van_dense_pull",
+                              self._row_wire_bytes(self.rows),
+                              logical_nbytes=out.nbytes):
+                    rc = lib.ps_van_dense_pull_w(
+                        self.fd, self.id, _f32p(out), self.rows, self.dim,
+                        self._wdt)
+                    if rc == -100:
+                        raise _WireUnsupported
+                    _check(rc, "van_dense_pull_w")
+            except _WireUnsupported:
+                self._wire_unsupported()
+                return self.dense_pull()
+            return out
         with _op_span("van_dense_pull", out.nbytes):
             _check(lib.ps_van_dense_pull(self.fd, self.id, _f32p(out),
                                          self.rows * self.dim),
@@ -293,6 +385,27 @@ class RemotePSTable:
 
     def dense_push(self, grad) -> None:
         g = _as_mat(grad, self.rows, self.dim)
+        if self.wire:
+            logical = g.nbytes
+            if self._ef is not None:
+                g = self._ef.fold_dense(g)
+            rt = np.empty_like(g) if self._ef is not None else None
+            try:
+                with _op_span("van_dense_push",
+                              self._row_wire_bytes(self.rows),
+                              logical_nbytes=logical):
+                    rc = lib.ps_van_dense_push_w(
+                        self.fd, self.id, _f32p(g), self.rows, self.dim,
+                        self._wdt, 0, None if rt is None else _f32p(rt))
+                    if rc == -100:
+                        raise _WireUnsupported
+                    _check(rc, "van_dense_push_w")
+            except _WireUnsupported:
+                self._wire_unsupported()
+                return self.dense_push(g)
+            if self._ef is not None:
+                self._ef.absorb_dense(g, rt)
+            return
         with _op_span("van_dense_push", g.nbytes):
             _check(lib.ps_van_dense_push(self.fd, self.id, _f32p(g),
                                          self.rows * self.dim),

@@ -1,0 +1,176 @@
+"""Shared quantized-wire layer: codecs + logical-vs-wire byte accounting.
+
+Hetu's three bandwidth-bound paths — PS gradient push-pull (`ps/van.py`),
+KV-cache migration (`serve/migrate.py`), and gradient allreduce
+(`parallel/collectives.quantized_psum`) — all move f32-logical tensors
+over a wire that does not need f32.  EQuARX (PAPERS.md, arXiv 2506.17615)
+shows the collective can quantize inside the compiled graph with
+negligible quality loss; the ZeRO line (arXiv 2004.13336) shows
+per-replica communication volume is the scaling ceiling.  This module is
+the one place the wire-dtype conventions live so the three paths cannot
+drift:
+
+* **wire dtypes** — ``"f32"`` (exact), ``"bf16"`` (2 B/elt, lossless-ish:
+  8 mantissa bits), ``"int8"`` (1 B/elt + one f32 scale per block/row,
+  lossy — gradient paths pair it with error feedback, see
+  ``ps.client.ErrorFeedback``);
+* **numpy block codec** — :func:`q8_encode_axes` / :func:`q8_decode_axes`
+  quantize a host array with one symmetric scale per block (the axes
+  REDUCED become the block), matching the csrc per-row scheme's NaN→0 /
+  ±Inf→±127 clamp;
+* **jax block codec** — :func:`jnp_block_encode` / :func:`jnp_block_decode`
+  for in-graph use (``quantized_psum`` stays inside jit so XLA fuses
+  quantize → collective → dequantize);
+* **byte accounting** — :func:`record_wire_bytes` feeds the shared
+  ``<path>.bytes_logical`` / ``<path>.bytes_wire`` (+ ``.bytes_saved``)
+  counter pair in ``telemetry.default_registry``, so a Prometheus
+  snapshot shows each compressed path's savings without diffing two runs.
+
+The csrc side of the same convention is ``hetu_ps_dtype.h`` (storage and
+van wire rows); its direct ABI (``ps_q8_encode``/``ps_q8_decode``) is
+wrapped by ``ps.client.q8_encode``/``q8_decode``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+WIRE_DTYPES = ("f32", "bf16", "int8")
+
+# wire codes shared with csrc (hetu_ps_van.cpp WireDtype / client TABLE_
+# DTYPES use the same numbering: f32=0, bf16=1, int8=2)
+WIRE_CODES = {"f32": 0, "bf16": 1, "int8": 2}
+
+
+def check_wire(wire: str) -> str:
+    if wire not in WIRE_DTYPES:
+        raise ValueError(f"unknown wire dtype {wire!r}; "
+                         f"expected one of {WIRE_DTYPES}")
+    return wire
+
+
+def row_wire_bytes(wire: str, n: int, dim: int) -> int:
+    """Wire bytes of ``n`` rows of ``dim`` elements in ``wire`` encoding —
+    the Python mirror of csrc ``wire_row_bytes`` (int8 carries one f32
+    scale per row)."""
+    if wire == "bf16":
+        return n * dim * 2
+    if wire == "int8":
+        return n * (dim + 4)
+    return n * dim * 4
+
+
+def block_wire_bytes(n_elems: int, wire: str, block: int) -> int:
+    """Wire bytes of ``n_elems`` flat elements in block-scaled ``wire``
+    encoding (one f32 scale per ``block`` elements, int8 only)."""
+    if wire == "bf16":
+        return n_elems * 2
+    if wire == "int8":
+        nblk = -(-max(n_elems, 1) // block)
+        return n_elems + nblk * 4
+    return n_elems * 4
+
+
+# ---------------------------------------------------------------------------
+# numpy block codec (host-side: KV migration payloads)
+# ---------------------------------------------------------------------------
+
+def q8_encode_axes(a, reduce_axes) -> tuple:
+    """Symmetric int8 quantization with one scale per block, where a block
+    is the set of elements sharing the non-``reduce_axes`` coordinates
+    (e.g. K/V ``[layers, tokens, heads, head_dim]`` with
+    ``reduce_axes=(1, 3)`` → one scale per (layer, head)).
+
+    Returns ``(q int8 same-shape, scales f32 keepdims-shape)``.  Clamp
+    semantics match the csrc codec: the scale sees only FINITE magnitudes,
+    NaN quantizes to 0, ±Inf saturates to ±127; an all-zero (or
+    all-nonfinite) block keeps scale 0 and decodes to exact zeros.
+    """
+    a32 = np.asarray(a, np.float32)
+    finite = np.isfinite(a32)
+    amax = np.max(np.abs(np.where(finite, a32, 0.0)), axis=reduce_axes,
+                  keepdims=True)
+    scale = (amax / 127.0).astype(np.float32)
+    inv = np.where(scale > 0, 1.0 / np.where(scale > 0, scale, 1.0), 0.0)
+    with np.errstate(invalid="ignore"):  # Inf * inv and NaN handled below
+        q = np.clip(np.rint(a32 * inv), -127, 127)
+        q = np.where(np.isnan(a32), 0.0, q)
+        q = np.where(np.isposinf(a32), 127.0, q)
+        q = np.where(np.isneginf(a32), -127.0, q)
+    return q.astype(np.int8), scale
+
+
+def q8_decode_axes(q, scales) -> np.ndarray:
+    """Inverse of :func:`q8_encode_axes` (f32 output; cast at the caller
+    if the logical dtype differs)."""
+    return q.astype(np.float32) * np.asarray(scales, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# jax block codec (in-graph: quantized collectives)
+# ---------------------------------------------------------------------------
+
+def jnp_block_encode(x, block: int):
+    """Flatten ``x``, pad to a multiple of ``block`` and quantize each
+    block to int8 with a symmetric f32 scale; returns ``(q [nblk, block]
+    int8, scales [nblk, 1] f32)``.  Pure jnp — traceable, fusable.
+
+    Same clamp semantics as the csrc/numpy codecs: the scale sees only
+    FINITE magnitudes, NaN quantizes to 0 and ±Inf saturates to ±127 —
+    without this, one non-finite element would zero (or poison) its
+    whole block, silently, where the exact f32 path would have surfaced
+    the NaN in the loss."""
+    import jax.numpy as jnp
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(-1, block)
+    finite = jnp.isfinite(blocks)
+    amax = jnp.max(jnp.abs(jnp.where(finite, blocks, 0.0)), axis=1,
+                   keepdims=True)
+    scale = amax / 127.0
+    inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0), 0.0)
+    q = jnp.clip(jnp.round(blocks * inv), -127, 127)
+    q = jnp.where(jnp.isnan(blocks), 0.0, q)
+    q = jnp.where(jnp.isposinf(blocks), 127.0, q)
+    q = jnp.where(jnp.isneginf(blocks), -127.0, q)
+    return q.astype(jnp.int8), scale
+
+
+def jnp_block_decode(q, scales, size: int, shape):
+    """Inverse of :func:`jnp_block_encode` back to ``shape`` (f32)."""
+    import jax.numpy as jnp
+    out = q.astype(jnp.float32) * scales
+    return out.reshape(-1)[:size].reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# shared logical-vs-wire byte accounting
+# ---------------------------------------------------------------------------
+
+_wire_metrics: dict = {}
+
+
+def record_wire_bytes(path: str, logical: int, wire: int) -> None:
+    """Fold one transfer into the shared counter pair
+    ``<path>.bytes_logical`` / ``<path>.bytes_wire`` (plus
+    ``<path>.bytes_saved`` = the nonnegative difference) in
+    ``telemetry.default_registry``.  Metric objects resolve once per path
+    — compressed pushes sit on training hot paths."""
+    m = _wire_metrics.get(path)
+    if m is None:
+        from hetu_tpu.telemetry import default_registry as reg
+        m = (reg.counter(f"{path}.bytes_logical",
+                         help="uncompressed (f32-logical) payload bytes"),
+             reg.counter(f"{path}.bytes_wire",
+                         help="bytes actually crossing the wire"),
+             reg.counter(f"{path}.bytes_saved",
+                         help="bytes the wire encoding avoided moving"))
+        _wire_metrics[path] = m
+    logical = int(logical)
+    wire = int(wire)
+    m[0].inc(logical)
+    m[1].inc(wire)
+    if logical > wire:
+        m[2].inc(logical - wire)

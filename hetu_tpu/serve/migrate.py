@@ -135,16 +135,95 @@ def request_from_record(rec: dict, *, now: float | None = None):
 # payload pack/unpack
 # ---------------------------------------------------------------------------
 
-def pack(spec, snapshots, records=()) -> bytes:
+CODECS = ("none", "bf16", "int8")
+
+
+def _encode_kv(arr: np.ndarray, codec: str, dt: np.dtype) -> bytes:
+    """One K or V array ``[layers, tokens, heads, head_dim]`` → body bytes.
+
+    ``bf16``: elementwise round (lossless when the model already runs
+    bf16 — the token-parity tier); ``int8``: block-scaled with one f32
+    scale per (layer, head) — scales prefix the codes, ~4x smaller than
+    f32 K/V at negligible per-token cost."""
+    if codec == "none":
+        return arr.tobytes()
+    if codec == "bf16":
+        return np.ascontiguousarray(
+            arr.astype(_np_dtype("bfloat16"))).tobytes()
+    if codec == "int8":
+        from hetu_tpu.quantwire import q8_encode_axes
+        q, scales = q8_encode_axes(arr, (1, 3))  # block = (layer, head)
+        return (np.ascontiguousarray(scales, np.float32).tobytes()
+                + np.ascontiguousarray(q).tobytes())
+    raise ValueError(f"unknown KV codec {codec!r}; expected one of {CODECS}")
+
+
+def _decode_kv(buf: memoryview, codec: str, dt: np.dtype,
+               shape_tail: tuple, slot: int, name: str) -> np.ndarray:
+    """Inverse of :func:`_encode_kv` back to the spec dtype; raises
+    :class:`MigrationError` naming the slot on any size mismatch."""
+    L, _, H, D = shape_tail
+    if codec == "none":
+        return np.frombuffer(buf, dt).reshape(shape_tail)
+    if codec == "bf16":
+        bf = _np_dtype("bfloat16")
+        return np.frombuffer(buf, bf).reshape(shape_tail).astype(dt)
+    if codec == "int8":
+        from hetu_tpu.quantwire import q8_decode_axes
+        scale_bytes = L * H * 4
+        if len(buf) < scale_bytes:
+            raise MigrationError(
+                f"slot {slot}: {name} compressed body shorter than its "
+                f"{L}x{H} block-scale table")
+        scales = np.frombuffer(buf[:scale_bytes],
+                               np.float32).reshape(L, 1, H, 1)
+        q = np.frombuffer(buf[scale_bytes:], np.int8).reshape(shape_tail)
+        return q8_decode_axes(q, scales).astype(dt)
+    raise MigrationError(f"payload names unknown KV codec {codec!r}; "
+                         f"this build speaks {CODECS}")
+
+
+def _encoded_tokens(nbytes: int, codec: str, dt: np.dtype, L: int, H: int,
+                    D: int) -> int:
+    """Token count implied by an encoded K/V byte length (-1: not a whole
+    number of tokens — corrupt meta)."""
+    if codec == "bf16":
+        per_tok = L * H * D * 2
+    elif codec == "int8":
+        nbytes -= L * H * 4  # block-scale prefix
+        per_tok = L * H * D
+    else:
+        per_tok = L * H * D * dt.itemsize
+    if nbytes < 0 or per_tok <= 0 or nbytes % per_tok:
+        return -1
+    return nbytes // per_tok
+
+
+def pack(spec, snapshots, records=(), *, codec: str = "none") -> bytes:
     """Serialize slot snapshots (+ optional request records) into one
     migration payload.  ``spec`` is the source cache's ``KVCacheSpec`` —
-    the receiver validates it against its own before touching a slot."""
+    the receiver validates it against its own before touching a slot.
+
+    ``codec`` compresses the K/V body ("bf16": 2 B/elt, lossless for
+    bf16-model caches; "int8": ~1 B/elt, block-scaled per (layer, head)).
+    The payload is self-describing — the header names the codec and the
+    body CRC covers the COMPRESSED bytes — so ``unpack`` needs no side
+    channel and an old payload (no codec field) still decodes as raw.
+    Logical-vs-wire bytes land on the shared ``serve.migrate.bytes_*``
+    telemetry counters."""
+    if codec not in CODECS:
+        raise ValueError(f"unknown KV codec {codec!r}; expected one of "
+                         f"{CODECS}")
     dt = np.dtype(spec.dtype)
     slots_meta = []
     blobs = []
+    logical = 0
     for s in snapshots:
-        kb = np.ascontiguousarray(s.k).tobytes()
-        vb = np.ascontiguousarray(s.v).tobytes()
+        k = np.ascontiguousarray(s.k)
+        v = np.ascontiguousarray(s.v)
+        logical += k.nbytes + v.nbytes
+        kb = _encode_kv(k, codec, dt)
+        vb = _encode_kv(v, codec, dt)
         slots_meta.append({"slot": int(s.slot), "length": int(s.length),
                            "meta": dict(s.meta),
                            "k_bytes": len(kb), "v_bytes": len(vb)})
@@ -153,6 +232,7 @@ def pack(spec, snapshots, records=()) -> bytes:
     body = b"".join(blobs)
     header = {
         "version": VERSION,
+        "codec": codec,
         "spec": {"num_layers": int(spec.num_layers),
                  "num_kv_heads": int(spec.num_kv_heads),
                  "head_dim": int(spec.head_dim),
@@ -162,6 +242,8 @@ def pack(spec, snapshots, records=()) -> bytes:
         "body_bytes": len(body),
         "body_crc": zlib.crc32(body),
     }
+    from hetu_tpu.quantwire import record_wire_bytes
+    record_wire_bytes("serve.migrate", logical, len(body))
     hb = json.dumps(header, separators=(",", ":")).encode()
     return _PAYLOAD_HDR.pack(MAGIC, VERSION, len(hb)) + hb + body
 
@@ -194,28 +276,43 @@ def unpack(payload: bytes):
         raise MigrationError("migration body CRC mismatch — refusing to "
                              "adopt any slot from a corrupt transfer")
     spec_d = header["spec"]
+    codec = header.get("codec", "none")  # pre-codec payloads: raw body
+    if codec not in CODECS:
+        raise MigrationError(f"payload names unknown KV codec {codec!r}; "
+                             f"this build speaks {CODECS}")
     dt = _np_dtype(spec_d["dtype"])
-    shape_tail = (int(spec_d["num_layers"]), -1,
-                  int(spec_d["num_kv_heads"]), int(spec_d["head_dim"]))
+    L = int(spec_d["num_layers"])
+    H = int(spec_d["num_kv_heads"])
+    D = int(spec_d["head_dim"])
     snaps = []
     pos = 0
+    bodyv = memoryview(body)
     for m in header["slots"]:
         kb, vb = int(m["k_bytes"]), int(m["v_bytes"])
         if pos + kb + vb > len(body):
             raise MigrationError("slot byte ranges overrun the body")
+        # token counts are derived from the ENCODED byte lengths before
+        # any frombuffer touches the body — a corrupt meta fails loudly,
+        # never reshapes garbage
+        nk = _encoded_tokens(kb, codec, dt, L, H, D)
+        nv = _encoded_tokens(vb, codec, dt, L, H, D)
+        if nk < 0 or nv < 0:
+            raise MigrationError(
+                f"slot {m['slot']}: K/V bytes do not factor into the "
+                f"declared geometry under codec {codec!r}")
         try:
-            k = np.frombuffer(body, dt, count=kb // dt.itemsize,
-                              offset=pos).reshape(shape_tail)
-            v = np.frombuffer(body, dt, count=vb // dt.itemsize,
-                              offset=pos + kb).reshape(shape_tail)
+            k = _decode_kv(bodyv[pos:pos + kb], codec, dt, (L, nk, H, D),
+                           int(m["slot"]), "k")
+            v = _decode_kv(bodyv[pos + kb:pos + kb + vb], codec, dt,
+                           (L, nv, H, D), int(m["slot"]), "v")
         except ValueError as e:
             raise MigrationError(
                 f"slot {m['slot']}: K/V bytes do not factor into the "
                 f"declared geometry ({e})") from None
         pos += kb + vb
-        if k.shape[1] != int(m["length"]) or v.shape[1] != int(m["length"]):
+        if nk != int(m["length"]) or nv != int(m["length"]):
             raise MigrationError(
-                f"slot {m['slot']}: {k.shape[1]} rows of K/V for a "
+                f"slot {m['slot']}: {nk} rows of K/V for a "
                 f"declared length of {m['length']}")
         snaps.append(KVSlotSnapshot(slot=int(m["slot"]),
                                     length=int(m["length"]),
@@ -314,7 +411,7 @@ def recv_payload(channel, *, seq0: int = 1,
 # orchestration
 # ---------------------------------------------------------------------------
 
-def migrate_inflight(src, dst, *, wire=None,
+def migrate_inflight(src, dst, *, wire=None, codec: str = "none",
                      chunk_bytes: int = DEFAULT_CHUNK_BYTES,
                      timeout_s: float = 60.0) -> dict:
     """Move EVERY in-flight request from scheduler ``src`` to scheduler
@@ -327,6 +424,13 @@ def migrate_inflight(src, dst, *, wire=None,
     blob puts block on the single-slot ack window); ``None`` hands the
     host arrays over directly (same-process fast path, identical
     validation via the engines).
+
+    ``codec`` ("bf16"/"int8", wire transfers only): compress the K/V
+    body — see :func:`pack`.  "bf16" keeps token parity for bf16-model
+    caches at half the bytes; "int8" is ~4x smaller (2x for bf16 caches)
+    with per-(layer, head) block scales, a near-lossless approximation
+    whose drain payloads move the migrate-vs-re-prefill crossover to
+    shorter contexts (``bench.py migrate --quant``).
 
     Failure atomicity: any error re-adopts the requests AND their slots
     at the source (the slots were never released) and re-raises —
@@ -344,7 +448,7 @@ def migrate_inflight(src, dst, *, wire=None,
     try:
         if wire is not None and snaps:
             spec = src.engine.cache.spec
-            payload = pack(spec, snaps)
+            payload = pack(spec, snaps, codec=codec)
             tx, rx = wire
             send_exc: list = []
             send_stop = threading.Event()

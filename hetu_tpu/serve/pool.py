@@ -167,6 +167,7 @@ class ServingPool:
                  health_poll_s: float = 0.05,
                  request_timeout_s: float = 60.0,
                  chunk_bytes: int = _migrate.DEFAULT_CHUNK_BYTES,
+                 migrate_codec: str = "none",
                  migrate_channel_base: int = MIGRATE_CHANNEL_BASE,
                  metrics: Optional[ServeMetrics] = None,
                  member_factory=None,
@@ -200,6 +201,13 @@ class ServingPool:
         self._max_loop_errors = int(max_loop_errors)
         self._failover_grace_s = float(failover_grace_s)
         self._chunk_bytes = int(chunk_bytes)
+        # wire codec for drain payloads ("bf16"/"int8", see migrate.pack);
+        # validated here so a typo fails at pool construction, not at the
+        # first drain under a preemption deadline
+        if migrate_codec not in _migrate.CODECS:
+            raise ValueError(f"unknown migrate_codec {migrate_codec!r}; "
+                             f"expected one of {_migrate.CODECS}")
+        self.migrate_codec = migrate_codec
         self._lock = threading.RLock()
         # see _MIG_SEQ: ids are drawn process-globally; the base is only
         # caller-assignable for pools in SEPARATE processes on one van
@@ -504,6 +512,7 @@ class ServingPool:
                         slot_map = _migrate.migrate_inflight(
                             m.scheduler, tgt.scheduler,
                             wire=tuple(chs) if chs else None,
+                            codec=self.migrate_codec,
                             chunk_bytes=self._chunk_bytes)
                         break
                     except _migrate.MigrationTargetError:

@@ -90,10 +90,25 @@ class Executor:
     def __init__(self, loss_fn: Callable, optimizer: Optional[Optimizer] = None,
                  *, mesh: Optional[Mesh] = None, dp_axis: str = AXIS_DP,
                  param_sharding=None, dist_strategy=None,
+                 grad_sync: object = "exact", grad_sync_block: int = 256,
                  seed: Optional[int] = None):
         """dist_strategy: a parallel.strategies.Strategy — init_state places
         params (and mirrored optimizer slots) per its specs, the reference's
-        `Executor(..., dist_strategy=...)` ergonomics."""
+        `Executor(..., dist_strategy=...)` ergonomics.
+
+        grad_sync selects how data-parallel gradients synchronize:
+        "exact" (default) leaves the psum to XLA/SPMD; "int8"/"bf16" run
+        the gradient allreduce through
+        ``parallel.collectives.quantized_psum`` (EQuARX-style block-scaled
+        wire) under an explicit shard_map over ``dp_axis`` — or pass a
+        callable ``path_str -> wire`` to choose PER PARAMETER (e.g. int8
+        for the bulky matmul weights, exact f32 for layernorm scales).
+        Quantized sync needs a mesh, a batch sharded on dim 0, and a
+        loss_fn that is per-shard pure (no cross-dp collectives of its
+        own — the executor owns the dp sync).  Wire-vs-logical bytes per
+        step land on the ``train.grad_sync.bytes_*`` telemetry counters;
+        ``grad_sync_block`` is the int8 block size (one f32 scale per
+        block)."""
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh
@@ -102,6 +117,27 @@ class Executor:
         self.dist_strategy = dist_strategy
         if dist_strategy is not None and mesh is None:
             raise ValueError("dist_strategy requires a mesh")
+        if isinstance(grad_sync, str) and grad_sync not in (
+                "exact", "f32", "bf16", "int8"):
+            raise ValueError(f"unknown grad_sync {grad_sync!r}; expected "
+                             f"'exact'/'f32'/'bf16'/'int8' or a callable")
+        self.grad_sync = grad_sync
+        self.grad_sync_block = int(grad_sync_block)
+        if self._quant_sync():
+            if mesh is None:
+                raise ValueError("quantized grad_sync requires a mesh")
+            if dist_strategy is not None or param_sharding is not None:
+                # _quant_grad_step's shard_map declares params replicated
+                # (in_specs=P()); running it over sharded params would
+                # all-gather the full parameter set on every device each
+                # step and, with check_rep off, silently produce wrong
+                # gradients for a loss_fn doing its own model-axis
+                # collectives — refuse loudly instead
+                raise ValueError(
+                    "quantized grad_sync supports replicated parameters "
+                    "only (plain data parallelism); it cannot combine "
+                    "with dist_strategy/param_sharding")
+        self._grad_sync_bytes = None  # (logical, wire) per step, lazy
         if seed is not None:
             hrng.set_random_seed(seed)
         # constant baked into the traced step: an elastic shrink at fixed
@@ -165,14 +201,84 @@ class Executor:
                 shard, dict) else state
         return state
 
+    # ---- quantized gradient sync (parallel/collectives.quantized_psum) --
+    def _quant_sync(self) -> bool:
+        return callable(self.grad_sync) or self.grad_sync in ("int8",
+                                                              "bf16")
+
+    def _wire_for(self, path_str: str) -> str:
+        gs = self.grad_sync
+        return gs(path_str) if callable(gs) else gs
+
+    def _quant_grad_step(self, state: TrainState, batch, step_rng):
+        """Per-shard grads + explicit quantized dp allreduce.
+
+        Under plain pjit the dp gradient psum belongs to XLA and cannot
+        be intercepted; shard_map makes the sync OURS: the loss runs on
+        each dp shard's local batch, then every gradient leaf crosses
+        the wire in its selected dtype (quantized_pmean) while loss and
+        float metrics pmean exactly.  check_rep=False: a quantized
+        allreduce is device-identical but not PROVABLY replicated to the
+        rep checker.
+
+        Reduction semantics vs the exact path (where loss_fn sees the
+        GLOBAL batch): float metrics pmean over dp, integer metrics
+        psum (count semantics — a per-shard correct-prediction count
+        sums to the global one); model_state floats pmean, model_state
+        non-floats are NOT reduced (shard 0's value wins) — per-call
+        counters there would double-count under a sum, so keep
+        non-float state per-shard-invariant when using quantized
+        grad_sync."""
+        from jax.tree_util import tree_map, tree_map_with_path
+
+        from hetu_tpu.parallel.collectives import (
+            quantized_pmean, shard_map,
+        )
+        dp = self.dp_axis
+        block = self.grad_sync_block
+
+        def local(params, model_state, batch, rng):
+            def lf(p):
+                return self.loss_fn(p, model_state, batch, rng, True)
+            (loss, (metrics, nms)), g = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            g = tree_map_with_path(
+                lambda pth, leaf: quantized_pmean(
+                    leaf, dp, wire=self._wire_for(jax.tree_util.keystr(pth)),
+                    block=block), g)
+
+            def red_metric(v):
+                dt = jnp.result_type(v)
+                if jnp.issubdtype(dt, jnp.inexact):
+                    return jax.lax.pmean(v, dp)
+                if jnp.issubdtype(dt, jnp.integer):
+                    return jax.lax.psum(v, dp)
+                return v
+            pm = lambda v: (jax.lax.pmean(v, dp)  # noqa: E731
+                            if jnp.issubdtype(jnp.result_type(v),
+                                              jnp.inexact) else v)
+            return (jax.lax.pmean(loss, dp), tree_map(red_metric, metrics),
+                    tree_map(pm, nms), g)
+
+        from jax.sharding import PartitionSpec as _P
+        f = shard_map(local, mesh=self.mesh,
+                      in_specs=(_P(), _P(), _P(dp), _P()),
+                      out_specs=(_P(), _P(), _P(), _P()),
+                      check_rep=False)
+        return f(state.params, state.model_state, batch, step_rng)
+
     # ---- step builders ----
     def _train_step(self, state: TrainState, batch):
         step_rng = jax.random.fold_in(state.rng, state.step)
         def lf(params):
             return self.loss_fn(params, state.model_state, batch, step_rng,
                                 True)
-        (loss, (metrics, new_model_state)), grads = jax.value_and_grad(
-            lf, has_aux=True)(state.params)
+        if self._quant_sync():
+            loss, metrics, new_model_state, grads = self._quant_grad_step(
+                state, batch, step_rng)
+        else:
+            (loss, (metrics, new_model_state)), grads = jax.value_and_grad(
+                lf, has_aux=True)(state.params)
         if self.grad_scale != 1.0:
             s = self.grad_scale
             grads = jax.tree_util.tree_map(lambda g: g * s, grads)
@@ -245,6 +351,8 @@ class Executor:
         if name not in self._compiled:
             trace.instant("train.compile", {"subexecutor": name})
             self._compiled[name] = self._compile(name)
+        if self._quant_sync() and name in ("train", "train_guarded"):
+            self._record_grad_sync_bytes(state)
         with trace.span("train.host_to_device"):
             batch = _device_batch(batch, self.mesh, self.dp_axis)
         sname = _STEP_SPAN.get(name)
@@ -259,6 +367,24 @@ class Executor:
                 # barrier — tracing off keeps the async pipeline.
                 jax.block_until_ready(out)
             return out
+
+    def _record_grad_sync_bytes(self, state: TrainState) -> None:
+        """Fold one step's gradient-sync traffic into the shared
+        ``train.grad_sync.bytes_logical``/``.bytes_wire`` counter pair.
+        Sizes are static per model, so they compute once; the per-step
+        cost is two counter increments."""
+        from hetu_tpu.quantwire import block_wire_bytes, record_wire_bytes
+        if self._grad_sync_bytes is None:
+            logical = wire = 0
+            for pth, leaf in jax.tree_util.tree_leaves_with_path(
+                    state.params):
+                w = self._wire_for(jax.tree_util.keystr(pth))
+                n = int(leaf.size)
+                logical += n * 4
+                wire += block_wire_bytes(
+                    n, "f32" if w == "exact" else w, self.grad_sync_block)
+            self._grad_sync_bytes = (logical, wire)
+        record_wire_bytes("train.grad_sync", *self._grad_sync_bytes)
 
     def save(self, path, state: TrainState, *, extra=None) -> None:
         """Reference-parity convenience (executor.py:558): checkpoint the

@@ -46,6 +46,8 @@ CASES = [
     ("ctr_serve", ["--steps", "40", "--requests", "16"], "ctr serve: OK"),
     ("resilient_train", ["--steps", "30"], "resilient train: OK"),
     ("elastic_train", ["--steps", "24"], "elastic train: OK"),
+    ("quant_train", ["--steps", "120", "--vocab", "500", "--batch", "64"],
+     "quant train: OK"),
 ]
 
 

@@ -259,6 +259,133 @@ def test_chunked_frames_roundtrip_and_crc_detection():
         mg.recv_payload(_ListChannel(bad2))
 
 
+# ---- wire compression (ISSUE 8: quantized KV migration codec) ----
+
+@pytest.fixture(scope="module")
+def gpt_bf16():
+    m = GPTModel(GPTConfig(
+        vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+        ffn_size=128, max_position=64, dropout_rate=0.0,
+        dtype=jnp.bfloat16))
+    return m, m.init(jax.random.PRNGKey(0))
+
+
+def test_bf16_model_pack_unpack_roundtrip(gpt_bf16):
+    """The `_np_dtype` ml_dtypes fallback (migrate.py:80): a bf16 cache's
+    dtype name round-trips through the JSON header and back to a numpy
+    dtype np.dtype() alone cannot resolve."""
+    model, variables = gpt_bf16
+    eng = _engine(model, variables)
+    slot = eng.alloc_slot()
+    eng.prefill(slot, [4, 5, 6, 7])
+    snaps = eng.export_slots([slot])
+    assert np.dtype(snaps[0].k.dtype) == mg._np_dtype("bfloat16")
+    payload = mg.pack(eng.cache.spec, snaps)
+    spec_d, snaps2, _ = mg.unpack(payload)
+    assert spec_d["dtype"] == "bfloat16"
+    (s,) = snaps2
+    np.testing.assert_array_equal(np.asarray(s.k), np.asarray(snaps[0].k))
+    np.testing.assert_array_equal(np.asarray(s.v), np.asarray(snaps[0].v))
+
+
+def test_int8_codec_shrinks_and_bounds_error(gpt):
+    model, variables = gpt
+    eng = _engine(model, variables)
+    slot = eng.alloc_slot()
+    eng.prefill(slot, list(range(1, 25)))
+    snaps = eng.export_slots([slot])
+    raw = mg.pack(eng.cache.spec, snaps)
+    packed = mg.pack(eng.cache.spec, snaps, codec="int8")
+    assert len(raw) >= 3 * len(packed)  # ~4x on an f32 cache
+    spec_d, snaps2, _ = mg.unpack(packed)
+    assert spec_d["dtype"] == "float32"
+    (s,) = snaps2
+    assert s.k.dtype == np.float32 and s.length == snaps[0].length
+    for a, b in ((snaps[0].k, s.k), (snaps[0].v, s.v)):
+        # per-(layer, head) block scale: |err| <= blockmax/254 per element
+        bound = np.max(np.abs(a), axis=(1, 3), keepdims=True) / 254 + 1e-7
+        assert np.all(np.abs(np.asarray(a) - np.asarray(b)) <= bound)
+    # the decoded snapshots adopt cleanly (dtype/geometry gates pass)
+    dst = _engine(model, variables)
+    slot_map = dst.adopt_slots(snaps2)
+    assert snaps[0].slot in slot_map
+
+
+def test_bf16_codec_token_parity_on_bf16_model(gpt_bf16):
+    """bf16 codec over a bf16 cache is bit-lossless: a request migrated
+    through the COMPRESSED payload decodes token-for-token identically
+    to one never migrated."""
+    model, variables = gpt_bf16
+    prompt = [3, 1, 4, 1, 5]
+    n_total, n_before = 10, 4
+    ref = _ref_greedy(model, variables, prompt, n_total)
+    src = _engine(model, variables)
+    dst = _engine(model, variables)
+    slot = src.alloc_slot()
+    toks = [src.prefill(slot, prompt)]
+    for _ in range(n_before - 1):
+        toks.append(src.decode()[slot])
+    payload = mg.pack(src.cache.spec, src.export_slots([slot]),
+                      codec="bf16")
+    spec_d, snaps, _ = mg.unpack(payload)
+    mg.check_spec(dst.cache.spec, spec_d)
+    slot_map = dst.adopt_slots(snaps)
+    new = slot_map[slot]
+    while len(toks) < n_total:
+        toks.append(dst.decode()[new])
+    assert toks == ref
+
+
+def test_corrupt_compressed_body_names_chunk(gpt):
+    """A compressed payload crossing the chunked wire with a flipped byte
+    fails with a MigrationError NAMING the offending chunk — and nothing
+    decodes (the whole-body CRC also refuses the direct-unpack path)."""
+    model, variables = gpt
+    eng = _engine(model, variables)
+    slot = eng.alloc_slot()
+    eng.prefill(slot, list(range(1, 30)))
+    payload = mg.pack(eng.cache.spec, eng.export_slots([slot]),
+                      codec="int8")
+    store: dict = {}
+    mg.send_payload(_ListChannel(store), payload, chunk_bytes=2048)
+    assert len(store) >= 3
+    bad = dict(store)
+    frame = bytearray(bad[3])
+    frame[-1] ^= 0x40
+    bad[3] = bytes(frame)
+    with pytest.raises(MigrationError, match="chunk 2 CRC mismatch"):
+        mg.recv_payload(_ListChannel(bad))
+    # same corruption surviving to unpack (e.g. a bad disk copy): the
+    # body CRC still refuses it before any snapshot is built
+    corrupt = bytearray(payload)
+    corrupt[-1] ^= 0x40
+    with pytest.raises(MigrationError, match="CRC"):
+        mg.unpack(bytes(corrupt))
+
+
+def test_unknown_codec_rejected_both_ways(gpt):
+    model, variables = gpt
+    eng = _engine(model, variables)
+    slot = eng.alloc_slot()
+    eng.prefill(slot, [1, 2, 3])
+    snaps = eng.export_slots([slot])
+    with pytest.raises(ValueError, match="codec"):
+        mg.pack(eng.cache.spec, snaps, codec="zstd")
+    # a payload CLAIMING a codec this build does not speak errors loudly
+    # (self-describing header, validate-first)
+    payload = mg.pack(eng.cache.spec, snaps)
+    import json as _json
+    magic, ver, hlen = mg._PAYLOAD_HDR.unpack_from(payload)
+    off = mg._PAYLOAD_HDR.size
+    hdr = _json.loads(payload[off:off + hlen])
+    hdr["codec"] = "zstd"
+    hb = _json.dumps(hdr, separators=(",", ":")).encode()
+    tampered = mg._PAYLOAD_HDR.pack(magic, ver, len(hb)) + hb + \
+        payload[off + hlen:]
+    with pytest.raises(MigrationError, match="unknown KV codec"):
+        mg.unpack(tampered)
+
+
 # ---- scheduler hand-off ----
 
 def test_scheduler_migration_mid_decode_parity(gpt):
