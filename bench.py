@@ -1546,6 +1546,189 @@ def _enable_compile_cache():
         pass  # read-only checkout / older jax: cache is best-effort
 
 
+def bench_crosshost():
+    """Cross-process serving control plane: what the process boundary
+    costs, and how fast a real member-process SIGKILL is detected and
+    recovered.
+
+    Arm A (baseline): the in-process ``ServingPool`` drain — live KV
+    slots hand over between two engines in ONE process (wire-framed but
+    loopback-local, shared objects for the requests).  Arm B: the
+    ``CrossProcessServingPool`` drain — same model, same in-flight load,
+    but source and target are separate OS processes and BOTH the KV
+    payload and the request records cross the van as chunked CRC frames,
+    two-phase-committed.  The ratio is the price of a real process
+    boundary on the preemption path.
+
+    Then the unplanned path: seeded ``member_kill`` faults SIGKILL a
+    member process under load; the timeline pairs each ``fault.
+    member_kill`` with its ``serve.failover`` span, yielding
+    detect/recover percentiles for LEASE-based (heartbeat-timeout)
+    death detection — the number an operator tunes ``lease_s`` /
+    ``suspect_grace_s`` against.  Member processes are pinned to CPU
+    (``member_env``) so an accelerator box's chip stays with the
+    controller; both arms serve the same CPU-side model, so the ratio
+    compares control planes, not devices.
+    """
+    import os
+    import tempfile
+    import threading
+
+    from hetu_tpu.models.gpt import GPTConfig, GPTModel
+    from hetu_tpu.resilience.faults import (
+        FaultEvent, FaultInjector, FaultSchedule,
+    )
+    from hetu_tpu.serve import ServeEngine, ServingPool
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+    from hetu_tpu.serve.scheduler import Request
+    from hetu_tpu.telemetry import timeline, trace
+
+    smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
+    if smoke:
+        H, L, MAXLEN, N_REQ, GEN, DRAIN_REPS, KILLS = 64, 2, 64, 6, 24, 2, 2
+    else:
+        H, L, MAXLEN, N_REQ, GEN, DRAIN_REPS, KILLS = 128, 4, 128, 8, 48, 3, 3
+    model_spec = {"vocab_size": 256, "hidden_size": H, "num_layers": L,
+                  "num_heads": 4, "ffn_size": 4 * H,
+                  "max_position": MAXLEN, "num_slots": N_REQ,
+                  "max_len": MAXLEN, "min_bucket": 8, "seed": 0}
+    LEASE_S, GRACE_S = 0.4, 0.3
+    prompts = [[(7 * i) % 251 + 1, (3 * i) % 251 + 1, 5]
+               for i in range(N_REQ)]
+
+    # ---- arm A: in-process drain ----
+    model = GPTModel(GPTConfig(
+        vocab_size=256, hidden_size=H, num_layers=L, num_heads=4,
+        ffn_size=4 * H, max_position=MAXLEN, dropout_rate=0.0))
+    variables = model.init(jax.random.PRNGKey(0))
+
+    def factory():
+        return ServeEngine(model, variables, num_slots=N_REQ,
+                           max_len=MAXLEN, min_bucket=8)
+
+    inproc_s = []
+    pool = ServingPool({"a": factory, "b": factory}, start_poll=False)
+    try:
+        names = ["a", "b"]
+        for rep in range(DRAIN_REPS):
+            src = names[rep % 2]
+            reqs = [Request(prompt=list(p), max_tokens=GEN,
+                            timeout_s=300.0) for p in prompts]
+            for r in reqs:
+                pool.members[src].scheduler.submit(r)
+            deadline = time.monotonic() + 60
+            while not all(r.tokens for r in reqs):
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            t0 = time.perf_counter()
+            pool.drain_member(src)
+            inproc_s.append(time.perf_counter() - t0)
+            for r in reqs:
+                assert r.done.wait(120) and r.status == "ok"
+            pool.revive_member(src)
+    finally:
+        pool.close()
+
+    # ---- arm B: cross-process drain + seeded member kills ----
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    cross_s = []
+    with tempfile.TemporaryDirectory(prefix="bench_crosshost_") as wd:
+        xpool = CrossProcessServingPool(
+            2, workdir=wd, model=model_spec, lease_s=LEASE_S,
+            suspect_grace_s=GRACE_S, request_timeout_s=300.0,
+            member_env={"JAX_PLATFORMS": "cpu"})
+        try:
+            def load(n_tokens):
+                results = {}
+
+                def worker(i):
+                    results[i] = xpool.generate(
+                        prompts[i], max_tokens=n_tokens, timeout_s=300.0)
+                ts = [threading.Thread(target=worker, args=(i,))
+                      for i in range(N_REQ)]
+                for t in ts:
+                    t.start()
+                return results, ts
+
+            for rep in range(DRAIN_REPS):
+                results, ts = load(GEN)
+                src = None
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    src = max(range(2),
+                              key=lambda s: xpool._inflight.get(s, 0))
+                    if xpool._inflight.get(src, 0) >= N_REQ // 2:
+                        break
+                    time.sleep(0.005)
+                t0 = time.perf_counter()
+                xpool.drain_member(src, close=True)
+                cross_s.append(time.perf_counter() - t0)
+                for t in ts:
+                    t.join(300)
+                # a request whose thread is STILL stuck after the join
+                # timeout never wrote its result — len() catches exactly
+                # the hung-request failure this bench exists to surface
+                assert len(results) == N_REQ, sorted(results)
+                assert all(r["status"] == "ok"
+                           for r in results.values()), results
+                xpool.revive_member(src)
+
+            schedule = FaultSchedule([FaultEvent(k + 1, "member_kill",
+                                                 float(k % 2))
+                                      for k in range(KILLS)])
+            inj = FaultInjector(schedule, member_procs=xpool.procs)
+            for k in range(KILLS):
+                results, ts = load(GEN)
+                time.sleep(0.1)
+                inj.on_step(k + 1)
+                for t in ts:
+                    t.join(300)
+                assert len(results) == N_REQ, sorted(results)
+                assert all(r["status"] == "ok"
+                           for r in results.values()), results
+                deadline = time.monotonic() + 30
+                while xpool.metrics.count("pool_failovers") < k + 1 and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.02)
+                dead = next(s for s in range(2)
+                            if xpool.procs[s].poll() is not None)
+                xpool.revive_member(dead)
+        finally:
+            xpool.close()
+            trace.disable()
+
+    pairs = [p for p in timeline.correlate(tracer.events)
+             if p.kind == "member_kill"]
+    assert pairs and all(p.paired for p in pairs), pairs
+    detect = sorted(p.detect_s for p in pairs)
+    recover = sorted(p.recover_s for p in pairs)
+
+    def pct(xs, q):
+        return xs[min(int(q * len(xs)), len(xs) - 1)]
+
+    in_p50 = sorted(inproc_s)[len(inproc_s) // 2]
+    x_p50 = sorted(cross_s)[len(cross_s) // 2]
+    _emit({
+        "metric": "crosshost_drain_overhead_x",
+        "value": round(x_p50 / in_p50, 3),
+        "unit": "x_vs_in_process_drain_p50",
+        "extra": {
+            "inproc_drain_s": [round(t, 4) for t in sorted(inproc_s)],
+            "cross_drain_s": [round(t, 4) for t in sorted(cross_s)],
+            "kill_detect_s": {"p50": round(pct(detect, 0.5), 3),
+                              "p99": round(pct(detect, 0.99), 3)},
+            "kill_recover_s": {"p50": round(pct(recover, 0.5), 3),
+                               "p99": round(pct(recover, 0.99), 3)},
+            "kills": len(pairs),
+            "lease_s": LEASE_S, "suspect_grace_s": GRACE_S,
+            "requests_per_round": N_REQ, "gen_tokens": GEN,
+            "members_on": "cpu (member_env pins member processes off "
+                          "the controller's backend)",
+        },
+    })
+
+
 _METRIC_BY_CMD = {
     "gpt": "gpt2s_bf16_train_mfu_1chip",
     "gpt_sweep": "gpt_config_sweep_best_mfu_1chip",
@@ -1559,6 +1742,7 @@ _METRIC_BY_CMD = {
     "resilience": "resilience_supervisor_overhead_pct",
     "elastic": "elastic_supervisor_overhead_pct",
     "telemetry": "telemetry_tracing_overhead_pct",
+    "crosshost": "crosshost_drain_overhead_x",
 }
 
 
@@ -1598,6 +1782,7 @@ def main():
      "quant": bench_quant,
      "resilience": bench_resilience,
      "elastic": bench_elastic,
+     "crosshost": bench_crosshost,
      "telemetry": bench_telemetry}.get(cmd, bench_gpt)()
 
 

@@ -84,6 +84,38 @@ def initialize_from_env() -> None:
         process_id=int(os.environ["HETU_TPU_PROCESS_ID"]))
 
 
+def local_env(*, extra: Optional[dict] = None,
+              cpu_devices: Optional[int] = None) -> dict:
+    """Environment for a locally spawned process: the caller's env plus
+    ``extra``, optionally forced onto ``cpu_devices`` virtual CPU
+    devices (the local multi-process test mode — each process gets its
+    own XLA:CPU world, the jax.distributed-per-host analog)."""
+    env = {**os.environ, **{k: str(v) for k, v in (extra or {}).items()}}
+    if cpu_devices:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{cpu_devices}").strip()
+    return env
+
+
+def spawn_local(argv: List[str], *, extra_env: Optional[dict] = None,
+                cpu_devices: Optional[int] = None,
+                stdout=None, stderr=None) -> subprocess.Popen:
+    """The ONE local process-spawn primitive: used by :func:`launch` for
+    localhost nodes and by the cross-process harnesses
+    (``resilience/shardproc.py`` → serving members, training workers).
+    Sets ``PYTHONPATH`` to this repo so ``python -m hetu_tpu.*`` entry
+    points resolve without an install."""
+    repo = str(Path(__file__).resolve().parents[1])
+    env = local_env(extra=extra_env, cpu_devices=cpu_devices)
+    path = env.get("PYTHONPATH", "")
+    if repo not in path.split(os.pathsep):
+        env["PYTHONPATH"] = repo + (os.pathsep + path if path else "")
+    return subprocess.Popen(list(argv), env=env, stdout=stdout,
+                            stderr=stderr)
+
+
 def launch(config: DistConfig, argv: List[str], *,
            local_devices_per_proc: Optional[int] = None,
            dry_run: bool = False) -> int:
@@ -93,12 +125,6 @@ def launch(config: DistConfig, argv: List[str], *,
     procs = []
     cmds = []
     for pid, node in enumerate(config.nodes or [NodeSpec("localhost")]):
-        env = {**os.environ, **config.env_for(pid)}
-        if local_devices_per_proc:
-            env["JAX_PLATFORMS"] = "cpu"
-            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
-                                f" --xla_force_host_platform_device_count="
-                                f"{local_devices_per_proc}").strip()
         if node.host in ("localhost", "127.0.0.1"):
             cmd = list(argv)
         else:
@@ -107,7 +133,9 @@ def launch(config: DistConfig, argv: List[str], *,
             cmd = ["ssh", node.host, f"{exports} {' '.join(argv)}"]
         cmds.append(cmd)
         if not dry_run:
-            procs.append(subprocess.Popen(cmd, env=env))
+            procs.append(spawn_local(
+                cmd, extra_env=config.env_for(pid),
+                cpu_devices=local_devices_per_proc))
     if dry_run:
         for c in cmds:
             print(" ".join(c))

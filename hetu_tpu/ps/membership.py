@@ -1,0 +1,367 @@
+"""Cluster membership over the van wire: join / heartbeat / lease.
+
+The cross-process control plane both deployment tiers share (arXiv
+2412.14374's multi-controller coordination over DCN, scaled to this
+repo's van): serving-pool member processes and elastic training worker
+processes each own a SLOT in a small f32 "blackboard" table on the van
+server, heartbeat into their row, and read a controller-written CONTROL
+row back.  The controller never talks to a member directly to learn
+liveness — it watches beats advance and runs a lease state machine:
+
+``alive`` --lease_s without a beat--> ``suspect`` --suspect_grace_s
+more--> ``lost``; a beat landing while ``suspect`` CLEARS the suspicion
+(the member was partitioned/SIGSTOPped, not dead — this is the state
+that keeps a paused process from being double-counted as
+lost-then-rejoined), and a beat carrying a NEW incarnation after
+``lost``/``left`` is a rejoin.
+
+Why a table and not new csrc ops: the blackboard needs exactly the
+sparse_set/sparse_pull semantics the van already ships — idempotent
+row writes, reads of any subset, survives client reconnects — so the
+membership plane is ordinary wire traffic (visible in ``van.*``
+telemetry, injectable by the chaos van hook) rather than a parallel
+protocol.  All values are small integers, exact in f32 up to 2**24.
+
+Row layout (``MEMBER_DIM`` f32 fields per member slot)::
+
+    0 incarnation  random nonzero id per process lifetime (0 = empty)
+    1 beat         monotonically increasing heartbeat counter
+    2 flag         0 = left (clean exit), 1 = serving/training
+    3 load         workload-defined load signal (routing hint)
+    4 healthy      0/1: the member's own engine/loop health
+    5 committed    workload-defined progress (training: last committed step)
+    6 epoch_ack    last control epoch this member has acted on
+    7 pid          OS pid (debugging only; never trusted for liveness)
+
+The CONTROL row (slot ``n_slots``) is controller-written, member-read::
+
+    0 epoch  1 width  2 alive_mask  3 resume_step  4 phase  5.. unused
+
+``phase`` makes epoch transitions two-phase (the freeze the
+multi-controller trainer needs): ``1`` = PREPARE — members stop taking
+new steps at their next step boundary and ack the epoch with their
+frozen progress; once every present member acked, the controller
+publishes the same epoch with ``phase=0`` and an exact ``resume_step``
+computed from the frozen (no longer racing) progress values.
+
+Every wire op here goes through :func:`control_rpc` — bounded retries
+with exponential backoff and jittered deadlines — because membership is
+exactly the traffic that must survive a transiently overloaded van.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+MEMBER_DIM = 8
+# base blackboard table ids ('MEMB' / 'WKRS') — controllers normally
+# draw a FRESH id (fresh_table_id) and hand it to member processes via
+# their spawn config: the native table registry outlives van.stop(), so
+# a fixed id would collide with a previous pool's blackboard in any
+# process that builds two pools (tests, notebooks)
+SERVE_MEMBERSHIP_TABLE = 0x4D454D42
+TRAIN_MEMBERSHIP_TABLE = 0x574B5253
+
+
+def fresh_table_id() -> int:
+    """A unique van table id (the RemotePSTable convention — random
+    30-bit band, cross-process collision negligible)."""
+    from hetu_tpu.ps.van import fresh_table_id as _fresh
+    return _fresh()
+
+F_INCARNATION, F_BEAT, F_FLAG, F_LOAD = 0, 1, 2, 3
+F_HEALTHY, F_COMMITTED, F_EPOCH_ACK, F_PID = 4, 5, 6, 7
+C_EPOCH, C_WIDTH, C_MASK, C_RESUME, C_PHASE = 0, 1, 2, 3, 4
+
+
+def fresh_incarnation() -> int:
+    """Random nonzero 20-bit id — exact in f32, negligible collision odds
+    across the handful of processes sharing one blackboard."""
+    return 1 + int.from_bytes(os.urandom(3), "little") % ((1 << 20) - 1)
+
+
+def control_rpc(fn: Callable, *, attempts: int = 4, base_s: float = 0.05,
+                max_s: float = 1.0, rng: Optional[random.Random] = None,
+                is_transient: Optional[Callable] = None):
+    """Run one control-plane wire op with bounded retry + exponential
+    backoff + jittered deadlines.  Membership traffic shares the van with
+    bulk KV/gradient transfers, so a transiently saturated (or
+    fault-injected) wire must cost a retry, not a false loss decision —
+    while real bugs (non-transient errors) surface immediately."""
+    if is_transient is None:
+        from hetu_tpu.resilience.supervisor import default_is_transient
+        is_transient = default_is_transient
+    rng = rng if rng is not None else random
+    delay = base_s
+    for attempt in range(max(int(attempts), 1)):
+        try:
+            return fn()
+        except Exception as e:
+            if attempt + 1 >= attempts or not is_transient(e):
+                raise
+            # full jitter: desynchronize N members retrying against the
+            # same recovering van (a fixed backoff would re-stampede it)
+            time.sleep(rng.uniform(0.0, min(delay, max_s)))
+            delay *= 2.0
+
+
+def create_blackboard(host: str, port: int, *, table_id: int,
+                      n_slots: int, connect_timeout_s: float = 10.0):
+    """Controller side: create (or re-attach to) the membership table.
+    ``n_slots`` member rows + 1 control row, zero-initialized; plain SGD
+    so ``sparse_set`` writes rows verbatim."""
+    from hetu_tpu.ps.van import RemotePSTable
+    return RemotePSTable(host, port, n_slots + 1, MEMBER_DIM,
+                         table_id=table_id, create=True, init="zeros",
+                         optimizer="sgd", lr=0.0,
+                         connect_timeout_s=connect_timeout_s)
+
+
+def attach_blackboard(host: str, port: int, *, table_id: int,
+                      n_slots: int, connect_timeout_s: float = 10.0):
+    """Member side: attach to the controller-created table (no create —
+    a member racing the controller must fail loudly, not fork the id)."""
+    from hetu_tpu.ps.van import RemotePSTable
+    return RemotePSTable(host, port, n_slots + 1, MEMBER_DIM,
+                         table_id=table_id, create=False,
+                         connect_timeout_s=connect_timeout_s)
+
+
+class MembershipClient:
+    """A member process's handle on the blackboard: join once, then
+    heartbeat on a cadence; ``read_control`` returns the controller's
+    decided ``(epoch, width, alive_mask, resume_step)``."""
+
+    def __init__(self, host: str, port: int, *, table_id: int, slot: int,
+                 n_slots: int, incarnation: Optional[int] = None,
+                 connect_timeout_s: float = 10.0):
+        if not 0 <= int(slot) < int(n_slots):
+            raise ValueError(f"slot {slot} outside [0, {n_slots})")
+        self.slot = int(slot)
+        self.n_slots = int(n_slots)
+        self.incarnation = int(incarnation) if incarnation else \
+            fresh_incarnation()
+        self.beat = 0
+        self._table = attach_blackboard(host, port, table_id=table_id,
+                                        n_slots=n_slots,
+                                        connect_timeout_s=connect_timeout_s)
+        self._rng = random.Random((self.incarnation, self.slot))
+        # last-written workload fields: a later write that doesn't name a
+        # field must NOT zero it (leave() clobbering `committed` would
+        # erase the very progress record the controller reads post-exit)
+        self._last = {"load": 0.0, "healthy": 1.0, "committed": 0.0,
+                      "epoch_ack": 0.0}
+
+    def _bump_beat(self) -> None:
+        # wrap WELL below 2**24: the row is f32, and a beat counter that
+        # saturates (float32(2**24+1) == 2**24) would stop "advancing" —
+        # a healthy 15-days-uptime member would be declared lost.  The
+        # service only compares beats for INEQUALITY, so wrapping is safe
+        self.beat = (self.beat + 1) % (1 << 20)
+
+    def _write_row(self, flag: float, **fields) -> None:
+        self._last.update({k: float(v) for k, v in fields.items()})
+        row = np.zeros((1, MEMBER_DIM), np.float32)
+        row[0, F_INCARNATION] = self.incarnation
+        row[0, F_BEAT] = self.beat
+        row[0, F_FLAG] = flag
+        row[0, F_LOAD] = self._last["load"]
+        row[0, F_HEALTHY] = self._last["healthy"]
+        row[0, F_COMMITTED] = self._last["committed"]
+        row[0, F_EPOCH_ACK] = self._last["epoch_ack"]
+        row[0, F_PID] = os.getpid() % (1 << 24)
+        control_rpc(lambda: self._table.sparse_set([self.slot], row),
+                    rng=self._rng)
+
+    def join(self, **fields) -> int:
+        """Claim the slot with this process's incarnation; returns it."""
+        self._bump_beat()
+        self._write_row(1.0, **fields)
+        return self.incarnation
+
+    def heartbeat(self, *, healthy: bool = True, **fields) -> None:
+        self._bump_beat()
+        self._write_row(1.0, healthy=1.0 if healthy else 0.0, **fields)
+
+    def leave(self) -> None:
+        """Clean exit (planned drain / normal shutdown): the controller
+        must not grieve a member that said goodbye.  The workload fields
+        keep their last written values — a finished worker's committed
+        step survives its departure."""
+        self._bump_beat()
+        self._write_row(0.0)
+
+    def read_control(self) -> tuple:
+        """``(epoch, width, alive_mask, resume_step, phase)`` as ints."""
+        row = control_rpc(
+            lambda: self._table.sparse_pull([self.n_slots]), rng=self._rng)
+        return (int(row[0, C_EPOCH]), int(row[0, C_WIDTH]),
+                int(row[0, C_MASK]), int(row[0, C_RESUME]),
+                int(row[0, C_PHASE]))
+
+    def close(self) -> None:
+        self._table.close()
+
+
+@dataclass
+class MemberState:
+    """Controller-side view of one slot."""
+
+    slot: int
+    state: str = "empty"          # empty|alive|suspect|lost|left
+    incarnation: int = 0
+    beat: int = -1
+    last_advance: float = 0.0     # monotonic ts of the last beat advance
+    suspect_since: Optional[float] = None
+    row: np.ndarray = field(default_factory=lambda: np.zeros(
+        MEMBER_DIM, np.float32))
+
+    @property
+    def load(self) -> float:
+        return float(self.row[F_LOAD])
+
+    @property
+    def healthy(self) -> bool:
+        return bool(self.row[F_HEALTHY])
+
+    @property
+    def committed(self) -> int:
+        return int(self.row[F_COMMITTED])
+
+    @property
+    def epoch_ack(self) -> int:
+        return int(self.row[F_EPOCH_ACK])
+
+
+class MembershipService:
+    """Controller-side lease machine over the blackboard.
+
+    :meth:`poll` pulls every member row and returns membership EVENTS in
+    slot order: ``("join"|"rejoin"|"suspect"|"clear"|"lost"|"left",
+    slot)``.  The caller (a serving pool controller or the
+    multi-controller training supervisor) decides what each event means —
+    the service only decides WHEN a silence becomes a loss:
+
+    * no beat advance for ``lease_s``      → ``suspect`` (stop routing
+      new work to it, but its state is presumed intact);
+    * ``suspect_grace_s`` more of silence  → ``lost`` (failover/reshard);
+    * a beat while ``suspect``             → ``clear`` — the member was
+      paused or partitioned, NOT dead, and must not be double-counted
+      as a loss followed by a rejoin (the chaos acceptance invariant);
+    * ``flag=0``                           → ``left`` (clean exit, never
+      grieved);
+    * a NEW incarnation in a ``lost``/``left``/``empty`` slot → ``join``
+      / ``rejoin``; in a live slot, the process restarted faster than
+      one poll — surfaced honestly as ``lost`` then ``rejoin``.
+    """
+
+    def __init__(self, table, n_slots: int, *, lease_s: float = 1.0,
+                 suspect_grace_s: float = 1.0):
+        self.table = table
+        self.n_slots = int(n_slots)
+        self.lease_s = float(lease_s)
+        self.suspect_grace_s = float(suspect_grace_s)
+        self.members = [MemberState(slot=i) for i in range(self.n_slots)]
+        self._rng = random.Random(0x4C454153)
+
+    # ---- controller → members ----
+    def publish_control(self, *, epoch: int, width: int, alive_mask: int,
+                        resume_step: int = 0, phase: int = 0) -> None:
+        row = np.zeros((1, MEMBER_DIM), np.float32)
+        row[0, C_EPOCH] = int(epoch)
+        row[0, C_WIDTH] = int(width)
+        row[0, C_MASK] = int(alive_mask)
+        row[0, C_RESUME] = int(resume_step)
+        row[0, C_PHASE] = int(phase)
+        control_rpc(lambda: self.table.sparse_set([self.n_slots], row),
+                    rng=self._rng)
+
+    # ---- members → controller ----
+    def poll(self) -> list:
+        rows = control_rpc(
+            lambda: self.table.sparse_pull(list(range(self.n_slots))),
+            rng=self._rng)
+        now = time.monotonic()
+        events = []
+        for m in self.members:
+            row = rows[m.slot]
+            inc, beat = int(row[F_INCARNATION]), int(row[F_BEAT])
+            flag = int(row[F_FLAG])
+            m.row = row
+            if inc == 0:
+                continue  # slot never claimed
+            if inc != m.incarnation:
+                # a different process lifetime now owns the slot
+                if m.state in ("alive", "suspect"):
+                    events.append(("lost", m.slot))
+                    events.append(("rejoin", m.slot))
+                else:
+                    events.append(
+                        ("rejoin" if m.state in ("lost", "left") else
+                         "join", m.slot))
+                m.incarnation, m.beat = inc, beat
+                m.last_advance = now
+                m.suspect_since = None
+                m.state = "alive"
+                continue
+            if flag == 0:
+                if m.state in ("alive", "suspect"):
+                    events.append(("left", m.slot))
+                    m.state = "left"
+                    m.suspect_since = None
+                continue
+            if m.state in ("lost", "left"):
+                # same incarnation resurfacing after we already declared
+                # it: its old lease is void — only a NEW incarnation (a
+                # restarted process) re-admits the slot.  Keeps a
+                # zombie's stale beats from flapping the fleet.
+                continue
+            if beat != m.beat:
+                m.beat = beat
+                m.last_advance = now
+                if m.state == "suspect":
+                    events.append(("clear", m.slot))
+                m.state = "alive"
+                m.suspect_since = None
+            elif m.state == "alive" and now - m.last_advance > self.lease_s:
+                m.state = "suspect"
+                m.suspect_since = now
+                events.append(("suspect", m.slot))
+            elif m.state == "suspect" and \
+                    now - m.suspect_since > self.suspect_grace_s:
+                m.state = "lost"
+                events.append(("lost", m.slot))
+        return events
+
+    # ---- views ----
+    def alive_slots(self) -> list:
+        """Slots currently usable for routing/placement: alive AND not
+        suspect (a suspected member gets no NEW work until it clears)."""
+        return [m.slot for m in self.members if m.state == "alive"]
+
+    def present_slots(self) -> list:
+        """Alive + suspect — membership that has not been declared lost
+        (a suspect still counts toward the mesh until its grace runs
+        out; kicking it early is exactly the double-count bug)."""
+        return [m.slot for m in self.members
+                if m.state in ("alive", "suspect")]
+
+    def state_of(self, slot: int) -> MemberState:
+        return self.members[int(slot)]
+
+    @staticmethod
+    def mask_of(slots) -> int:
+        mask = 0
+        for s in slots:
+            mask |= 1 << int(s)
+        return mask
+
+    @staticmethod
+    def slots_of(mask: int) -> list:
+        return [i for i in range(24) if int(mask) & (1 << i)]

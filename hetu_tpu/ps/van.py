@@ -29,6 +29,15 @@ def _fresh_remote_id() -> int:
     return (1 << 24) + int.from_bytes(os.urandom(3), "little")
 
 
+# public alias: anything allocating a shared van table id outside
+# RemotePSTable (the membership blackboards of ps/membership.py, tests)
+# must draw from the same collision-avoiding band.  NOTE the native
+# table registry outlives stop()/serve() cycles within one process —
+# fixed ids collide on re-create, which is exactly why callers draw
+# fresh ones.
+fresh_table_id = _fresh_remote_id
+
+
 # All deadline arithmetic in this module uses time.monotonic(): wall-clock
 # (time.time) jumps — NTP slew, manual resets, VM suspend/resume — must not
 # spuriously expire or indefinitely extend transport timeouts.  The native

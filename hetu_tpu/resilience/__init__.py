@@ -1,9 +1,13 @@
 """Fault-tolerant training runtime: deterministic chaos harness +
 supervisor (checkpoint retention, retry, NaN guard, PS shard repair) +
-elastic mesh resharding (survive permanent worker loss/rejoin).
+elastic mesh resharding (survive permanent worker loss/rejoin) +
+multi-controller elastic training across real process boundaries
+(resilience/multicontroller.py — imported lazily there, not here: it
+drags the van/membership plane in, and the in-process supervisors must
+stay importable without it).
 
-See README "Fault tolerance" and "Elastic operation" for usage and
-guarantees/limits.
+See README "Fault tolerance", "Elastic operation", and "Cross-host
+deployment" for usage and guarantees/limits.
 """
 
 from hetu_tpu.resilience.elastic import (

@@ -33,6 +33,15 @@ Fault kinds
 ``serve_engine_kill``  serving-pool member ``arg``'s engine dies
                    UNANNOUNCED (SIGKILL-alike, KV state lost); the pool
                    fails its queue over to a peer via re-prefill
+``member_kill``    SIGKILL the serving-member PROCESS ``arg`` (real OS
+                   death: the cross-process pool's lease expires and it
+                   fails the member's requests over — serve/crosshost.py)
+``member_suspend`` SIGSTOP member process ``arg`` for ``arg2`` seconds,
+                   then SIGCONT — the partition lookalike the lease
+                   machinery must NOT double-count as loss+rejoin
+``worker_proc_kill``  SIGKILL training-worker PROCESS ``arg`` — the
+                   multi-controller fleet resharding path
+                   (resilience/multicontroller.py)
 
 The van hooks ride :func:`hetu_tpu.ps.van.set_fault_hook`; everything else
 is plain process/OS plumbing, so the harness needs no native lib to import.
@@ -65,7 +74,8 @@ class TransientDataError(RuntimeError):
 KINDS = ("van_error", "van_delay", "data_error", "nan_grad",
          "kill_shard", "suspend_shard", "preempt",
          "worker_loss", "worker_join",
-         "serve_preempt", "serve_engine_kill")
+         "serve_preempt", "serve_engine_kill",
+         "member_kill", "member_suspend", "worker_proc_kill")
 
 
 @dataclass(frozen=True, order=True)
@@ -111,7 +121,10 @@ class FaultSchedule:
                  worker_losses: int = 0, worker_joins: int = 0,
                  n_workers: int = 1,
                  serve_preempts: int = 0, serve_engine_kills: int = 0,
-                 n_members: int = 1) -> "FaultSchedule":
+                 n_members: int = 1,
+                 member_kills: int = 0, member_suspends: int = 0,
+                 member_suspend_s: float = 0.5,
+                 worker_proc_kills: int = 0) -> "FaultSchedule":
         """Draw a schedule over training steps ``[1, steps)`` from ``seed``.
 
         Counts are clipped to the available steps.  Shard-targeted faults
@@ -133,6 +146,13 @@ class FaultSchedule:
         failover), each picking a victim member uniformly from
         ``n_members``.  Drawn after everything above — same
         byte-identity guarantee for pre-existing kwargs.
+
+        Process-level faults (cross-process deployments):
+        ``member_kills`` SIGKILL a serving-member process,
+        ``member_suspends`` SIGSTOP one for ``member_suspend_s``
+        seconds (then SIGCONT), ``worker_proc_kills`` SIGKILL a
+        training-worker process — victims drawn uniformly from
+        ``n_members`` / ``n_workers``, after ALL earlier kinds.
         """
         rng = np.random.default_rng(seed)
         hi = max(int(steps), 2)
@@ -193,6 +213,22 @@ class FaultSchedule:
             events.append(FaultEvent(s, "serve_engine_kill",
                                      float(rng.integers(max(n_members,
                                                             1)))))
+        # process-level kinds: real SIGKILL/SIGSTOP on Popen handles.
+        # Drawn after EVERYTHING above — schedules generated with the
+        # pre-existing kwargs stay byte-identical (the frozen-bytes test)
+        for s in pick(member_kills):
+            events.append(FaultEvent(s, "member_kill",
+                                     float(rng.integers(max(n_members,
+                                                            1)))))
+        for s in pick(member_suspends):
+            events.append(FaultEvent(s, "member_suspend",
+                                     float(rng.integers(max(n_members,
+                                                            1))),
+                                     float(member_suspend_s)))
+        for s in pick(worker_proc_kills):
+            events.append(FaultEvent(s, "worker_proc_kill",
+                                     float(rng.integers(max(n_workers,
+                                                            1)))))
         return cls(events)
 
     def at(self, step: int) -> list[FaultEvent]:
@@ -234,9 +270,15 @@ class FaultInjector:
     """
 
     def __init__(self, schedule: FaultSchedule, *, shard_procs=(),
+                 member_procs=None, worker_procs=None,
                  pid: int | None = None):
         self.schedule = schedule
         self.shard_procs = list(shard_procs)  # subprocess.Popen-likes
+        # LIVE references (not copies): the cross-process pool /
+        # multi-controller supervisor revive slots in place, and a fault
+        # landing after a revive must target the CURRENT incarnation
+        self.member_procs = member_procs if member_procs is not None else []
+        self.worker_procs = worker_procs if worker_procs is not None else []
         self.pid = int(pid) if pid is not None else os.getpid()
         self.counters = defaultdict(int)
         self._armed_van = deque()   # one-shot ("error"|"delay", arg)
@@ -326,6 +368,16 @@ class FaultInjector:
                 self.counters[k + "s_injected"] += 1
                 with self._lock:
                     self.serve_events.append((k, int(ev.arg)))
+            elif k == "member_kill":
+                self._proc_kill(self.member_procs, int(ev.arg),
+                                "member_procs_killed")
+            elif k == "member_suspend":
+                self._proc_suspend(self.member_procs, int(ev.arg),
+                                   ev.arg2 or 0.5,
+                                   "member_procs_suspended")
+            elif k == "worker_proc_kill":
+                self._proc_kill(self.worker_procs, int(ev.arg),
+                                "worker_procs_killed")
 
     def pop_serve_events(self) -> list:
         """Drain pending serving-pool events as
@@ -364,6 +416,36 @@ class FaultInjector:
             return
         p.send_signal(signal.SIGSTOP)
         self.counters["shards_suspended"] += 1
+        t = threading.Timer(duration_s,
+                            lambda: p.send_signal(signal.SIGCONT))
+        t.daemon = True
+        t.start()
+
+    # ---- process-level faults (cross-process pools / fleets) ----
+    def _pick_proc(self, procs, idx: int):
+        """Index modulo the LIVE slot list (a kill drawn for slot k must
+        hit a real process even after drains emptied some slots)."""
+        live = [p for p in procs if p is not None and p.poll() is None]
+        if not live:
+            self.counters["proc_faults_skipped_no_proc"] += 1
+            return None
+        return live[int(idx) % len(live)]
+
+    def _proc_kill(self, procs, idx: int, counter: str) -> None:
+        p = self._pick_proc(procs, idx)
+        if p is None:
+            return
+        p.kill()
+        p.wait()
+        self.counters[counter] += 1
+
+    def _proc_suspend(self, procs, idx: int, duration_s: float,
+                      counter: str) -> None:
+        p = self._pick_proc(procs, idx)
+        if p is None:
+            return
+        p.send_signal(signal.SIGSTOP)
+        self.counters[counter] += 1
         t = threading.Timer(duration_s,
                             lambda: p.send_signal(signal.SIGCONT))
         t.daemon = True

@@ -1,0 +1,684 @@
+"""Multi-controller elastic training: each dp worker is its own process.
+
+:class:`~hetu_tpu.resilience.elastic.ElasticSupervisor` reshapes ONE
+process's mesh; this module is the cross-process promotion the ROADMAP
+names (arXiv 2412.14374's multi-controller coordination over DCN, with
+arXiv 2004.13336's width-as-resharding contract): N worker PROCESSES
+coordinate through the van — weights and optimizer state live on a PS
+table (which is what makes membership change cheap: resharding moves no
+parameter bytes, only the DATA partition), membership crosses the
+:mod:`hetu_tpu.ps.membership` blackboard, and steps synchronize on van
+barriers.
+
+The determinism contract is PR 3's, now literal across processes: the
+global batch sequence is a pure function of ``(seed, step)``
+(:class:`~hetu_tpu.data.dataloader.ElasticBatchSchedule`), and a resize
+only re-slices each global batch over the survivors.  Every worker
+appends a CONSUMED record (step, epoch, width, rank, slice CRC) to its
+log right after its gradient push — the record is evidence the slice's
+bytes entered training.  :func:`check_complete_cover` then asserts the
+cross-run invariant: every step in order carries a COMPLETE cover (one
+width, every rank, each slice CRC equal to the width-invariant
+schedule's bytes) at the step's LATEST epoch — so the committed batch
+sequence is byte-identical to a never-resized run.  A step a worker
+died inside may additionally carry partial records from the aborted
+epoch (torn state at SIGKILL is unknowable; the epoch that re-ran the
+step is the committed one): gradient application across a crash step is
+AT-LEAST-ONCE (benign — the PS-side SGD is linear, a re-pushed slice is
+a second small step, not corruption), while batch-sequence consumption
+is exactly-once.
+
+Per step, per worker::
+
+    sync barrier(epoch)  →  pull weights  →  grad on local_slice(step,
+    rank, width)  →  push grad  →  commit barrier(epoch)  →  write the
+    commit to the blackboard  →  log + step+1
+
+Barrier ids encode ``(epoch, phase)``: a worker that timed out (peer
+suspended/killed) re-reads the control row — if the controller moved the
+membership, the in-flight step is DISCARDED (never logged) and re-runs
+at the new width from ``resume_step``; otherwise it simply re-waits.
+The generation-counted van barrier withdraws timed-out arrivals, so
+lockstep cannot release short-handed.
+
+Epoch transitions are TWO-PHASE, because ``resume_step`` must be exact:
+the fleet keeps committing steps while the controller deliberates, so a
+resume computed from racing progress reports would re-run (or skip) a
+global batch.  The controller first publishes the new epoch with
+``phase=PREPARE``: every worker stops at its next step boundary and
+acks with its frozen committed step; only when every present worker has
+acked does the controller publish ``phase=0`` with ``resume_step =
+max(frozen committed) + 1`` — commits are barrier-atomic, so the frozen
+values agree and the resume is exact for survivors and rejoiners alike.
+
+The CONTROLLER process (:class:`MultiControllerElasticSupervisor`) owns
+no training math at all: it spawns workers, watches leases, and
+publishes membership epochs — worker SIGKILL → lease expiry →
+``elastic.reshard`` span (ends when every survivor acked the new epoch)
+→ survivors reshard; a replacement process joins with a fresh
+incarnation and is re-admitted and re-placed the same way.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+import zlib
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from hetu_tpu.ps import membership as _mb
+from hetu_tpu.telemetry import trace
+
+WEIGHTS_TABLE_ID = 0x57454947          # 'WEIG'
+BARRIER_BASE = 0x42415252              # 'BARR'
+
+
+@dataclass
+class WorkerSpec:
+    """Everything a worker process needs — JSON into the spawn config.
+    The dataset is REGENERATED from ``data_seed`` in every process
+    (deterministic), so no training bytes ever cross the spawn
+    boundary; only the PS table does."""
+
+    port: int
+    slot: int
+    n_slots: int
+    steps: int
+    global_batch: int
+    features: int = 8
+    out_dim: int = 4
+    n_samples: int = 256
+    data_seed: int = 0
+    lr: float = 0.05
+    hb_ms: int = 80
+    membership_table: int = _mb.TRAIN_MEMBERSHIP_TABLE
+    weights_table: int = WEIGHTS_TABLE_ID
+    barrier_base: int = BARRIER_BASE
+    barrier_wait_s: float = 0.5
+    # per-step throttle: a CPU-box fleet steps this tiny model at
+    # 50-100 steps/s, far faster than any lease window — chaos tests
+    # (and the bench's detect/recover timing) pace the fleet so faults
+    # land INSIDE a run, not after it finished
+    step_sleep_s: float = 0.0
+    log_path: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "WorkerSpec":
+        return cls(**json.loads(s))
+
+
+def make_dataset(spec: WorkerSpec):
+    """Seeded synthetic regression problem, identical in every process:
+    ``Y = X @ W_true`` plus small noise."""
+    rng = np.random.default_rng(spec.data_seed)
+    X = rng.standard_normal((spec.n_samples, spec.features),
+                            dtype=np.float32)
+    w_true = rng.standard_normal((spec.features, spec.out_dim),
+                                 dtype=np.float32)
+    Y = X @ w_true + 0.01 * rng.standard_normal(
+        (spec.n_samples, spec.out_dim), dtype=np.float32)
+    return X, Y
+
+
+def make_schedule(spec: WorkerSpec):
+    from hetu_tpu.data.dataloader import ElasticBatchSchedule
+    X, Y = make_dataset(spec)
+    return ElasticBatchSchedule((X, Y), spec.global_batch,
+                                seed=spec.data_seed)
+
+
+def slice_crc(arrays) -> int:
+    crc = 0
+    for a in arrays:
+        crc = zlib.crc32(np.ascontiguousarray(a).tobytes(), crc)
+    return crc
+
+
+# ---------------------------------------------------------------------------
+# worker process
+# ---------------------------------------------------------------------------
+
+class _EpochChanged(Exception):
+    """The controller published a new membership epoch mid-step: the
+    in-flight step is void (never logged) and re-runs at the new
+    width."""
+
+
+class WorkerProcess:
+    """One dp worker: its own controller over its own slice (numpy math
+    — the data plane here is the VAN, not the accelerator; the jax
+    executor path stays with the in-process supervisors)."""
+
+    def __init__(self, spec: WorkerSpec):
+        from hetu_tpu.ps import van
+        self.spec = spec
+        self._van = van
+        self.schedule = make_schedule(spec)
+        self.member = _mb.MembershipClient(
+            "127.0.0.1", spec.port, table_id=spec.membership_table,
+            slot=spec.slot, n_slots=spec.n_slots)
+        self.table = van.RemotePSTable(
+            "127.0.0.1", spec.port, spec.features, spec.out_dim,
+            table_id=spec.weights_table, create=False)
+        self.committed = -1
+        self.epoch = 0
+        self.acked = 0
+        self._bars = None  # (epoch, sync_barrier, commit_barrier)
+        self._stop = threading.Event()
+        self._log = open(spec.log_path or
+                         f"worker_{spec.slot}.jsonl", "a")
+        self.member.join(committed=-1.0)
+        self._beat = threading.Thread(target=self._beat_loop, daemon=True)
+        self._beat.start()
+
+    def _beat_loop(self) -> None:
+        period = max(self.spec.hb_ms, 10) / 1000.0
+        while not self._stop.wait(period):
+            try:
+                self._sync_row()
+            except Exception:
+                time.sleep(period)  # silence IS the loss signal; keep at it
+
+    def _sync_row(self) -> None:
+        self.member.heartbeat(committed=float(self.committed),
+                              epoch_ack=float(self.acked))
+
+    def _barrier(self, phase: int, width: int):
+        bid = self.spec.barrier_base + 2 * self.epoch + phase
+        return self._van.RemoteBarrier("127.0.0.1", self.spec.port, bid,
+                                       width)
+
+    def _epoch_barriers(self, width: int):
+        """The (sync, commit) barrier pair for the CURRENT epoch, cached
+        — barrier ids and widths only change with the epoch, and opening
+        two fresh van connections per STEP would put hundreds of
+        connect/close cycles per second on the hot path."""
+        if self._bars is None or self._bars[0] != self.epoch:
+            self._close_barriers()
+            self._bars = (self.epoch, self._barrier(0, width),
+                          self._barrier(1, width))
+        return self._bars[1], self._bars[2]
+
+    def _close_barriers(self) -> None:
+        if self._bars is not None:
+            for bar in self._bars[1:]:
+                try:
+                    bar.close()
+                except Exception:
+                    pass
+            self._bars = None
+
+    def _await_barrier(self, bar) -> None:
+        """Wait out one lockstep barrier, re-checking the control row
+        between short waits; raises :class:`_EpochChanged` when the
+        controller moved the membership (new epoch OR a prepare freeze)
+        — the in-flight step is then void."""
+        while True:
+            try:
+                bar.wait(timeout_s=self.spec.barrier_wait_s)
+                return
+            except TimeoutError:
+                e, _, _, _, phase = self.member.read_control()
+                if e != self.epoch or phase != 0:
+                    raise _EpochChanged
+
+    def run(self) -> None:
+        spec = self.spec
+        step = 0
+        while not self._stop.is_set():
+            e, width, mask, resume, phase = self.member.read_control()
+            if e == 0:
+                if self._stop.wait(0.05):
+                    break
+                continue
+            if phase != 0:
+                # PREPARE: freeze at this step boundary and ack with the
+                # frozen progress (written synchronously — the controller
+                # computes the exact resume from these rows)
+                if self.acked < e:
+                    self.acked = e
+                    self._sync_row()
+                if self._stop.wait(0.02):
+                    break
+                continue
+            if e != self.epoch:
+                # the resume is EXACT (computed from frozen acks), so
+                # adopting it never re-runs or skips a committed step
+                self.epoch = e
+                self.acked = max(self.acked, e)
+                step = resume
+            slots = _mb.MembershipService.slots_of(mask)
+            if spec.slot not in slots:
+                if self._stop.wait(0.05):
+                    break
+                continue
+            rank = slots.index(spec.slot)
+            if step >= spec.steps:
+                break
+            bar_sync, bar_commit = self._epoch_barriers(width)
+            try:
+                self._await_barrier(bar_sync)
+                Xb, Yb = self.schedule.local_slice(step, rank, width)
+                w = self.table.dense_pull()
+                err = Xb @ w - Yb
+                # d/dw of mean_{GLOBAL batch} ||Xw - Y||^2: each
+                # worker pushes its slice's share; the PS-side SGD is
+                # linear, so N sequential pushes apply exactly the
+                # summed global-mean gradient
+                grad = (2.0 / spec.global_batch) * (Xb.T @ err)
+                self.table.dense_push(grad)
+                # the consumption record lands BEFORE the commit
+                # barrier: the push already happened, so if this
+                # process is SIGKILLed parked in the barrier (whose
+                # server-side arrival can still release the peers —
+                # the ghost-arrival window), the evidence that its
+                # slice entered training is on disk.  A record whose
+                # step later re-runs at a new epoch is crash residue
+                # check_complete_cover knowingly tolerates.
+                self._log.write(json.dumps(
+                    {"step": step, "epoch": self.epoch,
+                     "width": width, "rank": rank,
+                     "crc": slice_crc((Xb, Yb)),
+                     "loss": float(np.mean(err * err))}) + "\n")
+                self._log.flush()
+                self._await_barrier(bar_commit)
+            except _EpochChanged:
+                continue  # step discarded, re-run at the new width
+            # COMMITTED: every worker of this epoch passed the commit
+            # barrier; the blackboard row is written BEFORE proceeding,
+            # so a prepare freeze always reads current progress
+            self.committed = step
+            try:
+                self._sync_row()
+            except Exception:
+                pass  # the beat thread re-writes it within hb_ms
+            step += 1
+            if spec.step_sleep_s > 0:
+                self._stop.wait(spec.step_sleep_s)
+        self.close()
+
+    def close(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        try:
+            self._sync_row()
+            self.member.leave()
+        except Exception:
+            pass
+        self._close_barriers()
+        self._log.close()
+        self.member.close()
+        self.table.close()
+
+
+def worker_main(config_path: str) -> int:
+    spec = WorkerSpec.from_json(open(config_path).read())
+    worker = WorkerProcess(spec)
+    print("READY", spec.slot, flush=True)
+    worker.run()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# consumed-batch verification (the byte-identity evidence)
+# ---------------------------------------------------------------------------
+
+def merge_consumed_logs(paths) -> dict:
+    """Merge worker logs → ``{step: [(epoch, width, rank, crc), ...]}``."""
+    out: dict = {}
+    for p in paths:
+        p = Path(p)
+        if not p.exists():
+            continue
+        for line in p.read_text().splitlines():
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            out.setdefault(int(rec["step"]), []).append(
+                (int(rec["epoch"]), int(rec["width"]), int(rec["rank"]),
+                 int(rec["crc"])))
+    return out
+
+
+def check_complete_cover(consumed: dict, schedule, steps: int) -> None:
+    """Assert the merged logs prove the run consumed byte-identical
+    global batches vs a never-resized run, for every step in
+    ``[0, steps)``:
+
+    * the step's LATEST epoch carries a COMPLETE cover — one width,
+      every rank ``0..width-1`` exactly once, each slice's CRC equal to
+      the width-invariant schedule's bytes (``local_slice`` partitions
+      the SAME ``global_batch(step)`` at every width, so a complete
+      cover at ANY width is the same global bytes);
+    * records from EARLIER epochs of the same step are crash residue —
+      a worker SIGKILLed between its gradient push and the commit
+      barrier (gradient at-least-once, tolerated) — and must still be a
+      valid partial slicing (CRCs match, no duplicate rank per width).
+
+    Raises AssertionError naming the first violation."""
+    for step in range(int(steps)):
+        recs = consumed.get(step)
+        assert recs, f"step {step} was never consumed by any worker"
+        last_epoch = max(e for e, _, _, _ in recs)
+        cover = [(w, r) for e, w, r, _ in recs if e == last_epoch]
+        widths = {w for w, _ in cover}
+        assert len(widths) == 1, \
+            (f"step {step}: epoch {last_epoch} records carry several "
+             f"widths {sorted(widths)}")
+        width = widths.pop()
+        ranks = sorted(r for _, r in cover)
+        assert ranks == list(range(width)), \
+            (f"step {step}: epoch {last_epoch} ranks {ranks} do not "
+             f"cover width {width}")
+        seen = set()
+        for e, w, r, crc in recs:
+            assert (e, w, r) not in seen, \
+                f"step {step}: duplicate record for epoch {e} rank {r}/{w}"
+            seen.add((e, w, r))
+            want = slice_crc(schedule.local_slice(step, r, w))
+            assert crc == want, \
+                (f"step {step} rank {r}/{w}: consumed slice CRC "
+                 f"{crc:#x} != schedule's {want:#x}")
+
+
+# ---------------------------------------------------------------------------
+# controller
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ReshardRecord:
+    """One published membership epoch, for reports/benches."""
+
+    epoch: int
+    kind: str          # "shrink" | "grow"
+    slot: int
+    width: int
+    resume_step: int
+    downtime_s: float
+    alive: tuple = field(default_factory=tuple)
+
+
+class MultiControllerElasticSupervisor:
+    """Membership authority over N worker PROCESSES.
+
+    Owns the van, the weights table (where the model actually lives —
+    the property that makes a worker process stateless-but-for-data),
+    the blackboard, and the lease state machine.  It publishes decided
+    membership epochs; workers do everything else.  ``procs`` holds the
+    live ``Popen`` handles the ``worker_proc_kill`` chaos fault targets.
+    """
+
+    def __init__(self, n_workers: int, *, workdir, steps: int,
+                 global_batch: int, features: int = 8, out_dim: int = 4,
+                 n_samples: int = 256, data_seed: int = 0,
+                 lr: float = 0.05, hb_ms: int = 80,
+                 lease_s: float = 0.6, suspect_grace_s: float = 0.4,
+                 min_width: int = 1, port: int = 0,
+                 step_sleep_s: float = 0.0,
+                 injector=None, spawn_timeout_s: float = 120.0):
+        from hetu_tpu.ps import van
+        if n_workers < 1:
+            raise ValueError("need at least one worker")
+        for w in range(max(min_width, 1), n_workers + 1):
+            if global_batch % w:
+                raise ValueError(
+                    f"global batch {global_batch} must divide by every "
+                    f"reachable width (fails at {w})")
+        self._van = van
+        self.port = van.serve(port)
+        self.workdir = Path(workdir)
+        self.steps = int(steps)
+        self.n_workers = int(n_workers)
+        self.min_width = int(min_width)
+        self.injector = injector
+        self._spawn_timeout_s = float(spawn_timeout_s)
+        self._incarnations = 0
+        self.epoch = 0
+        self.resume_step = 0
+        self.resizes: list = []
+        self.log_paths: list = []
+        # fresh table/barrier ids per supervisor: the native table and
+        # barrier registries outlive van.stop(), so fixed ids would leak
+        # state between two fleets built in one process (tests, benches)
+        weights_table = _mb.fresh_table_id()
+        membership_table = _mb.fresh_table_id()
+        barrier_base = BARRIER_BASE + (_mb.fresh_table_id() << 8)
+        self.spec = WorkerSpec(
+            port=self.port, slot=-1, n_slots=n_workers, steps=self.steps,
+            global_batch=int(global_batch), features=int(features),
+            out_dim=int(out_dim), n_samples=int(n_samples),
+            data_seed=int(data_seed), lr=float(lr), hb_ms=int(hb_ms),
+            membership_table=membership_table,
+            weights_table=weights_table, barrier_base=barrier_base,
+            step_sleep_s=float(step_sleep_s))
+        self.table = van.RemotePSTable(
+            "127.0.0.1", self.port, int(features), int(out_dim),
+            table_id=weights_table, create=True, init="zeros",
+            optimizer="sgd", lr=float(lr))
+        self._bb = _mb.create_blackboard(
+            "127.0.0.1", self.port,
+            table_id=membership_table, n_slots=n_workers)
+        self.svc = _mb.MembershipService(self._bb, n_workers,
+                                         lease_s=lease_s,
+                                         suspect_grace_s=suspect_grace_s)
+        self.procs: list = [None] * n_workers
+        self._fired_through = 0
+        try:
+            for slot in range(n_workers):
+                self._spawn(slot)
+            self._wait_joined(range(n_workers))
+        except Exception:
+            self.close()
+            raise
+        # epoch numbering starts at 1: a zeroed control row must not
+        # read as a published membership
+        self._publish(kind=None)
+
+    # ---- spawning ----
+    def _spawn(self, slot: int) -> None:
+        from hetu_tpu.resilience.shardproc import spawn_module
+        self._incarnations += 1
+        tag = f"worker_{slot}_{self._incarnations}"
+        spec = WorkerSpec(**{**asdict(self.spec), "slot": int(slot),
+                             "log_path": str(self.workdir /
+                                             f"{tag}.jsonl")})
+        cfg = self.workdir / f"{tag}.json"
+        cfg.write_text(spec.to_json())
+        self.log_paths.append(spec.log_path)
+        # workers are numpy+van only — force them onto CPU so a fleet on
+        # an accelerator box never has N processes fighting for the chip
+        self.procs[slot] = spawn_module(
+            self.workdir, tag, "hetu_tpu.resilience.multicontroller",
+            [str(cfg)], extra_env={"JAX_PLATFORMS": "cpu"},
+            timeout_s=self._spawn_timeout_s)
+
+    def _wait_joined(self, slots, timeout_s: Optional[float] = None):
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self._spawn_timeout_s)
+        want = set(int(s) for s in slots)
+        while time.monotonic() < deadline:
+            self.svc.poll()
+            if want <= set(self.svc.present_slots()):
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"workers {sorted(want)} did not join in time")
+
+    # ---- membership → epochs ----
+    def _publish(self, *, kind: Optional[str], slot: int = -1,
+                 t0: Optional[float] = None) -> None:
+        """Move the fleet to a new membership epoch.
+
+        Two-phase when the fleet is live (``kind`` set): publish
+        ``phase=PREPARE`` (workers freeze at their next step boundary
+        and ack with frozen progress), wait for every present worker's
+        ack — re-preparing with a fresh epoch if the membership moves
+        again mid-wait — then publish ``phase=0`` with the EXACT
+        ``resume_step`` computed from the frozen values.  Initial
+        bring-up (``kind=None``) skips the prepare: nobody is stepping
+        yet."""
+        while True:
+            present = self.svc.present_slots()
+            width = len(present)
+            if width < max(self.min_width, 1):
+                raise RuntimeError(
+                    f"only {width} workers present (min_width="
+                    f"{self.min_width}); cannot reform the fleet")
+            mask = _mb.MembershipService.mask_of(present)
+            self.epoch += 1
+            if kind is None:
+                self.resume_step = 0
+                self.svc.publish_control(epoch=self.epoch, width=width,
+                                         alive_mask=mask, resume_step=0)
+                return
+            self.svc.publish_control(epoch=self.epoch, width=width,
+                                     alive_mask=mask, phase=1)
+            deadline = time.monotonic() + 30.0
+            moved = False
+            while time.monotonic() < deadline:
+                if any(k in ("lost", "join", "rejoin", "left")
+                       for k, _ in self.svc.poll()):
+                    moved = True  # membership moved again: re-prepare
+                    break
+                if all(self.svc.state_of(s).epoch_ack >= self.epoch
+                       for s in self.svc.present_slots()):
+                    break
+                time.sleep(0.02)
+            else:
+                raise TimeoutError(
+                    f"epoch {self.epoch} prepare not acked by "
+                    f"{self.svc.present_slots()} within 30s")
+            if moved:
+                continue
+            present = self.svc.present_slots()
+            # the resume considers EVERY slot that ever reported progress
+            # (present, left, lost — commits are barrier-atomic, so no
+            # departed row can be ahead of a live one): a worker
+            # rejoining a finished-and-departed fleet must resume AFTER
+            # the work, not re-train the dataset alone from step 0
+            frozen = [m.committed for m in self.svc.members
+                      if m.state != "empty"]
+            self.resume_step = max(max(frozen) + 1, 0)
+            self.svc.publish_control(
+                epoch=self.epoch, width=len(present),
+                alive_mask=_mb.MembershipService.mask_of(present),
+                resume_step=self.resume_step)
+            dt = time.perf_counter() - (t0 if t0 is not None
+                                        else time.perf_counter())
+            self.resizes.append(ReshardRecord(
+                epoch=self.epoch, kind=kind, slot=int(slot),
+                width=len(present), resume_step=self.resume_step,
+                downtime_s=dt, alive=tuple(present)))
+            return
+
+    def poll(self) -> list:
+        """One membership sweep: drives the injector by observed
+        committed step, applies lease decisions as published epochs.
+        Returns the membership events seen."""
+        if self.injector is not None:
+            cur = max((self.svc.state_of(s).committed
+                       for s in range(self.n_workers)), default=-1)
+            for t in range(self._fired_through + 1, cur + 1):
+                self.injector.on_step(t)
+            self._fired_through = max(self._fired_through, cur)
+        events = self.svc.poll()
+        for kind, slot in events:
+            if kind == "lost":
+                t0 = time.perf_counter()
+                with trace.span("elastic.reshard") as sp:
+                    sp.set("kind", "shrink")
+                    sp.set("worker", int(slot))
+                    self._publish(kind="shrink", slot=slot, t0=t0)
+                    sp.set("width", len(self.svc.present_slots()))
+            elif kind in ("rejoin", "join"):
+                t0 = time.perf_counter()
+                with trace.span("elastic.reshard") as sp:
+                    sp.set("kind", "grow")
+                    sp.set("worker", int(slot))
+                    self._publish(kind="grow", slot=slot, t0=t0)
+                    sp.set("width", len(self.svc.present_slots()))
+        return events
+
+    def spawn_replacement(self, slot: int) -> None:
+        """Re-admit a lost worker slot with a FRESH process: it joins
+        with a new incarnation, the next poll publishes a grow epoch,
+        and the worker re-places itself (weights come from the PS —
+        rejoin ships zero parameter bytes from the controller)."""
+        p = self.procs[slot]
+        if p is not None and p.poll() is None:
+            p.kill()
+            p.wait()
+        self._spawn(slot)
+
+    # ---- driving ----
+    def run(self, *, deadline_s: float = 300.0,
+            poll_s: float = 0.05) -> dict:
+        """Poll until every present worker committed the final step (or
+        left after doing so).  Returns a report dict."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            self.poll()
+            states = [self.svc.state_of(s) for s in range(self.n_workers)]
+            present = [m for m in states if m.state in ("alive", "suspect")]
+            finished = [m for m in states
+                        if m.state == "left" and
+                        m.committed >= self.steps - 1]
+            if present and all(m.committed >= self.steps - 1
+                               for m in present):
+                break
+            if not present and finished:
+                break
+            time.sleep(poll_s)
+        else:
+            raise TimeoutError(
+                f"fleet did not finish {self.steps} steps within "
+                f"{deadline_s}s: "
+                f"{[(m.slot, m.state, m.committed) for m in states]}")
+        consumed = merge_consumed_logs(self.log_paths)
+        return {
+            "steps": self.steps,
+            "epochs": self.epoch,
+            "resizes": [asdict(r) for r in self.resizes],
+            "consumed": consumed,
+            "final_weights": self.table.dense_pull(),
+        }
+
+    def verify_consumed(self, consumed: Optional[dict] = None) -> None:
+        """The chaos acceptance check: complete-cover-per-step,
+        width-invariant, byte-identical global-batch consumption."""
+        if consumed is None:
+            consumed = merge_consumed_logs(self.log_paths)
+        check_complete_cover(consumed, make_schedule(self.spec),
+                             self.steps)
+
+    def close(self) -> None:
+        for p in self.procs:
+            if p is None:
+                continue
+            try:
+                if p.poll() is None:
+                    p.kill()
+                p.wait()
+            except Exception:
+                traceback.print_exc()
+        for t in (getattr(self, "table", None), getattr(self, "_bb", None)):
+            if t is not None:
+                try:
+                    t.close()
+                except Exception:
+                    pass
+        self._van.stop()
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(worker_main(sys.argv[1]))

@@ -1,10 +1,17 @@
-"""Spawn throwaway PS van server subprocesses.
+"""Process harness: spawn READY-handshaking subprocesses.
 
-Shared by the chaos tests and ``bench.py resilience`` — the
-:class:`~hetu_tpu.resilience.faults.FaultInjector`'s kill/suspend targets
-are exactly these ``Popen`` handles, so keeping the bootstrap (inline
-script, READY handshake, port allocation) in ONE place keeps the harness
-and the bench from drifting apart.
+Started life as the chaos tests' throwaway PS-shard spawner; now the
+generic bootstrap the whole cross-process control plane shares — PS van
+shards, serving-member processes (``hetu_tpu.serve.crosshost``), and
+multi-controller training workers (``hetu_tpu.resilience.
+multicontroller``) all come up through here, so the handshake (spawn,
+wait for a READY line, fail loudly with the process's output otherwise)
+and the spawn environment (``launcher.spawn_local``: repo PYTHONPATH,
+optional forced-CPU device world) live in ONE place.  The returned
+``Popen`` handles are exactly what
+:class:`~hetu_tpu.resilience.faults.FaultInjector`'s process-level fault
+kinds (``kill_shard``, ``member_kill``, ``worker_proc_kill``, ...)
+target.
 """
 
 from __future__ import annotations
@@ -12,6 +19,7 @@ from __future__ import annotations
 import socket
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 _REPO = Path(__file__).resolve().parents[2]
@@ -25,6 +33,19 @@ print("READY", port, flush=True)
 time.sleep({lifetime})
 """
 
+# a van server that REGISTERS with a scheduler (the postoffice server
+# role) — the rejoin-at-a-new-address path the heartbeat tests exercise
+_REGISTERED_SERVER_SRC = """\
+import sys, time
+sys.path.insert(0, {repo!r})
+from hetu_tpu.ps import van
+port, rank = van.serve_and_register("127.0.0.1", {sched_port},
+                                    port={port}, rank_hint={rank_hint},
+                                    beat_ms={beat_ms})
+print("READY", port, rank, flush=True)
+time.sleep({lifetime})
+"""
+
 
 def free_port() -> int:
     with socket.socket() as s:
@@ -32,19 +53,79 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def spawn_shard_server(workdir, port: int, tag: str = "s", *,
-                       lifetime_s: int = 600) -> subprocess.Popen:
-    """Start a van server subprocess on ``port``; blocks until it prints
-    READY (the server is accepting connections).  The caller owns the
-    returned ``Popen`` — kill()/wait() it (chaos does exactly that)."""
-    script = Path(workdir) / f"shard_server_{tag}.py"
-    script.write_text(_SERVER_SRC.format(repo=str(_REPO), port=int(port),
-                                         lifetime=int(lifetime_s)))
+def spawn_ready(workdir, tag: str, src: str, **fmt) -> subprocess.Popen:
+    """Write ``src.format(repo=..., **fmt)`` as a script, spawn it, and
+    block until it prints a READY line on stdout (stashed on the handle
+    as ``proc.ready`` — e.g. the bound port).  The caller owns the
+    returned ``Popen`` — kill()/wait() it; chaos does exactly that."""
+    script = Path(workdir) / f"{tag}.py"
+    script.write_text(src.format(repo=str(_REPO), **fmt))
     proc = subprocess.Popen([sys.executable, str(script)],
                             stdout=subprocess.PIPE, text=True)
     line = proc.stdout.readline()
     if not line.startswith("READY"):
         proc.kill()
         proc.wait()
-        raise RuntimeError(f"shard server failed to start: {line!r}")
+        raise RuntimeError(f"{tag}: process failed to start: {line!r}")
+    proc.ready = line.split()[1:]
     return proc
+
+
+def spawn_module(workdir, tag: str, module: str, args, *,
+                 cpu_devices: int | None = None,
+                 extra_env: dict | None = None,
+                 timeout_s: float = 120.0) -> subprocess.Popen:
+    """Spawn ``python -m module *args`` and wait for its READY line.
+
+    Unlike :func:`spawn_ready`, stdout/stderr go to a LOG FILE in
+    ``workdir`` (``<tag>.log``, path stashed as ``proc.log_path``) and
+    READY is awaited by tailing it: long-lived member/worker processes
+    print tracebacks and progress, and an unread stdout PIPE would
+    eventually fill and wedge them — a deadlock indistinguishable from
+    the very hangs the chaos harness injects on purpose."""
+    from hetu_tpu.launcher import spawn_local
+    log_path = Path(workdir) / f"{tag}.log"
+    with open(log_path, "w") as log:
+        # the child inherits its own copy of the fd; holding the parent's
+        # open would leak one fd per spawn (revive/replacement loops)
+        proc = spawn_local([sys.executable, "-m", module,
+                            *map(str, args)],
+                           cpu_devices=cpu_devices, extra_env=extra_env,
+                           stdout=log, stderr=subprocess.STDOUT)
+    proc.log_path = log_path
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if "READY" in log_path.read_text(errors="replace"):
+            proc.ready = True
+            return proc
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"{tag}: process exited rc={proc.returncode} before "
+                f"READY:\n{log_path.read_text(errors='replace')[-2000:]}")
+        time.sleep(0.05)
+    proc.kill()
+    proc.wait()
+    raise TimeoutError(
+        f"{tag}: no READY within {timeout_s}s:\n"
+        f"{log_path.read_text(errors='replace')[-2000:]}")
+
+
+def spawn_shard_server(workdir, port: int, tag: str = "s", *,
+                       lifetime_s: int = 600) -> subprocess.Popen:
+    """Start a van server subprocess on ``port``; blocks until READY
+    (the server is accepting connections)."""
+    return spawn_ready(workdir, f"shard_server_{tag}", _SERVER_SRC,
+                       port=int(port), lifetime=int(lifetime_s))
+
+
+def spawn_registered_server(workdir, sched_port: int, tag: str = "r", *,
+                            port: int = 0, rank_hint: int = -1,
+                            beat_ms: int = 200,
+                            lifetime_s: int = 600) -> subprocess.Popen:
+    """Start a van server that registers with the scheduler at
+    ``sched_port`` (native beat thread keeps the registration live);
+    ``proc.ready`` holds ``[bound_port, rank]``."""
+    return spawn_ready(workdir, f"reg_server_{tag}",
+                       _REGISTERED_SERVER_SRC, sched_port=int(sched_port),
+                       port=int(port), rank_hint=int(rank_hint),
+                       beat_ms=int(beat_ms), lifetime=int(lifetime_s))

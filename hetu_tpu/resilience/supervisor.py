@@ -75,6 +75,8 @@ def default_is_transient(exc: BaseException) -> bool:
     # the native layer surfaces every failed wire op as
     # RuntimeError("hetu_ps <op> failed with rc=..."); during a shard
     # restart these clear once the heartbeat re-resolves the endpoint
+    # (asserted end-to-end, with real SIGKILLed shard processes and a
+    # same-port AND new-port restart, in tests/test_van_heartbeat.py)
     return isinstance(exc, RuntimeError) and "hetu_ps" in str(exc)
 
 

@@ -20,6 +20,9 @@ of the training-only models:
     slot transfer over the van, scheduler hand-off with zero re-prefill;
   * :mod:`pool` — :class:`ServingPool`: health-routed routing over N
     members, planned drain (migrate-then-exit) and unplanned failover;
+  * :mod:`crosshost` — :class:`CrossProcessServingPool`: the pool
+    across REAL process boundaries — member processes, membership
+    leases over the van, two-phase cross-process KV drain;
   * :mod:`recsys` — the SECOND serving workload: online CTR inference
     (WideDeep/DeepFM/DCN) behind the same van front-end and pool
     machinery, with a staleness-bounded hot-embedding serving cache
@@ -29,6 +32,7 @@ See examples/gpt_serve.py, examples/gpt_serve_pool.py and
 examples/ctr_serve.py for the end-to-end paths.
 """
 
+from hetu_tpu.serve.crosshost import CrossProcessServingPool
 from hetu_tpu.serve.engine import ServeEngine
 from hetu_tpu.serve.kv_cache import KVCache, KVCacheSpec, KVSlotSnapshot
 from hetu_tpu.serve.metrics import ServeMetrics
@@ -46,6 +50,7 @@ from hetu_tpu.serve.server import (
 __all__ = [
     "ServeEngine", "KVCache", "KVCacheSpec", "KVSlotSnapshot",
     "ServeMetrics", "MigrationError", "ServingPool",
+    "CrossProcessServingPool",
     "ContinuousBatchingScheduler", "Request",
     "InferenceClient", "InferenceServer",
     "request_channel", "response_channel",

@@ -1,0 +1,941 @@
+"""Cross-process serving pool: members are real OS processes.
+
+PR 5's :class:`~hetu_tpu.serve.pool.ServingPool` proved the HA
+machinery — health routing, live KV drain, fold re-prefill failover —
+but its members share one Python process, so "member death" was a kill
+switch, not a kill.  This module promotes the pool across the process
+boundary: each member is a SEPARATE process running a listener-less
+:class:`~hetu_tpu.serve.server.InferenceServer` (engine loop + requeue
+machinery, ``own_van=False``) attached to the controller's van, and the
+control plane crosses the wire:
+
+* **membership** — members join and heartbeat through the van
+  blackboard (:mod:`hetu_tpu.ps.membership`); the controller's lease
+  state machine (alive → suspect → lost) replaces in-process
+  ``server.healthy`` polling.  A SIGSTOPped member goes *suspect*
+  (unroutable, state presumed intact) and CLEARS when its beats resume
+  — never double-counted as a loss plus a rejoin;
+* **requests** — the controller routes each accepted request to the
+  least-loaded alive member over a per-process submit channel and
+  resolves it from the member's completion events; member death
+  (SIGKILL → lease expiry) re-routes every outstanding request to a
+  survivor, which re-prefills from the original prompt — greedy decode
+  makes the re-served tokens exactly the tokens the dead member would
+  have produced;
+* **drain** — a planned preemption ships the member's live KV slots AND
+  its in-flight request records to a peer process over the existing
+  chunked-CRC migrate wire (:func:`hetu_tpu.serve.migrate.
+  export_payload` / :func:`~hetu_tpu.serve.migrate.adopt_payload`),
+  two-phase: the source holds its export until the target confirms
+  adoption, so a failed transfer rolls back to a still-serving source.
+  The adopting process continues mid-decode sequences token-for-token
+  with zero re-prefill.
+
+Channel topology on the ONE shared van: each member process gets a
+fresh (submit, event) blob-channel pair allocated by the controller
+(never reused across member incarnations — blob seqs are per-channel
+and a revived process must start clean), migration transfers draw ids
+from their own base (disjoint from the in-process pool's
+``MIGRATE_CHANNEL_BASE`` — several pools can share one van), and the
+membership blackboard is a small f32 table.  Recovery spans mirror the
+in-process pool (``serve.migrate`` / ``serve.failover``) plus the new
+retroactive ``serve.member_suspect`` for a partition that healed.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import queue
+import threading
+import time
+import traceback
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+from hetu_tpu.ps import membership as _mb
+from hetu_tpu.serve import migrate as _migrate
+from hetu_tpu.serve.metrics import ServeMetrics
+from hetu_tpu.serve.pool import _MIG_SEQ
+from hetu_tpu.telemetry import trace
+
+# controller-allocated control channels ('CHCT'); migration transfers get
+# their own base ('MIG3'), disjoint from serve/pool.py's in-process base
+# so a mixed deployment sharing one van cannot cross streams
+CONTROL_CHANNEL_BASE = 0x43484354
+CROSSHOST_MIGRATE_BASE = 0x4D494733
+
+_xfer_ids = itertools.count(1)
+
+
+@dataclass
+class MemberSpec:
+    """Everything a member process needs to build its engine and find
+    the control plane — JSON-serialized into the spawn config so the
+    member re-derives the SAME model weights (deterministic seeded
+    init) the controller and its peers hold."""
+
+    port: int
+    slot: int
+    n_slots: int
+    submit_ch: int
+    event_ch: int
+    membership_table: int = _mb.SERVE_MEMBERSHIP_TABLE
+    hb_ms: int = 100
+    request_timeout_s: float = 60.0
+    max_loop_errors: int = 2
+    failover_grace_s: float = 5.0
+    model: dict = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "MemberSpec":
+        return cls(**json.loads(s))
+
+
+DEFAULT_MODEL = {
+    "vocab_size": 97, "hidden_size": 64, "num_layers": 2, "num_heads": 4,
+    "ffn_size": 128, "max_position": 64, "seed": 0,
+    "num_slots": 4, "max_len": 48, "min_bucket": 8,
+}
+
+
+def build_engine(model_spec: dict):
+    """Deterministic engine construction shared by member processes and
+    in-test reference engines: same spec → same weights everywhere, the
+    property that makes cross-process failover token-exact."""
+    import jax
+
+    from hetu_tpu.models.gpt import GPTConfig, GPTModel
+    from hetu_tpu.serve.engine import ServeEngine
+    spec = {**DEFAULT_MODEL, **(model_spec or {})}
+    cfg = GPTConfig(
+        vocab_size=int(spec["vocab_size"]),
+        hidden_size=int(spec["hidden_size"]),
+        num_layers=int(spec["num_layers"]),
+        num_heads=int(spec["num_heads"]),
+        ffn_size=int(spec["ffn_size"]),
+        max_position=int(spec["max_position"]), dropout_rate=0.0)
+    model = GPTModel(cfg)
+    variables = model.init(jax.random.PRNGKey(int(spec["seed"])))
+    return model, variables, ServeEngine(
+        model, variables, num_slots=int(spec["num_slots"]),
+        max_len=int(spec["max_len"]), min_bucket=int(spec["min_bucket"]))
+
+
+# ---------------------------------------------------------------------------
+# member process
+# ---------------------------------------------------------------------------
+
+class MemberHarness:
+    """The member-process half of the control plane.
+
+    Wraps a listener-less :class:`InferenceServer` (its engine loop,
+    crash requeue, and failover-grace machinery are reused unchanged)
+    with three wire surfaces on the shared van: a command loop on the
+    submit channel (submit / drain two-phase / adopt / shutdown — ONE
+    reader thread, so a drain command is naturally ordered after every
+    submit the controller sent before it), an outbound event queue
+    (completions, drain acks) on the event channel, and a membership
+    heartbeat carrying load + engine health."""
+
+    def __init__(self, spec: MemberSpec):
+        from hetu_tpu.ps import van
+        from hetu_tpu.serve.scheduler import ContinuousBatchingScheduler
+        from hetu_tpu.serve.server import InferenceServer
+        self.spec = spec
+        self._van = van
+        _, _, engine = build_engine(spec.model)
+        self.scheduler = ContinuousBatchingScheduler(engine)
+        self.server = InferenceServer(
+            self.scheduler, port=spec.port, own_van=False, max_clients=0,
+            request_timeout_s=spec.request_timeout_s,
+            max_loop_errors=spec.max_loop_errors,
+            failover_grace_s=spec.failover_grace_s)
+        self.member = _mb.MembershipClient(
+            "127.0.0.1", spec.port, table_id=spec.membership_table,
+            slot=spec.slot, n_slots=spec.n_slots)
+        self._stop = threading.Event()
+        self._events: queue.Queue = queue.Queue()
+        self._migrated: set = set()   # rids handed to a peer (no event)
+        self._pending_drain = None    # (xfer_id, pairs) awaiting commit
+        self._in = van.BlobChannel("127.0.0.1", spec.port, spec.submit_ch)
+        self._out = van.BlobChannel("127.0.0.1", spec.port, spec.event_ch)
+        self.member.join()
+        self._threads = [
+            threading.Thread(target=self._beat_loop, daemon=True),
+            threading.Thread(target=self._event_loop, daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    # ---- outbound ----
+    def _emit(self, ev: dict) -> None:
+        self._events.put(ev)
+
+    def _event_loop(self) -> None:
+        seq = 1
+        while not self._stop.is_set():
+            try:
+                ev = self._events.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            payload = json.dumps(ev).encode()
+            while not self._stop.is_set():
+                try:
+                    # idempotent same-seq resend: a timeout retries the
+                    # SAME slot until the controller drains it
+                    self._out.put(payload, seq, timeout_s=2.0)
+                    seq += 1
+                    break
+                except (TimeoutError, RuntimeError):
+                    time.sleep(0.05)
+
+    def _beat_loop(self) -> None:
+        period = max(self.spec.hb_ms, 10) / 1000.0
+        while not self._stop.wait(period):
+            try:
+                self.member.heartbeat(
+                    load=float(self.scheduler.load),
+                    healthy=self.server.healthy)
+            except Exception:
+                # a transiently unreachable van must not kill the beat
+                # thread — silence IS the loss signal, so keep trying
+                time.sleep(period)
+
+    def _watch(self, req) -> None:
+        """Report the request's terminal state to the controller once it
+        resolves — unless it migrated away (the adopter reports it)."""
+        def run():
+            req.done.wait()
+            if req.status == "migrated" or req.rid in self._migrated:
+                return
+            self._emit({"type": "done", "rid": int(req.rid),
+                        "status": req.status or "ok",
+                        "tokens": [int(t) for t in req.tokens],
+                        "ttft_s": req.ttft_s})
+        threading.Thread(target=run, daemon=True).start()
+
+    # ---- command dispatch (single reader: ordering is the protocol) ----
+    def run(self) -> None:
+        seq = 1
+        while not self._stop.is_set():
+            try:
+                raw = self._in.get(seq, timeout_s=0.25)
+            except TimeoutError:
+                continue
+            except RuntimeError:
+                break  # van gone under us
+            seq += 1
+            try:
+                msg = json.loads(raw)
+                if not self._dispatch(msg):
+                    break
+            except Exception:
+                traceback.print_exc()  # one bad command must not kill
+                # the member — the controller's lease would misread a
+                # parse error as a death
+        self.close()
+
+    def _dispatch(self, msg: dict) -> bool:
+        from hetu_tpu.serve.scheduler import Request
+        cmd = msg.get("cmd")
+        if cmd == "submit":
+            req = Request(prompt=[int(t) for t in msg["prompt"]],
+                          max_tokens=int(msg.get("max_tokens", 16)),
+                          eos_id=msg.get("eos_id"),
+                          timeout_s=float(msg.get(
+                              "timeout_s", self.spec.request_timeout_s)))
+            req.rid = int(msg["rid"])  # controller-global id: completion
+            # events and cross-process drains correlate on it
+            self._watch(req)
+            self.scheduler.submit(req)
+        elif cmd == "recv_migration":
+            self._recv_migration(int(msg["ch"]), int(msg["xfer"]),
+                                 float(msg.get("timeout_s", 30.0)))
+        elif cmd == "drain":
+            self._drain(int(msg["ch"]), int(msg["xfer"]),
+                        str(msg.get("codec", "none")),
+                        float(msg.get("timeout_s", 30.0)))
+        elif cmd == "drain_commit":
+            self._drain_commit(int(msg["xfer"]), leave=bool(msg.get("exit")))
+            if msg.get("exit"):
+                return False
+        elif cmd == "drain_abort":
+            self._drain_abort(int(msg["xfer"]))
+        elif cmd == "shutdown":
+            return False
+        return True
+
+    # ---- migration (two-phase, source side holds until commit) ----
+    def _drain(self, ch_id: int, xfer: int, codec: str,
+               timeout_s: float) -> None:
+        pairs = None
+        try:
+            payload, pairs = _migrate.export_payload(self.scheduler,
+                                                     codec=codec)
+            tx = self._van.BlobChannel("127.0.0.1", self.spec.port, ch_id)
+            try:
+                _migrate.send_payload(tx, payload, timeout_s=timeout_s)
+            finally:
+                tx.close()
+        except Exception as e:
+            traceback.print_exc()
+            if pairs is not None:
+                try:
+                    self.scheduler.adopt_inflight(pairs)  # resume serving
+                except Exception:
+                    traceback.print_exc()
+            self._emit({"type": "drain_failed", "xfer": xfer,
+                        "error": repr(e)})
+            return
+        self._pending_drain = (xfer, pairs)
+        self._emit({"type": "drained", "xfer": xfer, "n": len(pairs)})
+
+    def _drain_commit(self, xfer: int, *, leave: bool = True) -> None:
+        from hetu_tpu.serve.scheduler import finish_request
+        if self._pending_drain is None or self._pending_drain[0] != xfer:
+            return
+        _, pairs = self._pending_drain
+        self._pending_drain = None
+        for req, _slot in pairs:
+            # resolve locally as 'migrated' so the watcher stays silent —
+            # the ADOPTER owns the client-visible completion now
+            self._migrated.add(req.rid)
+            finish_request(req, "migrated", None)
+        _migrate.release_exported(self.scheduler, pairs)
+        if leave:
+            try:
+                self.member.leave()  # planned exit: never grieved
+            except Exception:
+                pass
+
+    def _drain_abort(self, xfer: int) -> None:
+        if self._pending_drain is None or self._pending_drain[0] != xfer:
+            return
+        _, pairs = self._pending_drain
+        self._pending_drain = None
+        try:
+            self.scheduler.adopt_inflight(pairs)  # back in service
+        except Exception:
+            traceback.print_exc()
+
+    def _recv_migration(self, ch_id: int, xfer: int,
+                        timeout_s: float) -> None:
+        # ack FIRST: the controller must not start the source's send
+        # before this member is committed to receiving
+        self._emit({"type": "mig_ready", "xfer": xfer})
+        try:
+            rx = self._van.BlobChannel("127.0.0.1", self.spec.port, ch_id)
+            try:
+                got = _migrate.recv_payload(rx, timeout_s=timeout_s)
+            finally:
+                rx.close()
+            reqs, slot_map = _migrate.adopt_payload(self.scheduler, got)
+        except Exception as e:
+            traceback.print_exc()
+            self._emit({"type": "adopt_failed", "xfer": xfer,
+                        "error": repr(e)})
+            return
+        for req in reqs:
+            self._watch(req)
+        self._emit({"type": "adopted", "xfer": xfer, "n": len(reqs),
+                    "slots": len(slot_map)})
+
+    def close(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        try:
+            self.member.leave()
+        except Exception:
+            pass
+        try:
+            self.server.close(5.0)
+        except Exception:
+            traceback.print_exc()
+        for ch in (self._in, self._out):
+            try:
+                ch.close()
+            except Exception:
+                pass
+        self.member.close()
+
+
+def member_main(config_path: str) -> int:
+    """Entry point for a spawned member process: build the harness,
+    announce READY (the spawner's handshake), serve until told to stop."""
+    spec = MemberSpec.from_json(open(config_path).read())
+    harness = MemberHarness(spec)
+    print("READY", spec.slot, flush=True)
+    harness.run()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# controller
+# ---------------------------------------------------------------------------
+
+class PoolRequest:
+    """Controller-side request record: the original message (the
+    failover resubmission source), current route, and the waiter's
+    completion event.  Response dict shape matches the in-process
+    pool's ``generate``."""
+
+    __slots__ = ("rid", "msg", "member", "retries", "tokens", "status",
+                 "ttft_s", "done")
+
+    def __init__(self, rid: int, msg: dict):
+        self.rid = rid
+        self.msg = msg
+        self.member: Optional[int] = None
+        self.retries = 0
+        self.tokens: list = []
+        self.status: Optional[str] = None
+        self.ttft_s = None
+        self.done = threading.Event()
+
+
+class CrossProcessServingPool:
+    """Controller over N serving-member PROCESSES on one van.
+
+    Construction starts the van, creates the membership blackboard,
+    spawns ``n_members`` member processes (each builds the same seeded
+    model), and waits for them to join.  ``generate``/``submit`` route
+    over the wire; the poll thread runs the lease state machine and the
+    failover/suspect handling; ``drain_member`` runs the two-phase
+    cross-process KV migration.  ``procs`` holds the live ``Popen``
+    handles — exactly what the chaos harness's ``member_kill`` /
+    ``member_suspend`` faults target.
+    """
+
+    def __init__(self, n_members: int = 2, *, workdir, model: dict = None,
+                 port: int = 0, own_van: bool = True,
+                 hb_ms: int = 80, lease_s: float = 0.6,
+                 suspect_grace_s: float = 0.5,
+                 poll_s: float = 0.05,
+                 request_timeout_s: float = 60.0,
+                 max_retries: int = 3,
+                 migrate_codec: str = "none",
+                 membership_table: Optional[int] = None,
+                 metrics: Optional[ServeMetrics] = None,
+                 member_env: Optional[dict] = None,
+                 spawn_timeout_s: float = 120.0,
+                 start_poll: bool = True):
+        from hetu_tpu.ps import van
+        if n_members < 1:
+            raise ValueError("a serving pool needs at least one member")
+        migrate_codec = _migrate.check_codec(migrate_codec)
+        self._van = van
+        self._own_van = own_van
+        if own_van:
+            self.port = van.serve(port)
+        else:
+            if not port:
+                raise ValueError("own_van=False needs the running van's port")
+            self.port = port
+        self.workdir = workdir
+        self.model = {**DEFAULT_MODEL, **(model or {})}
+        self.n_members = int(n_members)
+        self.hb_ms = int(hb_ms)
+        self.request_timeout_s = float(request_timeout_s)
+        self.max_retries = int(max_retries)
+        self.migrate_codec = migrate_codec
+        # fresh by default: the native table registry outlives van.stop(),
+        # and two pools in one process must not share a blackboard
+        self._membership_table = int(membership_table) \
+            if membership_table is not None else _mb.fresh_table_id()
+        self._spawn_timeout_s = float(spawn_timeout_s)
+        # e.g. {"JAX_PLATFORMS": "cpu"} — a bench on an accelerator box
+        # keeps member processes off the chip the controller holds
+        self._member_env = dict(member_env) if member_env else None
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self._lock = threading.RLock()
+        self._poll_lock = threading.Lock()
+        self._rids = itertools.count(1)
+        self._ctrl_ids = itertools.count(0)  # fresh channels per process
+        self._requests: dict = {}       # rid -> PoolRequest
+        self._inflight: dict = {}       # slot -> outstanding count
+        self._draining: set = set()
+        self._quarantined: set = set()  # engine-dead / failed-over slots
+        self._suspect_t0: dict = {}     # slot -> trace ts of suspicion
+        self._xfers: dict = {}          # xfer id -> {"evt", "events"}
+        self._out: dict = {}            # slot -> (channel, lock, [seq])
+        self._listeners: dict = {}      # slot -> (thread, stop)
+        self.procs: list = [None] * self.n_members
+        self._stop = threading.Event()
+        try:
+            self._bb = _mb.create_blackboard(
+                "127.0.0.1", self.port, table_id=self._membership_table,
+                n_slots=self.n_members)
+            self.svc = _mb.MembershipService(
+                self._bb, self.n_members, lease_s=lease_s,
+                suspect_grace_s=suspect_grace_s)
+            for slot in range(self.n_members):
+                self._spawn(slot)
+            self._wait_joined(range(self.n_members))
+        except Exception:
+            self.close()
+            raise
+        self._poll_thread = None
+        if start_poll:
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, args=(float(poll_s),), daemon=True)
+            self._poll_thread.start()
+
+    # ---- spawning ----
+    def _spawn(self, slot: int) -> None:
+        from hetu_tpu.resilience.shardproc import spawn_module
+        cid = next(self._ctrl_ids)
+        spec = MemberSpec(
+            port=self.port, slot=slot, n_slots=self.n_members,
+            submit_ch=CONTROL_CHANNEL_BASE + 2 * cid,
+            event_ch=CONTROL_CHANNEL_BASE + 2 * cid + 1,
+            membership_table=self._membership_table, hb_ms=self.hb_ms,
+            request_timeout_s=self.request_timeout_s, model=self.model)
+        from pathlib import Path
+        cfg = Path(self.workdir) / f"member_{slot}_{cid}.json"
+        cfg.write_text(spec.to_json())
+        proc = spawn_module(self.workdir, f"member_{slot}_{cid}",
+                            "hetu_tpu.serve.crosshost", [str(cfg)],
+                            extra_env=self._member_env,
+                            timeout_s=self._spawn_timeout_s)
+        self.procs[slot] = proc
+        ch = self._van.BlobChannel("127.0.0.1", self.port, spec.submit_ch)
+        with self._lock:
+            old = self._out.get(slot)
+            self._out[slot] = (ch, threading.Lock(), [1])
+            self._inflight[slot] = 0
+        if old is not None:  # a revived slot's previous control channel
+            try:
+                old[0].close()
+            except Exception:
+                pass
+        self._start_listener(slot, spec.event_ch)
+
+    def _start_listener(self, slot: int, event_ch: int) -> None:
+        old = self._listeners.get(slot)
+        if old is not None:
+            old[1].set()
+        stop = threading.Event()
+        t = threading.Thread(target=self._event_loop,
+                             args=(slot, event_ch, stop), daemon=True)
+        self._listeners[slot] = (t, stop)
+        t.start()
+
+    def _wait_joined(self, slots, timeout_s: Optional[float] = None) -> None:
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self._spawn_timeout_s)
+        want = set(int(s) for s in slots)
+        while time.monotonic() < deadline:
+            self.poll()
+            if want <= set(self.svc.present_slots()):
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"members {sorted(want)} did not join within "
+                           f"the spawn window")
+
+    # ---- wire helpers ----
+    def _send(self, slot: int, msg: dict, *, timeout_s: float = 2.0,
+              attempts: int = 2) -> None:
+        """One ordered control send with bounded retry: same-seq blob
+        resend is idempotent, so a transport wobble retries safely; a
+        member that stays unreadable (suspended/dead) surfaces as the
+        TimeoutError the router treats as 'pick someone else'."""
+        ent = self._out.get(slot)
+        if ent is None:
+            raise ConnectionError(f"member {slot} has no control channel")
+        ch, lock, seq = ent
+        payload = json.dumps(msg).encode()
+        with lock:
+            _mb.control_rpc(
+                lambda: ch.put(payload, seq[0], timeout_s=timeout_s),
+                attempts=attempts, base_s=0.05,
+                is_transient=lambda e: isinstance(
+                    e, (TimeoutError, ConnectionError, RuntimeError)))
+            seq[0] += 1
+
+    def _event_loop(self, slot: int, event_ch: int,
+                    stop: threading.Event) -> None:
+        ch = self._van.BlobChannel("127.0.0.1", self.port, event_ch)
+        seq = 1
+        try:
+            while not (stop.is_set() or self._stop.is_set()):
+                try:
+                    raw = ch.get(seq, timeout_s=0.25)
+                except TimeoutError:
+                    continue
+                except RuntimeError:
+                    if self._stop.is_set():
+                        break
+                    time.sleep(0.1)
+                    continue
+                seq += 1
+                try:
+                    ev = json.loads(raw)
+                except (ValueError, TypeError):
+                    continue
+                try:
+                    self._dispatch_event(slot, ev)
+                except Exception:
+                    traceback.print_exc()
+        finally:
+            ch.close()
+
+    def _dispatch_event(self, slot: int, ev: dict) -> None:
+        kind = ev.get("type")
+        if kind == "done":
+            self._on_done(slot, ev)
+            return
+        xfer = self._xfers.get(int(ev.get("xfer", -1)))
+        if xfer is not None:
+            xfer["events"][kind] = ev
+            xfer["evt"].set()
+
+    def _on_done(self, slot: int, ev: dict) -> None:
+        req = self._requests.get(int(ev.get("rid", -1)))
+        if req is None or req.done.is_set():
+            return  # late duplicate from a failed-over member: first wins
+        status = ev.get("status", "error")
+        if status in ("error", "shutdown"):
+            with self._lock:
+                stale = req.member != slot
+            if stale:
+                return  # an old owner's drain echo; the new owner decides
+            if req.retries < self.max_retries:
+                # the member failed the request without serving it (engine
+                # death drain, poisoned admission): fold re-prefill on a
+                # peer = resubmit the original record elsewhere
+                req.retries += 1
+                self.metrics.inc("requests_rerouted")
+                self._route(req, exclude={slot})
+                return
+        self._resolve(req, status, tokens=ev.get("tokens", ()),
+                      ttft_s=ev.get("ttft_s"))
+
+    def _resolve(self, req: PoolRequest, status: str, *, tokens=(),
+                 ttft_s=None) -> None:
+        with self._lock:
+            if req.done.is_set():
+                return
+            if req.member is not None:
+                self._inflight[req.member] = max(
+                    self._inflight.get(req.member, 1) - 1, 0)
+            req.tokens = [int(t) for t in tokens]
+            req.status = status
+            req.ttft_s = ttft_s
+            req.done.set()
+            # evict: a long-lived controller must not retain every
+            # completed request forever (a late duplicate completion
+            # for an evicted rid is simply ignored by _on_done)
+            self._requests.pop(req.rid, None)
+        self.metrics.inc(f"requests_{status}")
+
+    # ---- routing ----
+    def _routable(self, exclude=()) -> list:
+        alive = set(self.svc.alive_slots())
+        with self._lock:
+            return [s for s in alive
+                    if s not in exclude and s not in self._draining
+                    and s not in self._quarantined
+                    and self.svc.state_of(s).healthy]
+
+    def _route(self, req: PoolRequest, *, exclude=None) -> None:
+        exclude = set(exclude or ())
+        while True:
+            with self._lock:
+                cands = self._routable(exclude)
+                if not cands:
+                    break
+                slot = min(cands, key=lambda s: self._inflight.get(s, 0))
+                prev = req.member
+                req.member = slot
+                self._inflight[slot] = self._inflight.get(slot, 0) + 1
+                if prev is not None:
+                    self._inflight[prev] = max(
+                        self._inflight.get(prev, 1) - 1, 0)
+            try:
+                self._send(slot, {"cmd": "submit", "rid": req.rid,
+                                  **req.msg})
+                return
+            except Exception:
+                with self._lock:
+                    self._inflight[slot] = max(
+                        self._inflight.get(slot, 1) - 1, 0)
+                    req.member = None
+                exclude.add(slot)
+        self._resolve(req, "error")
+        self.metrics.inc("requests_rejected_no_member")
+
+    def submit(self, prompt, *, max_tokens: int = 16, eos_id=None,
+               timeout_s: Optional[float] = None) -> PoolRequest:
+        rid = next(self._rids)
+        msg = {"prompt": [int(t) for t in prompt],
+               "max_tokens": int(max_tokens), "eos_id": eos_id,
+               "timeout_s": float(timeout_s if timeout_s is not None
+                                  else self.request_timeout_s)}
+        req = PoolRequest(rid, msg)
+        with self._lock:
+            self._requests[rid] = req
+        self.metrics.inc("pool_requests")
+        self._route(req)
+        return req
+
+    def generate(self, prompt, *, max_tokens: int = 16, eos_id=None,
+                 timeout_s: Optional[float] = None) -> dict:
+        req = self.submit(prompt, max_tokens=max_tokens, eos_id=eos_id,
+                          timeout_s=timeout_s)
+        # generous backstop over the serving deadline: a failover or a
+        # suspended-then-resumed member must not strand the waiter
+        if not req.done.wait(timeout=req.msg["timeout_s"] + 30.0):
+            self._resolve(req, "timeout")
+        return {"id": req.rid, "status": req.status or "ok",
+                "tokens": list(req.tokens), "ttft_s": req.ttft_s}
+
+    # ---- membership / failover ----
+    def _poll_loop(self, poll_s: float) -> None:
+        while not self._stop.wait(poll_s):
+            try:
+                self.poll()
+            except Exception:
+                traceback.print_exc()  # the poll must survive anything
+
+    def poll(self) -> int:
+        """One membership sweep; returns how many members failed over.
+        Serialized by ``_poll_lock``: the background poll thread and
+        direct callers (``revive_member``'s join wait, tests) share one
+        lease state machine."""
+        with self._poll_lock:
+            return self._poll_locked()
+
+    def _poll_locked(self) -> int:
+        events = self.svc.poll()
+        n = 0
+        for kind, slot in events:
+            if kind == "suspect":
+                self._suspect_t0[slot] = trace.now_us()
+                self.metrics.inc("members_suspected")
+            elif kind == "clear":
+                t0 = self._suspect_t0.pop(slot, None)
+                if t0 is not None:
+                    # the retroactive recovery span: the partition HEALED
+                    # — no loss, no rejoin, just a measured outage window
+                    trace.complete("serve.member_suspect", t0,
+                                   {"member": int(slot)}, cat="serve")
+                self.metrics.inc("members_suspect_cleared")
+            elif kind == "lost":
+                self._suspect_t0.pop(slot, None)
+                self.failover(slot)
+                n += 1
+            elif kind in ("join", "rejoin"):
+                with self._lock:
+                    self._quarantined.discard(slot)
+                    self._draining.discard(slot)
+                if kind == "rejoin":
+                    self.metrics.inc("members_rejoined")
+            elif kind == "left":
+                with self._lock:
+                    self._draining.discard(slot)
+        # a live process whose ENGINE died reports healthy=0 in its
+        # heartbeat: its queue drains 'error' member-side (each request
+        # re-routes via its completion event), but stop routing NEW work
+        # at it immediately
+        for slot in self.svc.alive_slots():
+            if not self.svc.state_of(slot).healthy and \
+                    slot not in self._quarantined:
+                with self._lock:
+                    self._quarantined.add(slot)
+                self.metrics.inc("members_engine_dead")
+        return n
+
+    def failover(self, slot: int) -> int:
+        """The member process is gone (lease expired past the suspect
+        grace): every outstanding request re-routes to a survivor, which
+        re-prefills from the original prompt — the cross-process fold
+        (the dead process took the emitted tokens with it, and greedy
+        decode regenerates them exactly)."""
+        slot = int(slot)
+        with self._lock:
+            if slot in self._quarantined:
+                return 0  # already failed over (engine-dead path)
+            self._quarantined.add(slot)
+            pending = [r for r in self._requests.values()
+                       if r.member == slot and not r.done.is_set()]
+        with trace.span("serve.failover", cat="serve") as sp:
+            sp.set("member", slot)
+            for req in pending:
+                self._route(req, exclude={slot})
+            sp.set("requests", len(pending))
+        p = self.procs[slot]
+        if p is not None and p.poll() is None:
+            pass  # suspended-past-grace: declared lost but still exists;
+            # revive_member replaces it (and reaps) if the operator asks
+        self.metrics.inc("pool_failovers")
+        self.metrics.inc("requests_failed_over", len(pending))
+        return len(pending)
+
+    # ---- planned drain (cross-process live migration) ----
+    def drain_member(self, slot: int, *, codec: Optional[str] = None,
+                     close: bool = True, target: Optional[int] = None,
+                     timeout_s: float = 60.0) -> int:
+        """Two-phase planned drain: the source process exports its live
+        KV slots + request records over the migrate wire, the target
+        adopts, and only the target's confirmation releases the source
+        (which then leaves cleanly and, with ``close``, exits).  Any
+        failure before the commit aborts back to a still-serving source.
+        Returns the number of requests migrated.
+
+        ``codec`` overrides the pool default for THIS drain (a
+        preemption-deadline drain picks "int8"; routine drains stay
+        lossless)."""
+        slot = int(slot)
+        codec = self.migrate_codec if codec is None \
+            else _migrate.check_codec(codec)
+        with self._lock:
+            if slot in self._draining or slot in self._quarantined:
+                return 0
+            self._draining.add(slot)
+        xid = next(_xfer_ids)
+        xfer = {"evt": threading.Event(), "events": {}}
+        self._xfers[xid] = xfer
+        try:
+            with trace.span("serve.migrate", cat="serve") as sp:
+                sp.set("member", slot)
+                if target is None:
+                    cands = self._routable({slot})
+                    if not cands:
+                        raise RuntimeError(
+                            f"no surviving peer to drain member {slot} "
+                            f"into")
+                    target = min(cands,
+                                 key=lambda s: self._inflight.get(s, 0))
+                sp.set("target", int(target))
+                ch = CROSSHOST_MIGRATE_BASE + next(_MIG_SEQ)
+                self._send(target, {"cmd": "recv_migration", "ch": ch,
+                                    "xfer": xid, "timeout_s": timeout_s})
+                self._await_xfer(xfer, ("mig_ready",), timeout_s)
+                self._send(slot, {"cmd": "drain", "ch": ch, "xfer": xid,
+                                  "codec": codec, "timeout_s": timeout_s})
+                ev = self._await_xfer(
+                    xfer, ("adopted", "adopt_failed", "drain_failed"),
+                    timeout_s)
+                if ev.get("type") != "adopted":
+                    # roll the source back before surfacing the failure
+                    try:
+                        self._send(slot, {"cmd": "drain_abort",
+                                          "xfer": xid})
+                    except Exception:
+                        traceback.print_exc()
+                    raise RuntimeError(
+                        f"cross-process drain failed: {ev.get('error', ev)}")
+                n = int(ev.get("n", 0))
+                # evidence for callers/tests: how many LIVE KV slots the
+                # peer adopted (mid-decode continuations, zero re-prefill)
+                self.last_drain = {"source": slot, "target": int(target),
+                                   "requests": n,
+                                   "slots": int(ev.get("slots", 0)),
+                                   "codec": codec}
+                # the hand-off is real: re-home the outstanding rids so
+                # the target's completion events find their requests
+                with self._lock:
+                    moved = [r for r in self._requests.values()
+                             if r.member == slot and not r.done.is_set()]
+                    for r in moved:
+                        r.member = int(target)
+                    self._inflight[int(target)] = \
+                        self._inflight.get(int(target), 0) + len(moved)
+                    self._inflight[slot] = 0
+                self._send(slot, {"cmd": "drain_commit", "xfer": xid,
+                                  "exit": bool(close)})
+                sp.set("requests", n)
+        except Exception:
+            with self._lock:
+                self._draining.discard(slot)
+            raise
+        finally:
+            self._xfers.pop(xid, None)
+        if close:
+            p = self.procs[slot]
+            if p is not None:
+                try:
+                    p.wait(timeout=10.0)
+                except Exception:
+                    p.kill()
+        else:
+            # the emptied member keeps serving (it never left the
+            # blackboard): put it back in the routing set now
+            with self._lock:
+                self._draining.discard(slot)
+        self.metrics.inc("pool_migrations")
+        self.metrics.inc("requests_migrated", n)
+        return n
+
+    @staticmethod
+    def _await_xfer(xfer: dict, kinds, timeout_s: float) -> dict:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            for k in kinds:
+                ev = xfer["events"].get(k)
+                if ev is not None:
+                    return ev
+            xfer["evt"].wait(0.05)
+            xfer["evt"].clear()
+        raise TimeoutError(f"no {kinds} event within {timeout_s}s")
+
+    # ---- membership operations ----
+    def revive_member(self, slot: int) -> None:
+        """Replace a lost/drained member with a FRESH process on the
+        same slot (new incarnation, new control channels); it rejoins
+        routing once its first heartbeat lands."""
+        slot = int(slot)
+        p = self.procs[slot]
+        if p is not None and p.poll() is None:
+            p.kill()
+            p.wait()
+        self._spawn(slot)
+        self._wait_joined([slot])
+        with self._lock:
+            self._quarantined.discard(slot)
+            self._draining.discard(slot)
+        self.metrics.inc("members_revived")
+
+    # ---- lifecycle ----
+    def close(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        t = getattr(self, "_poll_thread", None)
+        if t is not None:
+            t.join(timeout_s)
+        for slot in range(self.n_members):
+            try:
+                self._send(slot, {"cmd": "shutdown"}, timeout_s=0.5,
+                           attempts=1)
+            except Exception:
+                pass
+        for _, (th, stop) in list(self._listeners.items()):
+            stop.set()
+        deadline = time.monotonic() + 5.0
+        for p in self.procs:
+            if p is None:
+                continue
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except Exception:
+                p.kill()
+                p.wait()
+        for slot, ent in list(self._out.items()):
+            try:
+                ent[0].close()
+            except Exception:
+                pass
+        bb = getattr(self, "_bb", None)
+        if bb is not None:
+            bb.close()
+        if self._own_van:
+            self._van.stop()
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(member_main(sys.argv[1]))

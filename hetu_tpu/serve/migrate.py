@@ -138,6 +138,17 @@ def request_from_record(rec: dict, *, now: float | None = None):
 CODECS = ("none", "bf16", "int8")
 
 
+def check_codec(codec: str) -> str:
+    """Validate a KV wire codec name up front (pool construction, the
+    per-drain override) so a typo fails where it was written, not at
+    the first drain under a preemption deadline.  ONE home for the
+    check — both pool flavors and both override points use it."""
+    if codec not in CODECS:
+        raise ValueError(f"unknown migrate codec {codec!r}; expected "
+                         f"one of {CODECS}")
+    return codec
+
+
 def _encode_kv(arr: np.ndarray, codec: str, dt: np.dtype) -> bytes:
     """One K or V array ``[layers, tokens, heads, head_dim]`` → body bytes.
 
@@ -334,6 +345,92 @@ def check_spec(spec, spec_dict: dict) -> None:
             f"KV cache geometry mismatch: payload {theirs} vs local "
             f"{mine} — slots can only migrate between engines serving "
             f"the same model geometry")
+
+
+# ---------------------------------------------------------------------------
+# whole-scheduler payloads (the cross-process drain)
+# ---------------------------------------------------------------------------
+
+def export_payload(scheduler, *, codec: str = "none"):
+    """Export EVERY in-flight request from ``scheduler`` into one
+    self-describing migration payload: mid-decode requests ride with
+    their live KV snapshots (zero re-prefill on the adopter), queued
+    ones as bare records.  The scheduler half of a PROCESS-BOUNDARY
+    drain (serve/crosshost.py): unlike :func:`migrate_inflight`, source
+    and destination here share no objects — everything a peer process
+    needs crosses inside the payload.
+
+    Returns ``(payload, pairs)``; ``pairs`` is the live export the
+    caller must hold for rollback (``scheduler.adopt_inflight(pairs)``)
+    until the peer confirms adoption, then release via
+    :func:`release_exported`.  Each request record carries its SOURCE
+    slot id (``rec["slot"]``, None for queued) so :func:`adopt_payload`
+    can rebind it to the imported snapshot."""
+    pairs, snaps = scheduler.export_inflight_with_slots()
+    try:
+        records = []
+        now = time.monotonic()
+        for req, slot in pairs:
+            rec = request_record(req, now=now)
+            rec["slot"] = None if slot is None else int(slot)
+            records.append(rec)
+        payload = pack(scheduler.engine.cache.spec, snaps, records,
+                       codec=codec)
+    except Exception:
+        # the export succeeded but the payload build did not: the
+        # requests are off the scheduler and the CALLER never received
+        # `pairs` to roll back — re-adopt here or they strand forever
+        scheduler.adopt_inflight(pairs)
+        raise
+    return payload, pairs
+
+
+def adopt_payload(scheduler, payload: bytes):
+    """Adopt an :func:`export_payload` payload into ``scheduler`` —
+    geometry-gated, all-or-nothing (KV import + request attachment under
+    the adopter's scheduler lock).  Requests are REBUILT from their wire
+    records (:func:`request_from_record`): the adopting process owns
+    fresh ``Request`` objects whose completion the caller must report
+    back over its own control plane.  Returns ``(requests,
+    slot_map)`` in the payload's admission order."""
+    spec_d, snaps, records = unpack(payload)
+    check_spec(scheduler.engine.cache.spec, spec_d)
+    now = time.monotonic()
+    by_slot = {int(s.slot): s for s in snaps}
+    pairs = []
+    for rec in records:
+        req = request_from_record(rec, now=now)
+        slot = rec.get("slot")
+        if slot is not None and int(slot) not in by_slot:
+            raise MigrationError(
+                f"record {rec.get('rid')} names source slot {slot} but "
+                f"the payload carries no snapshot for it")
+        pairs.append((req, None if slot is None else int(slot)))
+    carried = {s for _, s in pairs if s is not None}
+    orphans = sorted(set(by_slot) - carried)
+    if orphans:
+        raise MigrationError(
+            f"payload carries snapshots for slots {orphans} that no "
+            f"request record references — refusing a partial adoption")
+    try:
+        slot_map = scheduler.adopt_inflight(pairs,
+                                            snapshots=snaps or None)
+    except Exception as e:
+        raise MigrationTargetError(
+            f"destination failed the adoption: {e}") from e
+    return [req for req, _ in pairs], slot_map
+
+
+def release_exported(scheduler, pairs) -> None:
+    """Commit half of a cross-process drain: the peer confirmed
+    adoption, so the source's exported slots are dead weight — release
+    them (best-effort; the source may be about to exit anyway) and
+    charge ``requests_exported`` with the committed hand-off."""
+    from hetu_tpu.serve.scheduler import release_slot_best_effort
+    for _req, slot in pairs:
+        if slot is not None:
+            release_slot_best_effort(scheduler.engine, slot)
+    scheduler.metrics.inc("requests_exported", len(pairs))
 
 
 # ---------------------------------------------------------------------------

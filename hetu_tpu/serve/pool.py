@@ -201,13 +201,8 @@ class ServingPool:
         self._max_loop_errors = int(max_loop_errors)
         self._failover_grace_s = float(failover_grace_s)
         self._chunk_bytes = int(chunk_bytes)
-        # wire codec for drain payloads ("bf16"/"int8", see migrate.pack);
-        # validated here so a typo fails at pool construction, not at the
-        # first drain under a preemption deadline
-        if migrate_codec not in _migrate.CODECS:
-            raise ValueError(f"unknown migrate_codec {migrate_codec!r}; "
-                             f"expected one of {_migrate.CODECS}")
-        self.migrate_codec = migrate_codec
+        # wire codec for drain payloads ("bf16"/"int8", see migrate.pack)
+        self.migrate_codec = _migrate.check_codec(migrate_codec)
         self._lock = threading.RLock()
         # see _MIG_SEQ: ids are drawn process-globally; the base is only
         # caller-assignable for pools in SEPARATE processes on one van
@@ -450,7 +445,8 @@ class ServingPool:
 
     # ---- planned drain (live migration) ----
     def drain_member(self, name: str, *, close: bool = True,
-                     wire: bool = True) -> dict:
+                     wire: bool = True,
+                     codec: Optional[str] = None) -> dict:
         """Planned drain (operator signal or ``serve_preempt`` fault):
         migrate every live KV slot and in-flight request to a surviving
         peer — the peer continues mid-decode sequences token-for-token
@@ -463,10 +459,18 @@ class ServingPool:
         CRC-checked chunks (the same path a cross-process pool takes);
         ``wire=False`` hands the host arrays over directly.
 
+        ``codec`` overrides the pool-level ``migrate_codec`` for THIS
+        drain only (PR 7 residual): a preemption-deadline drain can pick
+        "int8" (~4x smaller payload, near-lossless) while routine drains
+        stay on the pool default — the codec is a per-eviction-notice
+        decision, not a pool property.  ``None`` = the pool default.
+
         On failure the member re-adopts everything and KEEPS SERVING
         (the error re-raises) — unless its engine is already dead, in
         which case the caller's health poll takes the failover path.
         """
+        codec = self.migrate_codec if codec is None \
+            else _migrate.check_codec(codec)
         m = self.members[name]
         with self._lock:
             if m.dead or m.draining:
@@ -512,7 +516,7 @@ class ServingPool:
                         slot_map = _migrate.migrate_inflight(
                             m.scheduler, tgt.scheduler,
                             wire=tuple(chs) if chs else None,
-                            codec=self.migrate_codec,
+                            codec=codec,
                             chunk_bytes=self._chunk_bytes)
                         break
                     except _migrate.MigrationTargetError:

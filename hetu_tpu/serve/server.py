@@ -44,6 +44,15 @@ def response_channel(client_id: int) -> int:
 
 
 class InferenceServer:
+    """Engine loop + wire listeners over one scheduler.
+
+    ``max_clients=0`` is the LISTENER-LESS mode: only the engine loop,
+    crash-requeue, and failover-grace machinery run — the deployment
+    unit both pool flavors build on (serve/pool.py routes to it
+    in-process; serve/crosshost.py wraps it in a member PROCESS whose
+    submit/event channels and membership heartbeat replace the
+    per-client listeners)."""
+
     def __init__(self, scheduler: ContinuousBatchingScheduler, *,
                  port: int = 0, max_clients: int = 4,
                  request_timeout_s: float = 60.0,

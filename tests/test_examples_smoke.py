@@ -43,6 +43,8 @@ CASES = [
     ("gpt_serve", ["--requests", "4", "--max-tokens", "8"], "serve: OK"),
     ("gpt_serve_pool", ["--requests", "6", "--max-tokens", "8"],
      "serve pool: OK"),
+    ("gpt_serve_crosshost", ["--requests", "6", "--max-tokens", "16"],
+     "crosshost serve: OK"),
     ("ctr_serve", ["--steps", "40", "--requests", "16"], "ctr serve: OK"),
     ("resilient_train", ["--steps", "30"], "resilient train: OK"),
     ("elastic_train", ["--steps", "24"], "elastic train: OK"),

@@ -71,6 +71,51 @@ def test_schedule_same_seed_replays_byte_for_byte():
     assert FaultSchedule.from_json(a.to_json()).to_json() == a.to_json()
 
 
+def test_schedule_old_kwargs_stay_byte_identical_frozen():
+    """FROZEN bytes: this exact schedule was captured before the
+    process-level fault kinds (member_kill/member_suspend/
+    worker_proc_kill) existed.  New kinds must draw from the rng AFTER
+    every pre-existing kind, so old-seed schedules replay byte-for-byte
+    across versions — if this test breaks, a draw was reordered and
+    every recorded chaos run's replay contract with it."""
+    s = FaultSchedule.generate(
+        steps=50, seed=7, van_errors=2, van_delays=1, data_errors=1,
+        nan_steps=1, kill_shards=1, suspend_shards=1, n_shards=2,
+        preempt_at=40, worker_losses=1, worker_joins=1, n_workers=3,
+        serve_preempts=1, serve_engine_kills=1, n_members=2)
+    assert s.to_json() == (
+        '[[1,"serve_preempt",0.0,0.0],[3,"suspend_shard",0.0,0.3],'
+        '[14,"worker_loss",2.0,0.0],[29,"data_error",0.0,0.0],'
+        '[31,"van_error",0.0,0.0],[39,"nan_grad",0.0,0.0],'
+        '[40,"preempt",0.0,0.0],[41,"kill_shard",0.0,0.0],'
+        '[41,"serve_engine_kill",0.0,0.0],[44,"van_delay",0.02,0.0],'
+        '[46,"van_error",0.0,0.0],[46,"worker_join",2.0,0.0]]')
+    assert s.schedule_id == "3ecb3f71"
+
+
+def test_schedule_process_fault_kinds_draw_after_everything():
+    """Adding the process-level counts must not perturb any earlier
+    kind's draws — same events, plus the new ones."""
+    old = dict(steps=50, seed=7, van_errors=2, kill_shards=1, n_shards=2,
+               serve_preempts=1, n_members=2)
+    base = FaultSchedule.generate(**old)
+    grown = FaultSchedule.generate(**old, member_kills=1,
+                                   member_suspends=1, worker_proc_kills=1,
+                                   n_workers=3)
+    old_events = [e for e in grown.events
+                  if e.kind not in ("member_kill", "member_suspend",
+                                    "worker_proc_kill")]
+    assert old_events == base.events
+    new_kinds = [e.kind for e in grown.events
+                 if e.kind in ("member_kill", "member_suspend",
+                               "worker_proc_kill")]
+    assert sorted(new_kinds) == ["member_kill", "member_suspend",
+                                 "worker_proc_kill"]
+    # byte-stable serialization for the new kinds too
+    assert FaultSchedule.from_json(grown.to_json()).to_json() == \
+        grown.to_json()
+
+
 def test_schedule_at_and_validation():
     s = FaultSchedule([FaultEvent(3, "nan_grad"), FaultEvent(3, "van_error"),
                        FaultEvent(5, "preempt")])

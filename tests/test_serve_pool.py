@@ -145,6 +145,52 @@ def test_planned_drain_migrates_zero_prefill(gpt):
         pool.close()
 
 
+def test_drain_codec_override_per_drain(gpt):
+    """ISSUE 9 satellite (PR 7 residual): ``drain_member(codec=)``
+    overrides the pool-level ``migrate_codec`` for ONE drain — the
+    preemption-deadline case picks a compressed wire while the pool
+    default stays lossless — and the compressed body really moves fewer
+    wire bytes (``serve.migrate.bytes_*`` telemetry delta)."""
+    from hetu_tpu.serve import Request
+    from hetu_tpu.telemetry import default_registry as reg
+
+    def counter(name):
+        m = reg.metrics().get(name)
+        return m.value if m is not None else 0
+
+    model, variables = gpt
+    f = _factory(model, variables)
+    pool = ServingPool({"a": f, "b": f}, start_poll=False)
+    try:
+        with pytest.raises(ValueError, match="codec"):
+            pool.drain_member("a", codec="zstd")
+        a = pool.members["a"]
+        reqs = []
+        for p in ([1, 2, 3], [9, 8, 7, 6]):
+            r = Request(prompt=p, max_tokens=12, timeout_s=90.0)
+            a.scheduler.submit(r)
+            reqs.append(r)
+        deadline = time.monotonic() + 30
+        while not all(r.tokens for r in reqs):
+            assert time.monotonic() < deadline, "decode never started"
+            time.sleep(0.01)
+        logical0 = counter("serve.migrate.bytes_logical")
+        wire0 = counter("serve.migrate.bytes_wire")
+        slot_map = pool.drain_member("a", codec="bf16")
+        assert len(slot_map) >= 1
+        # the pool-level default is untouched by the per-drain override
+        assert pool.migrate_codec == "none"
+        logical = counter("serve.migrate.bytes_logical") - logical0
+        wire = counter("serve.migrate.bytes_wire") - wire0
+        assert logical > 0
+        assert wire * 2 == logical  # bf16 body: exactly half the bytes
+        for r in reqs:
+            assert r.done.wait(60)
+            assert r.status == "ok"
+    finally:
+        pool.close()
+
+
 def test_unplanned_kill_fails_over_with_parity(gpt):
     model, variables = gpt
     f = _factory(model, variables)

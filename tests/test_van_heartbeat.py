@@ -1,0 +1,149 @@
+"""Van ``heartbeat_ms`` under REAL process death (ISSUE 9 satellite).
+
+``resilience/supervisor.py`` (default_is_transient) retries
+``hetu_ps``-tagged RuntimeErrors on the claim that "during a shard
+restart these clear once the heartbeat re-resolves the endpoint".
+This file asserts that claim end to end with actual SIGKILLed
+processes: a killed group shard is detected dead within the heartbeat
+window, ops against it fail AS transients (retryable per the
+supervisor's predicate), and a restarted shard — same port (static
+endpoints) or a NEW port (scheduler-resolved) — re-resolves with no
+client reconfiguration.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.crosshost]
+
+from hetu_tpu.ps import available
+
+if not available():  # pragma: no cover
+    pytest.skip("native PS lib unavailable", allow_module_level=True)
+
+from hetu_tpu.ps import van
+from hetu_tpu.resilience.shardproc import (
+    free_port, spawn_registered_server, spawn_shard_server,
+)
+from hetu_tpu.resilience.supervisor import default_is_transient
+
+HB_MS = 100
+
+
+def _wait_alive(table, want, *, budget_s):
+    """Poll the group's alive mask until it equals ``want``; the budget
+    is expressed in heartbeat windows — the detection-latency claim."""
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        if table.alive == want:
+            return time.monotonic()
+        time.sleep(0.02)
+    raise AssertionError(f"alive stayed {table.alive}, wanted {want} "
+                         f"within {budget_s}s")
+
+
+def test_sigkilled_shard_detected_within_heartbeat_window(tmp_path):
+    ports = [free_port() for _ in range(2)]
+    procs = [spawn_shard_server(tmp_path, p, f"hb{i}")
+             for i, p in enumerate(ports)]
+    table = None
+    try:
+        table = van.PartitionedPSTable(
+            [("127.0.0.1", p) for p in ports], rows=64, dim=4,
+            table_id=4301, optimizer="sgd", lr=1.0, heartbeat_ms=HB_MS)
+        idx = np.arange(64)
+        base = table.sparse_pull(idx)
+        assert table.alive == [True, True]
+
+        procs[0].kill()
+        procs[0].wait()
+        t_kill = time.monotonic()
+        # detected within a few heartbeat windows (generous 20x margin
+        # for a loaded CI box — the claim is "the window", not "ever")
+        t_seen = _wait_alive(table, [False, True],
+                             budget_s=20 * HB_MS / 1000.0)
+        assert t_seen - t_kill < 20 * HB_MS / 1000.0
+
+        # ops touching the dead shard fail AS TRANSIENTS — exactly what
+        # the supervisor's retry predicate (supervisor.py) claims clears
+        # after the heartbeat re-resolves
+        with pytest.raises(Exception) as ei:
+            table.sparse_pull(idx)
+        assert default_is_transient(ei.value), ei.value
+
+        # restart on the SAME port: the heartbeat reconnects, the blank
+        # shard is re-created (recovered increments), ops clear with NO
+        # client reconfiguration
+        procs[0] = spawn_shard_server(tmp_path, ports[0], "hb0b")
+        _wait_alive(table, [True, True], budget_s=10.0)
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                again = table.sparse_pull(idx)
+                break
+            except Exception as e:
+                assert default_is_transient(e), e
+                assert time.monotonic() < deadline, "ops never cleared"
+                time.sleep(0.05)
+        assert table.recovered >= 1
+        # shard 1 never died: its rows are bitwise intact
+        starts = table.shard_starts + [64]
+        lo, hi = starts[1], starts[2]
+        assert np.array_equal(again[lo:hi], base[lo:hi])
+    finally:
+        if table is not None:
+            table.close()
+        for p in procs:
+            p.kill()
+            p.wait()
+
+
+def test_restarted_shard_re_resolves_at_a_new_port(tmp_path):
+    """The scheduler-resolved rejoin path: the replacement comes back on
+    a DIFFERENT port with only a rank hint, and the same client group
+    re-resolves it through the scheduler map — the full claim behind
+    supervisor.py's transient-retry comment."""
+    sched_port = free_port()
+    sched = spawn_shard_server(tmp_path, sched_port, "sched")
+    servers = [spawn_registered_server(tmp_path, sched_port, f"r{i}",
+                                       rank_hint=i, beat_ms=100)
+               for i in range(2)]
+    table = None
+    try:
+        table = van.PartitionedPSTable.from_scheduler(
+            "127.0.0.1", sched_port, 2, rows=64, dim=4, table_id=4302,
+            optimizer="sgd", lr=1.0, heartbeat_ms=HB_MS)
+        idx = np.arange(64)
+        table.sparse_pull(idx)
+
+        servers[1].kill()
+        servers[1].wait()
+        _wait_alive(table, [True, False], budget_s=5.0)
+
+        # rejoin at a NEW (OS-chosen) port, same rank hint
+        servers[1] = spawn_registered_server(tmp_path, sched_port, "r1b",
+                                             rank_hint=1, beat_ms=100)
+        new_port = int(servers[1].ready[0])
+        _wait_alive(table, [True, True], budget_s=10.0)
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                table.sparse_pull(idx)
+                break
+            except Exception as e:
+                assert default_is_transient(e), e
+                assert time.monotonic() < deadline, "ops never cleared"
+                time.sleep(0.05)
+        # the client really is talking to the NEW endpoint: the
+        # scheduler map advertises it alive at the new port
+        m = {e["rank"]: e for e in van.scheduler_map("127.0.0.1",
+                                                     sched_port)}
+        assert m[1]["alive"] and m[1]["port"] == new_port
+    finally:
+        if table is not None:
+            table.close()
+        for p in [sched] + servers:
+            p.kill()
+            p.wait()
