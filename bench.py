@@ -1729,6 +1729,228 @@ def bench_crosshost():
     })
 
 
+def bench_netchaos():
+    """Network-plane chaos: what gray failures cost, and what the
+    system responses buy back.
+
+    Scripted scenario on a cross-process serving pool (real member
+    processes, ps/netem link emulation inside them):
+
+    1. **Partition detection** — K seeded one-way EGRESS partitions of
+       a member (its beats black-hole, it still hears the controller);
+       the timeline pairs each ``fault.netem_partition`` with its
+       retroactive ``serve.member_suspect`` window → detect p50/p99
+       (how long a one-way partition goes unnoticed; bounded by
+       lease_s + poll) and recover p50/p99 (the heal), with lost=0 and
+       failovers=0 asserted — the membership-hardening contract.
+
+    2. **Shed vs collapse** — the same seeded traffic spike + lossy
+       link driven at TWO pools, admission shedding on vs off.  The
+       deadline and spike size are calibrated from warm SEQUENTIAL
+       singles (compile and queueing excluded) so the overload is
+       genuine on any box: the spike offers ~2.5x what the pool can
+       serve inside the deadline.  Accepted requests finish inside
+       their deadlines in BOTH arms (the deadline eviction guarantees
+       that); what differs is the OVERFLOW: with shedding off it
+       queues until the deadline evicts it (timeout-collapse — the
+       client burns the full deadline to learn nothing), with it on it
+       resolves 'shed' in milliseconds.  The headline metric is the
+       overflow's p99 resolution-latency ratio (no-shed / shed) over
+       the identical spike, with shed-arm timeouts asserted ZERO.
+    """
+    import os
+    import tempfile
+    import threading
+
+    from hetu_tpu.resilience.faults import (
+        FaultEvent, FaultInjector, FaultSchedule,
+    )
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+    from hetu_tpu.telemetry import timeline, trace
+
+    smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
+    if smoke:
+        H, L, SLOTS, MAXLEN, GEN, PARTS = 64, 2, 4, 48, 24, 2
+    else:
+        H, L, SLOTS, MAXLEN, GEN, PARTS = 128, 4, 6, 96, 48, 3
+    model_spec = {"vocab_size": 256, "hidden_size": H, "num_layers": L,
+                  "num_heads": 4, "ffn_size": 4 * H,
+                  "max_position": MAXLEN, "num_slots": SLOTS,
+                  "max_len": MAXLEN, "min_bucket": 8, "seed": 0}
+    N_MEMBERS, LEASE_S, GRACE_S, PART_S = 3, 0.4, 2.5, 0.8
+    capacity = N_MEMBERS * SLOTS
+    g = np.random.default_rng(0)
+
+    def run_pool(wd, *, shed):
+        return CrossProcessServingPool(
+            N_MEMBERS, workdir=wd, model=model_spec, hb_ms=60,
+            lease_s=LEASE_S, suspect_grace_s=GRACE_S,
+            request_timeout_s=300.0, shed=shed,
+            member_env={"JAX_PLATFORMS": "cpu"})
+
+    def fire(pool, prompts, timeout_s):
+        """Generate all prompts concurrently; returns (results,
+        per-request resolution latencies)."""
+        results, lat = {}, {}
+
+        def worker(i, p):
+            t0 = time.perf_counter()
+            results[i] = pool.generate(p, max_tokens=GEN,
+                                       timeout_s=timeout_s)
+            lat[i] = time.perf_counter() - t0
+
+        ts = [threading.Thread(target=worker, args=(i, p))
+              for i, p in enumerate(prompts)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(600)
+        assert len(results) == len(prompts)
+        return results, lat
+
+    def prompts_for(n):
+        return [[int(t) for t in g.integers(1, 250, 3)] for _ in range(n)]
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(int(q * len(xs)), len(xs) - 1)]
+
+    def _spike_stats(res, lat):
+        statuses = {i: r["status"] for i, r in res.items()}
+        vals = list(statuses.values())
+        ok_lat = [lat[i] for i, s in statuses.items() if s == "ok"]
+        over_lat = [lat[i] for i, s in statuses.items() if s != "ok"]
+        return {
+            "ok": vals.count("ok"), "shed": vals.count("shed"),
+            "timeout": vals.count("timeout"),
+            "error": vals.count("error"),
+            "ok_p99_s": round(pct(ok_lat, 0.99), 4) if ok_lat else None,
+            # the OVERFLOW's time-to-resolution: how long a client
+            # waits to learn its request will not be served
+            "overflow_p99_s": round(pct(over_lat, 0.99), 4)
+            if over_lat else None}
+
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    arms = {}
+    try:
+        # ---- arm 1: shed pool — partitions, then the calibrated spike
+        with tempfile.TemporaryDirectory(prefix="bench_netchaos_") as wd:
+            pool = run_pool(wd, shed=True)
+            try:
+                # warmup round 1: compiles + seeds every member's
+                # service-time model (latencies here include compile —
+                # calibration must NOT use them)
+                warm, _ = fire(pool, prompts_for(capacity), 300.0)
+                assert all(r["status"] == "ok" for r in warm.values())
+                # calibration round: closed-loop WARM burst -> the
+                # pool's real sustainable rate, every bottleneck
+                # included (decode, wire, event-channel serialization —
+                # the last dominates this tiny model, exactly as it
+                # would dominate a control-plane-bound deployment)
+                t0 = time.perf_counter()
+                warm2, _ = fire(pool, prompts_for(3 * capacity), 300.0)
+                assert all(r["status"] == "ok" for r in warm2.values())
+                rate = (3 * capacity) / (time.perf_counter() - t0)
+                # the spike offers ~3x what the pool can serve inside
+                # the deadline; the deadline floor keeps it well above
+                # the cross-process event-transit tail so 'shed in
+                # milliseconds' vs 'burn the whole deadline' is the
+                # thing actually measured
+                spike_n = min(int(3.0 * rate * 3.0), 300)
+                deadline_s = max(spike_n / (3.0 * rate), 1.2)
+                sched = FaultSchedule(
+                    [FaultEvent(k + 1, "netem_partition", 1.0, PART_S)
+                     for k in range(PARTS)] +
+                    [FaultEvent(PARTS + 1, "netem_degrade", 0.0, 3.0)])
+                inj = FaultInjector(sched)
+                # partition rounds: light traffic, suspect+clear each
+                for k in range(PARTS):
+                    inj.on_step(k + 1)
+                    pool.run_net_events(inj.pop_net_events())
+                    res, _ = fire(pool, prompts_for(4), 300.0)
+                    assert all(r["status"] == "ok" for r in res.values())
+                    deadline = time.monotonic() + 30
+                    while pool.metrics.count(
+                            "members_suspect_cleared") < k + 1 and \
+                            time.monotonic() < deadline:
+                        time.sleep(0.05)
+                assert pool.metrics.count("pool_failovers") == 0
+                assert pool.metrics.count("members_suspected") == PARTS
+                assert pool.metrics.count(
+                    "members_suspect_cleared") == PARTS
+                # the lossy link + the spike
+                inj.on_step(PARTS + 1)
+                pool.run_net_events(inj.pop_net_events())
+                spike_prompts = prompts_for(spike_n)
+                res, lat = fire(pool, spike_prompts, deadline_s)
+                arms["shed"] = _spike_stats(res, lat)
+                # the shed contract: zero timeout-collapse, real sheds
+                assert arms["shed"]["timeout"] == 0, arms
+                assert arms["shed"]["shed"] > 0, arms
+            finally:
+                pool.close()
+
+        # ---- arm 2: same spike, shedding off (the collapse baseline)
+        with tempfile.TemporaryDirectory(prefix="bench_netchaos_") as wd:
+            pool = run_pool(wd, shed=False)
+            try:
+                warm, _ = fire(pool, prompts_for(capacity), 300.0)
+                assert all(r["status"] == "ok" for r in warm.values())
+                inj2 = FaultInjector(FaultSchedule(
+                    [FaultEvent(1, "netem_degrade", 0.0, 3.0)]))
+                inj2.on_step(1)
+                pool.run_net_events(inj2.pop_net_events())
+                res, lat = fire(pool, spike_prompts, deadline_s)
+                arms["noshed"] = _spike_stats(res, lat)
+                # the collapse baseline must actually collapse, or the
+                # calibration failed and the A/B is meaningless
+                assert arms["noshed"]["timeout"] > 0, arms
+            finally:
+                pool.close()
+    finally:
+        trace.disable()
+
+    pairs = timeline.correlate(tracer.events)
+    parts = [p for p in pairs if p.kind == "netem_partition"]
+    assert len(parts) == PARTS and all(p.paired for p in parts), parts
+    assert all(p.recovery_name == "serve.member_suspect" for p in parts)
+    detect = [p.detect_s for p in parts]
+    recover = [p.recover_s for p in parts]
+    ratio = arms["noshed"]["overflow_p99_s"] / \
+        max(arms["shed"]["overflow_p99_s"] or 1e-9, 1e-9)
+    print(f"# partition detect p50 {pct(detect, 0.5) * 1e3:8.1f} ms  "
+          f"p99 {pct(detect, 0.99) * 1e3:8.1f} ms  "
+          f"(lease {LEASE_S}s)", file=sys.stderr)
+    print(f"# spike ({spike_n} req, deadline {deadline_s:.2f}s): "
+          f"shed arm ok {arms['shed']['ok']} shed "
+          f"{arms['shed']['shed']} timeout {arms['shed']['timeout']} "
+          f"(overflow p99 {arms['shed']['overflow_p99_s']}s)  vs  "
+          f"no-shed ok {arms['noshed']['ok']} timeout "
+          f"{arms['noshed']['timeout']} (overflow p99 "
+          f"{arms['noshed']['overflow_p99_s']}s)", file=sys.stderr)
+    _emit({
+        "metric": "netchaos_shed_vs_noshed_p99_x",
+        "value": round(ratio, 3),
+        "unit": "noshed_over_shed_overflow_p99_resolution_ratio",
+        "vs_baseline": round(ratio, 3),
+        "extra": {
+            "partition_detect_s": {"p50": round(pct(detect, 0.5), 3),
+                                   "p99": round(pct(detect, 0.99), 3)},
+            "partition_recover_s": {"p50": round(pct(recover, 0.5), 3),
+                                    "p99": round(pct(recover, 0.99), 3)},
+            "partitions": PARTS, "partition_s": PART_S,
+            "lease_s": LEASE_S, "suspect_grace_s": GRACE_S,
+            "spike_requests": spike_n,
+            "deadline_s": round(deadline_s, 3),
+            "warm_rate_req_per_s": round(rate, 2),
+            "arms": arms,
+            "ab": {"optimized": "deadline_projection_admission_shed",
+                   "baseline": "queue_everything_no_shed"},
+        },
+    })
+
+
 _METRIC_BY_CMD = {
     "gpt": "gpt2s_bf16_train_mfu_1chip",
     "gpt_sweep": "gpt_config_sweep_best_mfu_1chip",
@@ -1743,6 +1965,7 @@ _METRIC_BY_CMD = {
     "elastic": "elastic_supervisor_overhead_pct",
     "telemetry": "telemetry_tracing_overhead_pct",
     "crosshost": "crosshost_drain_overhead_x",
+    "netchaos": "netchaos_shed_vs_noshed_p99_x",
 }
 
 
@@ -1783,6 +2006,7 @@ def main():
      "resilience": bench_resilience,
      "elastic": bench_elastic,
      "crosshost": bench_crosshost,
+     "netchaos": bench_netchaos,
      "telemetry": bench_telemetry}.get(cmd, bench_gpt)()
 
 

@@ -77,7 +77,24 @@ def fresh_table_id() -> int:
 
 F_INCARNATION, F_BEAT, F_FLAG, F_LOAD = 0, 1, 2, 3
 F_HEALTHY, F_COMMITTED, F_EPOCH_ACK, F_PID = 4, 5, 6, 7
+# control row: fields 5/6 are the straggler-injection plane (a slot the
+# controller wants running behind an emulated slow link, and the per-op
+# netem delay in ms) — carried on the control row because workers
+# already poll it every step and a SEPARATE wire for fault plumbing
+# would not survive the very link faults it injects
 C_EPOCH, C_WIDTH, C_MASK, C_RESUME, C_PHASE = 0, 1, 2, 3, 4
+C_SLOW_SLOT, C_SLOW_MS = 5, 6
+
+
+class MembershipWireError(TimeoutError):
+    """A membership control-plane RPC exhausted its bounded retries (or
+    its wall-clock budget) against transient transport failures.  Names
+    the op and the LINK so an operator reading a chaos log knows which
+    wire was down — a bare ``ConnectionError`` from the Nth retry says
+    neither.  Subclasses ``TimeoutError``: the caller-visible semantic
+    is "the control plane did not answer in time", and retry layers
+    above must not spin on it (the bounded retrying already happened
+    here)."""
 
 
 def fresh_incarnation() -> int:
@@ -88,27 +105,60 @@ def fresh_incarnation() -> int:
 
 def control_rpc(fn: Callable, *, attempts: int = 4, base_s: float = 0.05,
                 max_s: float = 1.0, rng: Optional[random.Random] = None,
-                is_transient: Optional[Callable] = None):
+                is_transient: Optional[Callable] = None,
+                op: str = "", link: str = "",
+                deadline_s: Optional[float] = None):
     """Run one control-plane wire op with bounded retry + exponential
     backoff + jittered deadlines.  Membership traffic shares the van with
     bulk KV/gradient transfers, so a transiently saturated (or
     fault-injected) wire must cost a retry, not a false loss decision —
-    while real bugs (non-transient errors) surface immediately."""
+    while real bugs (non-transient errors) surface immediately.
+
+    Exhausting the retries against TRANSIENT failures raises
+    :class:`MembershipWireError` naming ``op`` and ``link`` (when given)
+    with the last underlying error chained — under a 100%-loss link
+    (netem partition) the caller gets a clear, attributable timeout, not
+    the Nth bare ``ConnectionError``.  ``deadline_s`` additionally caps
+    the TOTAL wall-clock across attempts and backoff sleeps: once the
+    budget is spent no further attempt starts and the remaining backoff
+    is truncated, so a fully partitioned member's heartbeat loop cycles
+    at a bounded period instead of stacking full backoff ladders."""
     if is_transient is None:
         from hetu_tpu.resilience.supervisor import default_is_transient
         is_transient = default_is_transient
     rng = rng if rng is not None else random
+    t0 = time.monotonic()
     delay = base_s
-    for attempt in range(max(int(attempts), 1)):
+    last = None
+    attempts = max(int(attempts), 1)
+    for attempt in range(attempts):
         try:
             return fn()
         except Exception as e:
-            if attempt + 1 >= attempts or not is_transient(e):
+            if not is_transient(e):
                 raise
+            last = e
+            elapsed = time.monotonic() - t0
+            out_of_time = (deadline_s is not None and
+                           elapsed >= deadline_s)
+            if attempt + 1 >= attempts or out_of_time:
+                where = f" {op}" if op else ""
+                via = f" over link {link}" if link else ""
+                raise MembershipWireError(
+                    f"membership rpc{where}{via} failed after "
+                    f"{attempt + 1} attempts in {elapsed:.2f}s "
+                    f"(last error: {e!r})") from e
             # full jitter: desynchronize N members retrying against the
             # same recovering van (a fixed backoff would re-stampede it)
-            time.sleep(rng.uniform(0.0, min(delay, max_s)))
+            sleep_s = rng.uniform(0.0, min(delay, max_s))
+            if deadline_s is not None:
+                sleep_s = min(sleep_s,
+                              max(deadline_s - (time.monotonic() - t0),
+                                  0.0))
+            time.sleep(sleep_s)
             delay *= 2.0
+    raise MembershipWireError(  # attempts == 0 guard; unreachable above
+        f"membership rpc {op or fn!r} made no attempts") from last
 
 
 def create_blackboard(host: str, port: int, *, table_id: int,
@@ -140,7 +190,8 @@ class MembershipClient:
 
     def __init__(self, host: str, port: int, *, table_id: int, slot: int,
                  n_slots: int, incarnation: Optional[int] = None,
-                 connect_timeout_s: float = 10.0):
+                 connect_timeout_s: float = 10.0,
+                 rpc_deadline_s: float = 5.0):
         if not 0 <= int(slot) < int(n_slots):
             raise ValueError(f"slot {slot} outside [0, {n_slots})")
         self.slot = int(slot)
@@ -148,6 +199,12 @@ class MembershipClient:
         self.incarnation = int(incarnation) if incarnation else \
             fresh_incarnation()
         self.beat = 0
+        # the link name every RPC failure carries, and the total
+        # wall-clock cap per RPC (attempts + backoff): under a 100%-loss
+        # link the beat loop must cycle bounded, erroring with the link
+        # named — not stack backoff ladders into an unbounded hang
+        self.link = f"member{self.slot}->van"
+        self.rpc_deadline_s = float(rpc_deadline_s)
         self._table = attach_blackboard(host, port, table_id=table_id,
                                         n_slots=n_slots,
                                         connect_timeout_s=connect_timeout_s)
@@ -177,7 +234,8 @@ class MembershipClient:
         row[0, F_EPOCH_ACK] = self._last["epoch_ack"]
         row[0, F_PID] = os.getpid() % (1 << 24)
         control_rpc(lambda: self._table.sparse_set([self.slot], row),
-                    rng=self._rng)
+                    rng=self._rng, op="member_row_write", link=self.link,
+                    deadline_s=self.rpc_deadline_s)
 
     def join(self, **fields) -> int:
         """Claim the slot with this process's incarnation; returns it."""
@@ -198,12 +256,17 @@ class MembershipClient:
         self._write_row(0.0)
 
     def read_control(self) -> tuple:
-        """``(epoch, width, alive_mask, resume_step, phase)`` as ints."""
+        """``(epoch, width, alive_mask, resume_step, phase, slow_slot,
+        slow_ms)`` as ints — ``slow_slot`` is -1 when no straggler
+        injection is active."""
         row = control_rpc(
-            lambda: self._table.sparse_pull([self.n_slots]), rng=self._rng)
+            lambda: self._table.sparse_pull([self.n_slots]), rng=self._rng,
+            op="read_control", link=self.link,
+            deadline_s=self.rpc_deadline_s)
         return (int(row[0, C_EPOCH]), int(row[0, C_WIDTH]),
                 int(row[0, C_MASK]), int(row[0, C_RESUME]),
-                int(row[0, C_PHASE]))
+                int(row[0, C_PHASE]), int(row[0, C_SLOW_SLOT]),
+                int(row[0, C_SLOW_MS]))
 
     def close(self) -> None:
         self._table.close()
@@ -219,6 +282,11 @@ class MemberState:
     beat: int = -1
     last_advance: float = 0.0     # monotonic ts of the last beat advance
     suspect_since: Optional[float] = None
+    # why the member is suspect: "beats_stopped" (their beats froze —
+    # the classic silence that escalates to lost past the grace) or
+    # "probe_failed" (the CONTROLLER could not read the blackboard —
+    # the member may be beating perfectly; never escalates to lost)
+    suspect_reason: Optional[str] = None
     row: np.ndarray = field(default_factory=lambda: np.zeros(
         MEMBER_DIM, np.float32))
 
@@ -262,33 +330,104 @@ class MembershipService:
     """
 
     def __init__(self, table, n_slots: int, *, lease_s: float = 1.0,
-                 suspect_grace_s: float = 1.0):
+                 suspect_grace_s: float = 1.0,
+                 rpc_deadline_s: float = 5.0):
         self.table = table
         self.n_slots = int(n_slots)
         self.lease_s = float(lease_s)
         self.suspect_grace_s = float(suspect_grace_s)
+        self.rpc_deadline_s = float(rpc_deadline_s)
         self.members = [MemberState(slot=i) for i in range(self.n_slots)]
         self._rng = random.Random(0x4C454153)
+        self.link = "controller->van"
+        # probe-failure accounting: while the CONTROLLER's own pulls
+        # fail, no silence clock may advance — the members are not
+        # observable, which is not evidence they stopped
+        self.probe_failures = 0
+        self.probe_blind_s = 0.0
+        self._blind_since: Optional[float] = None
+        # straggler-injection plane, persisted across epoch publishes
+        self._slow = (-1, 0)
 
     # ---- controller → members ----
     def publish_control(self, *, epoch: int, width: int, alive_mask: int,
-                        resume_step: int = 0, phase: int = 0) -> None:
+                        resume_step: int = 0, phase: int = 0,
+                        slow_slot: Optional[int] = None,
+                        slow_ms: Optional[int] = None) -> None:
+        """Write the control row.  ``slow_slot``/``slow_ms`` (the
+        straggler-injection fields) default to whatever was last
+        published — an epoch transition must not silently heal an
+        injected slow link."""
+        if slow_slot is not None or slow_ms is not None:
+            self._slow = (int(self._slow[0] if slow_slot is None
+                              else slow_slot),
+                          int(self._slow[1] if slow_ms is None
+                              else slow_ms))
         row = np.zeros((1, MEMBER_DIM), np.float32)
         row[0, C_EPOCH] = int(epoch)
         row[0, C_WIDTH] = int(width)
         row[0, C_MASK] = int(alive_mask)
         row[0, C_RESUME] = int(resume_step)
         row[0, C_PHASE] = int(phase)
+        row[0, C_SLOW_SLOT] = self._slow[0]
+        row[0, C_SLOW_MS] = self._slow[1]
+        self._last_control = dict(epoch=int(epoch), width=int(width),
+                                  alive_mask=int(alive_mask),
+                                  resume_step=int(resume_step),
+                                  phase=int(phase))
         control_rpc(lambda: self.table.sparse_set([self.n_slots], row),
-                    rng=self._rng)
+                    rng=self._rng, op="publish_control", link=self.link,
+                    deadline_s=self.rpc_deadline_s)
+
+    def set_slow(self, slot: int, ms: int) -> None:
+        """Flip ONLY the straggler-injection fields, re-publishing the
+        last control row otherwise unchanged (no epoch bump — injecting
+        a slow link is not a membership change).  ``slot=-1`` clears."""
+        last = getattr(self, "_last_control", None)
+        if last is None:
+            raise RuntimeError("set_slow before any publish_control")
+        self.publish_control(**last, slow_slot=int(slot), slow_ms=int(ms))
 
     # ---- members → controller ----
     def poll(self) -> list:
-        rows = control_rpc(
-            lambda: self.table.sparse_pull(list(range(self.n_slots))),
-            rng=self._rng)
+        """One lease sweep; returns membership events (see class doc).
+
+        Probe-failure handling (the "my probe failed" half of gray-
+        failure suspicion): when the controller's OWN blackboard pull
+        fails transiently — its link to the van is down, not the
+        members' — every alive member degrades to ``suspect`` with
+        ``suspect_reason="probe_failed"`` (stop routing new work: we
+        are blind) but NO silence clock advances and nothing ever
+        escalates to ``lost`` on that evidence.  When visibility
+        returns, the blind window is added back to every silence clock
+        — members whose beats advanced while we were blind ``clear``
+        immediately, and a member that was genuinely silent is judged
+        only on OBSERVED silence, so a controller-side partition can
+        never grieve a healthy, heartbeating member."""
+        try:
+            rows = control_rpc(
+                lambda: self.table.sparse_pull(list(range(self.n_slots))),
+                rng=self._rng, op="membership_poll", link=self.link,
+                deadline_s=self.rpc_deadline_s)
+        except MembershipWireError:
+            return self._probe_failed()
         now = time.monotonic()
         events = []
+        if self._blind_since is not None:
+            # visibility restored: the blind window was unobservable,
+            # not silent — shift every clock past it before judging
+            blind_dt = now - self._blind_since
+            self.probe_blind_s += blind_dt
+            self._blind_since = None
+            for m in self.members:
+                m.last_advance += blind_dt
+                if m.suspect_since is not None:
+                    m.suspect_since += blind_dt
+                if m.suspect_reason == "probe_failed":
+                    # reclassify: from here the normal machinery rules —
+                    # an advancing beat clears below; a genuinely frozen
+                    # one is now ordinary observed silence
+                    m.suspect_reason = "beats_stopped"
         for m in self.members:
             row = rows[m.slot]
             inc, beat = int(row[F_INCARNATION]), int(row[F_BEAT])
@@ -329,14 +468,38 @@ class MembershipService:
                     events.append(("clear", m.slot))
                 m.state = "alive"
                 m.suspect_since = None
+                m.suspect_reason = None
             elif m.state == "alive" and now - m.last_advance > self.lease_s:
                 m.state = "suspect"
                 m.suspect_since = now
+                m.suspect_reason = "beats_stopped"
                 events.append(("suspect", m.slot))
             elif m.state == "suspect" and \
+                    m.suspect_reason != "probe_failed" and \
                     now - m.suspect_since > self.suspect_grace_s:
+                # only OBSERVED silence escalates: probe_failed
+                # suspicion (our link, not theirs) holds at suspect
+                # until a successful poll reclassifies it
                 m.state = "lost"
                 events.append(("lost", m.slot))
+        return events
+
+    def _probe_failed(self) -> list:
+        """The controller could not read the blackboard: freeze the
+        silence clocks and degrade alive members to unroutable
+        ``suspect(probe_failed)``.  Returns the suspect events (first
+        blind poll only — later blind polls are silent)."""
+        now = time.monotonic()
+        self.probe_failures += 1
+        events = []
+        if self._blind_since is None:
+            self._blind_since = now
+            for m in self.members:
+                if m.state == "alive":
+                    m.state = "suspect"
+                    m.suspect_since = now
+                    m.suspect_reason = "probe_failed"
+                    events.append(("suspect", m.slot))
         return events
 
     # ---- views ----

@@ -44,6 +44,7 @@ fresh_table_id = _fresh_remote_id
 # layer (csrc) already uses std::chrono::steady_clock for the same reason.
 
 _fault_hook = None
+_netem_hook = None
 
 # --- per-op client telemetry -------------------------------------------------
 # Every client-side wire op runs under _op_span(op, nbytes): the fault hook
@@ -83,6 +84,7 @@ class _OpSpan:
 
     def __enter__(self):
         _maybe_inject(self.op)
+        _maybe_netem(self.op, self.nbytes)
         # record the span only if tracing was on for the WHOLE op: an
         # enable() landing mid-RPC would otherwise produce a span whose
         # start is the tracer's epoch (now_us() was 0.0 at entry)
@@ -159,10 +161,34 @@ def set_fault_hook(hook):
     return prev
 
 
+def set_netem_hook(hook):
+    """Install a callable invoked as ``hook(op: str, nbytes: int)`` before
+    every client-side wire op, AFTER the fault hook (an injected fault
+    surfaces first, exactly as without emulation).  ``nbytes`` is the
+    op's known payload size (0 when the size is only known at delivery,
+    e.g. blob get) so the hook can model BANDWIDTH, not just latency.
+    The hook may sleep (latency/jitter/serialization delay) or raise (a
+    dropped frame / a partitioned link) — a raise surfaces to the caller
+    exactly like a real transport failure.  Returns the previously
+    installed hook.  Used by :mod:`hetu_tpu.ps.netem`; this is the
+    link-emulation sibling of :func:`set_fault_hook` (one-shot injected
+    faults) — the two seams compose."""
+    global _netem_hook
+    prev = _netem_hook
+    _netem_hook = hook
+    return prev
+
+
 def _maybe_inject(op: str) -> None:
     hook = _fault_hook
     if hook is not None:
         hook(op)
+
+
+def _maybe_netem(op: str, nbytes: int) -> None:
+    hook = _netem_hook
+    if hook is not None:
+        hook(op, nbytes)
 
 
 def _connect_with_deadline(host: str, port: int, timeout_s: float) -> int:

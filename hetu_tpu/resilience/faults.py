@@ -42,9 +42,29 @@ Fault kinds
 ``worker_proc_kill``  SIGKILL training-worker PROCESS ``arg`` — the
                    multi-controller fleet resharding path
                    (resilience/multicontroller.py)
+``netem_partition``  one-way partition of member/worker ``arg``'s
+                   EGRESS for ``arg2`` seconds (ps/netem.py: its
+                   writes black-hole, its reads still work — the
+                   asymmetric gray failure the lease machine must
+                   degrade-and-clear on, never lost+rejoin)
+``netem_degrade``  member/worker ``arg``'s link turns gray for
+                   ``arg2`` seconds: loss + latency + a bandwidth cap
+                   (the pool's routing should penalize it; serving
+                   degrades to bounded latency, not collapse)
+``straggler``      worker ``arg`` runs behind an emulated slow link
+                   for ``arg2`` seconds — alive, beating, 10x slow;
+                   the straggler-aware barriers must detect it
+                   (``train.straggler``) and apply the wait/evict
+                   policy (resilience/multicontroller.py)
 
-The van hooks ride :func:`hetu_tpu.ps.van.set_fault_hook`; everything else
-is plain process/OS plumbing, so the harness needs no native lib to import.
+The van hooks ride :func:`hetu_tpu.ps.van.set_fault_hook` (one-shot
+faults) and :func:`hetu_tpu.ps.van.set_netem_hook` (link policies);
+everything else is plain process/OS plumbing, so the harness needs no
+native lib to import.  The netem/straggler kinds are RECORDED into
+``net_events`` (like the worker/serve kinds) — the pool controller or
+training supervisor drains them via :meth:`FaultInjector.
+pop_net_events` and applies the link policy through its own control
+plane, because the injector cannot reach into another process's wire.
 """
 
 from __future__ import annotations
@@ -75,7 +95,8 @@ KINDS = ("van_error", "van_delay", "data_error", "nan_grad",
          "kill_shard", "suspend_shard", "preempt",
          "worker_loss", "worker_join",
          "serve_preempt", "serve_engine_kill",
-         "member_kill", "member_suspend", "worker_proc_kill")
+         "member_kill", "member_suspend", "worker_proc_kill",
+         "netem_partition", "netem_degrade", "straggler")
 
 
 @dataclass(frozen=True, order=True)
@@ -124,7 +145,11 @@ class FaultSchedule:
                  n_members: int = 1,
                  member_kills: int = 0, member_suspends: int = 0,
                  member_suspend_s: float = 0.5,
-                 worker_proc_kills: int = 0) -> "FaultSchedule":
+                 worker_proc_kills: int = 0,
+                 netem_partitions: int = 0, netem_partition_s: float = 0.8,
+                 netem_degrades: int = 0, netem_degrade_s: float = 1.0,
+                 stragglers: int = 0,
+                 straggler_s: float = 1.0) -> "FaultSchedule":
         """Draw a schedule over training steps ``[1, steps)`` from ``seed``.
 
         Counts are clipped to the available steps.  Shard-targeted faults
@@ -153,6 +178,16 @@ class FaultSchedule:
         seconds (then SIGCONT), ``worker_proc_kills`` SIGKILL a
         training-worker process — victims drawn uniformly from
         ``n_members`` / ``n_workers``, after ALL earlier kinds.
+
+        Network-plane faults (gray failures, ps/netem.py):
+        ``netem_partitions`` one-way egress partitions of a member for
+        ``netem_partition_s`` seconds, ``netem_degrades`` gray-link
+        windows (loss+latency+bandwidth cap) for ``netem_degrade_s``,
+        ``stragglers`` slow-link windows on a training worker for
+        ``straggler_s`` — victims uniform from ``n_members`` /
+        ``n_members`` / ``n_workers``, drawn after EVERY pre-existing
+        kind so old-seed schedules replay byte-identical (the frozen-
+        bytes regression contract, third extension running).
         """
         rng = np.random.default_rng(seed)
         hi = max(int(steps), 2)
@@ -229,6 +264,23 @@ class FaultSchedule:
             events.append(FaultEvent(s, "worker_proc_kill",
                                      float(rng.integers(max(n_workers,
                                                             1)))))
+        # network-plane kinds: drawn after everything above — the same
+        # frozen-bytes guarantee the process-level kinds honored
+        for s in pick(netem_partitions):
+            events.append(FaultEvent(s, "netem_partition",
+                                     float(rng.integers(max(n_members,
+                                                            1))),
+                                     float(netem_partition_s)))
+        for s in pick(netem_degrades):
+            events.append(FaultEvent(s, "netem_degrade",
+                                     float(rng.integers(max(n_members,
+                                                            1))),
+                                     float(netem_degrade_s)))
+        for s in pick(stragglers):
+            events.append(FaultEvent(s, "straggler",
+                                     float(rng.integers(max(n_workers,
+                                                            1))),
+                                     float(straggler_s)))
         return cls(events)
 
     def at(self, step: int) -> list[FaultEvent]:
@@ -292,6 +344,11 @@ class FaultInjector:
         # pop_serve_events() by the pool's chaos driver (same record/
         # decide split: the injector cannot reach into the pool's engines)
         self.serve_events = deque()
+        # network-plane events: (kind, victim_idx, duration_s), drained
+        # via pop_net_events() — the controller applies the link policy
+        # through its own control plane (the injector cannot reach into
+        # another PROCESS's van hooks)
+        self.net_events = deque()
         self._lock = threading.Lock()
         self._prev_hook = None
         self._installed = False
@@ -378,6 +435,11 @@ class FaultInjector:
             elif k == "worker_proc_kill":
                 self._proc_kill(self.worker_procs, int(ev.arg),
                                 "worker_procs_killed")
+            elif k in ("netem_partition", "netem_degrade", "straggler"):
+                self.counters[k + "s_injected"] += 1
+                with self._lock:
+                    self.net_events.append((k, int(ev.arg),
+                                            float(ev.arg2) or 1.0))
 
     def pop_serve_events(self) -> list:
         """Drain pending serving-pool events as
@@ -386,6 +448,30 @@ class FaultInjector:
         with self._lock:
             out = list(self.serve_events)
             self.serve_events.clear()
+        return out
+
+    def pop_net_events(self, kinds=None) -> list:
+        """Drain pending network-plane events as ``[("netem_partition"
+        |"netem_degrade"|"straggler", victim_idx, duration_s)]`` — feed
+        them to ``CrossProcessServingPool.run_net_events`` (serving) or
+        ``MultiControllerElasticSupervisor`` (stragglers).
+
+        ``kinds`` drains selectively: events of OTHER kinds stay queued
+        for the driver that owns them.  A mixed schedule driven by the
+        training supervisor (which applies only stragglers) must not
+        silently swallow serving-plane partitions its injector already
+        recorded as injected — an unclaimed event staying visible in
+        the queue is the honest failure mode."""
+        with self._lock:
+            if kinds is None:
+                out = list(self.net_events)
+                self.net_events.clear()
+            else:
+                kinds = set(kinds)
+                out = [e for e in self.net_events if e[0] in kinds]
+                keep = [e for e in self.net_events if e[0] not in kinds]
+                self.net_events.clear()
+                self.net_events.extend(keep)
         return out
 
     def pop_worker_events(self) -> list:

@@ -173,6 +173,20 @@ class WorkerProcess:
         self.epoch = 0
         self.acked = 0
         self._bars = None  # (epoch, sync_barrier, commit_barrier)
+        # straggler plane: per-phase wall timing (logged per step) and
+        # the scalar WORK time reported in the heartbeat's load field —
+        # work time only, barrier waits excluded: a fast worker parked
+        # on a slow peer's barrier must not itself read as slow
+        self._work_ms = 0.0
+        self.phase_ms: dict = {}
+        # the injected slow link (control row C_SLOW_*): a NetEm
+        # latency policy on this worker's van ops — the fault is a slow
+        # WIRE, not a sleep in the math, so detection sees exactly what
+        # a congested DCN link would produce
+        from hetu_tpu.ps.netem import NetEm
+        self.netem = NetEm(local=f"w{spec.slot}", peer="van")
+        self.netem.install()
+        self._slow_ms_active = 0
         self._stop = threading.Event()
         self._log = open(spec.log_path or
                          f"worker_{spec.slot}.jsonl", "a")
@@ -190,7 +204,24 @@ class WorkerProcess:
 
     def _sync_row(self) -> None:
         self.member.heartbeat(committed=float(self.committed),
-                              epoch_ack=float(self.acked))
+                              epoch_ack=float(self.acked),
+                              load=float(self._work_ms))
+
+    def _apply_slow(self, slow_slot: int, slow_ms: int) -> None:
+        """Honor the control row's straggler-injection fields: install
+        (or clear) a symmetric latency policy on this worker's van
+        link.  Idempotent per published value."""
+        from hetu_tpu.ps.netem import LinkPolicy
+        want = int(slow_ms) if (int(slow_slot) == self.spec.slot and
+                                int(slow_ms) > 0) else 0
+        if want == self._slow_ms_active:
+            return
+        if want:
+            self.netem.set_link(LinkPolicy(latency_s=want / 1000.0),
+                                direction="both")
+        else:
+            self.netem.clear()
+        self._slow_ms_active = want
 
     def _barrier(self, phase: int, width: int):
         bid = self.spec.barrier_base + 2 * self.epoch + phase
@@ -227,7 +258,7 @@ class WorkerProcess:
                 bar.wait(timeout_s=self.spec.barrier_wait_s)
                 return
             except TimeoutError:
-                e, _, _, _, phase = self.member.read_control()
+                e, _, _, _, phase, _, _ = self.member.read_control()
                 if e != self.epoch or phase != 0:
                     raise _EpochChanged
 
@@ -235,7 +266,9 @@ class WorkerProcess:
         spec = self.spec
         step = 0
         while not self._stop.is_set():
-            e, width, mask, resume, phase = self.member.read_control()
+            e, width, mask, resume, phase, slow_slot, slow_ms = \
+                self.member.read_control()
+            self._apply_slow(slow_slot, slow_ms)
             if e == 0:
                 if self._stop.wait(0.05):
                     break
@@ -266,16 +299,27 @@ class WorkerProcess:
                 break
             bar_sync, bar_commit = self._epoch_barriers(width)
             try:
+                t0 = time.perf_counter()
                 self._await_barrier(bar_sync)
+                t1 = time.perf_counter()
                 Xb, Yb = self.schedule.local_slice(step, rank, width)
                 w = self.table.dense_pull()
+                t2 = time.perf_counter()
                 err = Xb @ w - Yb
                 # d/dw of mean_{GLOBAL batch} ||Xw - Y||^2: each
                 # worker pushes its slice's share; the PS-side SGD is
                 # linear, so N sequential pushes apply exactly the
                 # summed global-mean gradient
                 grad = (2.0 / spec.global_batch) * (Xb.T @ err)
+                t3 = time.perf_counter()
                 self.table.dense_push(grad)
+                t4 = time.perf_counter()
+                # the WORK phases only (pull/grad/push) feed the
+                # heartbeat's load field: barrier waits are time spent
+                # on PEERS, and charging them here would make every
+                # healthy worker in a fleet with one straggler read as
+                # a straggler itself
+                self._work_ms = (t4 - t1) * 1e3
                 # the consumption record lands BEFORE the commit
                 # barrier: the push already happened, so if this
                 # process is SIGKILLed parked in the barrier (whose
@@ -288,9 +332,17 @@ class WorkerProcess:
                     {"step": step, "epoch": self.epoch,
                      "width": width, "rank": rank,
                      "crc": slice_crc((Xb, Yb)),
-                     "loss": float(np.mean(err * err))}) + "\n")
+                     "loss": float(np.mean(err * err)),
+                     "ms": {"bar_sync": round((t1 - t0) * 1e3, 3),
+                            "pull": round((t2 - t1) * 1e3, 3),
+                            "grad": round((t3 - t2) * 1e3, 3),
+                            "push": round((t4 - t3) * 1e3, 3)}}) + "\n")
                 self._log.flush()
                 self._await_barrier(bar_commit)
+                self.phase_ms = {
+                    "bar_sync": (t1 - t0) * 1e3, "pull": (t2 - t1) * 1e3,
+                    "grad": (t3 - t2) * 1e3, "push": (t4 - t3) * 1e3,
+                    "bar_commit": (time.perf_counter() - t4) * 1e3}
             except _EpochChanged:
                 continue  # step discarded, re-run at the new width
             # COMMITTED: every worker of this epoch passed the commit
@@ -319,6 +371,7 @@ class WorkerProcess:
         self._log.close()
         self.member.close()
         self.table.close()
+        self.netem.uninstall()
 
 
 def worker_main(config_path: str) -> int:
@@ -425,7 +478,11 @@ class MultiControllerElasticSupervisor:
                  lease_s: float = 0.6, suspect_grace_s: float = 0.4,
                  min_width: int = 1, port: int = 0,
                  step_sleep_s: float = 0.0,
-                 injector=None, spawn_timeout_s: float = 120.0):
+                 injector=None, spawn_timeout_s: float = 120.0,
+                 straggler_factor: float = 4.0,
+                 straggler_policy: str = "wait",
+                 straggler_evict_after: int = 3,
+                 straggler_slow_ms: int = 120):
         from hetu_tpu.ps import van
         if n_workers < 1:
             raise ValueError("need at least one worker")
@@ -447,6 +504,26 @@ class MultiControllerElasticSupervisor:
         self.resume_step = 0
         self.resizes: list = []
         self.log_paths: list = []
+        # straggler plane (the slow-vs-dead split the lease machine
+        # cannot make: a straggler's beats FLOW, its work time grows).
+        # Detection: reported work_ms > straggler_factor x the median
+        # of its peers'.  Policy "wait" = record + tolerate (the
+        # barriers already pace the fleet at the straggler's speed);
+        # "evict" = after `straggler_evict_after` slow COMMITTED steps,
+        # reshard around it (a shrink epoch excluding the slot — batch
+        # byte-identity preserved by the same complete-cover machinery
+        # as any other shrink).
+        if straggler_policy not in ("wait", "evict"):
+            raise ValueError(f"unknown straggler_policy "
+                             f"{straggler_policy!r}: wait|evict")
+        self.straggler_factor = float(straggler_factor)
+        self.straggler_policy = straggler_policy
+        self.straggler_evict_after = int(straggler_evict_after)
+        self.straggler_slow_ms = int(straggler_slow_ms)
+        self.straggle_records: list = []   # closed train.straggler spans
+        self._straggle: dict = {}          # slot -> open window state
+        self._evicted: set = set()
+        self._slow_heal_at: Optional[float] = None
         # fresh table/barrier ids per supervisor: the native table and
         # barrier registries outlive van.stop(), so fixed ids would leak
         # state between two fleets built in one process (tests, benches)
@@ -527,7 +604,7 @@ class MultiControllerElasticSupervisor:
         bring-up (``kind=None``) skips the prepare: nobody is stepping
         yet."""
         while True:
-            present = self.svc.present_slots()
+            present = self._present()
             width = len(present)
             if width < max(self.min_width, 1):
                 raise RuntimeError(
@@ -559,7 +636,7 @@ class MultiControllerElasticSupervisor:
                     f"{self.svc.present_slots()} within 30s")
             if moved:
                 continue
-            present = self.svc.present_slots()
+            present = self._present()
             # the resume considers EVERY slot that ever reported progress
             # (present, left, lost — commits are barrier-atomic, so no
             # departed row can be ahead of a live one): a worker
@@ -580,9 +657,17 @@ class MultiControllerElasticSupervisor:
                 downtime_s=dt, alive=tuple(present)))
             return
 
+    def _present(self) -> list:
+        """Membership minus straggler evictions: a slot resharded
+        around for slowness is alive and beating — excluded from the
+        published mask, not from the lease machine."""
+        return [s for s in self.svc.present_slots()
+                if s not in self._evicted]
+
     def poll(self) -> list:
         """One membership sweep: drives the injector by observed
-        committed step, applies lease decisions as published epochs.
+        committed step, applies lease decisions as published epochs,
+        and runs the straggler detector over the reported work times.
         Returns the membership events seen."""
         if self.injector is not None:
             cur = max((self.svc.state_of(s).committed
@@ -590,6 +675,17 @@ class MultiControllerElasticSupervisor:
             for t in range(self._fired_through + 1, cur + 1):
                 self.injector.on_step(t)
             self._fired_through = max(self._fired_through, cur)
+            # claim only the straggler events: serving-plane netem
+            # kinds stay queued for whoever drives the pool
+            for _, idx, dur in self.injector.pop_net_events(
+                    kinds=("straggler",)):
+                self.inject_straggler(int(idx) % self.n_workers, dur)
+        if self._slow_heal_at is not None and \
+                time.monotonic() >= self._slow_heal_at:
+            # the heal runs HERE, serialized with every other control-
+            # row write (see inject_straggler)
+            self._slow_heal_at = None
+            self.svc.set_slow(-1, 0)
         events = self.svc.poll()
         for kind, slot in events:
             if kind == "lost":
@@ -606,7 +702,110 @@ class MultiControllerElasticSupervisor:
                     sp.set("worker", int(slot))
                     self._publish(kind="grow", slot=slot, t0=t0)
                     sp.set("width", len(self.svc.present_slots()))
+        self._check_stragglers()
         return events
+
+    # ---- straggler detection / policy ----
+    def inject_straggler(self, slot: int, duration_s: float,
+                         slow_ms: Optional[int] = None) -> None:
+        """Apply the ``straggler`` chaos fault: publish the control
+        row's slow fields so worker ``slot`` installs an emulated slow
+        link on its van ops, and schedule the heal.  No epoch bump — a
+        slow link is not a membership change.  The heal is applied by
+        the NEXT :meth:`poll` past its due time, NOT by a timer thread:
+        every control-row write must stay serialized with the two-phase
+        epoch publishes (a concurrent ``set_slow`` could republish a
+        stale snapshot — e.g. re-expose a mid-PREPARE ``phase=1`` row
+        after the supervisor already committed ``phase=0`` — and stall
+        the whole fleet on an epoch that will never commit)."""
+        ms = self.straggler_slow_ms if slow_ms is None else int(slow_ms)
+        self.svc.set_slow(int(slot), ms)
+        self._slow_heal_at = time.monotonic() + float(duration_s)
+
+    def _check_stragglers(self) -> None:
+        """Per-phase timing turned into a slow-vs-dead decision: a
+        worker whose reported WORK time (load field — barrier waits
+        excluded) exceeds ``straggler_factor`` x the median of its
+        peers' is a straggler — alive (its beats flow, the lease
+        machine never fires) but pacing the whole lockstep fleet.
+        Opens a retroactive ``train.straggler`` span per episode
+        (closed when the worker recovers, or at eviction), and under
+        ``straggler_policy="evict"`` reshards around the worker once
+        it has been slow for ``straggler_evict_after`` committed
+        steps."""
+        slots = [s for s in self._present()
+                 if self.svc.state_of(s).state == "alive"]
+        loads = {s: self.svc.state_of(s).load for s in slots
+                 if self.svc.state_of(s).load > 0.0}
+        for slot in list(self._straggle):
+            if slot not in loads and slot not in slots:
+                # lost/evicted mid-episode: close the window as-is
+                self._close_straggle(slot, resolution="departed")
+        if len(loads) < 2:
+            return
+        for slot, work_ms in loads.items():
+            others = [v for s, v in loads.items() if s != slot]
+            med = float(np.median(others))
+            slow = work_ms > self.straggler_factor * max(med, 1e-3)
+            st = self._straggle.get(slot)
+            committed = self.svc.state_of(slot).committed
+            if slow and st is None:
+                self._straggle[slot] = {
+                    "t0_us": trace.now_us(),
+                    "detected_at_step": committed,
+                    "last_step": committed, "slow_steps": 0,
+                    "ratio": work_ms / max(med, 1e-3)}
+            elif slow and st is not None:
+                st["ratio"] = max(st["ratio"], work_ms / max(med, 1e-3))
+                if committed > st["last_step"]:
+                    st["slow_steps"] += committed - st["last_step"]
+                    st["last_step"] = committed
+                if self.straggler_policy == "evict" and \
+                        slot not in self._evicted and \
+                        st["slow_steps"] >= self.straggler_evict_after:
+                    self._evict_straggler(slot)
+            elif not slow and st is not None:
+                # back under the bar: the episode closes as tolerated
+                self._close_straggle(slot, resolution="recovered")
+
+    def _close_straggle(self, slot: int, *, resolution: str) -> None:
+        st = self._straggle.pop(slot, None)
+        if st is None:
+            return
+        rec = {"worker": int(slot), "policy": self.straggler_policy,
+               "resolution": resolution,
+               "ratio": round(float(st["ratio"]), 2),
+               "slow_steps": int(st["slow_steps"])}
+        trace.complete("train.straggler", st["t0_us"], rec, cat="train")
+        self.straggle_records.append(rec)
+
+    def _evict_straggler(self, slot: int) -> None:
+        """The evict policy: reshard the fleet AROUND the straggler.
+        The slot stays in the lease machine (alive, beating — not
+        lost) but leaves the published mask; survivors re-cover every
+        global batch at the smaller width, byte-identical by the same
+        complete-cover contract as any other shrink."""
+        self._evicted.add(int(slot))
+        self._close_straggle(slot, resolution="evicted")
+        t0 = time.perf_counter()
+        with trace.span("elastic.reshard") as sp:
+            sp.set("kind", "shrink")
+            sp.set("worker", int(slot))
+            sp.set("reason", "straggler_evict")
+            self._publish(kind="shrink", slot=slot, t0=t0)
+            sp.set("width", len(self._present()))
+
+    def readmit_straggler(self, slot: int) -> None:
+        """Operator/test path: lift a straggler eviction (e.g. after
+        the slow link healed); the next publish regrows the mesh."""
+        if int(slot) in self._evicted:
+            self._evicted.discard(int(slot))
+            t0 = time.perf_counter()
+            with trace.span("elastic.reshard") as sp:
+                sp.set("kind", "grow")
+                sp.set("worker", int(slot))
+                self._publish(kind="grow", slot=slot, t0=t0)
+                sp.set("width", len(self._present()))
 
     def spawn_replacement(self, slot: int) -> None:
         """Re-admit a lost worker slot with a FRESH process: it joins
@@ -628,7 +827,12 @@ class MultiControllerElasticSupervisor:
         while time.monotonic() < deadline:
             self.poll()
             states = [self.svc.state_of(s) for s in range(self.n_workers)]
-            present = [m for m in states if m.state in ("alive", "suspect")]
+            # an evicted straggler is alive-but-excluded: it will never
+            # advance past its eviction point, so completion is judged
+            # on the workers actually IN the published mask
+            present = [m for m in states
+                       if m.state in ("alive", "suspect") and
+                       m.slot not in self._evicted]
             finished = [m for m in states
                         if m.state == "left" and
                         m.committed >= self.steps - 1]
@@ -643,6 +847,10 @@ class MultiControllerElasticSupervisor:
                 f"fleet did not finish {self.steps} steps within "
                 f"{deadline_s}s: "
                 f"{[(m.slot, m.state, m.committed) for m in states]}")
+        for slot in list(self._straggle):
+            # a still-open straggle window at run end must land in the
+            # trace (an unclosed span would silently drop the episode)
+            self._close_straggle(slot, resolution="run_end")
         consumed = merge_consumed_logs(self.log_paths)
         return {
             "steps": self.steps,

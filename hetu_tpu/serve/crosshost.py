@@ -86,6 +86,15 @@ class MemberSpec:
     max_loop_errors: int = 2
     failover_grace_s: float = 5.0
     model: dict = field(default_factory=dict)
+    # overload shedding in the member's scheduler (serve/scheduler.py):
+    # deadline-doomed submits resolve 'shed' instantly instead of
+    # queueing into collapse
+    shed: bool = False
+    shed_headroom: float = 1.0
+    # netem link emulation applied at process start: {"seed": int,
+    # "links": [[direction, policy_dict], ...]} — the static half; the
+    # dynamic half arrives over the wire as a "netem" command
+    netem: dict = field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -148,7 +157,19 @@ class MemberHarness:
         self.spec = spec
         self._van = van
         _, _, engine = build_engine(spec.model)
-        self.scheduler = ContinuousBatchingScheduler(engine)
+        self.scheduler = ContinuousBatchingScheduler(
+            engine, shed=spec.shed, shed_headroom=spec.shed_headroom)
+        # the member's half of the gray-failure plane: one emulator per
+        # process, installed up front (policies arrive via spec.netem
+        # and/or "netem" commands; an empty emulator is a transparent
+        # wire)
+        from hetu_tpu.ps.netem import LinkPolicy, NetEm
+        self.netem = NetEm(local=f"m{spec.slot}", peer="van",
+                           seed=int(spec.netem.get("seed", 0)))
+        for direction, pol in spec.netem.get("links", ()):
+            self.netem.set_link(LinkPolicy.from_dict(pol),
+                                direction=direction)
+        self.netem.install()
         self.server = InferenceServer(
             self.scheduler, port=spec.port, own_van=False, max_clients=0,
             request_timeout_s=spec.request_timeout_s,
@@ -186,11 +207,15 @@ class MemberHarness:
             while not self._stop.is_set():
                 try:
                     # idempotent same-seq resend: a timeout retries the
-                    # SAME slot until the controller drains it
+                    # SAME slot until the controller drains it.
+                    # ConnectionError covers a netem-partitioned egress
+                    # (NetemDrop): a one-way-partitioned member must
+                    # QUEUE its completions and flush them at heal, not
+                    # lose its event thread to the partition
                     self._out.put(payload, seq, timeout_s=2.0)
                     seq += 1
                     break
-                except (TimeoutError, RuntimeError):
+                except (TimeoutError, ConnectionError, RuntimeError):
                     time.sleep(0.05)
 
     def _beat_loop(self) -> None:
@@ -224,8 +249,9 @@ class MemberHarness:
         while not self._stop.is_set():
             try:
                 raw = self._in.get(seq, timeout_s=0.25)
-            except TimeoutError:
-                continue
+            except (TimeoutError, ConnectionError):
+                continue  # idle poll / netem-partitioned ingress: the
+                # command loop outlives a transiently unreachable wire
             except RuntimeError:
                 break  # van gone under us
             seq += 1
@@ -265,9 +291,25 @@ class MemberHarness:
                 return False
         elif cmd == "drain_abort":
             self._drain_abort(int(msg["xfer"]))
+        elif cmd == "netem":
+            self._apply_netem(msg)
         elif cmd == "shutdown":
             return False
         return True
+
+    def _apply_netem(self, msg: dict) -> None:
+        """Install (or clear) a link policy on this member's van wire.
+        The policy usually carries ``duration_s`` so a PARTITION heals
+        itself — a heal command could never cross the very link it is
+        supposed to heal."""
+        from hetu_tpu.ps.netem import LinkPolicy
+        direction = str(msg.get("direction", "both"))
+        pol = msg.get("policy")
+        if pol is None:
+            self.netem.clear_link(direction=direction)
+        else:
+            self.netem.set_link(LinkPolicy.from_dict(pol),
+                                direction=direction)
 
     # ---- migration (two-phase, source side holds until commit) ----
     def _drain(self, ch_id: int, xfer: int, codec: str,
@@ -362,6 +404,7 @@ class MemberHarness:
             except Exception:
                 pass
         self.member.close()
+        self.netem.uninstall()
 
 
 def member_main(config_path: str) -> int:
@@ -423,6 +466,8 @@ class CrossProcessServingPool:
                  metrics: Optional[ServeMetrics] = None,
                  member_env: Optional[dict] = None,
                  spawn_timeout_s: float = 120.0,
+                 shed: bool = False, shed_headroom: float = 1.0,
+                 rtt_degraded_x: float = 5.0,
                  start_poll: bool = True):
         from hetu_tpu.ps import van
         if n_members < 1:
@@ -461,6 +506,15 @@ class CrossProcessServingPool:
         self._draining: set = set()
         self._quarantined: set = set()  # engine-dead / failed-over slots
         self._suspect_t0: dict = {}     # slot -> trace ts of suspicion
+        # per-link health, measured from this controller's OWN control
+        # sends (every submit/drain command is a timed blob put): the
+        # routing penalty that keeps traffic off a member behind a
+        # degraded link BEFORE its lease ever wobbles
+        self._shed = bool(shed)
+        self._shed_headroom = float(shed_headroom)
+        self._rtt_degraded_x = float(rtt_degraded_x)
+        self._rtt: dict = {}            # slot -> EWMA send seconds
+        self._degraded_t0: dict = {}    # slot -> trace ts of degrade
         self._xfers: dict = {}          # xfer id -> {"evt", "events"}
         self._out: dict = {}            # slot -> (channel, lock, [seq])
         self._listeners: dict = {}      # slot -> (thread, stop)
@@ -494,7 +548,8 @@ class CrossProcessServingPool:
             submit_ch=CONTROL_CHANNEL_BASE + 2 * cid,
             event_ch=CONTROL_CHANNEL_BASE + 2 * cid + 1,
             membership_table=self._membership_table, hb_ms=self.hb_ms,
-            request_timeout_s=self.request_timeout_s, model=self.model)
+            request_timeout_s=self.request_timeout_s, model=self.model,
+            shed=self._shed, shed_headroom=self._shed_headroom)
         from pathlib import Path
         cfg = Path(self.workdir) / f"member_{slot}_{cid}.json"
         cfg.write_text(spec.to_json())
@@ -549,13 +604,65 @@ class CrossProcessServingPool:
             raise ConnectionError(f"member {slot} has no control channel")
         ch, lock, seq = ent
         payload = json.dumps(msg).encode()
-        with lock:
-            _mb.control_rpc(
-                lambda: ch.put(payload, seq[0], timeout_s=timeout_s),
-                attempts=attempts, base_s=0.05,
-                is_transient=lambda e: isinstance(
-                    e, (TimeoutError, ConnectionError, RuntimeError)))
-            seq[0] += 1
+        t0 = time.monotonic()
+        try:
+            with lock:
+                _mb.control_rpc(
+                    lambda: ch.put(payload, seq[0], timeout_s=timeout_s),
+                    attempts=attempts, base_s=0.05,
+                    op=f"send[{msg.get('cmd')}]", link=f"ctrl->m{slot}",
+                    is_transient=lambda e: isinstance(
+                        e, (TimeoutError, ConnectionError, RuntimeError)))
+                seq[0] += 1
+        finally:
+            # every control send doubles as a link probe — failures
+            # included (a send that burned its whole retry budget is the
+            # strongest degradation signal there is)
+            self._observe_rtt(slot, time.monotonic() - t0)
+
+    def _observe_rtt(self, slot: int, rtt_s: float) -> None:
+        prev = self._rtt.get(slot)
+        ewma = rtt_s if prev is None else 0.7 * prev + 0.3 * rtt_s
+        self._rtt[slot] = ewma
+        base = self._rtt_floor()
+        if base is None:
+            return
+        if ewma > self._rtt_degraded_x * base:
+            if slot not in self._degraded_t0:
+                # the degrade window opens: recorded retroactively as a
+                # serve.link_degraded span when the link recovers — the
+                # recovery event RECOVERY_FOR pairs with fault.netem_degrade
+                self._degraded_t0[slot] = trace.now_us()
+                self.metrics.inc("links_degraded")
+        elif ewma < 2.0 * base:
+            t0d = self._degraded_t0.pop(slot, None)
+            if t0d is not None:
+                trace.complete("serve.link_degraded", t0d,
+                               {"member": int(slot),
+                                "rtt_ms": round(ewma * 1e3, 3)},
+                               cat="serve")
+                self.metrics.inc("links_recovered")
+
+    def _rtt_floor(self) -> Optional[float]:
+        """The healthiest observed link (EWMA floor) — the baseline a
+        degraded link is judged against.  None until measured.  Floored
+        at 2ms: on loopback the true RTT is microseconds and any GIL
+        hiccup would read as a 5x 'degradation' — a link must be
+        MILLISECONDS worse than its peers before it is called gray."""
+        if not self._rtt:
+            return None
+        return max(min(self._rtt.values()), 2e-3)
+
+    def _rtt_penalty(self, slot: int) -> float:
+        """Routing penalty in 'equivalent in-flight requests': each
+        multiple of the baseline RTT costs like one extra outstanding
+        request, capped so a wedged link ranks worst but stays finite
+        (a suspect lease, not this penalty, takes it out entirely)."""
+        rtt = self._rtt.get(slot)
+        base = self._rtt_floor()
+        if rtt is None or base is None:
+            return 0.0
+        return min(max(rtt / base - 1.0, 0.0), 16.0)
 
     def _event_loop(self, slot: int, event_ch: int,
                     stop: threading.Event) -> None:
@@ -565,7 +672,7 @@ class CrossProcessServingPool:
             while not (stop.is_set() or self._stop.is_set()):
                 try:
                     raw = ch.get(seq, timeout_s=0.25)
-                except TimeoutError:
+                except (TimeoutError, ConnectionError):
                     continue
                 except RuntimeError:
                     if self._stop.is_set():
@@ -649,7 +756,13 @@ class CrossProcessServingPool:
                 cands = self._routable(exclude)
                 if not cands:
                     break
-                slot = min(cands, key=lambda s: self._inflight.get(s, 0))
+                # least-loaded, where "load" counts both outstanding
+                # requests AND the link penalty: a member behind a
+                # degraded link serves fewer requests per unit time, so
+                # its slower wire is priced like extra queue depth
+                slot = min(cands,
+                           key=lambda s: self._inflight.get(s, 0) +
+                           self._rtt_penalty(s))
                 prev = req.member
                 req.member = slot
                 self._inflight[slot] = self._inflight.get(slot, 0) + 1
@@ -748,7 +861,55 @@ class CrossProcessServingPool:
                 with self._lock:
                     self._quarantined.add(slot)
                 self.metrics.inc("members_engine_dead")
+        # active link probe for DEGRADED slots: routing steers traffic
+        # away from them, so without a probe no send would ever observe
+        # the recovery and the degrade window would never close.  The
+        # ping is a no-op command; its put waits on the member's ack of
+        # the previous frame, so it measures the member's real read path
+        for slot in list(self._degraded_t0):
+            if self.svc.state_of(slot).state in ("alive", "suspect"):
+                try:
+                    self._send(slot, {"cmd": "ping"}, timeout_s=0.5,
+                               attempts=1)
+                except Exception:
+                    pass  # the failure itself updated the RTT EWMA
         return n
+
+    # ---- network-plane chaos (ps/netem.py over the command wire) ----
+    def apply_net_fault(self, kind: str, member_idx: int,
+                        duration_s: float = 1.0) -> None:
+        """Route an injected network fault at a member by index:
+        ``netem_partition`` = one-way EGRESS partition (the member's
+        beats and completions black-hole; it still hears us — the
+        asymmetric case), ``netem_degrade`` = gray link both ways
+        (loss + latency + bandwidth cap).  Policies carry
+        ``duration_s`` and heal themselves member-side — a heal
+        command could not cross a cut link."""
+        slot = int(member_idx) % self.n_members
+        if kind == "netem_partition":
+            msg = {"cmd": "netem", "direction": "egress",
+                   "policy": {"partition": True,
+                              "duration_s": float(duration_s)}}
+        elif kind == "netem_degrade":
+            msg = {"cmd": "netem", "direction": "both",
+                   "policy": {"latency_s": 0.05, "jitter_s": 0.05,
+                              "drop_p": 0.05, "rate_mbps": 50.0,
+                              "duration_s": float(duration_s)}}
+        else:
+            raise ValueError(f"unknown net fault kind {kind!r}")
+        self.metrics.inc(f"{kind}s_applied")
+        self._send(slot, msg)
+
+    def run_net_events(self, events) -> None:
+        """Apply events drained from ``FaultInjector.pop_net_events()``
+        — prefer draining with ``kinds=("netem_partition",
+        "netem_degrade")`` so a mixed schedule's ``straggler`` events
+        stay queued for the training supervisor that owns them; any
+        straggler event handed here anyway is left untouched."""
+        for kind, idx, duration_s in events:
+            if kind == "straggler":
+                continue
+            self.apply_net_fault(kind, idx, duration_s)
 
     def failover(self, slot: int) -> int:
         """The member process is gone (lease expired past the suspect
@@ -793,6 +954,8 @@ class CrossProcessServingPool:
         slot = int(slot)
         codec = self.migrate_codec if codec is None \
             else _migrate.check_codec(codec)
+        if codec == "auto":
+            codec = self._resolve_auto_codec(slot)
         with self._lock:
             if slot in self._draining or slot in self._quarantined:
                 return 0
@@ -871,6 +1034,27 @@ class CrossProcessServingPool:
         self.metrics.inc("pool_migrations")
         self.metrics.inc("requests_migrated", n)
         return n
+
+    def _resolve_auto_codec(self, slot: int) -> str:
+        """Controller-side ``codec="auto"`` resolution (the member's
+        live token lengths are across a process boundary, so the
+        payload is ESTIMATED from the model spec and the slot's
+        outstanding requests — each assumed halfway through
+        ``max_len``); the link rate is this process's best evidence
+        (:func:`hetu_tpu.serve.migrate.known_link_mbps`: a netem cap,
+        else a previously observed BULK transfer — never the tiny
+        ack-paced control frames, whose bytes/latency ratio reads
+        orders of magnitude below the real wire).  No evidence resolves
+        to "none": on an unmeasured link, compression is a bet, not a
+        measurement."""
+        m = self.model
+        head_dim = int(m["hidden_size"]) // int(m["num_heads"])
+        per_tok = 2 * int(m["num_heads"]) * head_dim * 4  # f32 K+V
+        tokens = max(self._inflight.get(slot, 0), 1) * \
+            int(m["max_len"]) // 2
+        payload = tokens * int(m["num_layers"]) * per_tok
+        return _migrate.pick_codec(_migrate.known_link_mbps(),
+                                   payload, "float32")
 
     @staticmethod
     def _await_xfer(xfer: dict, kinds, timeout_s: float) -> dict:

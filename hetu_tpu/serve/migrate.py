@@ -138,15 +138,114 @@ def request_from_record(rec: dict, *, now: float | None = None):
 CODECS = ("none", "bf16", "int8")
 
 
-def check_codec(codec: str) -> str:
+def check_codec(codec: str, *, allow_auto: bool = True) -> str:
     """Validate a KV wire codec name up front (pool construction, the
     per-drain override) so a typo fails where it was written, not at
     the first drain under a preemption deadline.  ONE home for the
-    check — both pool flavors and both override points use it."""
+    check — both pool flavors and both override points use it.
+    ``"auto"`` (policy, not a wire format — :func:`pick_codec` resolves
+    it per drain from the measured link rate) is accepted everywhere
+    except the pack/unpack layer itself (``allow_auto=False``)."""
+    if codec == "auto" and allow_auto:
+        return codec
     if codec not in CODECS:
         raise ValueError(f"unknown migrate codec {codec!r}; expected "
-                         f"one of {CODECS}")
+                         f"one of {CODECS}" +
+                         (" or 'auto'" if allow_auto else ""))
     return codec
+
+
+# a link-rate sample must come from a transfer big enough that the
+# payload, not per-frame ack pacing, dominated the wall time
+MIN_RATE_SAMPLE_BYTES = 1 << 16
+
+
+def measured_link_mbps(registry=None) -> float | None:
+    """Observed bulk-transfer rate in Mbit/s — the op-span-derived half
+    of the "netem-visible or op-span-derived" link-rate signal the auto
+    drain codec uses.  The ONLY samples consulted are completed
+    migration payload sends of at least ``MIN_RATE_SAMPLE_BYTES``
+    (``migrate.wire.mbps``, recorded by :func:`send_payload`): the
+    generic ``van.blob_put`` aggregate is dominated by tiny ack-paced
+    control frames whose bytes/latency ratio reads orders of magnitude
+    below the real wire — a "measurement" that would always escalate
+    the codec on loopback.  Returns None until a real bulk transfer has
+    been observed (no evidence = no compression)."""
+    if registry is None:
+        from hetu_tpu.telemetry import default_registry as registry
+    g = registry.metrics().get("migrate.wire.mbps_last")
+    if g is None:
+        return None
+    rate = float(g.value)
+    return rate if rate > 0 else None
+
+
+def known_link_mbps() -> float | None:
+    """The best link-rate signal available in THIS process: an
+    installed netem bandwidth cap (the emulated truth) wins, else the
+    last observed bulk-transfer rate, else None."""
+    from hetu_tpu.ps import van as _van
+    em = getattr(getattr(_van, "_netem_hook", None), "__self__", None)
+    if em is not None and hasattr(em, "current_rate_mbps"):
+        rate = em.current_rate_mbps()
+        if rate is not None:
+            return rate
+    return measured_link_mbps()
+
+
+def estimate_payload_bytes(engine) -> int:
+    """Uncompressed (codec="none") drain payload size for the engine's
+    LIVE slots: what :func:`pack` would ship, from the cache's lengths
+    and geometry — no export needed to decide a codec."""
+    cache = engine.cache
+    spec = cache.spec
+    itemsize = _np_dtype(str(np.dtype(spec.dtype))).itemsize
+    per_tok = 2 * spec.num_kv_heads * spec.head_dim * itemsize
+    live_tokens = int(np.sum(cache.lengths))
+    return live_tokens * spec.num_layers * per_tok
+
+
+def pick_codec(rate_mbps: float | None, payload_bytes: int,
+               cache_dtype: str, *,
+               fast_s: float = 0.05, slow_s: float = 0.5) -> str:
+    """Resolve ``codec="auto"`` to a concrete wire codec from the
+    crossover model ``bench.py migrate --quant`` measures: compression
+    only wins when the LINK, not the CPU, is the bottleneck — loopback
+    moves bytes for free and the codec would just burn encode time.
+
+    * rate unknown or projected transfer under ``fast_s`` → ``none``
+      (nothing to save);
+    * bf16 cache → ``bf16`` (bit-lossless, 2x) once transfer costs
+      real time; escalate to ``int8`` (4x vs f32, 2x vs bf16,
+      near-lossless block scales) when even the bf16 payload would
+      exceed ``slow_s`` — the preemption-deadline regime where the
+      bench's crossover shows int8 winning outright;
+    * f32 cache → ``int8`` directly (bf16 would be lossy anyway at
+      only 2x; int8's block-scaled 4x is the measured winner).
+    """
+    if rate_mbps is None or rate_mbps <= 0 or payload_bytes <= 0:
+        return "none"
+    transfer_s = payload_bytes / (rate_mbps * 125_000.0)
+    if transfer_s <= fast_s:
+        return "none"
+    if "bfloat16" in str(cache_dtype) or "bf16" in str(cache_dtype):
+        return "int8" if transfer_s / 2.0 > slow_s else "bf16"
+    return "int8"
+
+
+def resolve_codec(codec: str, engine, *,
+                  rate_mbps: float | None = None) -> str:
+    """The per-drain "auto" resolution both pool flavors share: prefer
+    an explicitly known link rate (a netem cap, a configured DCN
+    share), fall back to the op-span-derived measurement, and feed the
+    engine's live payload estimate through :func:`pick_codec`.
+    Concrete codecs pass through untouched."""
+    if codec != "auto":
+        return check_codec(codec, allow_auto=False)
+    if rate_mbps is None:
+        rate_mbps = known_link_mbps()
+    return pick_codec(rate_mbps, estimate_payload_bytes(engine),
+                      str(np.dtype(engine.cache.spec.dtype)))
 
 
 def _encode_kv(arr: np.ndarray, codec: str, dt: np.dtype) -> bytes:
@@ -456,6 +555,7 @@ def send_payload(channel, payload: bytes, *, seq0: int = 1,
     chunk_bytes = max(int(chunk_bytes), 1)
     n = max((len(payload) + chunk_bytes - 1) // chunk_bytes, 1)
     slice_s = 0.5 if stop is not None else timeout_s
+    t0 = time.perf_counter()
     for i in range(n):
         part = payload[i * chunk_bytes:(i + 1) * chunk_bytes]
         frame = _CHUNK_HDR.pack(MAGIC, VERSION, i, n,
@@ -474,6 +574,16 @@ def send_payload(channel, payload: bytes, *, seq0: int = 1,
                 # ack window still blocked: same-seq resend is idempotent
                 if time.monotonic() >= deadline:
                     raise
+    dt = time.perf_counter() - t0
+    if len(payload) >= MIN_RATE_SAMPLE_BYTES and dt > 0:
+        # a completed BULK transfer is the one honest link-rate sample
+        # this process gets (control frames are tiny and ack-paced —
+        # their byte/latency aggregate reads orders of magnitude slow):
+        # feed the auto-codec model (measured_link_mbps)
+        from hetu_tpu.telemetry import default_registry as _reg
+        _reg.gauge("migrate.wire.mbps_last").set(
+            len(payload) * 8.0 / (dt * 1e6))
+        _reg.counter("migrate.wire.rate_samples").inc()
     return seq0 + n
 
 

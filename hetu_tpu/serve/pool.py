@@ -171,6 +171,7 @@ class ServingPool:
                  migrate_channel_base: int = MIGRATE_CHANNEL_BASE,
                  metrics: Optional[ServeMetrics] = None,
                  member_factory=None,
+                 shed: bool = False, shed_headroom: float = 1.0,
                  start_poll: bool = True):
         from hetu_tpu.ps import van
         # member_factory(pool, name, engine_factory) -> PoolMember lets a
@@ -201,7 +202,15 @@ class ServingPool:
         self._max_loop_errors = int(max_loop_errors)
         self._failover_grace_s = float(failover_grace_s)
         self._chunk_bytes = int(chunk_bytes)
-        # wire codec for drain payloads ("bf16"/"int8", see migrate.pack)
+        # overload shedding per member scheduler (serve/scheduler.py):
+        # a deadline-doomed submit resolves 'shed' instantly instead of
+        # queueing into collapse; pool.submit does NOT re-route a shed
+        # (every member sees the same overload — re-routing would just
+        # tour the pool before failing slower)
+        self._shed = bool(shed)
+        self._shed_headroom = float(shed_headroom)
+        # wire codec for drain payloads ("bf16"/"int8", see migrate.pack;
+        # "auto" picks per drain from the measured link rate)
         self.migrate_codec = _migrate.check_codec(migrate_codec)
         self._lock = threading.RLock()
         # see _MIG_SEQ: ids are drawn process-globally; the base is only
@@ -229,7 +238,8 @@ class ServingPool:
         engine = _GuardedEngine(factory())
         sched = ContinuousBatchingScheduler(
             engine, token_budget=self._token_budget,
-            max_requeues=self._max_requeues)
+            max_requeues=self._max_requeues,
+            shed=self._shed, shed_headroom=self._shed_headroom)
         srv = InferenceServer(
             sched, port=self.port, own_van=False, max_clients=0,
             request_timeout_s=self.request_timeout_s,
@@ -472,6 +482,12 @@ class ServingPool:
         codec = self.migrate_codec if codec is None \
             else _migrate.check_codec(codec)
         m = self.members[name]
+        if codec == "auto":
+            # per-drain resolution from the measured link rate (netem
+            # cap if one is installed, else the op-span-derived rate)
+            # and THIS member's live payload — the crossover model
+            # `bench.py migrate --quant` measures, applied at drain time
+            codec = _migrate.resolve_codec("auto", m.scheduler.engine)
         with self._lock:
             if m.dead or m.draining:
                 return {}

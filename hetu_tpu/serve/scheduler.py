@@ -110,7 +110,7 @@ class Request:
     # filled in by the scheduler
     tokens: list = field(default_factory=list)
     state: str = "new"        # new|queued|running|done
-    status: str = ""          # ok|timeout|cancelled|overflow|shutdown
+    status: str = ""          # ok|timeout|cancelled|overflow|shutdown|shed
     slot: Optional[int] = None
     requeues: int = 0         # engine-failover requeue count (bounded)
     rejected: bool = False    # intake-closed reject: the pool re-routes
@@ -135,7 +135,8 @@ class Request:
 
 class ContinuousBatchingScheduler:
     def __init__(self, engine, *, token_budget: Optional[int] = None,
-                 metrics=None, max_requeues: int = 3):
+                 metrics=None, max_requeues: int = 3,
+                 shed: bool = False, shed_headroom: float = 1.0):
         self.engine = engine
         self.metrics = metrics or engine.metrics
         # engine-failover requeue budget per request: a request whose
@@ -147,6 +148,18 @@ class ContinuousBatchingScheduler:
         # when admission would overrun physical capacity anyway)
         self.token_budget = int(token_budget or
                                 cache.num_slots * cache.max_len)
+        # overload shedding (admission control): with ``shed`` on, a
+        # submit whose PROJECTED completion (queue-delay model below)
+        # already blows its deadline resolves instantly as 'shed' —
+        # the client learns in microseconds instead of burning a slot's
+        # worth of work on an answer it will throw away, and the queue
+        # stays short enough that ACCEPTED requests still meet theirs.
+        # ``shed_headroom`` scales the projection (<1 sheds earlier,
+        # >1 later).  Off by default: a lone server with no deadline
+        # contract should queue, not reject.
+        self.shed = bool(shed)
+        self.shed_headroom = float(shed_headroom)
+        self._ewma_service_s: Optional[float] = None
         self._lock = threading.Lock()
         self._queue = deque()
         self._running = {}   # slot -> Request
@@ -154,11 +167,39 @@ class ContinuousBatchingScheduler:
         self._reject_status = "shutdown"  # status for post-drain submits
 
     # ---- request intake ----
+    def projected_wait_s(self) -> float:
+        """Queue-delay projection for a request submitted NOW: how long
+        until the engine would COMPLETE it, from the load ahead of it
+        and the EWMA of observed per-request service time.  0.0 until
+        the first completion seeds the model (no evidence = no shed).
+        Lock-free like :attr:`load` — a slightly stale projection only
+        nudges the shed boundary."""
+        ewma = self._ewma_service_s
+        if ewma is None:
+            return 0.0
+        slots = max(self.engine.cache.num_slots, 1)
+        ahead = len(self._queue) + len(self._running)
+        # `ahead/slots` service generations drain before its turn, then
+        # its own service — the M/M/c-flavored projection that needs
+        # only numbers already on hand
+        return (ahead / slots + 1.0) * ewma
+
     def submit(self, request: Request, *,
                resolve_on_reject: bool = True) -> Request:
         request.submitted_at = time.monotonic()
+        shed = False
         with self._lock:
-            if not self._accepting:
+            if self._accepting and self.shed and \
+                    request.timeout_s is not None:
+                # the shed decision runs AFTER the accepting gate: a
+                # submit that raced a drain must take the REJECT path
+                # below (the pool re-routes it to a live peer) — a
+                # draining member's queue is about to be handed away
+                # and says nothing about whether the deadline is
+                # feasible elsewhere
+                projected = self.projected_wait_s() * self.shed_headroom
+                shed = projected > request.timeout_s
+            if not shed and not self._accepting:
                 # a drain/stop_intake closed the front door — complete
                 # immediately with that drain's status ('shutdown', or
                 # 'error' for a dead engine) so the submitting listener
@@ -183,11 +224,23 @@ class ContinuousBatchingScheduler:
                     finish_request(request, self._reject_status, None)
                 self.metrics.inc("requests_rejected")
                 return request
-            request.state = "queued"
-            request.owner = self
-            self._queue.append(request)
-            self.metrics.inc("requests_submitted")
-            self.metrics.set_gauge("queue_depth", len(self._queue))
+            if not shed:
+                request.state = "queued"
+                request.owner = self
+                self._queue.append(request)
+                self.metrics.inc("requests_submitted")
+                self.metrics.set_gauge("queue_depth", len(self._queue))
+        if shed:
+            # instant reject: the deadline is already unmeetable —
+            # resolving now is the difference between bounded-latency
+            # partial service and every queued request timing out
+            # together (the collapse mode).  Terminal (not a re-route
+            # reject): every peer sees the same overload, and touring
+            # the pool would just fail slower.
+            trace.instant("serve.shed",
+                          {"rid": int(request.rid),
+                           "deadline_s": request.timeout_s})
+            self._finish(request, "shed")
         return request
 
     def requeue_inflight(self, *, max_requeues: Optional[int] = None) -> int:
@@ -672,7 +725,17 @@ class ContinuousBatchingScheduler:
         return False
 
     def _finish(self, req: Request, status: str) -> None:
-        finish_request(req, status, self.metrics)
+        if finish_request(req, status, self.metrics) and \
+                req.first_token_at is not None and \
+                req.finished_at is not None:
+            # learn per-request SERVICE time (first token -> finish:
+            # queue wait excluded, or load would inflate the model and
+            # the model then over-shed the load away) from every
+            # request that actually ran, whatever its status
+            service = max(req.finished_at - req.first_token_at, 1e-4)
+            prev = self._ewma_service_s
+            self._ewma_service_s = service if prev is None \
+                else 0.8 * prev + 0.2 * service
 
     # ---- convenience driver (tests / offline batch use) ----
     def run(self, requests, *, max_steps: int = 100_000) -> dict:

@@ -79,6 +79,20 @@ RECOVERY_FOR = {
     # PROCESS death → lease expiry → published shrink epoch; the span
     # ends when every survivor acked the new width
     "worker_proc_kill": ("elastic.reshard",),
+    # network plane (ps/netem.py): a one-way partition that heals is
+    # answered by the retroactive suspect window (the member was never
+    # lost); one that outlasts the grace falls back to the failover —
+    # structurally identical to member_suspend, which is the partition
+    # LOOKALIKE this kind makes real
+    "netem_partition": ("serve.member_suspect", "serve.failover"),
+    # a gray link (loss/latency/bandwidth cliff) is answered by the
+    # routing penalty window: the controller marks the link degraded on
+    # measured RTT and closes the span when the RTT recovers
+    "netem_degrade": ("serve.link_degraded",),
+    # an injected slow link on a training worker is answered by the
+    # straggler window (detection → policy applied or slowness gone);
+    # under the evict policy the reshard is the fallback recovery
+    "straggler": ("train.straggler", "elastic.reshard"),
 }
 
 # kinds whose RECOVERY_FOR tuple is a strict preference order: the first
@@ -86,7 +100,8 @@ RECOVERY_FOR = {
 # fallbacks.  For every other multi-name kind any listed name can be the
 # real recovery (a suspend_shard is repaired by whichever of
 # shard_repair/retry actually ran), so time decides, not the tuple.
-PREFERENCE_ORDERED = frozenset({"serve_preempt", "member_suspend"})
+PREFERENCE_ORDERED = frozenset({"serve_preempt", "member_suspend",
+                                "netem_partition", "straggler"})
 
 # fault kind -> args a candidate recovery event must carry.  A preempt
 # must claim the checkpoint the SIGTERM caused (reason="preempt"), not a

@@ -116,6 +116,32 @@ def test_schedule_process_fault_kinds_draw_after_everything():
         grown.to_json()
 
 
+def test_schedule_netem_fault_kinds_draw_after_everything():
+    """Third extension of the frozen-bytes contract (ISSUE 10): the
+    network-plane kinds (netem_partition/netem_degrade/straggler) must
+    draw from the rng AFTER every pre-existing kind — including the
+    process-level kinds PR 8 added — so every recorded chaos seed
+    still replays byte-for-byte."""
+    old = dict(steps=50, seed=7, van_errors=2, kill_shards=1, n_shards=2,
+               serve_preempts=1, n_members=2, member_kills=1,
+               member_suspends=1, worker_proc_kills=1, n_workers=3)
+    base = FaultSchedule.generate(**old)
+    net_kinds = ("netem_partition", "netem_degrade", "straggler")
+    grown = FaultSchedule.generate(**old, netem_partitions=1,
+                                   netem_partition_s=0.8,
+                                   netem_degrades=1, stragglers=1,
+                                   straggler_s=1.5)
+    old_events = [e for e in grown.events if e.kind not in net_kinds]
+    assert old_events == base.events
+    new = {e.kind: e for e in grown.events if e.kind in net_kinds}
+    assert sorted(new) == sorted(net_kinds)
+    # durations ride arg2, victims arg — byte-stable round trip
+    assert new["netem_partition"].arg2 == 0.8
+    assert new["straggler"].arg2 == 1.5
+    assert FaultSchedule.from_json(grown.to_json()).to_json() == \
+        grown.to_json()
+
+
 def test_schedule_at_and_validation():
     s = FaultSchedule([FaultEvent(3, "nan_grad"), FaultEvent(3, "van_error"),
                        FaultEvent(5, "preempt")])
