@@ -1951,6 +1951,130 @@ def bench_netchaos():
     })
 
 
+def bench_mpmd():
+    """Cross-process MPMD pipeline training: what the schedule buys,
+    and what a stage kill costs.
+
+    1. **GPipe vs 1F1B bubble** — two 3-stage pipelines (real stage
+       processes, synthetic per-op compute so the schedule dominates
+       the tiny matmuls) at MATCHED activation memory: GPipe is
+       stash-bounded to 1F1B's peak stash (S microbatches), so it runs
+       ceil(M/S) mini-flushes where 1F1B runs one.  Bubble fraction is
+       measured per stage per step as 1 - compute_busy/step_wall
+       (barrier-to-barrier) and averaged; the headline is the GPipe /
+       1F1B bubble ratio.  Both arms are seed-identical runs whose
+       final params are bitwise equal — the schedule moves the bubble,
+       never the math.
+
+    2. **Stage-kill recovery** — a seeded SIGKILL of the middle stage
+       on the 1F1B arm's configuration: lease expiry → replacement
+       spawned → PREPARE-frozen two-phase epoch → exact resume.
+       Reported: detect p50 (kill → replace span start) and recover p50
+       (kill → every stage acked the resume) from the paired timeline.
+    """
+    import os
+    import tempfile
+
+    from hetu_tpu.parallel.mpmd_elastic import MPMDPipelineSupervisor
+    from hetu_tpu.resilience.faults import FaultInjector, FaultSchedule
+    from hetu_tpu.telemetry import timeline, trace
+
+    smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
+    S, M, D = 3, 8, 8
+    STEPS = 4 if smoke else 8
+    COMPUTE_S = 0.006 if smoke else 0.010
+    KILL_STEPS, KILLS = (14, 1)
+
+    def run_arm(schedule, *, stash_limit=0, steps=STEPS, injector=None,
+                compute_sleep_s=COMPUTE_S, step_sleep_s=0.0):
+        with tempfile.TemporaryDirectory(prefix="bench_mpmd_") as wd:
+            sup = MPMDPipelineSupervisor(
+                S, workdir=wd, steps=steps, n_microbatches=M, width=D,
+                batch=M, schedule=schedule, stash_limit=stash_limit,
+                wire="bf16", compute_sleep_s=compute_sleep_s,
+                step_sleep_s=step_sleep_s, lease_s=0.5,
+                suspect_grace_s=0.3)
+            if injector is not None:
+                injector.stage_procs = sup.procs
+                sup.injector = injector
+            try:
+                rep = sup.run(deadline_s=240.0)
+                bubbles = []
+                for p in rep["log_paths"]:
+                    for line in open(p):
+                        try:
+                            r = json.loads(line)
+                        except ValueError:
+                            # a SIGKILLed incarnation can leave a
+                            # truncated final line — not a measurement
+                            continue
+                        # step 0 pays channel/connection setup: skip it
+                        if r["step"] == 0 or r["wall_ms"] <= 0:
+                            continue
+                        bubbles.append(1.0 - r["busy_ms"] / r["wall_ms"])
+                rep["bubble"] = float(np.mean(bubbles)) if bubbles \
+                    else float("nan")
+                return rep
+            finally:
+                sup.close()
+
+    # ---- arm 1/2: the schedule A/B at matched activation memory
+    onef1b = run_arm("1f1b")
+    gpipe = run_arm("gpipe", stash_limit=S)
+    for s in onef1b["final_params"]:
+        np.testing.assert_array_equal(onef1b["final_params"][s],
+                                      gpipe["final_params"][s])
+
+    # ---- arm 3: seeded middle-stage SIGKILL on the 1F1B pipeline
+    sched = FaultSchedule.generate(steps=10, seed=1, stage_kills=KILLS,
+                                   n_stages=S)
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    try:
+        chaos = run_arm("1f1b", steps=KILL_STEPS,
+                        injector=FaultInjector(sched),
+                        compute_sleep_s=0.0, step_sleep_s=0.03)
+    finally:
+        trace.disable()
+    pairs = timeline.correlate(tracer.events)
+    kills = [p for p in pairs if p.kind == "stage_kill" and p.paired]
+    assert len(kills) == KILLS and chaos["replacements"], pairs
+    detect = sorted(p.detect_s for p in kills)
+    recover = sorted(p.recover_s for p in kills)
+    p50 = lambda xs: xs[len(xs) // 2]  # noqa: E731
+
+    ratio = gpipe["bubble"] / max(onef1b["bubble"], 1e-9)
+    flushes = -(-M // S)
+    theory_g = flushes * (S - 1) / (M + flushes * (S - 1))
+    theory_f = (S - 1) / (M + S - 1)
+    print(f"# bubble: gpipe(stash={S}) {gpipe['bubble']:.3f}  vs  "
+          f"1f1b {onef1b['bubble']:.3f}  ({ratio:.2f}x)  "
+          f"[theory {theory_g:.3f} vs {theory_f:.3f}]", file=sys.stderr)
+    print(f"# stage_kill detect p50 {p50(detect) * 1e3:8.1f} ms  "
+          f"recover p50 {p50(recover) * 1e3:8.1f} ms  "
+          f"(replacement resume_step "
+          f"{chaos['replacements'][0]['resume_step']})", file=sys.stderr)
+    _emit({
+        "metric": "mpmd_gpipe_over_1f1b_bubble_x",
+        "value": round(ratio, 3),
+        "unit": "gpipe_over_1f1b_bubble_fraction_ratio_matched_stash",
+        "vs_baseline": round(ratio, 3),
+        "extra": {
+            "bubble_1f1b": round(onef1b["bubble"], 4),
+            "bubble_gpipe": round(gpipe["bubble"], 4),
+            "stages": S, "microbatches": M, "stash_limit": S,
+            "compute_sleep_ms": COMPUTE_S * 1e3,
+            "params_bitwise_equal_across_schedules": True,
+            "stage_kill_detect_s_p50": round(p50(detect), 3),
+            "stage_kill_recover_s_p50": round(p50(recover), 3),
+            "replacements": chaos["replacements"],
+            "wire": "bf16",
+            "ab": {"optimized": "1f1b_single_flush",
+                   "baseline": "gpipe_stash_matched_mini_flushes"},
+        },
+    })
+
+
 _METRIC_BY_CMD = {
     "gpt": "gpt2s_bf16_train_mfu_1chip",
     "gpt_sweep": "gpt_config_sweep_best_mfu_1chip",
@@ -1966,6 +2090,7 @@ _METRIC_BY_CMD = {
     "telemetry": "telemetry_tracing_overhead_pct",
     "crosshost": "crosshost_drain_overhead_x",
     "netchaos": "netchaos_shed_vs_noshed_p99_x",
+    "mpmd": "mpmd_gpipe_over_1f1b_bubble_x",
 }
 
 
@@ -2007,6 +2132,7 @@ def main():
      "elastic": bench_elastic,
      "crosshost": bench_crosshost,
      "netchaos": bench_netchaos,
+     "mpmd": bench_mpmd,
      "telemetry": bench_telemetry}.get(cmd, bench_gpt)()
 
 

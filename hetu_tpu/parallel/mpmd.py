@@ -27,6 +27,8 @@ from typing import List, Tuple
 
 import numpy as np
 
+from hetu_tpu import quantwire  # numpy-only; safe at module import
+
 
 def round_robin_assignments(n_microbatches: int, n_src: int,
                             n_dst: int) -> List[Tuple[int, int]]:
@@ -34,6 +36,140 @@ def round_robin_assignments(n_microbatches: int, n_src: int,
     by stage-B replica i % n_dst (reference context.py:164-188 round-robin
     send/recv target computation)."""
     return [(i % n_src, i % n_dst) for i in range(n_microbatches)]
+
+
+# ---------------------------------------------------------------------------
+# microbatch schedules (reference pipeline_subexecutor.py GPipe flush and
+# the PipeDream-flush 1F1B order, as per-stage op lists)
+# ---------------------------------------------------------------------------
+
+PIPELINE_SCHEDULES = ("gpipe", "1f1b")
+
+
+def schedule_ops(kind: str, *, stage: int, n_stages: int,
+                 n_microbatches: int,
+                 stash_limit: int = 0) -> List[Tuple[str, int]]:
+    """The per-stage microbatch op order of a synchronous pipeline step,
+    as ``[("F"|"B", microbatch), ...]``.
+
+    ``gpipe``: all forwards then all backwards (the flush schedule).
+    ``stash_limit`` bounds the activation stash by splitting the step
+    into ceil(M/stash_limit) mini-flushes — the memory/bubble trade the
+    bench measures (an unbounded GPipe stashes all M microbatches; 1F1B
+    never stashes more than ``n_stages - stage``).
+
+    ``1f1b``: PipeDream-flush — stage s runs ``min(M, S-1-s)`` warmup
+    forwards, then strict one-forward-one-backward, then drains.  Same
+    per-step weight semantics as gpipe (single flush, update at the
+    end); only the ORDER — and with it stash depth and bubble — differs.
+
+    Both schedules emit backwards in ascending microbatch order, so
+    gradient accumulation order (and therefore the summed f32 gradient,
+    bitwise) is schedule-invariant — the property the elastic trainer's
+    byte-identity contract leans on.
+    """
+    M, S, s = int(n_microbatches), int(n_stages), int(stage)
+    if not 0 <= s < S:
+        raise ValueError(f"stage {s} outside [0, {S})")
+    if kind == "gpipe":
+        chunk = M if not stash_limit else max(1, min(int(stash_limit), M))
+        ops: List[Tuple[str, int]] = []
+        for lo in range(0, M, chunk):
+            mbs = range(lo, min(lo + chunk, M))
+            ops += [("F", m) for m in mbs]
+            ops += [("B", m) for m in mbs]
+        return ops
+    if kind == "1f1b":
+        warmup = min(M, S - 1 - s)
+        ops = [("F", m) for m in range(warmup)]
+        f, b = warmup, 0
+        while f < M:
+            ops.append(("F", f))
+            f += 1
+            ops.append(("B", b))
+            b += 1
+        while b < M:
+            ops.append(("B", b))
+            b += 1
+        return ops
+    raise ValueError(f"unknown schedule {kind!r}; "
+                     f"expected one of {PIPELINE_SCHEDULES}")
+
+
+def peak_stash(ops) -> int:
+    """Max number of microbatches whose forward activations are held at
+    once under an op order (F stashes, B frees)."""
+    live = peak = 0
+    for op, _ in ops:
+        live += 1 if op == "F" else -1
+        peak = max(peak, live)
+    return peak
+
+
+# ---------------------------------------------------------------------------
+# activation/cotangent wire codecs (the quantwire conventions applied to
+# the mailbox payloads: f32-logical tensors over a non-f32 wire)
+# ---------------------------------------------------------------------------
+
+Q8_BLOCK = 64  # elements per int8 scale block on mailbox payloads
+
+
+def encode_wire(arr, wire: str) -> tuple:
+    """f32-logical array -> ``(payload bytes, logical_bytes)`` in the
+    given wire dtype.  bf16 rounds to nearest-even (the XLA convention);
+    int8 is block-scaled (one f32 scale per :data:`Q8_BLOCK` elements,
+    quantwire clamp semantics).  Both are pure functions of the input —
+    two runs encoding the same activations emit identical bytes, so a
+    quantized edge never breaks the replay/byte-identity contracts."""
+    quantwire.check_wire(wire)
+    flat = np.ascontiguousarray(arr, np.float32).ravel()
+    logical = flat.size * 4
+    if wire == "f32":
+        return flat.tobytes(), logical
+    if wire == "bf16":
+        u = flat.view(np.uint32).astype(np.uint64)
+        r = ((u + 0x7FFF + ((u >> 16) & 1)) >> 16).astype(np.uint16)
+        nan = np.isnan(flat)
+        if nan.any():
+            # the rounding carry overflows a NaN's mantissa into the
+            # exponent (0x7FFFFFFF would decode as -0.0): force the
+            # canonical quiet bf16 NaN instead — a NaN activation must
+            # PROPAGATE, not silently zero (the nan_grad contract)
+            sign = ((u >> 16) & 0x8000).astype(np.uint16)
+            r = np.where(nan, sign | np.uint16(0x7FC0), r)
+        return r.tobytes(), logical
+    pad = (-flat.size) % Q8_BLOCK
+    if pad:
+        flat = np.pad(flat, (0, pad))
+    q, scale = quantwire.q8_encode_axes(flat.reshape(-1, Q8_BLOCK), (1,))
+    return q.tobytes() + scale.tobytes(), logical
+
+
+def decode_wire(payload: bytes, n: int, wire: str) -> np.ndarray:
+    """Inverse of :func:`encode_wire` back to ``n`` f32 elements."""
+    quantwire.check_wire(wire)
+    if wire == "f32":
+        a = np.frombuffer(payload, np.float32).copy()
+        if a.size != n:
+            raise ValueError(f"wire payload has {a.size} f32s, "
+                             f"expected {n}")
+        return a
+    if wire == "bf16":
+        u = np.frombuffer(payload, np.uint16)
+        if u.size != n:
+            raise ValueError(f"wire payload has {u.size} bf16s, "
+                             f"expected {n}")
+        return (u.astype(np.uint32) << 16).view(np.float32).copy()
+    nblk = -(-int(n) // Q8_BLOCK)
+    want = nblk * Q8_BLOCK + nblk * 4
+    if len(payload) != want:
+        raise ValueError(f"wire payload has {len(payload)} bytes, "
+                         f"expected {want} for {n} int8-block elements")
+    q = np.frombuffer(payload[:nblk * Q8_BLOCK],
+                      np.int8).reshape(nblk, Q8_BLOCK)
+    scales = np.frombuffer(payload[nblk * Q8_BLOCK:],
+                           np.float32).reshape(nblk, 1)
+    return quantwire.q8_decode_axes(q, scales).ravel()[:n].copy()
 
 
 class VanMailbox:
@@ -53,6 +189,13 @@ class VanMailbox:
     nothing but table ops.  Flag rows are f32, exact only to 2**24, so
     the wire flag wraps into [1, 2**20] (``_wire``); the ack lockstep
     (at most one in-flight message) keeps wrapped flags unambiguous.
+
+    ``wire`` (blob transport only) selects the payload encoding —
+    ``"f32"`` exact, ``"bf16"``/``"int8"`` the quantwire codecs (both
+    deterministic, so quantized edges keep the replay contract); the
+    mailbox counts ``bytes_logical``/``bytes_wire`` per direction and,
+    when ``metric_path`` is set, folds them into the shared
+    ``<path>.bytes_*`` telemetry counters.
     """
 
     _SEQ_MOD = 1 << 20
@@ -62,12 +205,20 @@ class VanMailbox:
         return (seq - 1) % cls._SEQ_MOD + 1 if seq > 0 else 0
 
     def __init__(self, host: str, port: int, channel_id: int,
-                 capacity: int, *, impl: str = "blob",
+                 capacity: int, *, impl: str = "blob", wire: str = "f32",
+                 metric_path: str | None = None,
                  connect_timeout_s: float = 20.0):
         if impl not in ("blob", "sparse"):
             raise ValueError(f"unknown mailbox impl {impl!r}")
+        if wire != "f32" and impl != "blob":
+            raise ValueError("quantized wire needs the blob transport "
+                             "(the sparse fallback is f32 rows)")
         self.capacity = capacity
         self.impl = impl
+        self.wire = quantwire.check_wire(wire)
+        self.metric_path = metric_path
+        self.bytes_logical = 0
+        self.bytes_wire = 0
         self._last_seq = 0
         if impl == "blob":
             from hetu_tpu.ps.van import BlobChannel
@@ -106,8 +257,14 @@ class VanMailbox:
             raise ValueError(f"message {flat.size} > capacity "
                              f"{self.capacity}")
         if self.impl == "blob":
-            self._chan.put(flat, seq, timeout_s=timeout_s)
+            payload, logical = encode_wire(flat, self.wire)
+            self._chan.put(payload, seq, timeout_s=timeout_s)
             self._last_seq = seq
+            self.bytes_logical += logical
+            self.bytes_wire += len(payload)
+            if self.metric_path:
+                quantwire.record_wire_bytes(self.metric_path, logical,
+                                            len(payload))
             return
         deadline = time.monotonic() + timeout_s
         # wait for the reader's ack of the previous message
@@ -130,13 +287,12 @@ class VanMailbox:
         n = int(np.prod(shape))
         if self.impl == "blob":
             data = self._chan.get(seq, timeout_s=timeout_s)
-            # frombuffer over bytes is read-only; copy so consumers may
-            # mutate in place (the sparse transport's contract)
-            a = np.frombuffer(data, np.float32).copy()
-            if a.size != n:
-                raise ValueError(
-                    f"mailbox: message has {a.size} f32s, expected "
-                    f"{n} for shape {shape}")
+            # decode_wire copies out of the read-only buffer, so
+            # consumers may mutate in place (the sparse transport's
+            # contract)
+            a = decode_wire(data, n, self.wire)
+            self.bytes_logical += n * 4
+            self.bytes_wire += len(data)
             return a.reshape(shape)
         deadline = time.monotonic() + timeout_s
         while True:
@@ -186,10 +342,11 @@ class MPMDStageRunner:
                  stage_dps: List[int], n_microbatches: int,
                  in_shape, out_shape, host: str, port: int,
                  base_channel: int = 5_000_000, grad_size: int,
-                 worker_uid: int | None = None):
+                 wire: str = "f32", worker_uid: int | None = None):
         import jax
 
         self.fn = stage_fn
+        self.wire = quantwire.check_wire(wire)
         self.stage, self.replica = stage, replica
         self.dps = list(stage_dps)
         self.S = len(stage_dps)
@@ -218,7 +375,10 @@ class MPMDStageRunner:
             # downstream edge and my in_shape on my upstream edge
             cap = int(np.prod(self.out_shape)) if edge == self.stage \
                 else int(np.prod(self.in_shape))
-            self._mail[key] = VanMailbox(self.host, self.port, cid, cap)
+            self._mail[key] = VanMailbox(
+                self.host, self.port, cid, cap, wire=self.wire,
+                metric_path=f"mpmd.edge{edge}."
+                            f"{'bwd' if backward else 'fwd'}")
             self._seq[key] = 0
         return self._mail[key]
 

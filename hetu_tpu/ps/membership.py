@@ -283,10 +283,16 @@ class MemberState:
     last_advance: float = 0.0     # monotonic ts of the last beat advance
     suspect_since: Optional[float] = None
     # why the member is suspect: "beats_stopped" (their beats froze —
-    # the classic silence that escalates to lost past the grace) or
+    # the classic silence that escalates to lost past the grace),
     # "probe_failed" (the CONTROLLER could not read the blackboard —
-    # the member may be beating perfectly; never escalates to lost)
+    # the member may be beating perfectly; never escalates to lost), or
+    # "deaf" (beats arrive but control-row epochs are never acked — the
+    # ingress-cut gray failure; clears when the ack catches up)
     suspect_reason: Optional[str] = None
+    # when this incarnation was first observed — the deaf bound measures
+    # time the MEMBER had to ack, so a fresh joiner is never deaf-
+    # suspected for an epoch published before it existed
+    joined_at: float = 0.0
     row: np.ndarray = field(default_factory=lambda: np.zeros(
         MEMBER_DIM, np.float32))
 
@@ -331,12 +337,25 @@ class MembershipService:
 
     def __init__(self, table, n_slots: int, *, lease_s: float = 1.0,
                  suspect_grace_s: float = 1.0,
-                 rpc_deadline_s: float = 5.0):
+                 rpc_deadline_s: float = 5.0,
+                 deaf_ack_s: Optional[float] = None):
         self.table = table
         self.n_slots = int(n_slots)
         self.lease_s = float(lease_s)
         self.suspect_grace_s = float(suspect_grace_s)
         self.rpc_deadline_s = float(rpc_deadline_s)
+        # deaf-member detection (the INGRESS-cut gray failure: beats
+        # flow out, but the member never hears the controller — netem
+        # can inject it, and without this bound membership cannot see
+        # it).  A member whose beats advance but whose epoch_ack stays
+        # behind the published epoch for deaf_ack_s goes
+        # suspect(reason="deaf") — unroutable, but never escalated to
+        # lost on that evidence alone (it is demonstrably alive); the
+        # ack catching up clears it.  None disables (membership planes
+        # whose members do not ack epochs must not all read as deaf).
+        self.deaf_ack_s = None if deaf_ack_s is None else float(deaf_ack_s)
+        self._published_epoch = 0
+        self._published_epoch_at: Optional[float] = None
         self.members = [MemberState(slot=i) for i in range(self.n_slots)]
         self._rng = random.Random(0x4C454153)
         self.link = "controller->van"
@@ -375,6 +394,12 @@ class MembershipService:
                                   alive_mask=int(alive_mask),
                                   resume_step=int(resume_step),
                                   phase=int(phase))
+        if int(epoch) > self._published_epoch:
+            # the deaf clock starts at first publication of an epoch; a
+            # re-publish of the same epoch (phase flip, set_slow) must
+            # not restart it
+            self._published_epoch = int(epoch)
+            self._published_epoch_at = time.monotonic()
         control_rpc(lambda: self.table.sparse_set([self.n_slots], row),
                     rng=self._rng, op="publish_control", link=self.link,
                     deadline_s=self.rpc_deadline_s)
@@ -419,8 +444,11 @@ class MembershipService:
             blind_dt = now - self._blind_since
             self.probe_blind_s += blind_dt
             self._blind_since = None
+            if self._published_epoch_at is not None:
+                self._published_epoch_at += blind_dt
             for m in self.members:
                 m.last_advance += blind_dt
+                m.joined_at += blind_dt
                 if m.suspect_since is not None:
                     m.suspect_since += blind_dt
                 if m.suspect_reason == "probe_failed":
@@ -446,7 +474,9 @@ class MembershipService:
                          "join", m.slot))
                 m.incarnation, m.beat = inc, beat
                 m.last_advance = now
+                m.joined_at = now
                 m.suspect_since = None
+                m.suspect_reason = None
                 m.state = "alive"
                 continue
             if flag == 0:
@@ -464,22 +494,55 @@ class MembershipService:
             if beat != m.beat:
                 m.beat = beat
                 m.last_advance = now
+                deaf = (self.deaf_ack_s is not None and
+                        self._published_epoch > 0 and
+                        m.epoch_ack < self._published_epoch and
+                        self._published_epoch_at is not None and
+                        now - max(self._published_epoch_at,
+                                  m.joined_at) > self.deaf_ack_s)
+                if m.state == "suspect" and m.suspect_reason == "deaf":
+                    if not deaf:
+                        # the ack caught up (or the bound no longer
+                        # applies): the ingress path works again
+                        events.append(("clear", m.slot))
+                        m.state = "alive"
+                        m.suspect_since = None
+                        m.suspect_reason = None
+                    continue  # advancing beats never clear deafness
                 if m.state == "suspect":
                     events.append(("clear", m.slot))
                 m.state = "alive"
                 m.suspect_since = None
                 m.suspect_reason = None
+                if deaf:
+                    # beats arrive but the member never acted on the
+                    # published epoch inside the bound: it hears
+                    # nothing (ingress cut) — unroutable, yet alive,
+                    # so suspicion never escalates to lost from here
+                    m.state = "suspect"
+                    m.suspect_since = now
+                    m.suspect_reason = "deaf"
+                    events.append(("suspect", m.slot))
             elif m.state == "alive" and now - m.last_advance > self.lease_s:
                 m.state = "suspect"
                 m.suspect_since = now
                 m.suspect_reason = "beats_stopped"
                 events.append(("suspect", m.slot))
+            elif m.state == "suspect" and m.suspect_reason == "deaf" \
+                    and now - m.last_advance > self.lease_s:
+                # the deaf member's BEATS also stopped: from here it is
+                # ordinary observed silence — reclassify and let the
+                # grace run from now (a poll landing between two
+                # heartbeats must never read as silence, so deafness
+                # alone can never reach this escalation)
+                m.suspect_reason = "beats_stopped"
+                m.suspect_since = now
             elif m.state == "suspect" and \
-                    m.suspect_reason != "probe_failed" and \
+                    m.suspect_reason == "beats_stopped" and \
                     now - m.suspect_since > self.suspect_grace_s:
                 # only OBSERVED silence escalates: probe_failed
-                # suspicion (our link, not theirs) holds at suspect
-                # until a successful poll reclassifies it
+                # suspicion (our link, not theirs) and deaf suspicion
+                # (their ingress, beats still flowing) hold at suspect
                 m.state = "lost"
                 events.append(("lost", m.slot))
         return events

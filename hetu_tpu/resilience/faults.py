@@ -56,6 +56,15 @@ Fault kinds
                    the straggler-aware barriers must detect it
                    (``train.straggler``) and apply the wait/evict
                    policy (resilience/multicontroller.py)
+``stage_kill``     SIGKILL pipeline-stage PROCESS ``arg`` — the MPMD
+                   pipeline's lease-expiry stage-replacement path
+                   (parallel/mpmd_elastic.py: replacement pulls stage
+                   weights from the PS, exact two-phase resume)
+``stage_slow``     pipeline stage ``arg`` runs behind an emulated slow
+                   link for ``arg2`` seconds — the pipeline straggler
+                   the lockstep schedule must tolerate
+                   (``train.straggler``, wait policy only: a stage is
+                   not redundant)
 
 The van hooks ride :func:`hetu_tpu.ps.van.set_fault_hook` (one-shot
 faults) and :func:`hetu_tpu.ps.van.set_netem_hook` (link policies);
@@ -96,7 +105,8 @@ KINDS = ("van_error", "van_delay", "data_error", "nan_grad",
          "worker_loss", "worker_join",
          "serve_preempt", "serve_engine_kill",
          "member_kill", "member_suspend", "worker_proc_kill",
-         "netem_partition", "netem_degrade", "straggler")
+         "netem_partition", "netem_degrade", "straggler",
+         "stage_kill", "stage_slow")
 
 
 @dataclass(frozen=True, order=True)
@@ -149,7 +159,10 @@ class FaultSchedule:
                  netem_partitions: int = 0, netem_partition_s: float = 0.8,
                  netem_degrades: int = 0, netem_degrade_s: float = 1.0,
                  stragglers: int = 0,
-                 straggler_s: float = 1.0) -> "FaultSchedule":
+                 straggler_s: float = 1.0,
+                 stage_kills: int = 0, stage_slows: int = 0,
+                 stage_slow_s: float = 1.0,
+                 n_stages: int = 1) -> "FaultSchedule":
         """Draw a schedule over training steps ``[1, steps)`` from ``seed``.
 
         Counts are clipped to the available steps.  Shard-targeted faults
@@ -188,6 +201,13 @@ class FaultSchedule:
         ``n_members`` / ``n_workers``, drawn after EVERY pre-existing
         kind so old-seed schedules replay byte-identical (the frozen-
         bytes regression contract, third extension running).
+
+        Pipeline-stage faults (parallel/mpmd_elastic.py):
+        ``stage_kills`` SIGKILL a pipeline-stage process and
+        ``stage_slows`` slow-link windows on a stage for
+        ``stage_slow_s`` seconds — victims uniform from ``n_stages``,
+        drawn after EVERY kind above (fourth extension of the
+        frozen-bytes contract).
         """
         rng = np.random.default_rng(seed)
         hi = max(int(steps), 2)
@@ -281,6 +301,17 @@ class FaultSchedule:
                                      float(rng.integers(max(n_workers,
                                                             1))),
                                      float(straggler_s)))
+        # pipeline-stage kinds: drawn after everything above — the same
+        # frozen-bytes guarantee every earlier extension honored
+        for s in pick(stage_kills):
+            events.append(FaultEvent(s, "stage_kill",
+                                     float(rng.integers(max(n_stages,
+                                                            1)))))
+        for s in pick(stage_slows):
+            events.append(FaultEvent(s, "stage_slow",
+                                     float(rng.integers(max(n_stages,
+                                                            1))),
+                                     float(stage_slow_s)))
         return cls(events)
 
     def at(self, step: int) -> list[FaultEvent]:
@@ -322,7 +353,7 @@ class FaultInjector:
     """
 
     def __init__(self, schedule: FaultSchedule, *, shard_procs=(),
-                 member_procs=None, worker_procs=None,
+                 member_procs=None, worker_procs=None, stage_procs=None,
                  pid: int | None = None):
         self.schedule = schedule
         self.shard_procs = list(shard_procs)  # subprocess.Popen-likes
@@ -331,6 +362,7 @@ class FaultInjector:
         # landing after a revive must target the CURRENT incarnation
         self.member_procs = member_procs if member_procs is not None else []
         self.worker_procs = worker_procs if worker_procs is not None else []
+        self.stage_procs = stage_procs if stage_procs is not None else []
         self.pid = int(pid) if pid is not None else os.getpid()
         self.counters = defaultdict(int)
         self._armed_van = deque()   # one-shot ("error"|"delay", arg)
@@ -435,6 +467,14 @@ class FaultInjector:
             elif k == "worker_proc_kill":
                 self._proc_kill(self.worker_procs, int(ev.arg),
                                 "worker_procs_killed")
+            elif k == "stage_kill":
+                self._proc_kill(self.stage_procs, int(ev.arg),
+                                "stage_procs_killed")
+            elif k == "stage_slow":
+                self.counters["stage_slows_injected"] += 1
+                with self._lock:
+                    self.net_events.append((k, int(ev.arg),
+                                            float(ev.arg2) or 1.0))
             elif k in ("netem_partition", "netem_degrade", "straggler"):
                 self.counters[k + "s_injected"] += 1
                 with self._lock:
@@ -452,9 +492,11 @@ class FaultInjector:
 
     def pop_net_events(self, kinds=None) -> list:
         """Drain pending network-plane events as ``[("netem_partition"
-        |"netem_degrade"|"straggler", victim_idx, duration_s)]`` — feed
-        them to ``CrossProcessServingPool.run_net_events`` (serving) or
-        ``MultiControllerElasticSupervisor`` (stragglers).
+        |"netem_degrade"|"straggler"|"stage_slow", victim_idx,
+        duration_s)]`` — feed them to
+        ``CrossProcessServingPool.run_net_events`` (serving),
+        ``MultiControllerElasticSupervisor`` (stragglers), or
+        ``MPMDPipelineSupervisor`` (stage_slow).
 
         ``kinds`` drains selectively: events of OTHER kinds stay queued
         for the driver that owns them.  A mixed schedule driven by the

@@ -1,0 +1,142 @@
+"""Shared control-plane loop for cross-process member processes.
+
+Both lockstep training planes — the dp multi-controller worker
+(:mod:`hetu_tpu.resilience.multicontroller`) and the MPMD pipeline
+stage (:mod:`hetu_tpu.parallel.mpmd_elastic`) — speak the same member
+protocol: heartbeat into a blackboard row on a cadence, honor the
+control row's netem slow-link fields, ack PREPARE epochs with frozen
+progress, and wait out generation-counted van barriers while
+re-checking the control row so a membership move voids the in-flight
+step.  This module is the ONE copy of that protocol; the step BODY
+(what a member computes between barriers) stays with each plane.
+
+A member class mixes in :class:`ControlPlaneMember`, calls
+``_init_control_plane`` after its blackboard join is constructed, and
+uses ``_epoch_barriers`` / ``_await_barrier`` / ``_check_epoch`` in its
+run loop.  The shared methods read these attributes: ``member``
+(:class:`~hetu_tpu.ps.membership.MembershipClient`), ``committed``,
+``epoch``, ``acked``, ``_work_ms``, and a spec-like object with
+``hb_ms``, ``port``, ``barrier_base``, ``barrier_wait_s``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class EpochChanged(Exception):
+    """The controller published a new membership epoch (or a PREPARE
+    freeze) mid-step: the in-flight step is void (never logged/
+    committed) and re-runs after the member adopts the new epoch."""
+
+
+class ControlPlaneMember:
+    """Mixin: heartbeat thread, slow-link honoring, epoch-scoped
+    barrier pair, and movement-aware barrier waits."""
+
+    def _init_control_plane(self, *, van, netem_local: str,
+                            my_slot: int) -> None:
+        self._van = van
+        self._my_slot = int(my_slot)
+        self.committed = -1
+        self.epoch = 0
+        self.acked = 0
+        self._bars = None      # (epoch, sync_barrier, commit_barrier)
+        # the scalar WORK time reported in the heartbeat's load field —
+        # work time only, barrier/mailbox waits excluded: a fast member
+        # parked on a slow peer must not itself read as slow
+        self._work_ms = 0.0
+        # the injected slow link (control row C_SLOW_*): a NetEm
+        # latency policy on this member's van ops — the fault is a slow
+        # WIRE, not a sleep in the math, so detection sees exactly what
+        # a congested DCN link would produce
+        from hetu_tpu.ps.netem import NetEm
+        self.netem = NetEm(local=netem_local, peer="van")
+        self.netem.install()
+        self._slow_ms_active = 0
+        self._stop = threading.Event()
+
+    def _start_beat(self) -> None:
+        self._beat = threading.Thread(target=self._beat_loop,
+                                      daemon=True)
+        self._beat.start()
+
+    def _beat_loop(self) -> None:
+        period = max(self.spec.hb_ms, 10) / 1000.0
+        while not self._stop.wait(period):
+            try:
+                self._sync_row()
+            except Exception:
+                time.sleep(period)  # silence IS the loss signal
+
+    def _sync_row(self) -> None:
+        self.member.heartbeat(committed=float(self.committed),
+                              epoch_ack=float(self.acked),
+                              load=float(self._work_ms))
+
+    def _apply_slow(self, slow_slot: int, slow_ms: int) -> None:
+        """Honor the control row's straggler-injection fields: install
+        (or clear) a symmetric latency policy on this member's van
+        link.  Idempotent per published value."""
+        from hetu_tpu.ps.netem import LinkPolicy
+        want = int(slow_ms) if (int(slow_slot) == self._my_slot and
+                                int(slow_ms) > 0) else 0
+        if want == self._slow_ms_active:
+            return
+        if want:
+            self.netem.set_link(LinkPolicy(latency_s=want / 1000.0),
+                                direction="both")
+        else:
+            self.netem.clear()
+        self._slow_ms_active = want
+
+    def _barrier(self, phase: int, width: int):
+        bid = self.spec.barrier_base + 2 * self.epoch + phase
+        return self._van.RemoteBarrier("127.0.0.1", self.spec.port, bid,
+                                       width)
+
+    def _epoch_barriers(self, width: int):
+        """The (sync, commit) barrier pair for the CURRENT epoch,
+        cached — barrier ids and widths only change with the epoch, and
+        opening two fresh van connections per STEP would put hundreds
+        of connect/close cycles per second on the hot path."""
+        if self._bars is None or self._bars[0] != self.epoch:
+            self._close_barriers()
+            self._bars = (self.epoch, self._barrier(0, width),
+                          self._barrier(1, width))
+        return self._bars[1], self._bars[2]
+
+    def _close_barriers(self) -> None:
+        if self._bars is not None:
+            for bar in self._bars[1:]:
+                try:
+                    bar.close()
+                except Exception:
+                    pass
+            self._bars = None
+
+    def _check_epoch(self) -> None:
+        """Raise :class:`EpochChanged` when the controller moved the
+        membership (new epoch OR a prepare freeze) — the in-flight step
+        is then void."""
+        e, _, _, _, phase, _, _ = self.member.read_control()
+        if e != self.epoch or phase != 0:
+            raise EpochChanged
+
+    def _await_barrier(self, bar) -> None:
+        """Wait out one lockstep barrier, re-checking the control row
+        between short waits.  The generation-counted van barrier
+        withdraws timed-out arrivals, so lockstep cannot release
+        short-handed."""
+        while True:
+            try:
+                bar.wait(timeout_s=self.spec.barrier_wait_s)
+                return
+            except TimeoutError:
+                self._check_epoch()
+
+    def _close_control_plane(self) -> None:
+        self._close_barriers()
+        self.member.close()
+        self.netem.uninstall()

@@ -62,7 +62,6 @@ incarnation and is re-admitted and re-placed the same way.
 from __future__ import annotations
 
 import json
-import threading
 import time
 import traceback
 import zlib
@@ -73,6 +72,9 @@ from typing import Optional
 import numpy as np
 
 from hetu_tpu.ps import membership as _mb
+from hetu_tpu.resilience.memberproc import (
+    ControlPlaneMember, EpochChanged as _EpochChanged,
+)
 from hetu_tpu.telemetry import trace
 
 WEIGHTS_TABLE_ID = 0x57454947          # 'WEIG'
@@ -147,21 +149,17 @@ def slice_crc(arrays) -> int:
 # worker process
 # ---------------------------------------------------------------------------
 
-class _EpochChanged(Exception):
-    """The controller published a new membership epoch mid-step: the
-    in-flight step is void (never logged) and re-runs at the new
-    width."""
-
-
-class WorkerProcess:
+class WorkerProcess(ControlPlaneMember):
     """One dp worker: its own controller over its own slice (numpy math
     — the data plane here is the VAN, not the accelerator; the jax
-    executor path stays with the in-process supervisors)."""
+    executor path stays with the in-process supervisors).  The member
+    control plane (beats, slow-link honoring, epoch barriers) is the
+    shared :class:`~hetu_tpu.resilience.memberproc.ControlPlaneMember`;
+    this class owns the step body and the consumed-batch log."""
 
     def __init__(self, spec: WorkerSpec):
         from hetu_tpu.ps import van
         self.spec = spec
-        self._van = van
         self.schedule = make_schedule(spec)
         self.member = _mb.MembershipClient(
             "127.0.0.1", spec.port, table_id=spec.membership_table,
@@ -169,98 +167,14 @@ class WorkerProcess:
         self.table = van.RemotePSTable(
             "127.0.0.1", spec.port, spec.features, spec.out_dim,
             table_id=spec.weights_table, create=False)
-        self.committed = -1
-        self.epoch = 0
-        self.acked = 0
-        self._bars = None  # (epoch, sync_barrier, commit_barrier)
-        # straggler plane: per-phase wall timing (logged per step) and
-        # the scalar WORK time reported in the heartbeat's load field —
-        # work time only, barrier waits excluded: a fast worker parked
-        # on a slow peer's barrier must not itself read as slow
-        self._work_ms = 0.0
+        self._init_control_plane(van=van, netem_local=f"w{spec.slot}",
+                                 my_slot=spec.slot)
+        # straggler plane: per-phase wall timing, logged per step
         self.phase_ms: dict = {}
-        # the injected slow link (control row C_SLOW_*): a NetEm
-        # latency policy on this worker's van ops — the fault is a slow
-        # WIRE, not a sleep in the math, so detection sees exactly what
-        # a congested DCN link would produce
-        from hetu_tpu.ps.netem import NetEm
-        self.netem = NetEm(local=f"w{spec.slot}", peer="van")
-        self.netem.install()
-        self._slow_ms_active = 0
-        self._stop = threading.Event()
         self._log = open(spec.log_path or
                          f"worker_{spec.slot}.jsonl", "a")
         self.member.join(committed=-1.0)
-        self._beat = threading.Thread(target=self._beat_loop, daemon=True)
-        self._beat.start()
-
-    def _beat_loop(self) -> None:
-        period = max(self.spec.hb_ms, 10) / 1000.0
-        while not self._stop.wait(period):
-            try:
-                self._sync_row()
-            except Exception:
-                time.sleep(period)  # silence IS the loss signal; keep at it
-
-    def _sync_row(self) -> None:
-        self.member.heartbeat(committed=float(self.committed),
-                              epoch_ack=float(self.acked),
-                              load=float(self._work_ms))
-
-    def _apply_slow(self, slow_slot: int, slow_ms: int) -> None:
-        """Honor the control row's straggler-injection fields: install
-        (or clear) a symmetric latency policy on this worker's van
-        link.  Idempotent per published value."""
-        from hetu_tpu.ps.netem import LinkPolicy
-        want = int(slow_ms) if (int(slow_slot) == self.spec.slot and
-                                int(slow_ms) > 0) else 0
-        if want == self._slow_ms_active:
-            return
-        if want:
-            self.netem.set_link(LinkPolicy(latency_s=want / 1000.0),
-                                direction="both")
-        else:
-            self.netem.clear()
-        self._slow_ms_active = want
-
-    def _barrier(self, phase: int, width: int):
-        bid = self.spec.barrier_base + 2 * self.epoch + phase
-        return self._van.RemoteBarrier("127.0.0.1", self.spec.port, bid,
-                                       width)
-
-    def _epoch_barriers(self, width: int):
-        """The (sync, commit) barrier pair for the CURRENT epoch, cached
-        — barrier ids and widths only change with the epoch, and opening
-        two fresh van connections per STEP would put hundreds of
-        connect/close cycles per second on the hot path."""
-        if self._bars is None or self._bars[0] != self.epoch:
-            self._close_barriers()
-            self._bars = (self.epoch, self._barrier(0, width),
-                          self._barrier(1, width))
-        return self._bars[1], self._bars[2]
-
-    def _close_barriers(self) -> None:
-        if self._bars is not None:
-            for bar in self._bars[1:]:
-                try:
-                    bar.close()
-                except Exception:
-                    pass
-            self._bars = None
-
-    def _await_barrier(self, bar) -> None:
-        """Wait out one lockstep barrier, re-checking the control row
-        between short waits; raises :class:`_EpochChanged` when the
-        controller moved the membership (new epoch OR a prepare freeze)
-        — the in-flight step is then void."""
-        while True:
-            try:
-                bar.wait(timeout_s=self.spec.barrier_wait_s)
-                return
-            except TimeoutError:
-                e, _, _, _, phase, _, _ = self.member.read_control()
-                if e != self.epoch or phase != 0:
-                    raise _EpochChanged
+        self._start_beat()
 
     def run(self) -> None:
         spec = self.spec
@@ -275,11 +189,14 @@ class WorkerProcess:
                 continue
             if phase != 0:
                 # PREPARE: freeze at this step boundary and ack with the
-                # frozen progress (written synchronously — the controller
-                # computes the exact resume from these rows)
+                # frozen progress (the controller computes the exact
+                # resume from these rows)
                 if self.acked < e:
                     self.acked = e
-                    self._sync_row()
+                    try:
+                        self._sync_row()
+                    except Exception:
+                        pass  # the beat thread resends the ack in hb_ms
                 if self._stop.wait(0.02):
                     break
                 continue
@@ -291,6 +208,24 @@ class WorkerProcess:
                 step = resume
             slots = _mb.MembershipService.slots_of(mask)
             if spec.slot not in slots:
+                # excluded (evicted straggler): keep probing the van
+                # link and report the probe time as load — the
+                # probation loop's evidence that the link healed.  The
+                # probe is a timed pull + zero-push pair, i.e. a real
+                # step's WIRE cost (a zero gradient is a no-op on the
+                # weights), so an injected slow link keeps the probe
+                # honestly slow until it actually heals.  Probed every
+                # loop iteration (faster than the beat cadence) so each
+                # beat the controller counts carries a FRESH sample —
+                # a throttled probe would let one lucky measurement be
+                # double-counted toward re-admission.
+                try:
+                    t0 = time.perf_counter()
+                    w = self.table.dense_pull()
+                    self.table.dense_push(np.zeros_like(w))
+                    self._work_ms = (time.perf_counter() - t0) * 1e3
+                except Exception:
+                    pass  # an unreachable van is the beat's problem
                 if self._stop.wait(0.05):
                     break
                 continue
@@ -367,11 +302,9 @@ class WorkerProcess:
             self.member.leave()
         except Exception:
             pass
-        self._close_barriers()
         self._log.close()
-        self.member.close()
         self.table.close()
-        self.netem.uninstall()
+        self._close_control_plane()
 
 
 def worker_main(config_path: str) -> int:
@@ -482,7 +415,8 @@ class MultiControllerElasticSupervisor:
                  straggler_factor: float = 4.0,
                  straggler_policy: str = "wait",
                  straggler_evict_after: int = 3,
-                 straggler_slow_ms: int = 120):
+                 straggler_slow_ms: int = 120,
+                 straggler_readmit_after: int = 3):
         from hetu_tpu.ps import van
         if n_workers < 1:
             raise ValueError("need at least one worker")
@@ -520,9 +454,20 @@ class MultiControllerElasticSupervisor:
         self.straggler_policy = straggler_policy
         self.straggler_evict_after = int(straggler_evict_after)
         self.straggler_slow_ms = int(straggler_slow_ms)
-        self.straggle_records: list = []   # closed train.straggler spans
-        self._straggle: dict = {}          # slot -> open window state
+        # auto re-admission probation: an evicted-but-alive slot keeps
+        # probing its van link (the worker times a weights pull while
+        # excluded and reports it as load), and after this many
+        # consecutive healthy probed beats the controller lifts the
+        # eviction (readmit_straggler).  0 disables — eviction then
+        # stays operator-lifted only.
+        self.straggler_readmit_after = int(straggler_readmit_after)
+        from hetu_tpu.resilience.straggler import StragglerDetector
+        self._detector = StragglerDetector(
+            factor=self.straggler_factor, subject="worker",
+            policy=straggler_policy,
+            evict_after=self.straggler_evict_after)
         self._evicted: set = set()
+        self._probation: dict = {}         # slot -> {"beat", "ok"}
         self._slow_heal_at: Optional[float] = None
         # fresh table/barrier ids per supervisor: the native table and
         # barrier registries outlive van.stop(), so fixed ids would leak
@@ -703,6 +648,7 @@ class MultiControllerElasticSupervisor:
                     self._publish(kind="grow", slot=slot, t0=t0)
                     sp.set("width", len(self.svc.present_slots()))
         self._check_stragglers()
+        self._check_probation()
         return events
 
     # ---- straggler detection / policy ----
@@ -722,62 +668,33 @@ class MultiControllerElasticSupervisor:
         self.svc.set_slow(int(slot), ms)
         self._slow_heal_at = time.monotonic() + float(duration_s)
 
+    @property
+    def straggle_records(self) -> list:
+        """Closed ``train.straggler`` episodes (the shared detector's
+        span args verbatim)."""
+        return self._detector.records
+
     def _check_stragglers(self) -> None:
         """Per-phase timing turned into a slow-vs-dead decision: a
         worker whose reported WORK time (load field — barrier waits
         excluded) exceeds ``straggler_factor`` x the median of its
         peers' is a straggler — alive (its beats flow, the lease
         machine never fires) but pacing the whole lockstep fleet.
-        Opens a retroactive ``train.straggler`` span per episode
-        (closed when the worker recovers, or at eviction), and under
-        ``straggler_policy="evict"`` reshards around the worker once
-        it has been slow for ``straggler_evict_after`` committed
-        steps."""
+        Episode spans live in the shared
+        :class:`~hetu_tpu.resilience.straggler.StragglerDetector`;
+        the POLICY is applied here: under ``straggler_policy="evict"``
+        the fleet reshards around the worker once it has been slow for
+        ``straggler_evict_after`` committed steps."""
         slots = [s for s in self._present()
                  if self.svc.state_of(s).state == "alive"]
         loads = {s: self.svc.state_of(s).load for s in slots
                  if self.svc.state_of(s).load > 0.0}
-        for slot in list(self._straggle):
-            if slot not in loads and slot not in slots:
-                # lost/evicted mid-episode: close the window as-is
-                self._close_straggle(slot, resolution="departed")
-        if len(loads) < 2:
-            return
-        for slot, work_ms in loads.items():
-            others = [v for s, v in loads.items() if s != slot]
-            med = float(np.median(others))
-            slow = work_ms > self.straggler_factor * max(med, 1e-3)
-            st = self._straggle.get(slot)
-            committed = self.svc.state_of(slot).committed
-            if slow and st is None:
-                self._straggle[slot] = {
-                    "t0_us": trace.now_us(),
-                    "detected_at_step": committed,
-                    "last_step": committed, "slow_steps": 0,
-                    "ratio": work_ms / max(med, 1e-3)}
-            elif slow and st is not None:
-                st["ratio"] = max(st["ratio"], work_ms / max(med, 1e-3))
-                if committed > st["last_step"]:
-                    st["slow_steps"] += committed - st["last_step"]
-                    st["last_step"] = committed
-                if self.straggler_policy == "evict" and \
-                        slot not in self._evicted and \
-                        st["slow_steps"] >= self.straggler_evict_after:
-                    self._evict_straggler(slot)
-            elif not slow and st is not None:
-                # back under the bar: the episode closes as tolerated
-                self._close_straggle(slot, resolution="recovered")
-
-    def _close_straggle(self, slot: int, *, resolution: str) -> None:
-        st = self._straggle.pop(slot, None)
-        if st is None:
-            return
-        rec = {"worker": int(slot), "policy": self.straggler_policy,
-               "resolution": resolution,
-               "ratio": round(float(st["ratio"]), 2),
-               "slow_steps": int(st["slow_steps"])}
-        trace.complete("train.straggler", st["t0_us"], rec, cat="train")
-        self.straggle_records.append(rec)
+        committed = {s: self.svc.state_of(s).committed for s in slots}
+        for slot in self._detector.observe(loads, present=slots,
+                                           committed=committed):
+            if self.straggler_policy == "evict" and \
+                    slot not in self._evicted:
+                self._evict_straggler(slot)
 
     def _evict_straggler(self, slot: int) -> None:
         """The evict policy: reshard the fleet AROUND the straggler.
@@ -786,7 +703,7 @@ class MultiControllerElasticSupervisor:
         global batch at the smaller width, byte-identical by the same
         complete-cover contract as any other shrink."""
         self._evicted.add(int(slot))
-        self._close_straggle(slot, resolution="evicted")
+        self._detector.close(slot, resolution="evicted")
         t0 = time.perf_counter()
         with trace.span("elastic.reshard") as sp:
             sp.set("kind", "shrink")
@@ -795,11 +712,51 @@ class MultiControllerElasticSupervisor:
             self._publish(kind="shrink", slot=slot, t0=t0)
             sp.set("width", len(self._present()))
 
+    def _check_probation(self) -> None:
+        """Auto re-admission of evicted stragglers: an evicted slot
+        stays alive and beating, and while excluded its worker probes
+        the van link (a timed pull+push pair — a step's wire cost) and
+        reports the probe time as load.  Each NEW beat carrying a probe
+        under the RE-ADMISSION bar counts toward
+        ``straggler_readmit_after`` consecutive healthy beats; a slow
+        probe resets the count.  The re-admission bar is HALF the
+        eviction bar (hysteresis): the probe measures only the wire
+        share of a step while peers report wire+compute, so a
+        borderline link that barely clears the eviction bar must not
+        readmit only to be re-evicted — an indefinite evict/readmit
+        flap, two reshard epochs per cycle.  Reaching the count lifts
+        the eviction (the grow epoch re-covers every batch at the wider
+        width — same byte-identity contract as any other grow)."""
+        if self.straggler_readmit_after <= 0:
+            return
+        active = [self.svc.state_of(s).load for s in self._present()
+                  if self.svc.state_of(s).state == "alive" and
+                  self.svc.state_of(s).load > 0.0]
+        for slot in list(self._evicted):
+            m = self.svc.state_of(slot)
+            st = self._probation.setdefault(slot, {"beat": m.beat,
+                                                   "ok": 0})
+            if m.state != "alive":
+                st["ok"] = 0
+                continue
+            if m.beat == st["beat"]:
+                continue  # no fresh evidence since the last look
+            st["beat"] = m.beat
+            med = float(np.median(active)) if active else 0.0
+            healthy = m.load > 0.0 and (
+                med <= 0.0 or m.load <= 0.5 * self.straggler_factor *
+                max(med, 1e-3))
+            st["ok"] = st["ok"] + 1 if healthy else 0
+            if st["ok"] >= self.straggler_readmit_after:
+                self._probation.pop(slot, None)
+                self.readmit_straggler(slot)
+
     def readmit_straggler(self, slot: int) -> None:
         """Operator/test path: lift a straggler eviction (e.g. after
         the slow link healed); the next publish regrows the mesh."""
         if int(slot) in self._evicted:
             self._evicted.discard(int(slot))
+            self._probation.pop(int(slot), None)
             t0 = time.perf_counter()
             with trace.span("elastic.reshard") as sp:
                 sp.set("kind", "grow")
@@ -847,10 +804,9 @@ class MultiControllerElasticSupervisor:
                 f"fleet did not finish {self.steps} steps within "
                 f"{deadline_s}s: "
                 f"{[(m.slot, m.state, m.committed) for m in states]}")
-        for slot in list(self._straggle):
-            # a still-open straggle window at run end must land in the
-            # trace (an unclosed span would silently drop the episode)
-            self._close_straggle(slot, resolution="run_end")
+        # a still-open straggle window at run end must land in the
+        # trace (an unclosed span would silently drop the episode)
+        self._detector.close_all(resolution="run_end")
         consumed = merge_consumed_logs(self.log_paths)
         return {
             "steps": self.steps,

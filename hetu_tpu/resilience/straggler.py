@@ -1,0 +1,100 @@
+"""Shared straggler detection: the slow-vs-dead split the lease machine
+cannot make.
+
+A straggler's beats FLOW while its reported WORK time grows — the lease
+state machine never fires, yet the lockstep barriers pace the whole
+fleet at its speed.  Both cross-process training planes (the dp
+multi-controller fleet, ``resilience/multicontroller.py``, and the MPMD
+pipeline, ``parallel/mpmd_elastic.py``) detect it the same way: a
+member whose reported work time exceeds ``factor`` x the median of its
+peers' opens a retroactive ``train.straggler`` span (closed when it
+recovers, departs, or the policy acts).  This module is the ONE copy of
+that episode machinery; the POLICY (wait / evict-and-reshard /
+probation re-admission) stays with each supervisor — a pipeline stage
+is not redundant, so only the dp plane can evict.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hetu_tpu.telemetry import trace
+
+
+class StragglerDetector:
+    """Median-of-peers slow-member detection with per-episode
+    ``train.straggler`` spans.
+
+    ``observe(loads, present=..., committed=...)`` runs one sweep:
+    ``loads`` maps candidate slot -> reported work ms (callers exclude
+    members whose loads must not count — evicted, suspect);
+    ``present`` lists slots still around (an open episode whose slot
+    left both closes as ``departed``); ``committed`` (optional) maps
+    slot -> committed step for evict-threshold accounting.  Returns the
+    slots whose episode crossed ``evict_after`` slow committed steps
+    this sweep (empty when ``evict_after`` is 0) — the CALLER decides
+    what crossing means.
+    """
+
+    def __init__(self, *, factor: float, subject: str = "worker",
+                 policy: str = "wait", evict_after: int = 0):
+        self.factor = float(factor)
+        self.subject = subject
+        self.policy = policy
+        self.evict_after = int(evict_after)
+        self.records: list = []   # closed episodes, span args verbatim
+        self._open: dict = {}     # slot -> episode state
+
+    def observe(self, loads: dict, *, present=(),
+                committed=None) -> list:
+        present = set(present)
+        for slot in list(self._open):
+            if slot not in loads and slot not in present:
+                self.close(slot, resolution="departed")
+        if len(loads) < 2:
+            return []
+        crossed = []
+        for slot, work_ms in loads.items():
+            others = [v for s, v in loads.items() if s != slot]
+            med = float(np.median(others))
+            slow = work_ms > self.factor * max(med, 1e-3)
+            st = self._open.get(slot)
+            c = int(committed.get(slot, 0)) if committed else 0
+            if slow and st is None:
+                self._open[slot] = {
+                    "t0_us": trace.now_us(),
+                    "detected_at_step": c,
+                    "last_step": c, "slow_steps": 0,
+                    "ratio": work_ms / max(med, 1e-3)}
+            elif slow and st is not None:
+                st["ratio"] = max(st["ratio"], work_ms / max(med, 1e-3))
+                if c > st["last_step"]:
+                    st["slow_steps"] += c - st["last_step"]
+                    st["last_step"] = c
+                if self.evict_after and \
+                        st["slow_steps"] >= self.evict_after:
+                    crossed.append(slot)
+            elif not slow and st is not None:
+                # back under the bar: the episode closes as tolerated
+                self.close(slot, resolution="recovered")
+        return crossed
+
+    def close(self, slot, *, resolution: str) -> None:
+        st = self._open.pop(slot, None)
+        if st is None:
+            return
+        rec = {self.subject: int(slot), "policy": self.policy,
+               "resolution": resolution,
+               "ratio": round(float(st["ratio"]), 2),
+               "slow_steps": int(st["slow_steps"])}
+        trace.complete("train.straggler", st["t0_us"], rec, cat="train")
+        self.records.append(rec)
+
+    def close_all(self, *, resolution: str = "run_end") -> None:
+        """Flush every still-open episode (run end: an unclosed span
+        would silently drop the episode from the trace)."""
+        for slot in list(self._open):
+            self.close(slot, resolution=resolution)
+
+    def open_slots(self) -> list:
+        return list(self._open)

@@ -93,6 +93,13 @@ RECOVERY_FOR = {
     # straggler window (detection → policy applied or slowness gone);
     # under the evict policy the reshard is the fallback recovery
     "straggler": ("train.straggler", "elastic.reshard"),
+    # MPMD pipeline (parallel/mpmd_elastic.py): a SIGKILLed stage
+    # process is only ever answered by the stage-replacement epoch (the
+    # span ends when every stage acked the exact resume); a slow stage
+    # by the straggler window, falling back to a replacement only if
+    # the slowness degenerated into a lease expiry
+    "stage_kill": ("pipeline.stage_replace",),
+    "stage_slow": ("train.straggler", "pipeline.stage_replace"),
 }
 
 # kinds whose RECOVERY_FOR tuple is a strict preference order: the first
@@ -101,7 +108,8 @@ RECOVERY_FOR = {
 # real recovery (a suspend_shard is repaired by whichever of
 # shard_repair/retry actually ran), so time decides, not the tuple.
 PREFERENCE_ORDERED = frozenset({"serve_preempt", "member_suspend",
-                                "netem_partition", "straggler"})
+                                "netem_partition", "straggler",
+                                "stage_slow"})
 
 # fault kind -> args a candidate recovery event must carry.  A preempt
 # must claim the checkpoint the SIGTERM caused (reason="preempt"), not a

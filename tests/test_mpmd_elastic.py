@@ -1,0 +1,439 @@
+"""Fault-tolerant cross-process MPMD pipeline training (ISSUE 11).
+
+Fast lane: GPipe/1F1B schedule algebra, activation-stash accounting, the
+quantized mailbox wire codecs, spec round-trips, and the synthetic
+timeline pairing rules for the new stage fault kinds.
+
+Slow+chaos (``mpmd_chaos`` marker): real stage PROCESSES — the chaos
+acceptance (seeded SIGKILL of a middle stage on a 3-stage 1F1B pipeline
+→ replacement admitted, run completes, final params BYTE-IDENTICAL to an
+un-killed same-seed run, fault paired as ``pipeline.stage_replace``), a
+SIGSTOPped stage suspected-then-cleared with zero replacements, a
+``stage_slow`` netem link detected as a ``train.straggler`` window, and
+GPipe-vs-1F1B bitwise gradient equivalence across processes.
+"""
+
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hetu_tpu.parallel.mpmd import (
+    Q8_BLOCK, decode_wire, encode_wire, peak_stash, schedule_ops,
+)
+from hetu_tpu.parallel.mpmd_elastic import (
+    StageSpec, stage_init_weights, stage_table_rows, step_batch,
+)
+from hetu_tpu.ps import available
+from hetu_tpu.telemetry import timeline
+
+pytestmark = pytest.mark.mpmd_chaos
+
+
+# ---------------------------------------------------------------------------
+# fast lane: schedules
+# ---------------------------------------------------------------------------
+
+def _check_valid(ops, M):
+    """Every microbatch runs F exactly once, B exactly once, F before
+    its B — and backwards in ascending order (the accumulation-order
+    invariant byte-identity leans on)."""
+    fs = [m for op, m in ops if op == "F"]
+    bs = [m for op, m in ops if op == "B"]
+    assert sorted(fs) == list(range(M))
+    assert bs == list(range(M))
+    pos = {("F", m): i for i, (op, m) in enumerate(ops) if op == "F"}
+    for i, (op, m) in enumerate(ops):
+        if op == "B":
+            assert pos[("F", m)] < i
+
+
+def test_gpipe_schedule_is_flush_order():
+    ops = schedule_ops("gpipe", stage=1, n_stages=3, n_microbatches=4)
+    assert ops == [("F", 0), ("F", 1), ("F", 2), ("F", 3),
+                   ("B", 0), ("B", 1), ("B", 2), ("B", 3)]
+    assert peak_stash(ops) == 4
+
+
+def test_gpipe_stash_limit_chunks_into_mini_flushes():
+    ops = schedule_ops("gpipe", stage=0, n_stages=3, n_microbatches=8,
+                       stash_limit=3)
+    _check_valid(ops, 8)
+    assert peak_stash(ops) == 3
+    # 3 mini-flushes: 3 + 3 + 2
+    assert ops[:6] == [("F", 0), ("F", 1), ("F", 2),
+                       ("B", 0), ("B", 1), ("B", 2)]
+
+
+def test_1f1b_schedule_warmup_and_stash():
+    M, S = 8, 3
+    for s in range(S):
+        ops = schedule_ops("1f1b", stage=s, n_stages=S, n_microbatches=M)
+        _check_valid(ops, M)
+        warmup = min(M, S - 1 - s)
+        assert ops[:warmup] == [("F", m) for m in range(warmup)]
+        # the 1F1B memory contract: stash never exceeds S - s
+        assert peak_stash(ops) == min(M, S - s)
+    # last stage strictly alternates
+    assert schedule_ops("1f1b", stage=2, n_stages=3,
+                        n_microbatches=3) == \
+        [("F", 0), ("B", 0), ("F", 1), ("B", 1), ("F", 2), ("B", 2)]
+
+
+def test_1f1b_stash_beats_unbounded_gpipe():
+    for s in range(4):
+        g = peak_stash(schedule_ops("gpipe", stage=s, n_stages=4,
+                                    n_microbatches=16))
+        f = peak_stash(schedule_ops("1f1b", stage=s, n_stages=4,
+                                    n_microbatches=16))
+        assert f <= 4 < g == 16
+
+
+def test_schedule_rejects_unknown_kind_and_bad_stage():
+    with pytest.raises(ValueError, match="unknown schedule"):
+        schedule_ops("pipedream2bw", stage=0, n_stages=2,
+                     n_microbatches=2)
+    with pytest.raises(ValueError, match="outside"):
+        schedule_ops("gpipe", stage=3, n_stages=2, n_microbatches=2)
+
+
+# ---------------------------------------------------------------------------
+# fast lane: mailbox wire codecs
+# ---------------------------------------------------------------------------
+
+def test_wire_codec_roundtrips_and_determinism():
+    a = np.random.default_rng(3).standard_normal(257).astype(np.float32)
+    for wire, tol in (("f32", 0.0), ("bf16", 0.01), ("int8", 0.05)):
+        p1, logical = encode_wire(a, wire)
+        p2, _ = encode_wire(a, wire)
+        assert p1 == p2  # deterministic: quantized edges stay replayable
+        assert logical == a.size * 4
+        b = decode_wire(p1, a.size, wire)
+        assert b.dtype == np.float32 and b.shape == (a.size,)
+        np.testing.assert_allclose(b, a, atol=tol * np.abs(a).max())
+    # exactness of the f32 path
+    p, _ = encode_wire(a, "f32")
+    np.testing.assert_array_equal(decode_wire(p, a.size, "f32"), a)
+
+
+def test_wire_codec_sizes():
+    n = 300
+    a = np.ones(n, np.float32)
+    assert len(encode_wire(a, "f32")[0]) == n * 4
+    assert len(encode_wire(a, "bf16")[0]) == n * 2
+    nblk = -(-n // Q8_BLOCK)
+    assert len(encode_wire(a, "int8")[0]) == nblk * Q8_BLOCK + nblk * 4
+
+
+def test_wire_codec_bf16_propagates_nonfinite():
+    """A NaN activation must PROPAGATE across a bf16 edge, never
+    silently zero (the rounding carry would overflow a high-mantissa
+    NaN into -0.0): the nan_grad fault contract depends on divergence
+    surfacing in the loss."""
+    a = np.array([1.0, np.nan, -np.nan, np.inf, -np.inf, 0.0],
+                 np.float32)
+    # the worst case: NaN payloads whose mantissa carries overflow
+    a[1] = np.frombuffer(np.uint32(0x7FFFFFFF).tobytes(), np.float32)[0]
+    a[2] = np.frombuffer(np.uint32(0xFFFFFFFF).tobytes(), np.float32)[0]
+    b = decode_wire(encode_wire(a, "bf16")[0], a.size, "bf16")
+    assert np.isnan(b[1]) and np.isnan(b[2])
+    assert b[3] == np.inf and b[4] == -np.inf
+    assert b[0] == 1.0 and b[5] == 0.0
+
+
+def test_wire_codec_rejects_wrong_sizes():
+    p, _ = encode_wire(np.ones(8, np.float32), "bf16")
+    with pytest.raises(ValueError, match="expected"):
+        decode_wire(p, 9, "bf16")
+    with pytest.raises(ValueError, match="unknown wire"):
+        encode_wire(np.ones(8, np.float32), "fp8")
+
+
+# ---------------------------------------------------------------------------
+# fast lane: spec / data determinism
+# ---------------------------------------------------------------------------
+
+def _spec(**kw):
+    base = dict(port=1, stage=0, n_stages=3, steps=4, n_microbatches=4,
+                width=8, batch=8, data_seed=5)
+    base.update(kw)
+    return StageSpec(**base)
+
+
+def test_stage_spec_roundtrip():
+    spec = _spec(schedule="gpipe", stash_limit=3, wire="int8",
+                 compute_sleep_s=0.001)
+    assert StageSpec.from_json(spec.to_json()) == spec
+
+
+def test_step_batch_and_init_weights_are_process_invariant():
+    """Two independently constructed specs regenerate byte-identical
+    batches and stage weights — the property that lets a replacement
+    process rebuild everything but the PS tables from the seed."""
+    a, b = _spec(), _spec()
+    for step in range(3):
+        Xa, Ya = step_batch(a, step)
+        Xb, Yb = step_batch(b, step)
+        np.testing.assert_array_equal(Xa, Xb)
+        np.testing.assert_array_equal(Ya, Yb)
+    for s in range(3):
+        np.testing.assert_array_equal(stage_init_weights(a, s),
+                                      stage_init_weights(b, s))
+    assert stage_table_rows(8) == 33  # w | m | w_prev | m_prev | ver
+
+
+# ---------------------------------------------------------------------------
+# fast lane: timeline pairing for the new stage fault kinds
+# ---------------------------------------------------------------------------
+
+def test_stage_fault_timeline_pairing_and_report_coverage():
+    """``stage_kill`` pairs only with ``pipeline.stage_replace``;
+    ``stage_slow`` PREFERS its ``train.straggler`` window over an
+    unrelated replacement — and ``timeline.report`` covers both kinds."""
+    evs = [
+        {"ph": "i", "name": "fault.stage_kill", "ts": 100.0, "seq": 0,
+         "args": {"kind": "stage_kill", "step": 3}},
+        {"ph": "i", "name": "fault.stage_slow", "ts": 110.0, "seq": 1,
+         "args": {"kind": "stage_slow", "step": 4}},
+        # ends first, but the slow stage's DIRECT recovery is the
+        # straggler window — preference order must skip past this
+        {"ph": "X", "name": "pipeline.stage_replace", "ts": 150.0,
+         "dur": 50.0, "seq": 2, "args": {"stage": 1}},
+        {"ph": "X", "name": "train.straggler", "ts": 160.0,
+         "dur": 300.0, "seq": 3, "args": {"stage": 2}},
+    ]
+    pairs = timeline.correlate(evs)
+    by = {p.kind: p for p in pairs}
+    assert by["stage_kill"].paired
+    assert by["stage_kill"].recovery_name == "pipeline.stage_replace"
+    assert by["stage_slow"].paired
+    assert by["stage_slow"].recovery_name == "train.straggler"
+    rep = timeline.report(pairs)
+    for kind in ("stage_kill", "stage_slow"):
+        assert rep[kind]["injected"] == 1
+        assert rep[kind]["paired"] == 1
+        assert "p50" in rep[kind]["recover_s"]
+
+
+def test_every_fault_kind_has_a_recovery_mapping():
+    """RECOVERY_FOR coverage: every schedulable fault kind is either
+    mapped to recovery names or explicitly mapped to () — a new kind
+    silently missing from the table would make its chaos runs report
+    unpaired forever."""
+    from hetu_tpu.resilience.faults import KINDS
+    for kind in KINDS:
+        assert kind in timeline.RECOVERY_FOR, kind
+
+
+# ---------------------------------------------------------------------------
+# real stage processes (slow + chaos)
+# ---------------------------------------------------------------------------
+
+needs_lib = pytest.mark.skipif(not available(),
+                               reason="native PS lib unavailable")
+
+
+def _fleet(tmp_path, *, schedule="1f1b", steps=12, injector=None, **kw):
+    from hetu_tpu.parallel.mpmd_elastic import MPMDPipelineSupervisor
+    base = dict(n_microbatches=4, width=8, batch=8, wire="bf16",
+                lease_s=0.5, suspect_grace_s=0.3, step_sleep_s=0.03)
+    base.update(kw)
+    sup = MPMDPipelineSupervisor(3, workdir=tmp_path, steps=steps,
+                                 schedule=schedule, **base)
+    if injector is not None:
+        injector.stage_procs = sup.procs
+        sup.injector = injector
+    return sup
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_stage_kill_replacement_byte_identical(tmp_path):
+    """THE acceptance: a seeded SIGKILL of the MIDDLE stage of a
+    3-stage 1F1B pipeline mid-run → lease expiry → a replacement
+    process is admitted (weights pulled from the PS, zero parameter
+    bytes from the controller), the two-phase epoch resumes at an exact
+    step boundary, the run completes, and the final per-stage params
+    are BYTE-IDENTICAL to an un-killed same-seed run.  The fault pairs
+    as ``pipeline.stage_replace`` in ``timeline.report()``."""
+    from hetu_tpu.resilience.faults import (
+        FaultInjector, FaultSchedule,
+    )
+    from hetu_tpu.telemetry import trace
+
+    schedule = FaultSchedule.generate(steps=10, seed=1, stage_kills=1,
+                                      n_stages=3)
+    (ev,) = schedule.events
+    assert ev.kind == "stage_kill"
+    assert ev.arg == 1.0  # seed 1 draws the MIDDLE stage at step 5
+    assert schedule.to_json() == FaultSchedule.generate(
+        steps=10, seed=1, stage_kills=1, n_stages=3).to_json()
+
+    (tmp_path / "clean").mkdir(exist_ok=True)
+    sup = _fleet(tmp_path / "clean", steps=14)
+    try:
+        clean = sup.run(deadline_s=240.0)
+        assert not clean["replacements"]
+    finally:
+        sup.close()
+
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    try:
+        (tmp_path / "chaos").mkdir(exist_ok=True)
+        sup = _fleet(tmp_path / "chaos", steps=14,
+                     injector=FaultInjector(schedule))
+        assert sup.injector.stage_procs is sup.procs
+        try:
+            chaos = sup.run(deadline_s=240.0)
+            assert len(chaos["replacements"]) == 1
+            assert sup.injector.counters["stage_procs_killed"] == 1
+            rep = chaos["replacements"][0]
+            assert rep["resume_step"] >= 1
+        finally:
+            sup.close()
+    finally:
+        trace.disable()
+
+    # byte-identity: exactly-once optimizer updates despite the
+    # at-least-once microbatch recompute
+    for s in clean["final_params"]:
+        np.testing.assert_array_equal(clean["final_params"][s],
+                                      chaos["final_params"][s])
+
+    pairs = timeline.correlate(tracer.events)
+    kills = [p for p in pairs if p.kind == "stage_kill"]
+    assert len(kills) == 1 and kills[0].paired
+    assert kills[0].recovery_name == "pipeline.stage_replace"
+    assert kills[0].detect_s < 10.0
+    rep_d = timeline.report(pairs)
+    assert rep_d["stage_kill"]["paired"] == 1
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_stage_sigstop_suspected_then_cleared(tmp_path):
+    """A SIGSTOPped stage (GC-pause / partition lookalike) is suspected
+    and CLEARED by the lease machine — zero replacements, zero extra
+    epochs, and the run still finishes with the clean-run params."""
+    sup = _fleet(tmp_path, steps=16, lease_s=0.4, suspect_grace_s=2.5)
+    try:
+        # pause the middle stage once the fleet is moving
+        deadline = time.monotonic() + 60.0
+        while max(sup.svc.state_of(s).committed for s in range(3)) < 2:
+            sup.poll()
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        victim = sup.procs[1]
+        victim.send_signal(signal.SIGSTOP)
+        t = threading.Timer(1.0,
+                            lambda: victim.send_signal(signal.SIGCONT))
+        t.daemon = True
+        t.start()
+        rep = sup.run(deadline_s=240.0)
+        assert rep["counters"].get("suspect", 0) >= 1
+        assert rep["counters"].get("clear", 0) >= 1
+        assert rep["counters"].get("lost", 0) == 0
+        assert not rep["replacements"]
+        assert rep["epochs"] == 1  # membership never moved
+    finally:
+        sup.close()
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_stage_slow_rides_straggler_detection(tmp_path):
+    """A seeded ``stage_slow`` netem link on stage 1 is detected by the
+    straggler plane (reported work time vs peers), opens and closes a
+    ``train.straggler`` span, pairs in the timeline — and the pipeline
+    completes with zero membership changes (wait policy: a stage is not
+    redundant)."""
+    from hetu_tpu.resilience.faults import (
+        FaultEvent, FaultInjector, FaultSchedule,
+    )
+    from hetu_tpu.telemetry import trace
+
+    inj = FaultInjector(FaultSchedule([FaultEvent(3, "stage_slow", 1.0,
+                                                  2.0)]))
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    try:
+        sup = _fleet(tmp_path, steps=40, injector=inj, lease_s=1.5,
+                     suspect_grace_s=1.0, straggler_slow_ms=120)
+        try:
+            rep = sup.run(deadline_s=240.0)
+            assert inj.counters["stage_slows_injected"] == 1
+            assert rep["straggle_records"], "slow stage never detected"
+            # with only two peers the median is noisy: a transient
+            # episode on another stage may open/close too — the
+            # VICTIM's episode is the one that must exist
+            rec = next(r for r in rep["straggle_records"]
+                       if r["stage"] == 1)
+            assert rec["policy"] == "wait"
+            assert rec["ratio"] >= 4.0
+            assert not rep["replacements"]
+        finally:
+            sup.close()
+    finally:
+        trace.disable()
+    pairs = timeline.correlate(tracer.events)
+    slows = [p for p in pairs if p.kind == "stage_slow"]
+    assert len(slows) == 1 and slows[0].paired
+    assert slows[0].recovery_name == "train.straggler"
+
+
+@needs_lib
+@pytest.mark.slow
+def test_gpipe_and_1f1b_grads_bitwise_equal_across_processes(tmp_path):
+    """The schedule moves only the bubble and the stash: a GPipe fleet
+    (stash-bounded to 1F1B's memory) and a 1F1B fleet from the same
+    seed finish with bitwise-identical per-stage params — backwards
+    accumulate in ascending microbatch order under both."""
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    sup = _fleet(tmp_path / "a", schedule="1f1b", steps=4,
+                 step_sleep_s=0.0)
+    try:
+        a = sup.run(deadline_s=180.0)["final_params"]
+    finally:
+        sup.close()
+    sup = _fleet(tmp_path / "b", schedule="gpipe", stash_limit=3,
+                 steps=4, step_sleep_s=0.0)
+    try:
+        b = sup.run(deadline_s=180.0)["final_params"]
+    finally:
+        sup.close()
+    for s in a:
+        np.testing.assert_array_equal(a[s], b[s])
+
+
+@needs_lib
+@pytest.mark.slow
+def test_quantized_edges_count_wire_bytes(tmp_path):
+    """bf16 activation edges move half the logical bytes; the per-edge
+    counters land in the stage logs."""
+    import json as _json
+    from pathlib import Path
+
+    sup = _fleet(tmp_path, steps=3, wire="bf16", step_sleep_s=0.0)
+    try:
+        rep = sup.run(deadline_s=180.0)
+    finally:
+        sup.close()
+    seen = 0
+    for p in rep["log_paths"]:
+        lines = [ln for ln in Path(p).read_text().splitlines()
+                 if ln.strip()]
+        if not lines:
+            continue
+        last = _json.loads(lines[-1])
+        wb = last["wire_bytes"]
+        if wb["logical"]:
+            seen += 1
+            assert wb["wire"] * 2 == wb["logical"]
+    assert seen == 3  # every stage has at least one quantized edge

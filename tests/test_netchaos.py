@@ -255,7 +255,7 @@ def test_asymmetric_partition_suspects_clears_never_grieves(tmp_path):
 
 
 def _run_straggler_fleet(tmp_path, *, policy, duration_s, steps=40,
-                         evict_after=2):
+                         evict_after=2, **kw):
     from hetu_tpu.resilience.faults import (
         FaultEvent, FaultInjector, FaultSchedule,
     )
@@ -269,7 +269,7 @@ def _run_straggler_fleet(tmp_path, *, policy, duration_s, steps=40,
         lease_s=1.5, suspect_grace_s=1.0, step_sleep_s=0.01,
         straggler_policy=policy, straggler_factor=4.0,
         straggler_evict_after=evict_after, straggler_slow_ms=120,
-        injector=FaultInjector(schedule))
+        injector=FaultInjector(schedule), **kw)
     return sup
 
 
@@ -342,6 +342,40 @@ def test_straggler_evict_policy_reshards_around(tmp_path):
     stragglers = [p for p in pairs if p.kind == "straggler"]
     assert len(stragglers) == 1 and stragglers[0].paired
     assert stragglers[0].recovery_name == "train.straggler"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_straggler_probation_auto_readmits_after_heal(tmp_path):
+    """ISSUE 11 satellite (closes the PR 10 'no auto re-admission'
+    residual): the evicted-but-alive straggler keeps probing its van
+    link while excluded; once the injected slow link heals, N
+    consecutive healthy probed beats trip the probation loop, the
+    controller lifts the eviction (a grow epoch), the worker rejoins
+    the mesh, and the run finishes at full width with byte-identical
+    consumed batches."""
+    if not available():
+        pytest.skip("native PS lib unavailable")
+    sup = _run_straggler_fleet(tmp_path, policy="evict",
+                               duration_s=2.5, evict_after=2,
+                               steps=220, straggler_readmit_after=3)
+    try:
+        rep = sup.run(deadline_s=240.0)
+        sup.verify_consumed(rep["consumed"])
+        # it WAS evicted...
+        assert any(r["resolution"] == "evicted"
+                   for r in sup.straggle_records)
+        shrinks = [r for r in rep["resizes"] if r["kind"] == "shrink"]
+        assert shrinks and shrinks[0]["slot"] == 1
+        # ...and the probation loop readmitted it without an operator
+        assert 1 not in sup._evicted
+        grows = [r for r in rep["resizes"] if r["kind"] == "grow"]
+        assert grows and grows[-1]["width"] == 3
+        assert grows[-1]["epoch"] > shrinks[0]["epoch"]
+        # the readmitted worker trained to the end at full width
+        assert sup.svc.state_of(1).committed >= sup.steps - 1
+    finally:
+        sup.close()
 
 
 @pytest.mark.slow

@@ -210,6 +210,153 @@ def test_beats_stopped_still_escalates_to_lost():
 
 
 # ---------------------------------------------------------------------------
+# deaf-member detection: the INGRESS-cut direction (ISSUE 11 satellite —
+# netem can already black-hole a member's reads; now membership sees it)
+# ---------------------------------------------------------------------------
+
+def _beat_ack(table, slot, inc, beat, epoch_ack):
+    row = np.zeros((1, mb.MEMBER_DIM), np.float32)
+    row[0, mb.F_INCARNATION] = inc
+    row[0, mb.F_BEAT] = beat
+    row[0, mb.F_FLAG] = 1.0
+    row[0, mb.F_EPOCH_ACK] = epoch_ack
+    table.sparse_set([slot], row)
+
+
+def test_deaf_member_suspected_then_cleared_on_ack():
+    """A member whose beats ARRIVE but who never acks the published
+    control epoch inside the bound is suspect(reason=deaf) — alive (no
+    escalation to lost while beating), unroutable — and CLEARS the
+    moment its epoch_ack catches up."""
+    t = FlakyTable(2)
+    svc = mb.MembershipService(t, 2, lease_s=10.0, suspect_grace_s=10.0,
+                               deaf_ack_s=0.05)
+    _beat_ack(t, 0, 7, 1, 0)
+    _beat_ack(t, 1, 9, 1, 0)
+    assert sorted(svc.poll()) == [("join", 0), ("join", 1)]
+    svc.publish_control(epoch=3, width=2, alive_mask=3)
+    # inside the bound: behind on acks is not yet deafness
+    _beat_ack(t, 0, 7, 2, 3)   # slot 0 hears and acks
+    _beat_ack(t, 1, 9, 2, 0)   # slot 1's ingress is cut: beats only
+    assert svc.poll() == []
+    time.sleep(0.1)            # past deaf_ack_s
+    _beat_ack(t, 0, 7, 3, 3)
+    _beat_ack(t, 1, 9, 3, 0)
+    assert svc.poll() == [("suspect", 1)]
+    assert svc.state_of(1).suspect_reason == "deaf"
+    assert svc.alive_slots() == [0]          # unroutable
+    assert sorted(svc.present_slots()) == [0, 1]  # but never kicked
+    # beats keep flowing: deafness must NOT clear, NOR escalate to lost
+    for b in (4, 5, 6):
+        _beat_ack(t, 1, 9, b, 0)
+        assert svc.poll() == []
+        assert svc.state_of(1).state == "suspect"
+    # the ingress heals: the next beat carries the ack → clear
+    _beat_ack(t, 1, 9, 7, 3)
+    assert svc.poll() == [("clear", 1)]
+    assert svc.state_of(1).state == "alive"
+    assert svc.state_of(1).suspect_reason is None
+
+
+def test_deaf_member_never_lost_while_beating_even_past_grace():
+    """The invariant under tight polling: a poll landing BETWEEN two
+    heartbeats of a deaf member must never read as silence — deafness
+    alone never escalates to lost, however long it lasts relative to
+    the suspect grace."""
+    t = FlakyTable(2)
+    svc = mb.MembershipService(t, 2, lease_s=0.3, suspect_grace_s=0.02,
+                               deaf_ack_s=0.03)
+    _beat_ack(t, 0, 7, 1, 0)
+    _beat_ack(t, 1, 9, 1, 0)
+    svc.poll()
+    svc.publish_control(epoch=2, width=2, alive_mask=3)
+    time.sleep(0.06)
+    _beat_ack(t, 0, 7, 2, 2)
+    _beat_ack(t, 1, 9, 2, 0)
+    assert svc.poll() == [("suspect", 1)]
+    deadline = time.monotonic() + 0.4
+    beat = 3
+    while time.monotonic() < deadline:
+        # beats keep flowing; MANY polls land between them (the
+        # grace, 20ms, elapses many times over)
+        for _ in range(4):
+            assert svc.poll() == []
+            time.sleep(0.02)
+        _beat_ack(t, 0, 7, beat, 2)
+        _beat_ack(t, 1, 9, beat, 0)
+        beat += 1
+    assert svc.state_of(1).state == "suspect"
+    assert svc.state_of(1).suspect_reason == "deaf"
+    assert svc.poll() == []  # absorb the loop's final beat write
+    # and when its beats REALLY stop, silence escalates normally
+    time.sleep(0.35)  # past lease_s: reclassified to beats_stopped
+    _beat_ack(t, 0, 7, 99, 2)  # slot 0 stays healthy throughout
+    assert svc.poll() == []
+    assert svc.state_of(1).suspect_reason == "beats_stopped"
+    time.sleep(0.05)  # past the (restarted) grace
+    _beat_ack(t, 0, 7, 100, 2)
+    assert svc.poll() == [("lost", 1)]
+
+
+def test_fresh_joiner_is_not_instantly_deaf():
+    """The deaf bound measures time the MEMBER had to ack: a
+    replacement joining long after the epoch was published gets its own
+    deaf_ack_s window before suspicion, instead of being suspected on
+    its first beat advance."""
+    t = FlakyTable(2)
+    svc = mb.MembershipService(t, 2, lease_s=10.0, suspect_grace_s=10.0,
+                               deaf_ack_s=0.05)
+    _beat_ack(t, 0, 7, 1, 0)
+    svc.poll()
+    svc.publish_control(epoch=2, width=2, alive_mask=3)
+    _beat_ack(t, 0, 7, 2, 2)
+    svc.poll()
+    time.sleep(0.08)           # well past deaf_ack_s since publication
+    _beat_ack(t, 1, 9, 1, 0)   # the replacement joins only NOW
+    assert svc.poll() == [("join", 1)]
+    _beat_ack(t, 1, 9, 2, 0)   # first beat advance, ack still pending
+    assert svc.poll() == []    # inside ITS OWN window: not deaf yet
+    assert svc.state_of(1).state == "alive"
+    time.sleep(0.08)           # its window elapses without an ack
+    _beat_ack(t, 1, 9, 3, 0)
+    assert svc.poll() == [("suspect", 1)]
+    assert svc.state_of(1).suspect_reason == "deaf"
+
+
+def test_deaf_detection_disabled_by_default():
+    """Membership planes whose members never ack epochs (the serving
+    pool's blackboard) must not all read as deaf: deaf_ack_s=None is
+    the default and disables the bound entirely."""
+    t = FlakyTable(1)
+    svc = mb.MembershipService(t, 1, lease_s=10.0, suspect_grace_s=10.0)
+    _beat_ack(t, 0, 5, 1, 0)
+    assert svc.poll() == [("join", 0)]
+    svc.publish_control(epoch=4, width=1, alive_mask=1)
+    time.sleep(0.1)
+    _beat_ack(t, 0, 5, 2, 0)   # never acks; still fine
+    assert svc.poll() == []
+    assert svc.state_of(0).state == "alive"
+
+
+def test_deaf_clock_starts_at_epoch_publication():
+    """The deaf clock measures time since the EPOCH was first
+    published, not since the member joined — re-publishes of the same
+    epoch (phase flips, set_slow) must not restart it."""
+    t = FlakyTable(1)
+    svc = mb.MembershipService(t, 1, lease_s=10.0, suspect_grace_s=10.0,
+                               deaf_ack_s=0.06)
+    _beat_ack(t, 0, 5, 1, 0)
+    svc.poll()
+    svc.publish_control(epoch=2, width=1, alive_mask=1)
+    time.sleep(0.08)
+    # same epoch re-published (a set_slow-style rewrite): no clock reset
+    svc.publish_control(epoch=2, width=1, alive_mask=1, phase=1)
+    _beat_ack(t, 0, 5, 2, 0)
+    assert svc.poll() == [("suspect", 0)]
+    assert svc.state_of(0).suspect_reason == "deaf"
+
+
+# ---------------------------------------------------------------------------
 # control_rpc under 100% drop: bounded, link-named (ISSUE 10 satellite)
 # ---------------------------------------------------------------------------
 

@@ -142,6 +142,30 @@ def test_schedule_netem_fault_kinds_draw_after_everything():
         grown.to_json()
 
 
+def test_schedule_stage_fault_kinds_draw_after_everything():
+    """Fourth extension of the frozen-bytes contract (ISSUE 11): the
+    pipeline-stage kinds (stage_kill/stage_slow) must draw from the rng
+    AFTER every pre-existing kind — including the network-plane kinds
+    PR 10 added — so every recorded chaos seed still replays
+    byte-for-byte."""
+    old = dict(steps=50, seed=7, van_errors=2, kill_shards=1, n_shards=2,
+               serve_preempts=1, n_members=2, member_kills=1,
+               member_suspends=1, worker_proc_kills=1, n_workers=3,
+               netem_partitions=1, netem_degrades=1, stragglers=1)
+    base = FaultSchedule.generate(**old)
+    stage_kinds = ("stage_kill", "stage_slow")
+    grown = FaultSchedule.generate(**old, stage_kills=1, stage_slows=1,
+                                   stage_slow_s=2.5, n_stages=3)
+    old_events = [e for e in grown.events if e.kind not in stage_kinds]
+    assert old_events == base.events
+    new = {e.kind: e for e in grown.events if e.kind in stage_kinds}
+    assert sorted(new) == sorted(stage_kinds)
+    assert new["stage_slow"].arg2 == 2.5
+    assert 0 <= new["stage_kill"].arg < 3
+    assert FaultSchedule.from_json(grown.to_json()).to_json() == \
+        grown.to_json()
+
+
 def test_schedule_at_and_validation():
     s = FaultSchedule([FaultEvent(3, "nan_grad"), FaultEvent(3, "van_error"),
                        FaultEvent(5, "preempt")])
