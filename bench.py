@@ -2075,6 +2075,149 @@ def bench_mpmd():
     })
 
 
+def bench_ctrlchaos():
+    """Control-plane failover: what a controller SIGKILL costs.
+
+    The durable tier (van) and the CONTROLLER run as separate
+    processes; a seeded ``controller_kill`` SIGKILLs the controller
+    mid-traffic on a 2-member cross-process serving pool.  A new
+    incarnation then takes over (claims the blackboard controller row,
+    reads the ledger, re-adopts the still-serving members, aborts
+    half-open drains, re-routes orphans) and resolves every accepted
+    request.  Reported from the paired timeline: detect p50 (kill →
+    ``ctrl.takeover`` start) and takeover p50 (kill → hand-off
+    complete), with accepted-requests-lost asserted ZERO — the number
+    that makes the ROADMAP's unattended autoscaling control loop
+    trustworthy.
+    """
+    import os
+    import tempfile
+    from pathlib import Path
+
+    from hetu_tpu.resilience.faults import FaultInjector, FaultSchedule
+    from hetu_tpu.resilience.shardproc import (
+        free_port, spawn_module, spawn_shard_server,
+    )
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+    from hetu_tpu.telemetry import timeline, trace
+
+    smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
+    ROUNDS = 1 if smoke else 2
+    N_REQ, GEN = (6, 24) if smoke else (10, 32)
+    model = {"vocab_size": 89, "hidden_size": 48, "num_layers": 2,
+             "num_heads": 4, "ffn_size": 96, "max_position": 96,
+             "num_slots": max(N_REQ, 4), "max_len": 88,
+             "min_bucket": 8, "seed": 1}
+    LEASE_S, GRACE_S = 0.5, 0.4
+
+    detect, takeover_s, lost_total, accepted_total = [], [], 0, 0
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    try:
+        for rnd in range(ROUNDS):
+            with tempfile.TemporaryDirectory(
+                    prefix="bench_ctrlchaos_") as wd:
+                port = free_port()
+                van_proc = spawn_shard_server(wd, port, tag=f"v{rnd}")
+                pool = None
+                ctrl = None
+                try:
+                    cfg = {"workdir": wd, "port": port, "n_members": 2,
+                           "model": model, "n_requests": N_REQ,
+                           "max_tokens": GEN, "submit_gap_s": 0.12,
+                           "hold_s": 600.0, "prompt_seed": rnd,
+                           "lease_s": LEASE_S,
+                           "suspect_grace_s": GRACE_S}
+                    cfg_path = Path(wd) / "ctrl.json"
+                    cfg_path.write_text(json.dumps(cfg))
+                    ctrl = spawn_module(wd, f"ctrl{rnd}",
+                                        "hetu_tpu.serve.crosshost",
+                                        ["--controller", str(cfg_path)],
+                                        extra_env={"JAX_PLATFORMS":
+                                                   "cpu"},
+                                        timeout_s=180.0)
+                    schedule = FaultSchedule.generate(
+                        steps=N_REQ, seed=rnd + 1, controller_kills=1)
+                    kill_step = schedule.events[0].step
+                    inj = FaultInjector(schedule, ctrl_procs=[ctrl])
+                    fired = 0
+                    deadline = time.monotonic() + 120.0
+                    while ctrl.poll() is None:
+                        assert time.monotonic() < deadline, \
+                            "seeded controller kill never fired"
+                        log = Path(ctrl.log_path).read_text(
+                            errors="replace")
+                        cur = sum(1 for ln in log.splitlines()
+                                  if ln.startswith("ACCEPTED"))
+                        for t in range(fired + 1, cur + 1):
+                            inj.on_step(t)
+                        fired = max(fired, cur)
+                        if fired >= kill_step:
+                            break
+                        time.sleep(0.05)
+                    while ctrl.poll() is None:
+                        time.sleep(0.02)
+                    accepted = sum(
+                        1 for ln in Path(ctrl.log_path).read_text(
+                            errors="replace").splitlines()
+                        if ln.startswith("ACCEPTED"))
+                    accepted_total += accepted
+                    pool = CrossProcessServingPool.takeover(
+                        workdir=wd, port=port, lease_s=LEASE_S,
+                        suspect_grace_s=GRACE_S)
+                    results = pool.wait_adopted(timeout_s=120.0)
+                    for rid in range(1, accepted + 1):
+                        ok = (results.get(rid, {}).get("status") == "ok"
+                              or pool.takeover_report["resolved"].get(rid) == "ok")
+                        lost_total += 0 if ok else 1
+                finally:
+                    if pool is not None:
+                        pool.close()
+                    for p in (ctrl, van_proc):
+                        if p is not None and p.poll() is None:
+                            p.kill()
+                            p.wait()
+                    # the members are the DEAD controller's children:
+                    # if takeover never adopted them, nothing else
+                    # holds a handle — reap by cmdline (every spawned
+                    # process names the workdir on its argv)
+                    import subprocess as _sp
+                    try:
+                        _sp.run(["pkill", "-9", "-f", wd],
+                                capture_output=True, timeout=10)
+                    except Exception:
+                        pass
+    finally:
+        trace.disable()
+
+    assert lost_total == 0, f"{lost_total} accepted requests lost"
+    pairs = [p for p in timeline.correlate(tracer.events)
+             if p.kind == "controller_kill"]
+    assert len(pairs) == ROUNDS and all(p.paired for p in pairs), pairs
+    detect = sorted(p.detect_s for p in pairs)
+    takeover_s = sorted(p.recover_s for p in pairs)
+    p50 = lambda xs: xs[len(xs) // 2]  # noqa: E731
+    print(f"# controller_kill detect p50 {p50(detect) * 1e3:8.1f} ms  "
+          f"takeover p50 {p50(takeover_s) * 1e3:8.1f} ms  "
+          f"(accepted {accepted_total}, lost {lost_total})",
+          file=sys.stderr)
+    _emit({
+        "metric": "ctrlchaos_takeover_p50_s",
+        "value": round(p50(takeover_s), 3),
+        "unit": "s_controller_kill_to_takeover_complete_p50",
+        "extra": {
+            "detect_s_p50": round(p50(detect), 3),
+            "detect_s": [round(t, 3) for t in detect],
+            "takeover_s": [round(t, 3) for t in takeover_s],
+            "rounds": ROUNDS, "accepted": accepted_total,
+            "requests_lost": lost_total,
+            "lease_s": LEASE_S, "suspect_grace_s": GRACE_S,
+            "topology": "van + controller as separate processes; "
+                        "takeover reads blackboard + ledger",
+        },
+    })
+
+
 _METRIC_BY_CMD = {
     "gpt": "gpt2s_bf16_train_mfu_1chip",
     "gpt_sweep": "gpt_config_sweep_best_mfu_1chip",
@@ -2091,6 +2234,7 @@ _METRIC_BY_CMD = {
     "crosshost": "crosshost_drain_overhead_x",
     "netchaos": "netchaos_shed_vs_noshed_p99_x",
     "mpmd": "mpmd_gpipe_over_1f1b_bubble_x",
+    "ctrlchaos": "ctrlchaos_takeover_p50_s",
 }
 
 
@@ -2133,6 +2277,7 @@ def main():
      "crosshost": bench_crosshost,
      "netchaos": bench_netchaos,
      "mpmd": bench_mpmd,
+     "ctrlchaos": bench_ctrlchaos,
      "telemetry": bench_telemetry}.get(cmd, bench_gpt)()
 
 

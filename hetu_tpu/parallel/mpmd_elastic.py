@@ -53,6 +53,8 @@ suspected-then-cleared by the lease machine with zero replacements.
 from __future__ import annotations
 
 import json
+import os
+import signal as _signal
 import time
 import traceback
 from collections import defaultdict
@@ -66,6 +68,7 @@ from hetu_tpu.parallel.mpmd import VanMailbox, schedule_ops
 from hetu_tpu.ps import membership as _mb
 from hetu_tpu.resilience.memberproc import (
     ControlPlaneMember, EpochChanged as _EpochChanged,
+    drive_controller_harness,
 )
 from hetu_tpu.telemetry import trace
 
@@ -103,6 +106,11 @@ class StageSpec:
     # lands inside a run
     compute_sleep_s: float = 0.0
     step_sleep_s: float = 0.0
+    # park when the CONTROLLER's blackboard beat is silent this long
+    # (0 disables): a headless pipeline freezes at its next step
+    # boundary and resumes on the first beat from ANY controller
+    # incarnation — the member half of fenced control-plane takeover
+    ctrl_lease_s: float = 0.0
     log_path: str = ""
 
     def to_json(self) -> str:
@@ -341,6 +349,9 @@ class PipelineStageProcess(ControlPlaneMember):
             e, width, mask, resume, phase, slow_slot, slow_ms = \
                 self.member.read_control()
             self._apply_slow(slow_slot, slow_ms)
+            if self._park_if_headless():
+                continue  # controller silent: frozen at this boundary
+                # until a (possibly new-incarnation) controller beats
             if e == 0:
                 if self._stop.wait(0.05):
                     break
@@ -458,9 +469,12 @@ class MPMDPipelineSupervisor:
                  lease_s: float = 0.6, suspect_grace_s: float = 0.4,
                  deaf_ack_s: Optional[float] = None,
                  compute_sleep_s: float = 0.0, step_sleep_s: float = 0.0,
+                 ctrl_lease_s: float = 0.0,
                  injector=None, spawn_timeout_s: float = 120.0,
                  straggler_factor: float = 4.0,
-                 straggler_slow_ms: int = 120, port: int = 0):
+                 straggler_slow_ms: int = 120, port: int = 0,
+                 own_van: bool = True,
+                 _takeover_spec: Optional[StageSpec] = None):
         from hetu_tpu.ps import van
         if n_stages < 2:
             raise ValueError("a pipeline needs at least two stages")
@@ -468,7 +482,17 @@ class MPMDPipelineSupervisor:
             raise ValueError(f"batch {batch} must divide into "
                              f"{n_microbatches} microbatches")
         self._van = van
-        self.port = van.serve(port)
+        self._own_van = bool(own_van)
+        if own_van:
+            self.port = van.serve(port)
+        else:
+            # attach to an EXTERNAL van process: the durable tier
+            # (stage tables, blackboard) must outlive the controller
+            # for its death to be survivable
+            if not port:
+                raise ValueError("own_van=False needs the running "
+                                 "van's port")
+            self.port = int(port)
         self.workdir = Path(workdir)
         self.steps = int(steps)
         self.n_stages = int(n_stages)
@@ -484,11 +508,49 @@ class MPMDPipelineSupervisor:
         self._committed_hw = -1
         self.straggler_factor = float(straggler_factor)
         self.straggler_slow_ms = int(straggler_slow_ms)
-        from hetu_tpu.resilience.straggler import StragglerDetector
-        self._detector = StragglerDetector(
-            factor=self.straggler_factor, subject="stage",
-            policy="wait")
-        self._slow_heal_at: Optional[float] = None
+        D = int(width)
+        self.tables: list = []
+        self.procs: list = [None] * self.n_stages
+        self._member_pids: dict = {}    # takeover-adopted pids (no Popen)
+        from hetu_tpu.resilience.straggler import SupervisorStragglerPlane
+        if _takeover_spec is not None:
+            # ---- takeover: adopt a running pipeline whose controller
+            # died.  Everything re-derives from the van: the control
+            # row (epoch/resume/phase), lease rows (alive stages,
+            # frozen committed), stage tables (the model), and spawn
+            # configs on disk (every id).
+            self.spec = StageSpec(**{**asdict(_takeover_spec),
+                                     "stage": -1, "log_path": ""})
+            # the whole attach sequence is guarded: a blackboard/claim
+            # failure after some tables connected must close them, not
+            # leak van connections for the process's life
+            try:
+                for s in range(self.n_stages):
+                    self.tables.append(van.RemotePSTable(
+                        "127.0.0.1", self.port, stage_table_rows(D), D,
+                        table_id=self.spec.table_base + s, create=False))
+                self._bb = _mb.attach_blackboard(
+                    "127.0.0.1", self.port,
+                    table_id=self.spec.membership_table,
+                    n_slots=self.n_stages)
+                self.svc = _mb.MembershipService(
+                    self._bb, self.n_stages, lease_s=lease_s,
+                    suspect_grace_s=suspect_grace_s,
+                    deaf_ack_s=deaf_ack_s)
+                self._stragglers = SupervisorStragglerPlane(
+                    self.svc, factor=self.straggler_factor,
+                    subject="stage", policy="wait",
+                    slow_ms=self.straggler_slow_ms)
+                self.log_paths = sorted(
+                    str(p) for p in self.workdir.glob("stage_*_*.jsonl"))
+                self._incarnations = len(
+                    list(self.workdir.glob("stage_*_*.json")))
+                self._adopt()
+            except Exception:
+                self.close()
+                raise
+            return
+        # ---- normal bring-up ----
         membership_table = _mb.fresh_table_id()
         table_base = _mb.fresh_table_id()
         mail_base = _mb.fresh_table_id()
@@ -503,13 +565,11 @@ class MPMDPipelineSupervisor:
             membership_table=membership_table, table_base=table_base,
             mail_base=mail_base, barrier_base=barrier_base,
             compute_sleep_s=float(compute_sleep_s),
-            step_sleep_s=float(step_sleep_s))
+            step_sleep_s=float(step_sleep_s),
+            ctrl_lease_s=float(ctrl_lease_s))
         # everything after van.serve is guarded: a table/blackboard/
         # spawn failure must stop the in-process van server (and close
         # what was created) instead of leaking it for the process's life
-        D = int(width)
-        self.tables: list = []
-        self.procs: list = [None] * self.n_stages
         try:
             # per-stage weight tables, seeded — the model lives HERE
             for s in range(self.n_stages):
@@ -530,6 +590,9 @@ class MPMDPipelineSupervisor:
             self.svc = _mb.MembershipService(
                 self._bb, self.n_stages, lease_s=lease_s,
                 suspect_grace_s=suspect_grace_s, deaf_ack_s=deaf_ack_s)
+            self._stragglers = SupervisorStragglerPlane(
+                self.svc, factor=self.straggler_factor, subject="stage",
+                policy="wait", slow_ms=self.straggler_slow_ms)
             for s in range(self.n_stages):
                 self._spawn(s)
             self._wait_joined(range(self.n_stages))
@@ -544,6 +607,119 @@ class MPMDPipelineSupervisor:
         except Exception:
             self.close()
             raise
+
+    @classmethod
+    def takeover(cls, *, workdir, port, lease_s: float = 0.6,
+                 suspect_grace_s: float = 0.4,
+                 deaf_ack_s: Optional[float] = None,
+                 spawn_timeout_s: float = 120.0,
+                 injector=None, **kw) -> "MPMDPipelineSupervisor":
+        """Become the pipeline's NEW controller after the old one died:
+        re-derive everything from the stage spawn configs under
+        ``workdir`` and the still-running van at ``port``, claim the
+        controller row with a higher incarnation, and re-freeze the
+        fleet (PREPARE → frozen acks → exact resume) under a
+        ``ctrl.takeover`` span."""
+        cfgs = sorted(Path(workdir).glob("stage_*_*.json"),
+                      key=lambda p: p.stat().st_mtime)
+        if not cfgs:
+            raise FileNotFoundError(
+                f"no stage spawn configs under {workdir}")
+        spec = StageSpec.from_json(cfgs[-1].read_text())
+        return cls(spec.n_stages, workdir=workdir, steps=spec.steps,
+                   n_microbatches=spec.n_microbatches, width=spec.width,
+                   batch=spec.batch, schedule=spec.schedule,
+                   stash_limit=spec.stash_limit, wire=spec.wire,
+                   data_seed=spec.data_seed, lr=spec.lr,
+                   momentum=spec.momentum, hb_ms=spec.hb_ms,
+                   lease_s=lease_s, suspect_grace_s=suspect_grace_s,
+                   deaf_ack_s=deaf_ack_s,
+                   compute_sleep_s=spec.compute_sleep_s,
+                   step_sleep_s=spec.step_sleep_s,
+                   ctrl_lease_s=spec.ctrl_lease_s, injector=injector,
+                   spawn_timeout_s=spawn_timeout_s, port=port,
+                   own_van=False, _takeover_spec=spec, **kw)
+
+    def _adopt(self) -> None:
+        """Adopt the pipeline: the control row carries the epoch (and a
+        possibly half-open PREPARE the old controller died inside), the
+        lease rows carry frozen progress — a fresh two-phase re-freeze
+        supersedes whatever was in flight and resumes at the exact
+        step."""
+        ctrl = self.svc.read_control_row()
+        self.epoch = int(ctrl["epoch"])
+        self.resume_step = int(ctrl["resume_step"])
+        # carry the predecessor's straggler injection forward: the
+        # takeover republish must not silently heal an injected slow
+        # link (the same rule every epoch transition honors)
+        self.svc.adopt_slow(ctrl["slow_slot"], ctrl["slow_ms"])
+        self.svc.wait_present(self._spawn_timeout_s)
+        # stage pids off the lease rows: these processes are the DEAD
+        # controller's children — the pid is the only handle
+        # close()/_replace_stages have on them
+        self._member_pids.update(self.svc.member_pids())
+        self._committed_hw = max(
+            self._committed_hw,
+            max((self.svc.state_of(s).committed
+                 for s in range(self.n_stages)), default=-1))
+        with trace.span("ctrl.takeover", cat="ctrl") as sp:
+            sp.set("plane", "mpmd")
+            sp.set("incarnation", self.svc.ctrl_incarnation)
+            sp.set("epoch_adopted", self.epoch)
+            sp.set("phase_at_death", int(ctrl["phase"]))
+            # refreeze whenever ANY stage is present — even a finished
+            # fleet: a stage parked under a mid-takeover hold only
+            # resumes (and exits) once the new incarnation republishes
+            if self.svc.present_slots():
+                self._refreeze()
+            sp.set("epoch", self.epoch)
+            sp.set("resume_step", self.resume_step)
+        # a stage that died AROUND the controller kill: its one-shot
+        # "lost" event was consumed by the nested polls above
+        # (wait_present, the refreeze ack-wait) and will never re-fire
+        # for the run loop — the same consumed-event case
+        # _replace_stages re-checks by STATE; without this sweep the
+        # pipeline runs a stage short until the deadline
+        stranded = [s for s in range(self.n_stages)
+                    if self.svc.state_of(s).state == "lost"]
+        if stranded and self._committed_hw < self.steps - 1:
+            self._replace_stages(stranded)
+        self.takeover_report = {
+            "incarnation": self.svc.ctrl_incarnation,
+            "epoch": self.epoch, "resume_step": self.resume_step,
+            "present": sorted(self.svc.present_slots()),
+        }
+
+    def _refreeze(self) -> None:
+        """The takeover republish: a FRESH epoch's PREPARE supersedes
+        any half-open transition the dead controller left behind,
+        frozen acks are collected from every live stage, and the exact
+        resume is published — the same two-phase contract as a stage
+        replacement, minus the spawn."""
+        full_mask = _mb.MembershipService.mask_of(range(self.n_stages))
+        self.epoch += 1
+        self.svc.publish_control(epoch=self.epoch, width=self.n_stages,
+                                 alive_mask=full_mask, phase=1)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            self.svc.poll()
+            if all(self.svc.state_of(s).epoch_ack >= self.epoch
+                   for s in range(self.n_stages)
+                   if self.svc.state_of(s).state not in
+                   ("left", "lost", "empty")):
+                break
+            time.sleep(0.02)
+        else:
+            raise TimeoutError(
+                f"takeover epoch {self.epoch} prepare not acked within "
+                f"30s: "
+                f"{[(m.slot, m.state, m.epoch_ack) for m in self.svc.members]}")
+        frozen = [m.committed for m in self.svc.members
+                  if m.state != "empty"]
+        self.resume_step = max(max(frozen), self._committed_hw) + 1
+        self.svc.publish_control(epoch=self.epoch, width=self.n_stages,
+                                 alive_mask=full_mask,
+                                 resume_step=self.resume_step)
 
     # ---- spawning ----
     def _spawn(self, stage: int) -> None:
@@ -595,6 +771,18 @@ class MPMDPipelineSupervisor:
                     if p is not None and p.poll() is None:
                         p.kill()
                         p.wait()
+                    elif sl in self._member_pids:
+                        # a takeover-adopted stage (the dead
+                        # controller's child): the pid is the only
+                        # handle — without the kill a SIGSTOPped-then-
+                        # resumed old stage and its replacement both
+                        # heartbeat the same slot
+                        try:
+                            os.kill(self._member_pids[sl],
+                                    _signal.SIGKILL)
+                        except OSError:
+                            pass
+                    self._member_pids.pop(sl, None)
                     self._spawn(sl)
                 self._wait_joined(pending)
                 pending.clear()
@@ -650,24 +838,19 @@ class MPMDPipelineSupervisor:
     # lockstep barriers already pace the fleet) ----
     def inject_stage_slow(self, slot: int, duration_s: float,
                           slow_ms: Optional[int] = None) -> None:
-        ms = self.straggler_slow_ms if slow_ms is None else int(slow_ms)
-        self.svc.set_slow(int(slot), ms)
-        self._slow_heal_at = time.monotonic() + float(duration_s)
+        self._stragglers.inject(slot, duration_s, slow_ms)
 
     @property
     def straggle_records(self) -> list:
-        return self._detector.records
+        return self._stragglers.records
 
     def _check_stragglers(self) -> None:
         slots = [s for s in self.svc.present_slots()
                  if self.svc.state_of(s).state == "alive"]
-        loads = {s: self.svc.state_of(s).load for s in slots
-                 if self.svc.state_of(s).load > 0.0}
-        committed = {s: self.svc.state_of(s).committed for s in slots}
-        # wait policy only (evict_after=0): the shared detector opens/
+        # wait policy only (evict_after=0): the shared plane opens/
         # closes the train.straggler spans; a pipeline has no redundant
         # member to reshard around, so crossing never evicts
-        self._detector.observe(loads, present=slots, committed=committed)
+        self._stragglers.observe(slots)
 
     # ---- driving ----
     def poll(self) -> list:
@@ -683,12 +866,9 @@ class MPMDPipelineSupervisor:
             for _, idx, dur in self.injector.pop_net_events(
                     kinds=("stage_slow",)):
                 self.inject_stage_slow(int(idx) % self.n_stages, dur)
-        if self._slow_heal_at is not None and \
-                time.monotonic() >= self._slow_heal_at:
-            # serialized with every other control-row write (the
-            # multicontroller's heal-in-poll rule)
-            self._slow_heal_at = None
-            self.svc.set_slow(-1, 0)
+        # serialized with every other control-row write (the shared
+        # SupervisorStragglerPlane's heal-in-poll rule)
+        self._stragglers.maybe_heal()
         events = self.svc.poll()
         self._committed_hw = max(
             self._committed_hw,
@@ -741,7 +921,7 @@ class MPMDPipelineSupervisor:
                 f"pipeline did not finish {self.steps} steps within "
                 f"{deadline_s}s: "
                 f"{[(m.slot, m.state, m.committed) for m in states]}")
-        self._detector.close_all(resolution="run_end")
+        self._stragglers.close_all(resolution="run_end")
         return {
             "steps": self.steps,
             "epochs": self.epoch,
@@ -764,7 +944,12 @@ class MPMDPipelineSupervisor:
         return out
 
     def close(self) -> None:
-        for p in self.procs:
+        # a FENCED controller no longer owns the fleet: its close()
+        # must not kill stage processes the new incarnation adopted
+        # (the same rule as the serving pool's fenced close)
+        svc = getattr(self, "svc", None)
+        fenced = bool(getattr(svc, "fenced", False))
+        for p in self.procs if not fenced else ():
             if p is None:
                 continue
             try:
@@ -773,6 +958,21 @@ class MPMDPipelineSupervisor:
                 p.wait()
             except Exception:
                 traceback.print_exc()
+        # takeover-adopted stages have no Popen handle — the pid off
+        # the lease row is the only one.  Only still-present slots are
+        # signalled (a finished fleet left cleanly; killing a recycled
+        # pid would hit an innocent process), and they were reparented
+        # when their spawner died, so there is no zombie-reap concern
+        for slot, pid in (() if fenced else
+                          list(getattr(self, "_member_pids",
+                                       {}).items())):
+            if svc is not None and \
+                    svc.state_of(slot).state not in ("alive", "suspect"):
+                continue
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except OSError:
+                pass
         for t in (*getattr(self, "tables", ()),
                   getattr(self, "_bb", None)):
             if t is not None:
@@ -780,9 +980,52 @@ class MPMDPipelineSupervisor:
                     t.close()
                 except Exception:
                     pass
-        self._van.stop()
+        if getattr(self, "_own_van", True):
+            self._van.stop()
+
+
+# ---------------------------------------------------------------------------
+# controller process harness (the chaos kill target)
+# ---------------------------------------------------------------------------
+
+def controller_main(config_path: str) -> int:
+    """Entry point for a spawned CONTROLLER process over an EXTERNAL
+    van: drive the pipeline and print the progress markers the chaos
+    harness keys on (``STEP k`` per committed-high-water advance,
+    ``ALLDONE``, ``FENCED``)."""
+    cfg = json.loads(open(config_path).read())
+    sup = MPMDPipelineSupervisor(
+        int(cfg["n_stages"]), workdir=cfg["workdir"],
+        steps=int(cfg["steps"]),
+        n_microbatches=int(cfg.get("n_microbatches", 4)),
+        width=int(cfg.get("width", 8)), batch=int(cfg.get("batch", 8)),
+        schedule=cfg.get("schedule", "1f1b"),
+        wire=cfg.get("wire", "f32"),
+        data_seed=int(cfg.get("data_seed", 0)),
+        lease_s=float(cfg.get("lease_s", 0.6)),
+        suspect_grace_s=float(cfg.get("suspect_grace_s", 0.4)),
+        step_sleep_s=float(cfg.get("step_sleep_s", 0.0)),
+        ctrl_lease_s=float(cfg.get("ctrl_lease_s", 0.0)),
+        hb_ms=int(cfg.get("hb_ms", 60)),
+        port=int(cfg["port"]), own_van=False)
+
+    def done():
+        states = [sup.svc.state_of(s) for s in range(sup.n_stages)]
+        present = [m for m in states
+                   if m.state in ("alive", "suspect")]
+        return bool((present and all(m.committed >= sup.steps - 1
+                                     for m in present)) or
+                    (not present and
+                     sup._committed_hw >= sup.steps - 1))
+
+    rc = drive_controller_harness(
+        sup.poll, lambda: sup._committed_hw, done,
+        deadline_s=float(cfg.get("deadline_s", 300.0)))
+    return 0 if rc is None else rc
 
 
 if __name__ == "__main__":
     import sys
+    if sys.argv[1] == "--controller":
+        sys.exit(controller_main(sys.argv[2]))
     sys.exit(stage_main(sys.argv[1]))

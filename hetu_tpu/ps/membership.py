@@ -35,7 +35,22 @@ Row layout (``MEMBER_DIM`` f32 fields per member slot)::
 
 The CONTROL row (slot ``n_slots``) is controller-written, member-read::
 
-    0 epoch  1 width  2 alive_mask  3 resume_step  4 phase  5.. unused
+    0 epoch  1 width  2 alive_mask  3 resume_step  4 phase
+    5 slow_slot  6 slow_ms  7 ctrl_inc (the incarnation FENCE)
+
+and the CONTROLLER row (slot ``n_slots + 1``) is the controller's OWN
+lease — the control plane stops being a single point of failure the
+moment the controller is just another leased member of the blackboard::
+
+    0 incarnation  1 beat  2 epoch  3 pid  4.. unused
+
+Controller incarnations are MONOTONIC fencing tokens (claim = read the
+row, write ``old + 1``), not random ids: a SIGSTOPped controller that
+wakes after a takeover holds a strictly smaller incarnation, so members
+(and the controller's own read-before-write checks) can reject its
+writes — the split-brain guard.  Members watch the controller beat the
+same way the controller watches theirs; silence past a bound means
+"park safely until a controller (any incarnation) beats again".
 
 ``phase`` makes epoch transitions two-phase (the freeze the
 multi-controller trainer needs): ``1`` = PREPARE — members stop taking
@@ -51,6 +66,7 @@ exactly the traffic that must survive a transiently overloaded van.
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import time
@@ -84,6 +100,19 @@ F_HEALTHY, F_COMMITTED, F_EPOCH_ACK, F_PID = 4, 5, 6, 7
 # would not survive the very link faults it injects
 C_EPOCH, C_WIDTH, C_MASK, C_RESUME, C_PHASE = 0, 1, 2, 3, 4
 C_SLOW_SLOT, C_SLOW_MS = 5, 6
+# the fence every control-row publish carries: members ignore a control
+# row whose incarnation is lower than the highest they have seen
+C_CTRL_INC = 7
+# controller row (slot n_slots + 1): the controller's own lease
+R_CINC, R_CBEAT, R_CEPOCH, R_CPID = 0, 1, 2, 3
+
+
+class ControllerFenced(RuntimeError):
+    """This controller's incarnation has been superseded: a NEWER
+    incarnation claimed the controller row (a takeover happened while
+    this process was suspended/partitioned).  Every control-plane write
+    path raises this instead of writing — a fenced zombie must stop,
+    loudly, without touching the fleet it no longer owns."""
 
 
 class MembershipWireError(TimeoutError):
@@ -163,11 +192,11 @@ def control_rpc(fn: Callable, *, attempts: int = 4, base_s: float = 0.05,
 
 def create_blackboard(host: str, port: int, *, table_id: int,
                       n_slots: int, connect_timeout_s: float = 10.0):
-    """Controller side: create (or re-attach to) the membership table.
-    ``n_slots`` member rows + 1 control row, zero-initialized; plain SGD
+    """Controller side: create the membership table.  ``n_slots`` member
+    rows + 1 control row + 1 controller row, zero-initialized; plain SGD
     so ``sparse_set`` writes rows verbatim."""
     from hetu_tpu.ps.van import RemotePSTable
-    return RemotePSTable(host, port, n_slots + 1, MEMBER_DIM,
+    return RemotePSTable(host, port, n_slots + 2, MEMBER_DIM,
                          table_id=table_id, create=True, init="zeros",
                          optimizer="sgd", lr=0.0,
                          connect_timeout_s=connect_timeout_s)
@@ -175,10 +204,11 @@ def create_blackboard(host: str, port: int, *, table_id: int,
 
 def attach_blackboard(host: str, port: int, *, table_id: int,
                       n_slots: int, connect_timeout_s: float = 10.0):
-    """Member side: attach to the controller-created table (no create —
-    a member racing the controller must fail loudly, not fork the id)."""
+    """Member (or takeover-controller) side: attach to an EXISTING
+    table (no create — a member racing the controller must fail loudly,
+    not fork the id; a takeover must adopt the rows, not zero them)."""
     from hetu_tpu.ps.van import RemotePSTable
-    return RemotePSTable(host, port, n_slots + 1, MEMBER_DIM,
+    return RemotePSTable(host, port, n_slots + 2, MEMBER_DIM,
                          table_id=table_id, create=False,
                          connect_timeout_s=connect_timeout_s)
 
@@ -188,10 +218,10 @@ class MembershipClient:
     heartbeat on a cadence; ``read_control`` returns the controller's
     decided ``(epoch, width, alive_mask, resume_step)``."""
 
-    def __init__(self, host: str, port: int, *, table_id: int, slot: int,
-                 n_slots: int, incarnation: Optional[int] = None,
+    def __init__(self, host: str = "", port: int = 0, *, table_id: int = 0,
+                 slot: int, n_slots: int, incarnation: Optional[int] = None,
                  connect_timeout_s: float = 10.0,
-                 rpc_deadline_s: float = 5.0):
+                 rpc_deadline_s: float = 5.0, table=None):
         if not 0 <= int(slot) < int(n_slots):
             raise ValueError(f"slot {slot} outside [0, {n_slots})")
         self.slot = int(slot)
@@ -205,15 +235,26 @@ class MembershipClient:
         # named — not stack backoff ladders into an unbounded hang
         self.link = f"member{self.slot}->van"
         self.rpc_deadline_s = float(rpc_deadline_s)
-        self._table = attach_blackboard(host, port, table_id=table_id,
-                                        n_slots=n_slots,
-                                        connect_timeout_s=connect_timeout_s)
-        self._rng = random.Random((self.incarnation, self.slot))
+        # `table` injects a pre-built table surface (tests); the normal
+        # path attaches over the van
+        self._table = table if table is not None else attach_blackboard(
+            host, port, table_id=table_id, n_slots=n_slots,
+            connect_timeout_s=connect_timeout_s)
+        self._rng = random.Random(self.incarnation * 1000003 + self.slot)
         # last-written workload fields: a later write that doesn't name a
         # field must NOT zero it (leave() clobbering `committed` would
         # erase the very progress record the controller reads post-exit)
         self._last = {"load": 0.0, "healthy": 1.0, "committed": 0.0,
                       "epoch_ack": 0.0}
+        # the member-side half of the controller lease: highest
+        # incarnation ever observed (the fence), its beat, and when the
+        # beat last ADVANCED (the silence clock `controller_silent`
+        # reads).  Updated by every read_control().
+        self.ctrl_inc = 0
+        self.ctrl_beat = -1
+        self._ctrl_advance: Optional[float] = None
+        self.stale_control_reads = 0
+        self._accepted_control = (0, 0, 0, 0, 0, -1, 0)
 
     def _bump_beat(self) -> None:
         # wrap WELL below 2**24: the row is f32, and a beat counter that
@@ -258,15 +299,51 @@ class MembershipClient:
     def read_control(self) -> tuple:
         """``(epoch, width, alive_mask, resume_step, phase, slow_slot,
         slow_ms)`` as ints — ``slow_slot`` is -1 when no straggler
-        injection is active."""
-        row = control_rpc(
-            lambda: self._table.sparse_pull([self.n_slots]), rng=self._rng,
-            op="read_control", link=self.link,
+        injection is active.
+
+        One pull fetches the control row AND the controller row: the
+        controller's lease (incarnation + beat) is tracked on this
+        client, and a control row carrying a LOWER incarnation than the
+        highest ever seen is a fenced zombie's write — ignored, the
+        last accepted control tuple returned instead (counted in
+        ``stale_control_reads``).  This member-side rejection is the
+        authoritative half of the fence: the zombie's own
+        read-before-write checks only narrow the race window."""
+        rows = control_rpc(
+            lambda: self._table.sparse_pull([self.n_slots,
+                                             self.n_slots + 1]),
+            rng=self._rng, op="read_control", link=self.link,
             deadline_s=self.rpc_deadline_s)
-        return (int(row[0, C_EPOCH]), int(row[0, C_WIDTH]),
-                int(row[0, C_MASK]), int(row[0, C_RESUME]),
-                int(row[0, C_PHASE]), int(row[0, C_SLOW_SLOT]),
-                int(row[0, C_SLOW_MS]))
+        crow = rows[1]
+        inc, beat = int(crow[R_CINC]), int(crow[R_CBEAT])
+        now = time.monotonic()
+        if inc > self.ctrl_inc:
+            self.ctrl_inc, self.ctrl_beat = inc, beat
+            self._ctrl_advance = now
+        elif inc == self.ctrl_inc and beat != self.ctrl_beat:
+            self.ctrl_beat = beat
+            self._ctrl_advance = now
+        row = rows[0]
+        ci = int(row[C_CTRL_INC])
+        if ci and ci < self.ctrl_inc:
+            self.stale_control_reads += 1
+            return self._accepted_control
+        out = (int(row[C_EPOCH]), int(row[C_WIDTH]),
+               int(row[C_MASK]), int(row[C_RESUME]),
+               int(row[C_PHASE]), int(row[C_SLOW_SLOT]),
+               int(row[C_SLOW_MS]))
+        self._accepted_control = out
+        return out
+
+    def controller_silent(self, bound_s: Optional[float]) -> bool:
+        """True when a controller has been observed AND its beat has
+        not advanced for ``bound_s`` (judged on this client's
+        ``read_control`` history — callers that never read cannot
+        detect silence).  ``bound_s`` None/<=0 disables."""
+        if not bound_s or bound_s <= 0 or self._ctrl_advance is None \
+                or self.ctrl_inc == 0:
+            return False
+        return time.monotonic() - self._ctrl_advance > float(bound_s)
 
     def close(self) -> None:
         self._table.close()
@@ -367,6 +444,102 @@ class MembershipService:
         self._blind_since: Optional[float] = None
         # straggler-injection plane, persisted across epoch publishes
         self._slow = (-1, 0)
+        # the controller's OWN lease: claiming bumps the stored
+        # incarnation (a monotonic fencing token — takeover = old + 1),
+        # and every poll beats the controller row so members can tell a
+        # live controller from a dead one
+        self.ctrl_incarnation = 0
+        self.ctrl_beat = 0
+        self.fenced = False
+        self.claim_controller()
+
+    # ---- the controller's own lease ----
+    def claim_controller(self) -> int:
+        """Claim (or take over) the controller row: the new incarnation
+        is the old one + 1 — strictly greater, so every write the OLD
+        incarnation attempts from here on is rejectable by comparison
+        alone.  Returns the claimed incarnation.
+
+        The claim is read-then-write (the van has no CAS op), so two
+        SIMULTANEOUS claimants could compute the same incarnation —
+        the write is therefore VERIFIED: re-read the row, and if the
+        pid on it is not ours, someone tied; re-claim one higher.
+        This converges (each retry strictly raises the incarnation,
+        and the last writer of a tie keeps it), narrowing the
+        split-brain window to sub-RPC scheduling — a true CAS is the
+        ROADMAP's residual."""
+        for _ in range(8):
+            row = control_rpc(
+                lambda: self.table.sparse_pull([self.n_slots + 1]),
+                rng=self._rng, op="controller_claim", link=self.link,
+                deadline_s=self.rpc_deadline_s)
+            self.ctrl_incarnation = max(int(row[0, R_CINC]) + 1,
+                                        self.ctrl_incarnation + 1)
+            self.ctrl_beat = 1
+            self._write_ctrl_row()
+            back = control_rpc(
+                lambda: self.table.sparse_pull([self.n_slots + 1]),
+                rng=self._rng, op="controller_claim_verify",
+                link=self.link, deadline_s=self.rpc_deadline_s)
+            if int(back[0, R_CINC]) == self.ctrl_incarnation and \
+                    int(back[0, R_CPID]) == os.getpid() % (1 << 24):
+                return self.ctrl_incarnation
+            time.sleep(self._rng.uniform(0.0, 0.05))
+        raise ControllerFenced(
+            "could not claim the controller row: persistent claim "
+            "contention (another controller keeps out-claiming us)")
+
+    def _write_ctrl_row(self) -> None:
+        row = np.zeros((1, MEMBER_DIM), np.float32)
+        row[0, R_CINC] = self.ctrl_incarnation
+        row[0, R_CBEAT] = self.ctrl_beat
+        row[0, R_CEPOCH] = self._published_epoch
+        row[0, R_CPID] = os.getpid() % (1 << 24)
+        control_rpc(
+            lambda: self.table.sparse_set([self.n_slots + 1], row),
+            rng=self._rng, op="controller_beat", link=self.link,
+            deadline_s=self.rpc_deadline_s)
+
+    def _check_fence(self, crow=None) -> None:
+        """Read-before-write fence: raise :class:`ControllerFenced` when
+        a HIGHER incarnation owns the controller row.  ``crow`` reuses a
+        row already pulled this sweep; otherwise a fresh pull is made
+        (best-effort — an unreadable row skips the check, because the
+        member-side incarnation comparison is the authoritative fence
+        and refusing to publish on a transient pull failure would turn
+        every van hiccup into a false fencing)."""
+        if self.fenced:
+            raise ControllerFenced(
+                f"controller incarnation {self.ctrl_incarnation} was "
+                f"superseded (previously observed a newer claim)")
+        if crow is None:
+            try:
+                crow = control_rpc(
+                    lambda: self.table.sparse_pull([self.n_slots + 1]),
+                    rng=self._rng, op="controller_fence_check",
+                    link=self.link, deadline_s=self.rpc_deadline_s)[0]
+            except MembershipWireError:
+                return
+        observed = int(crow[R_CINC])
+        if observed > self.ctrl_incarnation:
+            self.fenced = True
+            raise ControllerFenced(
+                f"controller incarnation {self.ctrl_incarnation} fenced "
+                f"by {observed}: a takeover happened — stop writing")
+
+    def read_control_row(self) -> dict:
+        """The last published control row, as the takeover path adopts
+        it (epoch/width/mask/resume/phase + the straggler fields)."""
+        row = control_rpc(
+            lambda: self.table.sparse_pull([self.n_slots]),
+            rng=self._rng, op="read_control_row", link=self.link,
+            deadline_s=self.rpc_deadline_s)[0]
+        return dict(epoch=int(row[C_EPOCH]), width=int(row[C_WIDTH]),
+                    alive_mask=int(row[C_MASK]),
+                    resume_step=int(row[C_RESUME]),
+                    phase=int(row[C_PHASE]),
+                    slow_slot=int(row[C_SLOW_SLOT]),
+                    slow_ms=int(row[C_SLOW_MS]))
 
     # ---- controller → members ----
     def publish_control(self, *, epoch: int, width: int, alive_mask: int,
@@ -376,7 +549,13 @@ class MembershipService:
         """Write the control row.  ``slow_slot``/``slow_ms`` (the
         straggler-injection fields) default to whatever was last
         published — an epoch transition must not silently heal an
-        injected slow link."""
+        injected slow link.
+
+        Every publish is FENCED: it carries this controller's
+        incarnation in ``C_CTRL_INC`` (members reject lower ones) and
+        is preceded by a read-before-write check of the controller row
+        (raises :class:`ControllerFenced` when superseded)."""
+        self._check_fence()
         if slow_slot is not None or slow_ms is not None:
             self._slow = (int(self._slow[0] if slow_slot is None
                               else slow_slot),
@@ -390,6 +569,7 @@ class MembershipService:
         row[0, C_PHASE] = int(phase)
         row[0, C_SLOW_SLOT] = self._slow[0]
         row[0, C_SLOW_MS] = self._slow[1]
+        row[0, C_CTRL_INC] = self.ctrl_incarnation
         self._last_control = dict(epoch=int(epoch), width=int(width),
                                   alive_mask=int(alive_mask),
                                   resume_step=int(resume_step),
@@ -403,6 +583,14 @@ class MembershipService:
         control_rpc(lambda: self.table.sparse_set([self.n_slots], row),
                     rng=self._rng, op="publish_control", link=self.link,
                     deadline_s=self.rpc_deadline_s)
+
+    def adopt_slow(self, slot: int, ms: int) -> None:
+        """Takeover path: seed the straggler-injection fields from the
+        PREDECESSOR's control row before the first republish — an
+        epoch transition (including the takeover's own re-freeze) must
+        not silently heal an injected slow link.  No write happens
+        here; the next :meth:`publish_control` carries the values."""
+        self._slow = (int(slot), int(ms))
 
     def set_slow(self, slot: int, ms: int) -> None:
         """Flip ONLY the straggler-injection fields, re-publishing the
@@ -431,11 +619,23 @@ class MembershipService:
         never grieve a healthy, heartbeating member."""
         try:
             rows = control_rpc(
-                lambda: self.table.sparse_pull(list(range(self.n_slots))),
+                lambda: self.table.sparse_pull(
+                    list(range(self.n_slots)) + [self.n_slots + 1]),
                 rng=self._rng, op="membership_poll", link=self.link,
                 deadline_s=self.rpc_deadline_s)
         except MembershipWireError:
             return self._probe_failed()
+        # the controller row rode the same pull: fence-check (a zombie
+        # waking after a takeover dies HERE, before acting on anything
+        # it read), then beat — the poll cadence IS the controller's
+        # heartbeat cadence, so members' silence clocks track exactly
+        # how live the lease machine is
+        self._check_fence(rows[self.n_slots])
+        self.ctrl_beat = (self.ctrl_beat + 1) % (1 << 20)
+        try:
+            self._write_ctrl_row()
+        except MembershipWireError:
+            pass  # a transiently unreachable van: the next poll beats
         now = time.monotonic()
         events = []
         if self._blind_since is not None:
@@ -578,6 +778,40 @@ class MembershipService:
         return [m.slot for m in self.members
                 if m.state in ("alive", "suspect")]
 
+    def member_pids(self) -> dict:
+        """slot → advertised OS pid for every present member.  After a
+        controller takeover these processes are the DEAD controller's
+        children — the pid off the lease row is the only handle the
+        successor's close()/replace paths have on them.  Debugging
+        grade by design: never consulted for liveness (the beat is),
+        only for delivering signals to an adopted fleet."""
+        return {m.slot: int(m.row[F_PID]) for m in self.members
+                if m.state in ("alive", "suspect") and int(m.row[F_PID])}
+
+    def wait_present(self, timeout_s: float, *, poll=None) -> bool:
+        """Poll until at least one member is present or ``timeout_s``
+        elapses; returns whether anyone is present.  The ONE adoption
+        wait every takeover plane shares — and a fleet that FINISHED
+        and left cleanly (flag=0) will never be present again: every
+        slot ``left``/``empty`` (at least one ``left``) breaks
+        immediately rather than stalling the takeover of a completed
+        run for the whole spawn budget.
+
+        ``poll`` substitutes the caller's event-processing sweep (the
+        serving pool folds membership events into failover/quarantine
+        state; dropping them here would skip that bookkeeping)."""
+        poll = self.poll if poll is None else poll
+        deadline = time.monotonic() + float(timeout_s)
+        while not self.present_slots() and time.monotonic() < deadline:
+            poll()
+            if not self.present_slots() and \
+                    any(m.state == "left" for m in self.members) and \
+                    all(m.state in ("left", "empty")
+                        for m in self.members):
+                break
+            time.sleep(0.05)
+        return bool(self.present_slots())
+
     def state_of(self, slot: int) -> MemberState:
         return self.members[int(slot)]
 
@@ -591,3 +825,164 @@ class MembershipService:
     @staticmethod
     def slots_of(mask: int) -> list:
         return [i for i in range(24) if int(mask) & (1 << i)]
+
+
+# ---------------------------------------------------------------------------
+# controller ledger: durable controller state on the van
+# ---------------------------------------------------------------------------
+
+# header magic, < 2**24 so it is exact in f32
+LEDGER_MAGIC = 0xBEEF42
+# header row fields: [magic, nbytes, version, ctrl_inc]
+L_MAGIC, L_NBYTES, L_VERSION, L_CINC = 0, 1, 2, 3
+
+
+class ControllerLedger:
+    """A small controller-state blob journaled to a PS table on the van.
+
+    Everything a controller holds ONLY in RAM that cannot be re-derived
+    from lease rows / the control row / member-side records (the serving
+    plane's rid→member ownership, retry budgets, half-open drains) is
+    written here as one JSON snapshot per state change, so a takeover
+    reads blackboard + ledger and owns the fleet.  Why a PS table and
+    not a blob channel: blob channels are single-slot acked queues — an
+    unread put blocks the writer, and the ledger's reader is by
+    definition not there until the writer is dead.
+
+    Encoding: JSON bytes packed TWO per f32 as u16 values (0..65535 —
+    exact in f32; storing raw f32 bit patterns would let the wire's NaN
+    quieting silently corrupt arbitrary bytes).  Header row carries
+    ``[magic, nbytes, version, ctrl_inc]``; header + payload go down in
+    ONE ``sparse_set`` frame, so a write is atomic on the van server
+    and a reader never sees a torn snapshot.
+
+    Writes are FENCED like every other controller write: the header's
+    recorded incarnation is read first, and a lower-incarnation writer
+    raises :class:`ControllerFenced` instead of clobbering its
+    successor's ledger.
+    """
+
+    def __init__(self, host: str = "", port: int = 0, *, table_id: int = 0,
+                 rows: int = 1024, dim: int = 32, create: bool = True,
+                 connect_timeout_s: float = 10.0,
+                 rpc_deadline_s: float = 5.0, table=None):
+        self.rows, self.dim = int(rows), int(dim)
+        if table is not None:
+            self._table = table
+        else:
+            from hetu_tpu.ps.van import RemotePSTable
+            self._table = RemotePSTable(
+                host, port, self.rows, self.dim, table_id=int(table_id),
+                create=create, init="zeros", optimizer="sgd", lr=0.0,
+                connect_timeout_s=connect_timeout_s)
+        self.version = 0
+        self.rpc_deadline_s = float(rpc_deadline_s)
+        self._rng = random.Random(0x4C4544)
+        # the write fence is READ-cached: the member-side incarnation
+        # comparison is the authoritative fence (see read_control) and
+        # a zombie's poll fences it within one poll period anyway, so
+        # paying a header pull on EVERY hot-path journal write buys
+        # only a narrower race window — re-read at most this often
+        self.fence_cache_s = 0.25
+        self._fence_read_at: Optional[float] = None
+        self._fenced_by = 0
+
+    def _rpc(self, fn, op: str):
+        """Ledger wire ops ride the same bounded-retry wrapper as every
+        other control-plane RPC: one transient van hiccup must cost a
+        retry, not a refused accept (submit treats a journal failure as
+        refuse-the-accept — correctly, but only for REAL failures)."""
+        return control_rpc(fn, rng=self._rng, op=op, link="ledger->van",
+                           deadline_s=self.rpc_deadline_s)
+
+    def capacity_bytes(self) -> int:
+        return (self.rows - 1) * self.dim * 2
+
+    def write(self, state: dict, *, ctrl_inc: int) -> int:
+        """Journal one full snapshot; returns the new version."""
+        data = json.dumps(state, separators=(",", ":")).encode()
+        if len(data) > self.capacity_bytes():
+            raise ValueError(
+                f"ledger snapshot {len(data)}B exceeds table capacity "
+                f"{self.capacity_bytes()}B — prune resolved entries or "
+                f"size the ledger up")
+        now = time.monotonic()
+        if self._fenced_by > int(ctrl_inc):
+            raise ControllerFenced(
+                f"ledger owned by incarnation {self._fenced_by} > "
+                f"{int(ctrl_inc)}: a takeover happened — stop writing")
+        if self._fence_read_at is None or \
+                now - self._fence_read_at >= self.fence_cache_s:
+            head = self._rpc(lambda: self._table.sparse_pull([0]),
+                             "ledger_fence_read")
+            self._fence_read_at = now
+            if int(head[0, L_MAGIC]) == LEDGER_MAGIC:
+                self._fenced_by = max(self._fenced_by,
+                                      int(head[0, L_CINC]))
+                self.version = max(self.version,
+                                   int(head[0, L_VERSION]))
+            if self._fenced_by > int(ctrl_inc):
+                raise ControllerFenced(
+                    f"ledger owned by incarnation {self._fenced_by} > "
+                    f"{int(ctrl_inc)}: a takeover happened — stop "
+                    f"writing")
+        version = self.version + 1
+        pad = data + b"\x00" * (len(data) % 2)
+        u16 = np.frombuffer(pad, np.uint16).astype(np.float32)
+        n_payload = -(-u16.size // self.dim) if u16.size else 0
+        frame = np.zeros((1 + n_payload, self.dim), np.float32)
+        frame[0, L_MAGIC] = LEDGER_MAGIC
+        frame[0, L_NBYTES] = len(data)
+        frame[0, L_VERSION] = version
+        frame[0, L_CINC] = int(ctrl_inc)
+        if n_payload:
+            frame[1:].reshape(-1)[:u16.size] = u16
+        self._rpc(lambda: self._table.sparse_set(
+            np.arange(1 + n_payload), frame), "ledger_write")
+        # the highest incarnation EVER seen through this handle also
+        # fences (no RPC): a lower-incarnation write through the same
+        # (or a later-reading) handle is refused instantly, and the
+        # cache above only bounds the cross-process zombie window
+        self._fenced_by = max(self._fenced_by, int(ctrl_inc))
+        self.version = version
+        return version
+
+    def read(self) -> Optional[dict]:
+        """Latest snapshot as ``{"state", "version", "ctrl_inc"}``, or
+        None when nothing was ever journaled."""
+        last = None
+        for _ in range(3):  # header+payload are two pulls; a concurrent
+            # write between them decodes garbage — retry, it converges
+            try:
+                head = self._rpc(lambda: self._table.sparse_pull([0]),
+                                 "ledger_read")
+                if int(head[0, L_MAGIC]) != LEDGER_MAGIC:
+                    return None
+                nbytes = int(head[0, L_NBYTES])
+                n_u16 = (nbytes + 1) // 2
+                n_payload = -(-n_u16 // self.dim) if n_u16 else 0
+                if n_payload:
+                    rows = self._rpc(
+                        lambda: self._table.sparse_pull(
+                            np.arange(1, 1 + n_payload)),
+                        "ledger_read_payload")
+                    data = rows.reshape(-1)[:n_u16].astype(
+                        np.uint16).tobytes()[:nbytes]
+                else:
+                    data = b""
+                out = {"state": json.loads(data) if data else {},
+                       "version": int(head[0, L_VERSION]),
+                       "ctrl_inc": int(head[0, L_CINC])}
+                self.version = out["version"]
+                self._fenced_by = max(self._fenced_by,
+                                      out["ctrl_inc"])
+                return out
+            except ValueError as e:
+                last = e
+                time.sleep(0.02)
+        raise RuntimeError(f"ledger snapshot would not decode: {last!r}")
+
+    def close(self) -> None:
+        close = getattr(self._table, "close", None)
+        if close is not None:
+            close()

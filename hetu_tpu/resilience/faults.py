@@ -65,6 +65,15 @@ Fault kinds
                    the lockstep schedule must tolerate
                    (``train.straggler``, wait policy only: a stage is
                    not redundant)
+``controller_kill``  SIGKILL the CONTROLLER process ``arg`` — the
+                   control plane itself is the fault domain: members
+                   park/queue, a new incarnation takes over from the
+                   blackboard + ledger (``ctrl.takeover``), and the
+                   fleet finishes token-exact / byte-identical
+``controller_suspend``  SIGSTOP controller ``arg`` for ``arg2``
+                   seconds, then SIGCONT — the ZOMBIE case: a takeover
+                   during the pause must fence the resumed controller
+                   (its writes rejected, fleet state unchanged)
 
 The van hooks ride :func:`hetu_tpu.ps.van.set_fault_hook` (one-shot
 faults) and :func:`hetu_tpu.ps.van.set_netem_hook` (link policies);
@@ -106,7 +115,8 @@ KINDS = ("van_error", "van_delay", "data_error", "nan_grad",
          "serve_preempt", "serve_engine_kill",
          "member_kill", "member_suspend", "worker_proc_kill",
          "netem_partition", "netem_degrade", "straggler",
-         "stage_kill", "stage_slow")
+         "stage_kill", "stage_slow",
+         "controller_kill", "controller_suspend")
 
 
 @dataclass(frozen=True, order=True)
@@ -162,7 +172,11 @@ class FaultSchedule:
                  straggler_s: float = 1.0,
                  stage_kills: int = 0, stage_slows: int = 0,
                  stage_slow_s: float = 1.0,
-                 n_stages: int = 1) -> "FaultSchedule":
+                 n_stages: int = 1,
+                 controller_kills: int = 0,
+                 controller_suspends: int = 0,
+                 controller_suspend_s: float = 1.0,
+                 n_controllers: int = 1) -> "FaultSchedule":
         """Draw a schedule over training steps ``[1, steps)`` from ``seed``.
 
         Counts are clipped to the available steps.  Shard-targeted faults
@@ -208,6 +222,13 @@ class FaultSchedule:
         ``stage_slow_s`` seconds — victims uniform from ``n_stages``,
         drawn after EVERY kind above (fourth extension of the
         frozen-bytes contract).
+
+        Control-plane faults (the controller is just another fault
+        domain): ``controller_kills`` SIGKILL a controller process,
+        ``controller_suspends`` SIGSTOP one for
+        ``controller_suspend_s`` seconds (the zombie-fencing path) —
+        victims uniform from ``n_controllers``, drawn after EVERY kind
+        above (FIFTH extension of the frozen-bytes contract).
         """
         rng = np.random.default_rng(seed)
         hi = max(int(steps), 2)
@@ -312,6 +333,17 @@ class FaultSchedule:
                                      float(rng.integers(max(n_stages,
                                                             1))),
                                      float(stage_slow_s)))
+        # control-plane kinds: drawn after everything above — the same
+        # frozen-bytes guarantee every earlier extension honored
+        for s in pick(controller_kills):
+            events.append(FaultEvent(s, "controller_kill",
+                                     float(rng.integers(
+                                         max(n_controllers, 1)))))
+        for s in pick(controller_suspends):
+            events.append(FaultEvent(s, "controller_suspend",
+                                     float(rng.integers(
+                                         max(n_controllers, 1))),
+                                     float(controller_suspend_s)))
         return cls(events)
 
     def at(self, step: int) -> list[FaultEvent]:
@@ -354,7 +386,7 @@ class FaultInjector:
 
     def __init__(self, schedule: FaultSchedule, *, shard_procs=(),
                  member_procs=None, worker_procs=None, stage_procs=None,
-                 pid: int | None = None):
+                 ctrl_procs=None, pid: int | None = None):
         self.schedule = schedule
         self.shard_procs = list(shard_procs)  # subprocess.Popen-likes
         # LIVE references (not copies): the cross-process pool /
@@ -363,6 +395,7 @@ class FaultInjector:
         self.member_procs = member_procs if member_procs is not None else []
         self.worker_procs = worker_procs if worker_procs is not None else []
         self.stage_procs = stage_procs if stage_procs is not None else []
+        self.ctrl_procs = ctrl_procs if ctrl_procs is not None else []
         self.pid = int(pid) if pid is not None else os.getpid()
         self.counters = defaultdict(int)
         self._armed_van = deque()   # one-shot ("error"|"delay", arg)
@@ -470,6 +503,13 @@ class FaultInjector:
             elif k == "stage_kill":
                 self._proc_kill(self.stage_procs, int(ev.arg),
                                 "stage_procs_killed")
+            elif k == "controller_kill":
+                self._proc_kill(self.ctrl_procs, int(ev.arg),
+                                "controller_procs_killed")
+            elif k == "controller_suspend":
+                self._proc_suspend(self.ctrl_procs, int(ev.arg),
+                                   ev.arg2 or 1.0,
+                                   "controller_procs_suspended")
             elif k == "stage_slow":
                 self.counters["stage_slows_injected"] += 1
                 with self._lock:

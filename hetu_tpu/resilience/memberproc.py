@@ -116,6 +116,48 @@ class ControlPlaneMember:
                     pass
             self._bars = None
 
+    def _park_if_headless(self) -> bool:
+        """Freeze at this step boundary while the CONTROLLER is silent
+        (its blackboard beat stopped for ``spec.ctrl_lease_s``): a
+        headless fleet must not race a takeover's re-freeze mid-step.
+        Parking is pure waiting — the beat thread keeps heartbeating
+        and the member's own lease stays live.
+
+        Unparking is incarnation-aware: beats from the SAME incarnation
+        mean the controller never died (a GC pause, a slow poll) —
+        nothing was missed, continue immediately.  Beats from a NEW
+        incarnation mean a takeover is in progress: hold until its
+        republish (a new epoch, or a PREPARE) lands, so the takeover's
+        freeze can never interleave with a half-run step — the one
+        ordering that would turn a clean controller death into an
+        at-least-once gradient (weight-byte-identity cannot absorb a
+        post-push discard).  Returns True when a park happened (the
+        caller re-reads the control row and continues).  Disabled when
+        ``spec.ctrl_lease_s`` is 0/absent."""
+        bound = float(getattr(self.spec, "ctrl_lease_s", 0.0) or 0.0)
+        if bound <= 0.0 or not self.member.controller_silent(bound):
+            return False
+        self.parks = getattr(self, "parks", 0) + 1
+        parked_inc = self.member.ctrl_inc
+        parked_epoch = self.epoch
+        while not self._stop.is_set():
+            try:
+                ctl = self.member.read_control()
+            except Exception:
+                ctl = None  # an unreachable van parks too; the beat
+                # thread keeps trying — silence is judged on beats
+            if ctl is None or self.member.controller_silent(bound):
+                self._stop.wait(0.05)
+                continue
+            if self.member.ctrl_inc == parked_inc:
+                break  # the same controller resumed: no takeover, no
+                # republish coming — just continue
+            if ctl[0] != parked_epoch or ctl[4] != 0:
+                break  # the takeover's republish landed: the next
+                # control read freezes/acks it at this boundary
+            self._stop.wait(0.02)
+        return True
+
     def _check_epoch(self) -> None:
         """Raise :class:`EpochChanged` when the controller moved the
         membership (new epoch OR a prepare freeze) — the in-flight step
@@ -140,3 +182,53 @@ class ControlPlaneMember:
         self._close_barriers()
         self.member.close()
         self.netem.uninstall()
+
+
+def drive_controller_harness(poll, progress, done, *,
+                             deadline_s: float,
+                             on_progress=None) -> int:
+    """The ONE copy of the spawned-controller chaos-harness drive loop
+    (the controller half of this module's member protocol).  Both
+    training planes' ``--controller`` entry points delegate here, so
+    the marker contract the chaos tests key on cannot drift between
+    them: ``READY`` once the caller's supervisor is built (the spawn
+    handshake), ``STEP <p>`` per ``progress()`` change, ``DEADLINE``
+    (rc 2) when
+    the fleet never finishes inside ``deadline_s`` — an ``ALLDONE``
+    there would mask the hang as completion — ``ALLDONE`` then hold
+    (the harness kills us, or we get fenced), and ``FENCED`` (rc 3)
+    on :class:`~hetu_tpu.ps.membership.ControllerFenced` WITHOUT any
+    fleet teardown: a fenced zombie's close() would kill member
+    processes the new incarnation now owns.
+
+    ``on_progress(p)`` is the per-plane edge hook (e.g. the elastic
+    harness's publish-PREPARE-then-hang mode); it may never return.
+    """
+    from hetu_tpu.ps import membership as _mb
+    print("READY", flush=True)
+    deadline = time.monotonic() + float(deadline_s)
+    last = object()
+    finished = False
+    try:
+        while time.monotonic() < deadline:
+            poll()
+            p = progress()
+            if p != last:
+                last = p
+                print(f"STEP {p}", flush=True)
+            if on_progress is not None:
+                on_progress(p)
+            if done():
+                finished = True
+                break
+            time.sleep(0.03)
+        if not finished:
+            print("DEADLINE", flush=True)
+            return 2
+        print("ALLDONE", flush=True)
+        while True:
+            poll()
+            time.sleep(0.05)
+    except _mb.ControllerFenced:
+        print("FENCED", flush=True)
+        return 3

@@ -62,6 +62,8 @@ incarnation and is re-admitted and re-placed the same way.
 from __future__ import annotations
 
 import json
+import os
+import signal as _signal
 import time
 import traceback
 import zlib
@@ -74,6 +76,7 @@ import numpy as np
 from hetu_tpu.ps import membership as _mb
 from hetu_tpu.resilience.memberproc import (
     ControlPlaneMember, EpochChanged as _EpochChanged,
+    drive_controller_harness,
 )
 from hetu_tpu.telemetry import trace
 
@@ -108,6 +111,11 @@ class WorkerSpec:
     # (and the bench's detect/recover timing) pace the fleet so faults
     # land INSIDE a run, not after it finished
     step_sleep_s: float = 0.0
+    # park when the CONTROLLER's blackboard beat is silent this long
+    # (0 disables): a headless fleet freezes at its next step boundary
+    # and resumes on the first beat from ANY controller incarnation —
+    # the member half of fenced control-plane takeover
+    ctrl_lease_s: float = 0.0
     log_path: str = ""
 
     def to_json(self) -> str:
@@ -183,6 +191,9 @@ class WorkerProcess(ControlPlaneMember):
             e, width, mask, resume, phase, slow_slot, slow_ms = \
                 self.member.read_control()
             self._apply_slow(slow_slot, slow_ms)
+            if self._park_if_headless():
+                continue  # controller silent: frozen at this boundary
+                # until a (possibly new-incarnation) controller beats
             if e == 0:
                 if self._stop.wait(0.05):
                     break
@@ -409,14 +420,18 @@ class MultiControllerElasticSupervisor:
                  n_samples: int = 256, data_seed: int = 0,
                  lr: float = 0.05, hb_ms: int = 80,
                  lease_s: float = 0.6, suspect_grace_s: float = 0.4,
+                 deaf_ack_s: Optional[float] = None,
                  min_width: int = 1, port: int = 0,
+                 own_van: bool = True,
                  step_sleep_s: float = 0.0,
+                 ctrl_lease_s: float = 0.0,
                  injector=None, spawn_timeout_s: float = 120.0,
                  straggler_factor: float = 4.0,
                  straggler_policy: str = "wait",
                  straggler_evict_after: int = 3,
                  straggler_slow_ms: int = 120,
-                 straggler_readmit_after: int = 3):
+                 straggler_readmit_after: int = 3,
+                 _takeover_spec: Optional[WorkerSpec] = None):
         from hetu_tpu.ps import van
         if n_workers < 1:
             raise ValueError("need at least one worker")
@@ -426,7 +441,17 @@ class MultiControllerElasticSupervisor:
                     f"global batch {global_batch} must divide by every "
                     f"reachable width (fails at {w})")
         self._van = van
-        self.port = van.serve(port)
+        self._own_van = bool(own_van)
+        if own_van:
+            self.port = van.serve(port)
+        else:
+            # attach to an EXTERNAL van process (the durable tier the
+            # ROADMAP's controller-failover story needs: a controller
+            # crash must not take the blackboard and the model with it)
+            if not port:
+                raise ValueError("own_van=False needs the running "
+                                 "van's port")
+            self.port = int(port)
         self.workdir = Path(workdir)
         self.steps = int(steps)
         self.n_workers = int(n_workers)
@@ -461,14 +486,53 @@ class MultiControllerElasticSupervisor:
         # eviction (readmit_straggler).  0 disables — eviction then
         # stays operator-lifted only.
         self.straggler_readmit_after = int(straggler_readmit_after)
-        from hetu_tpu.resilience.straggler import StragglerDetector
-        self._detector = StragglerDetector(
-            factor=self.straggler_factor, subject="worker",
-            policy=straggler_policy,
-            evict_after=self.straggler_evict_after)
         self._evicted: set = set()
         self._probation: dict = {}         # slot -> {"beat", "ok"}
-        self._slow_heal_at: Optional[float] = None
+        self.procs: list = [None] * n_workers
+        self._member_pids: dict = {}    # takeover-adopted pids (no Popen)
+        self._fired_through = 0
+        from hetu_tpu.resilience.straggler import SupervisorStragglerPlane
+        if _takeover_spec is not None:
+            # ---- takeover: adopt a running fleet whose controller
+            # died.  Everything the old controller held in RAM is
+            # re-derived from what survives on the van: the control row
+            # (epoch / mask / resume / a half-open PREPARE), the lease
+            # rows (who is alive, frozen committed progress), and the
+            # spawn configs on disk (every table id).  The fleet is
+            # parked (ctrl_lease_s) or frozen (phase=1); the republish
+            # below un-parks it with an EXACT resume.
+            self.spec = WorkerSpec(**{**asdict(_takeover_spec),
+                                      "slot": -1, "log_path": ""})
+            # the whole attach sequence is guarded: a blackboard/claim
+            # failure after the weights table connected must close it,
+            # not leak the van connection for the process's life
+            try:
+                self.table = van.RemotePSTable(
+                    "127.0.0.1", self.port, int(features), int(out_dim),
+                    table_id=self.spec.weights_table, create=False)
+                self._bb = _mb.attach_blackboard(
+                    "127.0.0.1", self.port,
+                    table_id=self.spec.membership_table,
+                    n_slots=n_workers)
+                self.svc = _mb.MembershipService(
+                    self._bb, n_workers, lease_s=lease_s,
+                    suspect_grace_s=suspect_grace_s,
+                    deaf_ack_s=deaf_ack_s)
+                self._stragglers = SupervisorStragglerPlane(
+                    self.svc, factor=self.straggler_factor,
+                    subject="worker", policy=straggler_policy,
+                    evict_after=self.straggler_evict_after,
+                    slow_ms=self.straggler_slow_ms)
+                self.log_paths = sorted(
+                    str(p) for p in self.workdir.glob("worker_*_*.jsonl"))
+                self._incarnations = len(
+                    list(self.workdir.glob("worker_*_*.json")))
+                self._adopt()
+            except Exception:
+                self.close()
+                raise
+            return
+        # ---- normal bring-up ----
         # fresh table/barrier ids per supervisor: the native table and
         # barrier registries outlive van.stop(), so fixed ids would leak
         # state between two fleets built in one process (tests, benches)
@@ -482,20 +546,27 @@ class MultiControllerElasticSupervisor:
             data_seed=int(data_seed), lr=float(lr), hb_ms=int(hb_ms),
             membership_table=membership_table,
             weights_table=weights_table, barrier_base=barrier_base,
-            step_sleep_s=float(step_sleep_s))
-        self.table = van.RemotePSTable(
-            "127.0.0.1", self.port, int(features), int(out_dim),
-            table_id=weights_table, create=True, init="zeros",
-            optimizer="sgd", lr=float(lr))
-        self._bb = _mb.create_blackboard(
-            "127.0.0.1", self.port,
-            table_id=membership_table, n_slots=n_workers)
-        self.svc = _mb.MembershipService(self._bb, n_workers,
-                                         lease_s=lease_s,
-                                         suspect_grace_s=suspect_grace_s)
-        self.procs: list = [None] * n_workers
-        self._fired_through = 0
+            step_sleep_s=float(step_sleep_s),
+            ctrl_lease_s=float(ctrl_lease_s))
+        # everything after van.serve is guarded: a table/blackboard/
+        # spawn failure must stop the in-process van server (and close
+        # what was created) instead of leaking it for the process's life
         try:
+            self.table = van.RemotePSTable(
+                "127.0.0.1", self.port, int(features), int(out_dim),
+                table_id=weights_table, create=True, init="zeros",
+                optimizer="sgd", lr=float(lr))
+            self._bb = _mb.create_blackboard(
+                "127.0.0.1", self.port,
+                table_id=membership_table, n_slots=n_workers)
+            self.svc = _mb.MembershipService(
+                self._bb, n_workers, lease_s=lease_s,
+                suspect_grace_s=suspect_grace_s, deaf_ack_s=deaf_ack_s)
+            self._stragglers = SupervisorStragglerPlane(
+                self.svc, factor=self.straggler_factor, subject="worker",
+                policy=straggler_policy,
+                evict_after=self.straggler_evict_after,
+                slow_ms=self.straggler_slow_ms)
             for slot in range(n_workers):
                 self._spawn(slot)
             self._wait_joined(range(n_workers))
@@ -505,6 +576,83 @@ class MultiControllerElasticSupervisor:
         # epoch numbering starts at 1: a zeroed control row must not
         # read as a published membership
         self._publish(kind=None)
+
+    @classmethod
+    def takeover(cls, *, workdir, port, lease_s: float = 0.6,
+                 suspect_grace_s: float = 0.4,
+                 deaf_ack_s: Optional[float] = None, min_width: int = 1,
+                 spawn_timeout_s: float = 120.0, injector=None,
+                 **straggler_kw) -> "MultiControllerElasticSupervisor":
+        """Become the fleet's NEW controller after the old one died:
+        re-derive the supervisor from the worker spawn configs under
+        ``workdir`` and the still-running van at ``port``, claim the
+        controller row with a higher incarnation, and republish the
+        frozen membership with an exact resume (a two-phase re-freeze)
+        under a ``ctrl.takeover`` span.  The killed-mid-PREPARE case is
+        covered by construction: the fresh epoch supersedes the
+        half-open one and collects fresh frozen acks."""
+        cfgs = sorted(Path(workdir).glob("worker_*_*.json"),
+                      key=lambda p: p.stat().st_mtime)
+        if not cfgs:
+            raise FileNotFoundError(
+                f"no worker spawn configs under {workdir}")
+        spec = WorkerSpec.from_json(cfgs[-1].read_text())
+        return cls(spec.n_slots, workdir=workdir, steps=spec.steps,
+                   global_batch=spec.global_batch,
+                   features=spec.features, out_dim=spec.out_dim,
+                   n_samples=spec.n_samples, data_seed=spec.data_seed,
+                   lr=spec.lr, hb_ms=spec.hb_ms, lease_s=lease_s,
+                   suspect_grace_s=suspect_grace_s,
+                   deaf_ack_s=deaf_ack_s, min_width=min_width,
+                   port=port, own_van=False,
+                   step_sleep_s=spec.step_sleep_s,
+                   ctrl_lease_s=spec.ctrl_lease_s, injector=injector,
+                   spawn_timeout_s=spawn_timeout_s,
+                   _takeover_spec=spec, **straggler_kw)
+
+    def _adopt(self) -> None:
+        """Adopt the fleet: republish the frozen epoch under the new
+        incarnation.  Every piece of the old controller's RAM is
+        re-derived — epoch and resume from the control row, the evicted
+        set from (alive lease rows) minus (published mask), the
+        committed high-water from the frozen progress rows."""
+        ctrl = self.svc.read_control_row()
+        self.epoch = int(ctrl["epoch"])
+        self.resume_step = int(ctrl["resume_step"])
+        # carry the predecessor's straggler injection forward: the
+        # takeover republish must not silently heal an injected slow
+        # link (the same rule every epoch transition honors)
+        self.svc.adopt_slow(ctrl["slow_slot"], ctrl["slow_ms"])
+        # learn who is beating before judging anything
+        self.svc.wait_present(self._spawn_timeout_s)
+        # worker pids off the lease rows: these processes are the DEAD
+        # controller's children — the pid is the only handle
+        # close()/spawn_replacement have on them
+        self._member_pids.update(self.svc.member_pids())
+        if self.epoch > 0:
+            mask_slots = set(_mb.MembershipService.slots_of(
+                int(ctrl["alive_mask"])))
+            self._evicted = {s for s in self.svc.present_slots()
+                             if s not in mask_slots}
+        with trace.span("ctrl.takeover", cat="ctrl") as sp:
+            sp.set("plane", "elastic")
+            sp.set("incarnation", self.svc.ctrl_incarnation)
+            sp.set("epoch_adopted", self.epoch)
+            sp.set("phase_at_death", int(ctrl["phase"]))
+            if self._present():
+                # the two-phase re-freeze: exact resume from fresh
+                # frozen acks — this is also what finishes an epoch the
+                # old controller died inside (phase=1 half-open)
+                t0 = time.perf_counter()
+                self._publish(kind="takeover", t0=t0)
+            sp.set("epoch", self.epoch)
+            sp.set("resume_step", self.resume_step)
+        self.takeover_report = {
+            "incarnation": self.svc.ctrl_incarnation,
+            "epoch": self.epoch, "resume_step": self.resume_step,
+            "evicted": sorted(self._evicted),
+            "present": sorted(self.svc.present_slots()),
+        }
 
     # ---- spawning ----
     def _spawn(self, slot: int) -> None:
@@ -625,12 +773,9 @@ class MultiControllerElasticSupervisor:
             for _, idx, dur in self.injector.pop_net_events(
                     kinds=("straggler",)):
                 self.inject_straggler(int(idx) % self.n_workers, dur)
-        if self._slow_heal_at is not None and \
-                time.monotonic() >= self._slow_heal_at:
-            # the heal runs HERE, serialized with every other control-
-            # row write (see inject_straggler)
-            self._slow_heal_at = None
-            self.svc.set_slow(-1, 0)
+        # the heal runs HERE, serialized with every other control-row
+        # write (see SupervisorStragglerPlane)
+        self._stragglers.maybe_heal()
         events = self.svc.poll()
         for kind, slot in events:
             if kind == "lost":
@@ -654,25 +799,17 @@ class MultiControllerElasticSupervisor:
     # ---- straggler detection / policy ----
     def inject_straggler(self, slot: int, duration_s: float,
                          slow_ms: Optional[int] = None) -> None:
-        """Apply the ``straggler`` chaos fault: publish the control
-        row's slow fields so worker ``slot`` installs an emulated slow
-        link on its van ops, and schedule the heal.  No epoch bump — a
-        slow link is not a membership change.  The heal is applied by
-        the NEXT :meth:`poll` past its due time, NOT by a timer thread:
-        every control-row write must stay serialized with the two-phase
-        epoch publishes (a concurrent ``set_slow`` could republish a
-        stale snapshot — e.g. re-expose a mid-PREPARE ``phase=1`` row
-        after the supervisor already committed ``phase=0`` — and stall
-        the whole fleet on an epoch that will never commit)."""
-        ms = self.straggler_slow_ms if slow_ms is None else int(slow_ms)
-        self.svc.set_slow(int(slot), ms)
-        self._slow_heal_at = time.monotonic() + float(duration_s)
+        """Apply the ``straggler`` chaos fault via the shared
+        :class:`~hetu_tpu.resilience.straggler.
+        SupervisorStragglerPlane` (injection + serialized-heal glue —
+        one copy for both cross-process training planes)."""
+        self._stragglers.inject(slot, duration_s, slow_ms)
 
     @property
     def straggle_records(self) -> list:
         """Closed ``train.straggler`` episodes (the shared detector's
         span args verbatim)."""
-        return self._detector.records
+        return self._stragglers.records
 
     def _check_stragglers(self) -> None:
         """Per-phase timing turned into a slow-vs-dead decision: a
@@ -680,18 +817,13 @@ class MultiControllerElasticSupervisor:
         excluded) exceeds ``straggler_factor`` x the median of its
         peers' is a straggler — alive (its beats flow, the lease
         machine never fires) but pacing the whole lockstep fleet.
-        Episode spans live in the shared
-        :class:`~hetu_tpu.resilience.straggler.StragglerDetector`;
-        the POLICY is applied here: under ``straggler_policy="evict"``
-        the fleet reshards around the worker once it has been slow for
+        Episode spans live in the shared detector plane; the POLICY is
+        applied here: under ``straggler_policy="evict"`` the fleet
+        reshards around the worker once it has been slow for
         ``straggler_evict_after`` committed steps."""
         slots = [s for s in self._present()
                  if self.svc.state_of(s).state == "alive"]
-        loads = {s: self.svc.state_of(s).load for s in slots
-                 if self.svc.state_of(s).load > 0.0}
-        committed = {s: self.svc.state_of(s).committed for s in slots}
-        for slot in self._detector.observe(loads, present=slots,
-                                           committed=committed):
+        for slot in self._stragglers.observe(slots):
             if self.straggler_policy == "evict" and \
                     slot not in self._evicted:
                 self._evict_straggler(slot)
@@ -703,7 +835,7 @@ class MultiControllerElasticSupervisor:
         global batch at the smaller width, byte-identical by the same
         complete-cover contract as any other shrink."""
         self._evicted.add(int(slot))
-        self._detector.close(slot, resolution="evicted")
+        self._stragglers.close(slot, resolution="evicted")
         t0 = time.perf_counter()
         with trace.span("elastic.reshard") as sp:
             sp.set("kind", "shrink")
@@ -773,6 +905,14 @@ class MultiControllerElasticSupervisor:
         if p is not None and p.poll() is None:
             p.kill()
             p.wait()
+        elif slot in self._member_pids:
+            # a takeover-adopted worker (the dead controller's child):
+            # the pid is the only handle
+            try:
+                os.kill(self._member_pids[slot], _signal.SIGKILL)
+            except OSError:
+                pass
+        self._member_pids.pop(slot, None)
         self._spawn(slot)
 
     # ---- driving ----
@@ -806,7 +946,7 @@ class MultiControllerElasticSupervisor:
                 f"{[(m.slot, m.state, m.committed) for m in states]}")
         # a still-open straggle window at run end must land in the
         # trace (an unclosed span would silently drop the episode)
-        self._detector.close_all(resolution="run_end")
+        self._stragglers.close_all(resolution="run_end")
         consumed = merge_consumed_logs(self.log_paths)
         return {
             "steps": self.steps,
@@ -825,7 +965,12 @@ class MultiControllerElasticSupervisor:
                              self.steps)
 
     def close(self) -> None:
-        for p in self.procs:
+        # a FENCED controller no longer owns the fleet: its close()
+        # must not kill worker processes the new incarnation adopted
+        # (the same rule as the serving pool's fenced close)
+        svc = getattr(self, "svc", None)
+        fenced = bool(getattr(svc, "fenced", False))
+        for p in self.procs if not fenced else ():
             if p is None:
                 continue
             try:
@@ -834,15 +979,96 @@ class MultiControllerElasticSupervisor:
                 p.wait()
             except Exception:
                 traceback.print_exc()
+        # takeover-adopted workers have no Popen handle — the pid off
+        # the lease row is the only one.  Only still-present slots are
+        # signalled (a finished fleet left cleanly; killing a recycled
+        # pid would hit an innocent process), and they were reparented
+        # when their spawner died, so there is no zombie-reap concern
+        for slot, pid in (() if fenced else
+                          list(getattr(self, "_member_pids",
+                                       {}).items())):
+            if svc is not None and \
+                    svc.state_of(slot).state not in ("alive", "suspect"):
+                continue
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except OSError:
+                pass
         for t in (getattr(self, "table", None), getattr(self, "_bb", None)):
             if t is not None:
                 try:
                     t.close()
                 except Exception:
                     pass
-        self._van.stop()
+        if getattr(self, "_own_van", True):
+            self._van.stop()
+
+
+# ---------------------------------------------------------------------------
+# controller process harness (the chaos kill target)
+# ---------------------------------------------------------------------------
+
+def controller_main(config_path: str) -> int:
+    """Entry point for a spawned CONTROLLER process: build the
+    supervisor against an EXTERNAL van, drive the fleet, and print the
+    progress markers the chaos harness keys on (``STEP k`` per
+    committed high-water advance, ``PREPARED`` for the killed-mid-
+    PREPARE edge mode, ``ALLDONE``, ``FENCED``).  ``prepare_hang_at``
+    publishes a PREPARE freeze at the named committed step and then
+    hangs — the takeover must finish the half-open epoch with an exact
+    resume."""
+    cfg = json.loads(open(config_path).read())
+    sup = MultiControllerElasticSupervisor(
+        int(cfg["n_workers"]), workdir=cfg["workdir"],
+        steps=int(cfg["steps"]), global_batch=int(cfg["global_batch"]),
+        data_seed=int(cfg.get("data_seed", 0)),
+        lease_s=float(cfg.get("lease_s", 0.6)),
+        suspect_grace_s=float(cfg.get("suspect_grace_s", 0.4)),
+        step_sleep_s=float(cfg.get("step_sleep_s", 0.0)),
+        ctrl_lease_s=float(cfg.get("ctrl_lease_s", 0.0)),
+        hb_ms=int(cfg.get("hb_ms", 80)),
+        port=int(cfg["port"]), own_van=False)
+    hang_at = cfg.get("prepare_hang_at")
+
+    def progress():
+        return max((sup.svc.state_of(s).committed
+                    for s in range(sup.n_workers)), default=-1)
+
+    def hang_mid_prepare(hw):
+        if hang_at is None or hw < int(hang_at):
+            return
+        # die mid-transition: PREPARE published, acks never collected —
+        # the takeover edge case
+        sup.epoch += 1
+        present = sup._present()
+        sup.svc.publish_control(
+            epoch=sup.epoch, width=len(present),
+            alive_mask=_mb.MembershipService.mask_of(present), phase=1)
+        print("PREPARED", flush=True)
+        while True:
+            time.sleep(3600)
+
+    def done():
+        states = [sup.svc.state_of(s) for s in range(sup.n_workers)]
+        present = [m for m in states
+                   if m.state in ("alive", "suspect") and
+                   m.slot not in sup._evicted]
+        finished = [m for m in states
+                    if m.state == "left" and
+                    m.committed >= sup.steps - 1]
+        return bool((present and all(m.committed >= sup.steps - 1
+                                     for m in present)) or
+                    (not present and finished))
+
+    rc = drive_controller_harness(
+        sup.poll, progress, done,
+        deadline_s=float(cfg.get("deadline_s", 300.0)),
+        on_progress=hang_mid_prepare)
+    return 0 if rc is None else rc
 
 
 if __name__ == "__main__":
     import sys
+    if sys.argv[1] == "--controller":
+        sys.exit(controller_main(sys.argv[2]))
     sys.exit(worker_main(sys.argv[1]))

@@ -16,6 +16,8 @@ is not redundant, so only the dp plane can evict.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from hetu_tpu.telemetry import trace
@@ -98,3 +100,71 @@ class StragglerDetector:
 
     def open_slots(self) -> list:
         return list(self._open)
+
+
+class SupervisorStragglerPlane:
+    """Supervisor-side straggler glue, shared by the cross-process
+    training planes (the dp multi-controller fleet and the MPMD
+    pipeline) so the two copies cannot drift: slow-link INJECTION via
+    the control row's ``C_SLOW_*`` fields with a scheduled self-heal,
+    and the per-sweep load/committed extraction feeding the shared
+    :class:`StragglerDetector`.
+
+    The heal is applied by :meth:`maybe_heal` from the supervisor's
+    ``poll()`` — NOT by a timer thread — so every control-row write
+    stays serialized with the two-phase epoch publishes (a concurrent
+    ``set_slow`` could republish a stale snapshot — e.g. re-expose a
+    mid-PREPARE ``phase=1`` row after the supervisor already committed
+    ``phase=0`` — and stall the whole fleet on an epoch that will
+    never commit).  The POLICY on a crossed threshold stays with each
+    supervisor: only the dp plane has a redundant member to evict.
+    """
+
+    def __init__(self, svc, *, factor: float, subject: str,
+                 policy: str = "wait", evict_after: int = 0,
+                 slow_ms: int = 120):
+        self.svc = svc
+        self.slow_ms = int(slow_ms)
+        self.detector = StragglerDetector(
+            factor=float(factor), subject=subject, policy=policy,
+            evict_after=int(evict_after))
+        self._heal_at = None
+
+    def inject(self, slot: int, duration_s: float,
+               slow_ms=None) -> None:
+        """Apply the slow-link chaos fault: publish the control row's
+        slow fields (no epoch bump — a slow link is not a membership
+        change) and schedule the heal for the next poll past its due
+        time."""
+        ms = self.slow_ms if slow_ms is None else int(slow_ms)
+        self.svc.set_slow(int(slot), ms)
+        self._heal_at = time.monotonic() + float(duration_s)
+
+    def maybe_heal(self) -> None:
+        if self._heal_at is not None and \
+                time.monotonic() >= self._heal_at:
+            self._heal_at = None
+            self.svc.set_slow(-1, 0)
+
+    def observe(self, candidate_slots) -> list:
+        """One sweep over the supervisors' candidate slots (callers
+        pass alive, non-excluded membership): loads are the reported
+        WORK-only ms from the heartbeat load field (zero = no evidence,
+        excluded), committed feeds the evict-threshold accounting.
+        Returns the slots whose episode crossed the evict bar."""
+        loads = {s: self.svc.state_of(s).load for s in candidate_slots
+                 if self.svc.state_of(s).load > 0.0}
+        committed = {s: self.svc.state_of(s).committed
+                     for s in candidate_slots}
+        return self.detector.observe(loads, present=candidate_slots,
+                                     committed=committed)
+
+    @property
+    def records(self) -> list:
+        return self.detector.records
+
+    def close(self, slot, *, resolution: str) -> None:
+        self.detector.close(slot, resolution=resolution)
+
+    def close_all(self, *, resolution: str = "run_end") -> None:
+        self.detector.close_all(resolution=resolution)

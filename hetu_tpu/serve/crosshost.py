@@ -40,18 +40,45 @@ from their own base (disjoint from the in-process pool's
 membership blackboard is a small f32 table.  Recovery spans mirror the
 in-process pool (``serve.migrate`` / ``serve.failover``) plus the new
 retroactive ``serve.member_suspect`` for a partition that healed.
+
+**Controller death is just another fault kind.**  The controller holds
+a lease of its own (the blackboard's controller row — incarnation
+fence + beat), journals every piece of RAM-only state (rid→member
+ownership, retry budgets, half-open drains, per-slot channel bases) to
+a :class:`~hetu_tpu.ps.membership.ControllerLedger` on the van, and
+keys every command channel by its incarnation.  A SIGKILLed controller
+therefore loses nothing durable: a new incarnation
+(:meth:`CrossProcessServingPool.takeover`) claims the fence, reads
+blackboard + ledger, re-adopts the still-serving member processes via
+their lease rows, aborts half-open drains back to a serving source,
+and resolves every accepted request (members re-announce their
+completion records when they rebind to the new incarnation's
+channels — the ``ctrl.takeover`` span measures the whole hand-off).
+A SIGSTOPped controller that wakes after the takeover is FENCED:
+members ignore its stale-incarnation control rows and commands, and
+its own read-before-write checks raise
+:class:`~hetu_tpu.ps.membership.ControllerFenced` before it can touch
+the fleet.  This requires the van (the durable tier) to outlive the
+controller — production deployments and the chaos tests run it as its
+own process (``resilience/shardproc.spawn_shard_server``) and build
+the pool with ``own_van=False``.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import os
 import queue
+import signal as _signal
 import threading
 import time
 import traceback
+from collections import OrderedDict
 from dataclasses import asdict, dataclass, field
 from typing import Optional
+
+import numpy as np
 
 from hetu_tpu.ps import membership as _mb
 from hetu_tpu.serve import migrate as _migrate
@@ -66,6 +93,30 @@ CONTROL_CHANNEL_BASE = 0x43484354
 CROSSHOST_MIGRATE_BASE = 0x4D494733
 
 _xfer_ids = itertools.count(1)
+
+# control channels are keyed by CONTROLLER incarnation: blob seqs are
+# per-channel and a takeover cannot know the dead controller's
+# positions, so each incarnation binds fresh channels — and a fenced
+# zombie keeps writing to channels nobody reads
+CTRL_CHAN_STRIDE = 1 << 20
+
+
+def _fenced_chan(base: int, ctrl_inc: int) -> int:
+    return int(base) + int(ctrl_inc) * CTRL_CHAN_STRIDE
+
+
+def seeded_prompts(n: int, seed: int = 0, *, vocab: int = 89,
+                   max_len: int = 6) -> list:
+    """Deterministic prompt set shared by the controller harness, the
+    chaos tests, and ``bench.py ctrlchaos`` — same (n, seed) → same
+    prompts in every process, so token-exactness is checkable across a
+    controller death without shipping the prompts anywhere."""
+    rng = np.random.default_rng((int(seed), 0xC7A0))
+    out = []
+    for _ in range(int(n)):
+        k = int(rng.integers(2, max(int(max_len), 3)))
+        out.append([int(t) for t in rng.integers(1, int(vocab), size=k)])
+    return out
 
 
 @dataclass
@@ -95,6 +146,10 @@ class MemberSpec:
     # "links": [[direction, policy_dict], ...]} — the static half; the
     # dynamic half arrives over the wire as a "netem" command
     netem: dict = field(default_factory=dict)
+    # the controller-ledger table id, recorded here so a TAKEOVER can
+    # find every durable control-plane id from any member's spawn
+    # config on disk; members themselves never read the ledger
+    ledger_table: int = 0
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -182,12 +237,41 @@ class MemberHarness:
         self._events: queue.Queue = queue.Queue()
         self._migrated: set = set()   # rids handed to a peer (no event)
         self._pending_drain = None    # (xfer_id, pairs) awaiting commit
-        self._in = van.BlobChannel("127.0.0.1", spec.port, spec.submit_ch)
-        self._out = van.BlobChannel("127.0.0.1", spec.port, spec.event_ch)
-        self.member.join()
+        # completion RECORDS, kept after emission: when a controller
+        # dies, whatever sat unread in the old event channel's single
+        # slot died with it — on rebind every record is re-announced
+        # and the new controller dedups by rid
+        self._done_log: list = []
+        self._fenced_cmds = 0
+        self._epoch_ack = 0
+        # the controller's incarnation keys the command channels: wait
+        # for the first control publish (the pool publishes BEFORE
+        # spawning members, so this is immediate except under chaos)
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                self._epoch_ack = self.member.read_control()[0]
+            except Exception:
+                pass
+            if self.member.ctrl_inc > 0:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "no controller incarnation on the control row")
+            time.sleep(0.02)
+        self._ctrl_gen = self.member.ctrl_inc
+        self._in_gen = self._out_gen = self._ctrl_gen
+        self._in = van.BlobChannel(
+            "127.0.0.1", spec.port,
+            _fenced_chan(spec.submit_ch, self._ctrl_gen))
+        self._out = van.BlobChannel(
+            "127.0.0.1", spec.port,
+            _fenced_chan(spec.event_ch, self._ctrl_gen))
+        self.member.join(epoch_ack=float(self._epoch_ack))
         self._threads = [
             threading.Thread(target=self._beat_loop, daemon=True),
             threading.Thread(target=self._event_loop, daemon=True),
+            threading.Thread(target=self._ctrl_watch_loop, daemon=True),
         ]
         for t in self._threads:
             t.start()
@@ -196,15 +280,55 @@ class MemberHarness:
     def _emit(self, ev: dict) -> None:
         self._events.put(ev)
 
+    def _ctrl_watch_loop(self) -> None:
+        """Track the controller lease: the read updates the client's
+        fence (``ctrl_inc``) and silence clock; an incarnation bump is
+        the rebind signal for the command/event loops, and the observed
+        control EPOCH is acked through the heartbeat so deaf-member
+        detection works on the serving plane too."""
+        period = max(self.spec.hb_ms, 10) / 1000.0
+        while not self._stop.wait(period):
+            try:
+                e = self.member.read_control()[0]
+            except Exception:
+                continue  # unreadable control row: nothing to react to
+            self._epoch_ack = max(self._epoch_ack, e)
+            if self.member.ctrl_inc > self._ctrl_gen:
+                self._ctrl_gen = self.member.ctrl_inc
+
     def _event_loop(self) -> None:
         seq = 1
+        backlog: list = []
         while not self._stop.is_set():
-            try:
-                ev = self._events.get(timeout=0.1)
-            except queue.Empty:
-                continue
+            if self._out_gen != self._ctrl_gen:
+                # a new controller incarnation owns the fleet: bind its
+                # event channel and RE-ANNOUNCE every completion record
+                # — the dead controller may have resolved none/some of
+                # them (the new one dedups by rid), and whatever sat
+                # unread in the old channel's single slot is gone
+                gen = self._ctrl_gen
+                try:
+                    self._out.close()
+                except Exception:
+                    pass
+                self._out = self._van.BlobChannel(
+                    "127.0.0.1", self.spec.port,
+                    _fenced_chan(self.spec.event_ch, gen))
+                self._out_gen = gen
+                seq = 1
+                backlog = list(self._done_log)
+            from_backlog = bool(backlog)
+            if from_backlog:
+                ev = backlog[0]
+            else:
+                try:
+                    ev = self._events.get(timeout=0.1)
+                except queue.Empty:
+                    continue
             payload = json.dumps(ev).encode()
-            while not self._stop.is_set():
+            sent = False
+            while not self._stop.is_set() and \
+                    self._out_gen == self._ctrl_gen:
                 try:
                     # idempotent same-seq resend: a timeout retries the
                     # SAME slot until the controller drains it.
@@ -214,9 +338,18 @@ class MemberHarness:
                     # lose its event thread to the partition
                     self._out.put(payload, seq, timeout_s=2.0)
                     seq += 1
+                    sent = True
                     break
                 except (TimeoutError, ConnectionError, RuntimeError):
                     time.sleep(0.05)
+            if sent:
+                if from_backlog:
+                    backlog.pop(0)
+            elif not from_backlog:
+                # a rebind (or stop) interrupted a queue event mid-send:
+                # requeue it — done events would ride the replay anyway,
+                # but drain acks exist only here
+                self._events.put(ev)
 
     def _beat_loop(self) -> None:
         period = max(self.spec.hb_ms, 10) / 1000.0
@@ -224,7 +357,8 @@ class MemberHarness:
             try:
                 self.member.heartbeat(
                     load=float(self.scheduler.load),
-                    healthy=self.server.healthy)
+                    healthy=self.server.healthy,
+                    epoch_ack=float(self._epoch_ack))
             except Exception:
                 # a transiently unreachable van must not kill the beat
                 # thread — silence IS the loss signal, so keep trying
@@ -232,21 +366,77 @@ class MemberHarness:
 
     def _watch(self, req) -> None:
         """Report the request's terminal state to the controller once it
-        resolves — unless it migrated away (the adopter reports it)."""
+        resolves — unless it migrated away (the adopter reports it).
+        The record survives in ``_done_log`` so a controller takeover
+        can be re-announced to."""
         def run():
             req.done.wait()
             if req.status == "migrated" or req.rid in self._migrated:
                 return
-            self._emit({"type": "done", "rid": int(req.rid),
-                        "status": req.status or "ok",
-                        "tokens": [int(t) for t in req.tokens],
-                        "ttft_s": req.ttft_s})
+            ev = {"type": "done", "rid": int(req.rid),
+                  "status": req.status or "ok",
+                  "tokens": [int(t) for t in req.tokens],
+                  "ttft_s": req.ttft_s}
+            self._done_log.append(ev)
+            if len(self._done_log) > 1024:
+                del self._done_log[0]
+            self._emit(ev)
         threading.Thread(target=run, daemon=True).start()
 
     # ---- command dispatch (single reader: ordering is the protocol) ----
     def run(self) -> None:
         seq = 1
         while not self._stop.is_set():
+            if self._in_gen != self._ctrl_gen:
+                # DRAIN the dying incarnation's channel before
+                # switching: the slot is one-deep, and the command
+                # possibly still sitting in it (e.g. the submit the
+                # dead controller journaled right before dying) belongs
+                # to a request the NEW controller has adopted and is
+                # waiting on — dropping it would strand that rid
+                # forever.  These drained commands bypass the staleness
+                # fence (they were written by the then-legitimate
+                # controller; a zombie can only reach this window by
+                # racing the one bounded drain, after which the old
+                # channel is never read again).
+                drain_deadline = time.monotonic() + 5.0
+                while not self._stop.is_set():
+                    try:
+                        # a generous get timeout: 0.2s would conflate
+                        # "slot empty" with "slow wire" and drop a
+                        # journaled submit under a netem-degraded link
+                        raw = self._in.get(seq, timeout_s=1.0)
+                    except TimeoutError:
+                        break  # slot empty — the drain is complete
+                    except RuntimeError:
+                        break  # van gone under us
+                    except ConnectionError:
+                        # transient wire wobble (netem degrade, van
+                        # hiccup): must not truncate the ONE bounded
+                        # drain — a journaled submit dropped here
+                        # strands the rid its successor adopted
+                        if time.monotonic() >= drain_deadline:
+                            break
+                        time.sleep(0.05)
+                        continue
+                    seq += 1
+                    try:
+                        if not self._dispatch(json.loads(raw),
+                                              allow_stale=True):
+                            self.close()
+                            return
+                    except Exception:
+                        traceback.print_exc()
+                gen = self._ctrl_gen
+                try:
+                    self._in.close()
+                except Exception:
+                    pass
+                self._in = self._van.BlobChannel(
+                    "127.0.0.1", self.spec.port,
+                    _fenced_chan(self.spec.submit_ch, gen))
+                self._in_gen = gen
+                seq = 1
             try:
                 raw = self._in.get(seq, timeout_s=0.25)
             except (TimeoutError, ConnectionError):
@@ -265,8 +455,15 @@ class MemberHarness:
                 # parse error as a death
         self.close()
 
-    def _dispatch(self, msg: dict) -> bool:
+    def _dispatch(self, msg: dict, *, allow_stale: bool = False) -> bool:
         from hetu_tpu.serve.scheduler import Request
+        ci = msg.get("ci")
+        if not allow_stale and ci is not None and \
+                int(ci) < self.member.ctrl_inc:
+            # a fenced (superseded-incarnation) controller's command:
+            # refused — the member-side half of the zombie fence
+            self._fenced_cmds += 1
+            return True
         cmd = msg.get("cmd")
         if cmd == "submit":
             req = Request(prompt=[int(t) for t in msg["prompt"]],
@@ -428,7 +625,7 @@ class PoolRequest:
     pool's ``generate``."""
 
     __slots__ = ("rid", "msg", "member", "retries", "tokens", "status",
-                 "ttft_s", "done")
+                 "ttft_s", "done", "sent")
 
     def __init__(self, rid: int, msg: dict):
         self.rid = rid
@@ -439,6 +636,12 @@ class PoolRequest:
         self.status: Optional[str] = None
         self.ttft_s = None
         self.done = threading.Event()
+        # True once the submit command LANDED on the member's channel:
+        # the ledger journals an ownership only when it is real — a
+        # concurrent journal snapshotting the optimistic assignment
+        # mid-send would otherwise record a member that never heard of
+        # the rid, and a takeover would wait on it forever
+        self.sent = False
 
 
 class CrossProcessServingPool:
@@ -463,12 +666,16 @@ class CrossProcessServingPool:
                  max_retries: int = 3,
                  migrate_codec: str = "none",
                  membership_table: Optional[int] = None,
+                 ledger_table: Optional[int] = None,
+                 ledger_rows: int = 1024,
+                 deaf_ack_s: Optional[float] = None,
                  metrics: Optional[ServeMetrics] = None,
                  member_env: Optional[dict] = None,
                  spawn_timeout_s: float = 120.0,
                  shed: bool = False, shed_headroom: float = 1.0,
                  rtt_degraded_x: float = 5.0,
-                 start_poll: bool = True):
+                 start_poll: bool = True,
+                 _takeover: bool = False):
         from hetu_tpu.ps import van
         if n_members < 1:
             raise ValueError("a serving pool needs at least one member")
@@ -492,6 +699,9 @@ class CrossProcessServingPool:
         # and two pools in one process must not share a blackboard
         self._membership_table = int(membership_table) \
             if membership_table is not None else _mb.fresh_table_id()
+        self._ledger_table = int(ledger_table) \
+            if ledger_table is not None else _mb.fresh_table_id()
+        self._ledger_rows = int(ledger_rows)
         self._spawn_timeout_s = float(spawn_timeout_s)
         # e.g. {"JAX_PLATFORMS": "cpu"} — a bench on an accelerator box
         # keeps member processes off the chip the controller holds
@@ -499,9 +709,19 @@ class CrossProcessServingPool:
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self._lock = threading.RLock()
         self._poll_lock = threading.Lock()
-        self._rids = itertools.count(1)
-        self._ctrl_ids = itertools.count(0)  # fresh channels per process
+        self._journal_lock = threading.Lock()
+        self._journal_dirty = False
+        self._rid_seq = 0               # journaled: rid space survives
+        self._ctrl_seq = 0              # a takeover (no reuse)
         self._requests: dict = {}       # rid -> PoolRequest
+        # rid -> terminal status, bounded: the ledger's dedup record —
+        # a member re-announcing an already-resolved completion after a
+        # takeover must be recognized, not re-served
+        self._resolved: OrderedDict = OrderedDict()
+        self._ch_bases: dict = {}       # slot -> (submit_base, event_base)
+        self._drain_journal: dict = {}  # xfer -> two-phase drain record
+        self._member_pids: dict = {}    # takeover-adopted pids (no Popen)
+        self._fenced = False
         self._inflight: dict = {}       # slot -> outstanding count
         self._draining: set = set()
         self._quarantined: set = set()  # engine-dead / failed-over slots
@@ -519,17 +739,45 @@ class CrossProcessServingPool:
         self._out: dict = {}            # slot -> (channel, lock, [seq])
         self._listeners: dict = {}      # slot -> (thread, stop)
         self.procs: list = [None] * self.n_members
+        self.adopted: dict = {}         # takeover: rid -> PoolRequest
+        self.takeover_report: dict = {}
         self._stop = threading.Event()
         try:
-            self._bb = _mb.create_blackboard(
-                "127.0.0.1", self.port, table_id=self._membership_table,
-                n_slots=self.n_members)
-            self.svc = _mb.MembershipService(
-                self._bb, self.n_members, lease_s=lease_s,
-                suspect_grace_s=suspect_grace_s)
-            for slot in range(self.n_members):
-                self._spawn(slot)
-            self._wait_joined(range(self.n_members))
+            if _takeover:
+                # adopt, don't create: the blackboard, ledger, and the
+                # member PROCESSES all outlived the dead controller
+                self._bb = _mb.attach_blackboard(
+                    "127.0.0.1", self.port,
+                    table_id=self._membership_table,
+                    n_slots=self.n_members)
+                self.svc = _mb.MembershipService(
+                    self._bb, self.n_members, lease_s=lease_s,
+                    suspect_grace_s=suspect_grace_s,
+                    deaf_ack_s=deaf_ack_s)
+                self._ledger = _mb.ControllerLedger(
+                    "127.0.0.1", self.port, table_id=self._ledger_table,
+                    rows=self._ledger_rows, create=False)
+                self._adopt()
+            else:
+                self._bb = _mb.create_blackboard(
+                    "127.0.0.1", self.port,
+                    table_id=self._membership_table,
+                    n_slots=self.n_members)
+                self.svc = _mb.MembershipService(
+                    self._bb, self.n_members, lease_s=lease_s,
+                    suspect_grace_s=suspect_grace_s,
+                    deaf_ack_s=deaf_ack_s)
+                self._ledger = _mb.ControllerLedger(
+                    "127.0.0.1", self.port, table_id=self._ledger_table,
+                    rows=self._ledger_rows, create=True)
+                # publish the control row BEFORE spawning: members key
+                # their command channels on the incarnation it carries
+                self.svc.publish_control(epoch=1, width=self.n_members,
+                                         alive_mask=0)
+                for slot in range(self.n_members):
+                    self._spawn(slot)
+                self._wait_joined(range(self.n_members))
+                self._journal()
         except Exception:
             self.close()
             raise
@@ -539,17 +787,223 @@ class CrossProcessServingPool:
                 target=self._poll_loop, args=(float(poll_s),), daemon=True)
             self._poll_thread.start()
 
+    @classmethod
+    def takeover(cls, *, workdir, port, lease_s: float = 0.6,
+                 suspect_grace_s: float = 0.5, poll_s: float = 0.05,
+                 request_timeout_s: float = 60.0, max_retries: int = 3,
+                 deaf_ack_s: Optional[float] = None,
+                 metrics: Optional[ServeMetrics] = None,
+                 spawn_timeout_s: float = 120.0,
+                 start_poll: bool = True) -> "CrossProcessServingPool":
+        """Become the fleet's NEW controller after the old one died.
+
+        Reads the dead controller's member spawn configs from
+        ``workdir`` (the durable record of every control-plane id:
+        blackboard, ledger, channel bases, model), attaches to the
+        still-running van at ``port``, claims the controller row with a
+        strictly higher incarnation, and adopts: members rebind their
+        command channels to the new incarnation and re-announce their
+        completion records, unresolved requests are restored from the
+        ledger (orphans re-routed), and half-open drains are aborted
+        back to a serving source — the whole hand-off under one
+        ``ctrl.takeover`` span.  Adopted in-flight requests land in
+        ``self.adopted``; :meth:`wait_adopted` blocks on them."""
+        from pathlib import Path
+        cfgs = sorted(Path(workdir).glob("member_*.json"),
+                      key=lambda p: p.stat().st_mtime)
+        if not cfgs:
+            raise FileNotFoundError(
+                f"no member spawn configs under {workdir} — nothing to "
+                f"take over")
+        spec = MemberSpec.from_json(cfgs[-1].read_text())
+        return cls(spec.n_slots, workdir=workdir, model=spec.model,
+                   port=port, own_van=False, hb_ms=spec.hb_ms,
+                   lease_s=lease_s, suspect_grace_s=suspect_grace_s,
+                   poll_s=poll_s, request_timeout_s=request_timeout_s,
+                   max_retries=max_retries,
+                   membership_table=spec.membership_table,
+                   ledger_table=spec.ledger_table,
+                   deaf_ack_s=deaf_ack_s, metrics=metrics,
+                   spawn_timeout_s=spawn_timeout_s,
+                   shed=spec.shed, shed_headroom=spec.shed_headroom,
+                   start_poll=start_poll, _takeover=True)
+
+    def _adopt(self) -> None:
+        got = self._ledger.read()
+        state = (got or {}).get("state") or {}
+        with trace.span("ctrl.takeover", cat="ctrl") as sp:
+            sp.set("plane", "serving")
+            sp.set("incarnation", self.svc.ctrl_incarnation)
+            ctrl = self.svc.read_control_row()
+            # carry any injected slow-link fields forward (the serving
+            # plane publishes rarely, but the rule is uniform: a
+            # takeover must not silently heal an injection)
+            self.svc.adopt_slow(ctrl["slow_slot"], ctrl["slow_ms"])
+            # republish under the NEW incarnation: this is the rebind
+            # signal every member's control watch is waiting for
+            self.svc.publish_control(
+                epoch=max(int(ctrl["epoch"]), 1), width=self.n_members,
+                alive_mask=int(ctrl["alive_mask"]))
+            with self._lock:
+                self._rid_seq = int(state.get("rid", 0))
+                self._ctrl_seq = int(state.get("cid", 0))
+                for s, bases in (state.get("channels") or {}).items():
+                    self._ch_bases[int(s)] = (int(bases[0]),
+                                              int(bases[1]))
+                for rid_s, rec in (state.get("requests") or {}).items():
+                    req = PoolRequest(int(rid_s), dict(rec["msg"]))
+                    req.member = rec.get("member")
+                    req.sent = req.member is not None
+                    req.retries = int(rec.get("retries", 0))
+                    self._requests[req.rid] = req
+                    self.adopted[req.rid] = req
+                for rid_s, st in (state.get("resolved") or {}).items():
+                    self._resolved[int(rid_s)] = st
+                self._drain_journal = {
+                    str(k): dict(v)
+                    for k, v in (state.get("drains") or {}).items()}
+            # wire up every recorded member under the new incarnation
+            inc = self.svc.ctrl_incarnation
+            for slot, (sub, evb) in sorted(self._ch_bases.items()):
+                ch = self._van.BlobChannel(
+                    "127.0.0.1", self.port, _fenced_chan(sub, inc))
+                with self._lock:
+                    old = self._out.get(slot)
+                    self._out[slot] = (ch, threading.Lock(), [1])
+                    self._inflight.setdefault(slot, 0)
+                if old is not None:
+                    try:
+                        old[0].close()
+                    except Exception:
+                        pass
+                self._start_listener(slot, evb)
+            # learn who is still beating (members that died WITH the
+            # controller surface as ordinary lease expiries below)
+            self.svc.wait_present(self._spawn_timeout_s, poll=self.poll)
+            # member pids off the blackboard: these processes are the
+            # DEAD controller's children — the pid is the only handle
+            # close()/revive have on them
+            self._member_pids.update(self.svc.member_pids())
+            # half-open drains: abort back to a still-serving source
+            # (the PR 5/8 abort path — the source re-adopts its export;
+            # a target that also adopted serves duplicates the rid
+            # dedup absorbs, token-identically).  The abort must LAND
+            # before the record may be dropped: the source parks its
+            # exported requests in _pending_drain until told, and a
+            # swallowed send failure would strand them forever.  The
+            # send can fail transiently right after takeover (the
+            # source rebinds its incarnation-keyed channel one watch
+            # period after the bump), so failed aborts retry until the
+            # source either hears us or loses its lease (dead source ⇒
+            # _pending_drain died with it; its rids re-route as
+            # orphans below).  Records that outlive the budget stay
+            # journaled for the next incarnation rather than vanish.
+            aborted = 0
+            orphaned = 0  # source died WITH the drain: no abort to
+            # deliver — the record drops and its rids re-route below
+            pending = dict(self._drain_journal)
+            abort_deadline = time.monotonic() + self._spawn_timeout_s
+            while pending:
+                for xid_s, d in list(pending.items()):
+                    src = int(d.get("source", -1))
+                    src_alive = 0 <= src < self.n_members and \
+                        self.svc.state_of(src).state in ("alive",
+                                                         "suspect")
+                    sent = False
+                    try:
+                        self._send(src, {"cmd": "drain_abort",
+                                         "xfer": int(xid_s)})
+                        sent = True
+                    except Exception:
+                        traceback.print_exc()
+                    if sent or not src_alive:
+                        with self._lock:
+                            self._draining.discard(src)
+                            self._drain_journal.pop(xid_s, None)
+                        del pending[xid_s]
+                        if sent:
+                            aborted += 1
+                        else:
+                            orphaned += 1
+                if pending:
+                    if time.monotonic() >= abort_deadline:
+                        break
+                    self.poll()
+                    time.sleep(0.05)
+            # rebuild routing state and re-home orphans: a request whose
+            # member is gone re-prefills on a survivor (the ordinary
+            # failover fold — greedy decode keeps it token-exact)
+            with self._lock:
+                for r in self._requests.values():
+                    if r.member is not None:
+                        self._inflight[r.member] = \
+                            self._inflight.get(r.member, 0) + 1
+                alive = set(self.svc.present_slots())
+                orphans = [r for r in self._requests.values()
+                           if r.member is None or r.member not in alive]
+            for r in orphans:
+                self._route(r, exclude=(
+                    {r.member} if r.member is not None else set()))
+            self.takeover_report = {
+                "incarnation": self.svc.ctrl_incarnation,
+                "adopted_requests": len(self.adopted),
+                "resolved_known": len(self._resolved),
+                # the ledger's pre-kill resolutions, by rid: the
+                # supported loss-accounting surface (a rid is safe iff
+                # adopted-and-resolved OR already here)
+                "resolved": dict(self._resolved),
+                "drains_aborted": aborted,
+                "drains_orphaned": orphaned,
+                "orphans_rerouted": len(orphans),
+                "members_present": sorted(self.svc.present_slots()),
+            }
+            sp.set("adopted_requests", len(self.adopted))
+            sp.set("drains_aborted", aborted)
+            sp.set("drains_orphaned", orphaned)
+            sp.set("orphans_rerouted", len(orphans))
+        self.metrics.inc("controller_takeovers")
+        self._journal()
+
+    def wait_adopted(self, timeout_s: float = 120.0) -> dict:
+        """Block until every request adopted at takeover resolves;
+        returns ``{rid: {"status", "tokens", "ttft_s"}}``.  A request
+        that never resolves within the budget reads status
+        'timeout'."""
+        deadline = time.monotonic() + float(timeout_s)
+        out = {}
+        for rid, req in sorted(self.adopted.items()):
+            if not req.done.wait(max(deadline - time.monotonic(), 0.01)):
+                self._resolve(req, "timeout")
+            out[rid] = {"status": req.status or "ok",
+                        "tokens": list(req.tokens),
+                        "ttft_s": req.ttft_s}
+        return out
+
+    @property
+    def fenced(self) -> bool:
+        """True once a newer controller incarnation superseded this one
+        (every further control write is refused)."""
+        return self._fenced
+
     # ---- spawning ----
+    def _next_rid(self) -> int:
+        with self._lock:
+            self._rid_seq += 1
+            return self._rid_seq
+
     def _spawn(self, slot: int) -> None:
         from hetu_tpu.resilience.shardproc import spawn_module
-        cid = next(self._ctrl_ids)
+        with self._lock:
+            cid = self._ctrl_seq
+            self._ctrl_seq += 1
         spec = MemberSpec(
             port=self.port, slot=slot, n_slots=self.n_members,
             submit_ch=CONTROL_CHANNEL_BASE + 2 * cid,
             event_ch=CONTROL_CHANNEL_BASE + 2 * cid + 1,
             membership_table=self._membership_table, hb_ms=self.hb_ms,
             request_timeout_s=self.request_timeout_s, model=self.model,
-            shed=self._shed, shed_headroom=self._shed_headroom)
+            shed=self._shed, shed_headroom=self._shed_headroom,
+            ledger_table=self._ledger_table)
         from pathlib import Path
         cfg = Path(self.workdir) / f"member_{slot}_{cid}.json"
         cfg.write_text(spec.to_json())
@@ -558,27 +1012,94 @@ class CrossProcessServingPool:
                             extra_env=self._member_env,
                             timeout_s=self._spawn_timeout_s)
         self.procs[slot] = proc
-        ch = self._van.BlobChannel("127.0.0.1", self.port, spec.submit_ch)
+        ch = self._van.BlobChannel(
+            "127.0.0.1", self.port,
+            _fenced_chan(spec.submit_ch, self.svc.ctrl_incarnation))
         with self._lock:
             old = self._out.get(slot)
             self._out[slot] = (ch, threading.Lock(), [1])
             self._inflight[slot] = 0
+            self._ch_bases[slot] = (spec.submit_ch, spec.event_ch)
+            self._member_pids.pop(slot, None)
         if old is not None:  # a revived slot's previous control channel
             try:
                 old[0].close()
             except Exception:
                 pass
         self._start_listener(slot, spec.event_ch)
+        # the fresh channel bases are JOURNALED state: a controller
+        # death right after a revive must hand the successor the new
+        # bases, not the dead slot's old ones (a takeover would
+        # otherwise wire this member to channels nobody serves)
+        try:
+            self._journal()
+        except Exception:
+            traceback.print_exc()
 
     def _start_listener(self, slot: int, event_ch: int) -> None:
         old = self._listeners.get(slot)
         if old is not None:
             old[1].set()
         stop = threading.Event()
-        t = threading.Thread(target=self._event_loop,
-                             args=(slot, event_ch, stop), daemon=True)
+        t = threading.Thread(
+            target=self._event_loop,
+            args=(slot, _fenced_chan(event_ch,
+                                     self.svc.ctrl_incarnation), stop),
+            daemon=True)
         self._listeners[slot] = (t, stop)
         t.start()
+
+    # ---- the controller ledger (durable RAM) ----
+    def _journal(self) -> None:
+        """Write the controller's recoverable state to the van ledger:
+        one small full snapshot per state change (accept / route /
+        resolve / drain transition).  Everything a takeover cannot
+        re-derive from lease rows or member-side records rides here —
+        rid→member ownership, retry budgets, original request messages,
+        half-open drains, per-slot channel bases, id high-waters.
+
+        ``_journal_lock`` orders snapshot-taking WITH the wire write:
+        without it, two concurrent journals could land out of order and
+        an older snapshot (taken before an accept) could overwrite the
+        newer one that recorded it — exactly the lost-accepted-request
+        hole the accept-before-route journaling exists to close."""
+        with self._journal_lock:
+            self._journal_locked()
+
+    def _journal_locked(self) -> None:
+        # clear the coalesce flag BEFORE the snapshot: a resolve landing
+        # after the snapshot re-marks it and the next sweep flushes —
+        # clearing after the write would swallow that re-mark
+        self._journal_dirty = False
+        with self._lock:
+            snap = {
+                "rid": self._rid_seq, "cid": self._ctrl_seq,
+                "channels": {str(s): list(b)
+                             for s, b in self._ch_bases.items()},
+                "requests": {str(r.rid): {
+                    # an ownership mid-send is NOT journaled (member
+                    # None = orphan = the takeover re-routes; if the
+                    # send actually landed, the duplicate submit is
+                    # absorbed by the rid dedup, token-identically)
+                    "msg": r.msg,
+                    "member": r.member if r.sent else None,
+                    "retries": r.retries}
+                    for r in self._requests.values()
+                    if not r.done.is_set()},
+                "resolved": {str(k): v
+                             for k, v in self._resolved.items()},
+                "drains": {str(k): dict(v)
+                           for k, v in self._drain_journal.items()},
+            }
+        try:
+            self._ledger.write(snap,
+                               ctrl_inc=self.svc.ctrl_incarnation)
+        except _mb.ControllerFenced:
+            self._fenced = True
+            raise
+        except Exception:
+            self._journal_dirty = True  # nothing landed: stay dirty
+            raise
 
     def _wait_joined(self, slots, timeout_s: Optional[float] = None) -> None:
         deadline = time.monotonic() + (timeout_s if timeout_s is not None
@@ -599,11 +1120,17 @@ class CrossProcessServingPool:
         resend is idempotent, so a transport wobble retries safely; a
         member that stays unreadable (suspended/dead) surfaces as the
         TimeoutError the router treats as 'pick someone else'."""
+        if self._fenced:
+            raise ConnectionError(
+                "controller fenced: a newer incarnation owns the fleet")
         ent = self._out.get(slot)
         if ent is None:
             raise ConnectionError(f"member {slot} has no control channel")
         ch, lock, seq = ent
-        payload = json.dumps(msg).encode()
+        # every command carries the incarnation: the member-side fence
+        # rejects a stale controller's writes wherever they land
+        payload = json.dumps(
+            {**msg, "ci": self.svc.ctrl_incarnation}).encode()
         t0 = time.monotonic()
         try:
             with lock:
@@ -738,7 +1265,19 @@ class CrossProcessServingPool:
             # completed request forever (a late duplicate completion
             # for an evicted rid is simply ignored by _on_done)
             self._requests.pop(req.rid, None)
+            self._resolved[req.rid] = status
+            while len(self._resolved) > 1024:
+                self._resolved.popitem(last=False)
         self.metrics.inc(f"requests_{status}")
+        # resolution journaling is COALESCED (flushed by the poll loop,
+        # or by the next synchronous accept/route/drain journal): this
+        # write only narrows the duplicate-replay window — a resolution
+        # lost with the controller is recovered from the members'
+        # re-announced ``_done_log`` records, token-identically — while
+        # the accept/route journals (the zero-loss contract) stay
+        # synchronous.  Journaling every resolve would put a
+        # full-snapshot van RPC on the serving hot path.
+        self._journal_dirty = True
 
     # ---- routing ----
     def _routable(self, exclude=()) -> list:
@@ -765,6 +1304,7 @@ class CrossProcessServingPool:
                            self._rtt_penalty(s))
                 prev = req.member
                 req.member = slot
+                req.sent = False
                 self._inflight[slot] = self._inflight.get(slot, 0) + 1
                 if prev is not None:
                     self._inflight[prev] = max(
@@ -772,6 +1312,14 @@ class CrossProcessServingPool:
             try:
                 self._send(slot, {"cmd": "submit", "rid": req.rid,
                                   **req.msg})
+                req.sent = True
+                # ownership journaling is coalesced like resolutions:
+                # by the snapshot's own invariant, losing it is safe —
+                # an unjournaled owner reads member=None, the takeover
+                # re-routes, and the duplicate submit is absorbed by
+                # the rid dedup token-identically.  Only the ACCEPT
+                # journal is load-bearing for zero loss.
+                self._journal_dirty = True
                 return
             except Exception:
                 with self._lock:
@@ -784,7 +1332,7 @@ class CrossProcessServingPool:
 
     def submit(self, prompt, *, max_tokens: int = 16, eos_id=None,
                timeout_s: Optional[float] = None) -> PoolRequest:
-        rid = next(self._rids)
+        rid = self._next_rid()
         msg = {"prompt": [int(t) for t in prompt],
                "max_tokens": int(max_tokens), "eos_id": eos_id,
                "timeout_s": float(timeout_s if timeout_s is not None
@@ -792,6 +1340,16 @@ class CrossProcessServingPool:
         req = PoolRequest(rid, msg)
         with self._lock:
             self._requests[rid] = req
+        # accepted ⇒ durable, BEFORE routing: once this journal write
+        # lands, a controller death at ANY later point still resolves
+        # the request (the zero-lost-accepted-requests contract).  A
+        # journal failure therefore REFUSES the accept.
+        try:
+            self._journal()
+        except Exception:
+            with self._lock:
+                self._requests.pop(rid, None)
+            raise
         self.metrics.inc("pool_requests")
         self._route(req)
         return req
@@ -814,6 +1372,12 @@ class CrossProcessServingPool:
                 self.poll()
             except Exception:
                 traceback.print_exc()  # the poll must survive anything
+            if self._journal_dirty and not self._fenced:
+                try:
+                    self._journal()
+                except Exception:
+                    traceback.print_exc()  # stays dirty; retried next
+                    # sweep
 
     def poll(self) -> int:
         """One membership sweep; returns how many members failed over.
@@ -824,7 +1388,16 @@ class CrossProcessServingPool:
             return self._poll_locked()
 
     def _poll_locked(self) -> int:
-        events = self.svc.poll()
+        try:
+            events = self.svc.poll()
+        except _mb.ControllerFenced:
+            # a newer incarnation owns the fleet: this controller is a
+            # zombie — stop acting, refuse every further write, and let
+            # the operator loop (controller_main) exit cleanly WITHOUT
+            # touching the members it no longer owns
+            self._fenced = True
+            self.metrics.inc("controller_fenced")
+            return 0
         n = 0
         for kind, slot in events:
             if kind == "suspect":
@@ -938,6 +1511,47 @@ class CrossProcessServingPool:
         return len(pending)
 
     # ---- planned drain (cross-process live migration) ----
+    def _drain_begin(self, slot: int, target: int, *, codec: str,
+                     close: bool, timeout_s: float) -> tuple:
+        """The BEGIN phase of a two-phase drain, shared by
+        :meth:`drain_member` and the chaos harness (which dies with the
+        drain half-open on purpose): allocate the migrate channel,
+        journal, recv_migration → mig_ready → drain.  Returns
+        ``(xid, xfer)``; a failure inside rolls back its own journal
+        record and xfer registration before re-raising.
+
+        Migrate channels are incarnation-keyed like the command
+        channels: the van outlives controllers, and a takeover's
+        process-local ``_MIG_SEQ`` restarts — an un-keyed id could
+        rebind a dead drain's channel, whose slot still holds an
+        unconsumed frame at foreign seqs.  The half-open record is
+        journaled BEFORE the first command: a controller death anywhere
+        inside the two-phase window leaves a record its successor
+        ABORTS back to a serving source (zero request loss)."""
+        xid = next(_xfer_ids)
+        xfer = {"evt": threading.Event(), "events": {}}
+        self._xfers[xid] = xfer
+        ch = _fenced_chan(CROSSHOST_MIGRATE_BASE + next(_MIG_SEQ),
+                          self.svc.ctrl_incarnation)
+        try:
+            with self._lock:
+                self._drain_journal[str(xid)] = {
+                    "source": int(slot), "target": int(target), "ch": ch,
+                    "codec": codec, "state": "begin",
+                    "close": bool(close)}
+            self._journal()
+            self._send(target, {"cmd": "recv_migration", "ch": ch,
+                                "xfer": xid, "timeout_s": timeout_s})
+            self._await_xfer(xfer, ("mig_ready",), timeout_s)
+            self._send(slot, {"cmd": "drain", "ch": ch, "xfer": xid,
+                              "codec": codec, "timeout_s": timeout_s})
+        except Exception:
+            self._xfers.pop(xid, None)
+            with self._lock:
+                self._drain_journal.pop(str(xid), None)
+            raise
+        return xid, xfer
+
     def drain_member(self, slot: int, *, codec: Optional[str] = None,
                      close: bool = True, target: Optional[int] = None,
                      timeout_s: float = 60.0) -> int:
@@ -960,9 +1574,7 @@ class CrossProcessServingPool:
             if slot in self._draining or slot in self._quarantined:
                 return 0
             self._draining.add(slot)
-        xid = next(_xfer_ids)
-        xfer = {"evt": threading.Event(), "events": {}}
-        self._xfers[xid] = xfer
+        xid = None
         try:
             with trace.span("serve.migrate", cat="serve") as sp:
                 sp.set("member", slot)
@@ -975,12 +1587,9 @@ class CrossProcessServingPool:
                     target = min(cands,
                                  key=lambda s: self._inflight.get(s, 0))
                 sp.set("target", int(target))
-                ch = CROSSHOST_MIGRATE_BASE + next(_MIG_SEQ)
-                self._send(target, {"cmd": "recv_migration", "ch": ch,
-                                    "xfer": xid, "timeout_s": timeout_s})
-                self._await_xfer(xfer, ("mig_ready",), timeout_s)
-                self._send(slot, {"cmd": "drain", "ch": ch, "xfer": xid,
-                                  "codec": codec, "timeout_s": timeout_s})
+                xid, xfer = self._drain_begin(
+                    slot, int(target), codec=codec, close=close,
+                    timeout_s=timeout_s)
                 ev = self._await_xfer(
                     xfer, ("adopted", "adopt_failed", "drain_failed"),
                     timeout_s)
@@ -1012,13 +1621,23 @@ class CrossProcessServingPool:
                     self._inflight[slot] = 0
                 self._send(slot, {"cmd": "drain_commit", "xfer": xid,
                                   "exit": bool(close)})
+                with self._lock:
+                    self._drain_journal.pop(str(xid), None)
+                self._journal()
                 sp.set("requests", n)
         except Exception:
             with self._lock:
                 self._draining.discard(slot)
+                if xid is not None:
+                    self._drain_journal.pop(str(xid), None)
+            try:
+                self._journal()
+            except Exception:
+                traceback.print_exc()
             raise
         finally:
-            self._xfers.pop(xid, None)
+            if xid is not None:
+                self._xfers.pop(xid, None)
         if close:
             p = self.procs[slot]
             if p is not None:
@@ -1078,6 +1697,13 @@ class CrossProcessServingPool:
         if p is not None and p.poll() is None:
             p.kill()
             p.wait()
+        elif slot in self._member_pids:
+            # a takeover-adopted member (the dead controller's child):
+            # the pid is the only handle
+            try:
+                os.kill(self._member_pids[slot], _signal.SIGKILL)
+            except OSError:
+                pass
         self._spawn(slot)
         self._wait_joined([slot])
         with self._lock:
@@ -1085,41 +1711,156 @@ class CrossProcessServingPool:
             self._draining.discard(slot)
         self.metrics.inc("members_revived")
 
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(int(pid), 0)
+            return True
+        except OSError:
+            return False
+
     # ---- lifecycle ----
     def close(self, timeout_s: float = 10.0) -> None:
         self._stop.set()
         t = getattr(self, "_poll_thread", None)
         if t is not None:
             t.join(timeout_s)
-        for slot in range(self.n_members):
+        if self._journal_dirty and not self._fenced:
             try:
-                self._send(slot, {"cmd": "shutdown"}, timeout_s=0.5,
-                           attempts=1)
+                self._journal()  # flush coalesced resolutions
             except Exception:
-                pass
+                traceback.print_exc()
+        if not self._fenced:
+            # a FENCED zombie does not own these members anymore: no
+            # shutdown commands, no kills — the new incarnation does
+            for slot in range(self.n_members):
+                try:
+                    self._send(slot, {"cmd": "shutdown"}, timeout_s=0.5,
+                               attempts=1)
+                except Exception:
+                    pass
         for _, (th, stop) in list(self._listeners.items()):
             stop.set()
         deadline = time.monotonic() + 5.0
-        for p in self.procs:
-            if p is None:
-                continue
-            try:
-                p.wait(timeout=max(deadline - time.monotonic(), 0.1))
-            except Exception:
-                p.kill()
-                p.wait()
+        if not self._fenced:
+            for p in self.procs:
+                if p is None:
+                    continue
+                try:
+                    p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+                except Exception:
+                    p.kill()
+                    p.wait()
+            # takeover-adopted members have no Popen handle — wait for
+            # their pids to honor the shutdown command, then SIGKILL
+            # stragglers (they were reparented when their spawner died,
+            # so there is no zombie-reap concern here)
+            for slot, pid in list(self._member_pids.items()):
+                while self._pid_alive(pid) and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.05)
+                if self._pid_alive(pid):
+                    try:
+                        os.kill(pid, _signal.SIGKILL)
+                    except OSError:
+                        pass
         for slot, ent in list(self._out.items()):
             try:
                 ent[0].close()
             except Exception:
                 pass
-        bb = getattr(self, "_bb", None)
-        if bb is not None:
-            bb.close()
+        for obj in (getattr(self, "_bb", None),
+                    getattr(self, "_ledger", None)):
+            if obj is not None:
+                try:
+                    obj.close()
+                except Exception:
+                    pass
         if self._own_van:
             self._van.stop()
 
 
+# ---------------------------------------------------------------------------
+# controller process harness (the chaos kill target)
+# ---------------------------------------------------------------------------
+
+def _begin_drain_and_hang(pool: CrossProcessServingPool, *,
+                          timeout_s: float = 30.0) -> None:
+    """Chaos-harness helper: START a two-phase drain (recv_migration +
+    drain sent, journaled half-open) and then hang forever — the
+    controller 'dies' with the drain half-exported; only a SIGKILL ends
+    this process.  The takeover must abort the drain back to a
+    still-serving source with zero request loss."""
+    with pool._lock:
+        src = max(range(pool.n_members),
+                  key=lambda s: pool._inflight.get(s, 0))
+        tgt = min((s for s in range(pool.n_members) if s != src),
+                  key=lambda s: pool._inflight.get(s, 0))
+        pool._draining.add(src)
+    pool._drain_begin(src, tgt, codec="none", close=True,
+                      timeout_s=timeout_s)
+    print("DRAIN_SENT", flush=True)
+    while True:
+        time.sleep(3600)
+
+
+def controller_main(config_path: str) -> int:
+    """Entry point for a spawned CONTROLLER process: build the pool
+    against an EXTERNAL van (the durable tier must outlive this
+    process — that is the whole point), submit a seeded request stream,
+    and hold.  The chaos harness SIGKILLs/SIGSTOPs this process; its
+    log carries the progress markers (``ACCEPTED k`` per accept,
+    ``ALLDONE``, ``DRAIN_SENT``, ``FENCED``) the harness keys on.  A
+    fenced wake-up (SIGSTOP → takeover → SIGCONT) exits WITHOUT
+    touching the members the new incarnation owns."""
+    cfg = json.loads(open(config_path).read())
+    pool = CrossProcessServingPool(
+        int(cfg.get("n_members", 2)), workdir=cfg["workdir"],
+        model=cfg.get("model"), port=int(cfg["port"]), own_van=False,
+        hb_ms=int(cfg.get("hb_ms", 80)),
+        lease_s=float(cfg.get("lease_s", 0.6)),
+        suspect_grace_s=float(cfg.get("suspect_grace_s", 0.5)),
+        request_timeout_s=float(cfg.get("request_timeout_s", 120.0)),
+        deaf_ack_s=cfg.get("deaf_ack_s"),
+        member_env={"JAX_PLATFORMS": "cpu"})
+    print("READY", flush=True)
+    try:
+        prompts = seeded_prompts(
+            int(cfg.get("n_requests", 8)),
+            int(cfg.get("prompt_seed", 0)),
+            vocab=int(pool.model["vocab_size"]))
+        gap = float(cfg.get("submit_gap_s", 0.05))
+        drain_at = cfg.get("drain_at")
+        reqs = []
+        for i, p in enumerate(prompts):
+            reqs.append(pool.submit(
+                p, max_tokens=int(cfg.get("max_tokens", 24))))
+            print(f"ACCEPTED {len(reqs)}", flush=True)
+            if drain_at is not None and i + 1 == int(drain_at):
+                _begin_drain_and_hang(pool)  # never returns
+            time.sleep(gap)
+        deadline = time.monotonic() + float(cfg.get("deadline_s",
+                                                    300.0))
+        while any(not r.done.is_set() for r in reqs) and \
+                not pool.fenced and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if not pool.fenced:
+            print("ALLDONE", flush=True)
+        hold_until = time.monotonic() + float(cfg.get("hold_s", 0.0))
+        while time.monotonic() < hold_until and not pool.fenced:
+            time.sleep(0.05)
+    except _mb.ControllerFenced:
+        pool._fenced = True  # fence mid-submit/mid-drain: exit below
+    if pool.fenced:
+        print("FENCED", flush=True)
+        pool.close()  # fenced close: channels only, members untouched
+        return 3
+    pool.close()
+    return 0
+
+
 if __name__ == "__main__":
     import sys
+    if sys.argv[1] == "--controller":
+        sys.exit(controller_main(sys.argv[2]))
     sys.exit(member_main(sys.argv[1]))

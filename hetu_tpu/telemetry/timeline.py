@@ -100,6 +100,14 @@ RECOVERY_FOR = {
     # the slowness degenerated into a lease expiry
     "stage_kill": ("pipeline.stage_replace",),
     "stage_slow": ("train.straggler", "pipeline.stage_replace"),
+    # control plane (ps/membership controller lease): a killed OR
+    # suspended-past-takeover controller is answered by the fenced
+    # takeover — a new incarnation claims the controller row, adopts
+    # the fleet from blackboard + ledger, and republishes the frozen
+    # epoch; the span ends when the hand-off (re-adoption, drain
+    # aborts, re-routes / exact resume) is complete
+    "controller_kill": ("ctrl.takeover",),
+    "controller_suspend": ("ctrl.takeover",),
 }
 
 # kinds whose RECOVERY_FOR tuple is a strict preference order: the first

@@ -24,10 +24,11 @@ pytestmark = pytest.mark.crosshost
 # ---------------------------------------------------------------------------
 
 class FakeTable:
-    """In-memory stand-in for the blackboard's RemotePSTable surface."""
+    """In-memory stand-in for the blackboard's RemotePSTable surface
+    (n member rows + control row + controller row)."""
 
     def __init__(self, n_slots):
-        self.rows = np.zeros((n_slots + 1, mb.MEMBER_DIM), np.float32)
+        self.rows = np.zeros((n_slots + 2, mb.MEMBER_DIM), np.float32)
 
     def sparse_set(self, idx, vals):
         self.rows[np.asarray(idx, int)] = np.asarray(vals, np.float32)

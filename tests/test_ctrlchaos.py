@@ -1,0 +1,834 @@
+"""Fenced control-plane takeover (ISSUE 12).
+
+Fast lane: the controller lease + incarnation-fence state machine on a
+fake blackboard, member-side stale-write rejection and silence
+detection, the van controller-ledger codec and its fencing, the shared
+supervisor straggler plane, timeline pairing for the new controller
+fault kinds, and the ``deaf_ack_s`` constructor plumb for the two
+planes that could not enable it before.
+
+Slow+chaos (``ctrlchaos`` marker): real processes — the van (durable
+tier) and the CONTROLLER each their own OS process, so a seeded
+``controller_kill`` is a real SIGKILL that does NOT take the
+blackboard/ledger/members down.  Acceptance per plane: takeover
+completes under a ``ctrl.takeover`` span, serving resolves every
+accepted request 'ok' token-exact with zero loss (including a drain
+left half-exported), training/pipeline runs finish byte-identical to
+un-killed same-seed runs (including a controller killed between
+PREPARE and the last ack), and a SIGSTOP→takeover→SIGCONT zombie is
+FENCED — its writes rejected, fleet state unchanged.
+"""
+
+import json
+import subprocess
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import available
+from hetu_tpu.ps import membership as mb
+from hetu_tpu.telemetry import timeline
+
+pytestmark = pytest.mark.ctrlchaos
+
+
+# ---------------------------------------------------------------------------
+# fast lane: controller lease + fence state machine (fake blackboard)
+# ---------------------------------------------------------------------------
+
+class FakeTable:
+    """In-memory blackboard stand-in (n member rows + control row +
+    controller row) — also reused at arbitrary shapes for the ledger."""
+
+    def __init__(self, rows):
+        self.rows = np.zeros((rows, mb.MEMBER_DIM), np.float32)
+
+    def sparse_set(self, idx, vals):
+        self.rows[np.asarray(idx, int)] = np.asarray(vals, np.float32)
+
+    def sparse_pull(self, idx):
+        return self.rows[np.asarray(idx, int)].copy()
+
+
+class FakeLedgerTable:
+    def __init__(self, rows, dim):
+        self.rows = np.zeros((rows, dim), np.float32)
+
+    def sparse_set(self, idx, vals):
+        self.rows[np.asarray(idx, int)] = np.asarray(vals, np.float32)
+
+    def sparse_pull(self, idx):
+        return self.rows[np.asarray(idx, int)].copy()
+
+
+def _bb(n=2):
+    return FakeTable(n + 2)
+
+
+def test_claim_is_monotonic_and_beats_ride_poll():
+    t = _bb()
+    svc = mb.MembershipService(t, 2, lease_s=10.0, suspect_grace_s=10.0)
+    assert svc.ctrl_incarnation == 1
+    row = t.sparse_pull([3])[0]
+    assert int(row[mb.R_CINC]) == 1
+    beat0 = int(row[mb.R_CBEAT])
+    svc.poll()
+    svc.poll()
+    row = t.sparse_pull([3])[0]
+    assert int(row[mb.R_CBEAT]) > beat0  # the poll cadence IS the beat
+
+
+def test_takeover_fences_the_old_controller():
+    """Two controllers on one blackboard: the second claim supersedes
+    the first, whose every write path then raises ControllerFenced —
+    and the NEW controller keeps working (a lower incarnation surfacing
+    on the row must not fence the current owner)."""
+    t = _bb()
+    old = mb.MembershipService(t, 2, lease_s=10.0, suspect_grace_s=10.0)
+    old.publish_control(epoch=1, width=2, alive_mask=3)
+    new = mb.MembershipService(t, 2, lease_s=10.0, suspect_grace_s=10.0)
+    assert new.ctrl_incarnation == old.ctrl_incarnation + 1
+    with pytest.raises(mb.ControllerFenced):
+        old.publish_control(epoch=2, width=2, alive_mask=3)
+    assert old.fenced
+    with pytest.raises(mb.ControllerFenced):
+        old.poll()  # fenced once = fenced forever
+    # the new incarnation publishes and polls freely
+    new.publish_control(epoch=2, width=2, alive_mask=3)
+    assert new.poll() == []
+    assert not new.fenced
+    # the control row carries the winner's incarnation
+    crow = t.sparse_pull([2])[0]
+    assert int(crow[mb.C_CTRL_INC]) == new.ctrl_incarnation
+
+
+def test_zombie_poll_detects_fence_before_acting():
+    """A SIGSTOP lookalike: the old controller sleeps through a
+    takeover, then wakes and polls — the poll's fence check fires
+    BEFORE any lease decision or beat write."""
+    t = _bb()
+    old = mb.MembershipService(t, 2, lease_s=0.01,
+                               suspect_grace_s=0.01)
+    old.publish_control(epoch=1, width=2, alive_mask=3)
+    new = mb.MembershipService(t, 2, lease_s=10.0,
+                               suspect_grace_s=10.0)
+    beat_row = t.sparse_pull([3])[0].copy()
+    with pytest.raises(mb.ControllerFenced):
+        old.poll()
+    # the zombie's poll wrote NO controller beat over the new owner's
+    np.testing.assert_array_equal(t.sparse_pull([3])[0], beat_row)
+    assert new.poll() == []
+
+
+def test_member_client_rejects_stale_control_rows():
+    """The member-side half of the fence: after observing incarnation
+    N, a control row stamped N-1 (the zombie's write racing the
+    takeover) is ignored and the last accepted control tuple
+    returned."""
+    t = _bb()
+    svc = mb.MembershipService(t, 2, lease_s=10.0, suspect_grace_s=10.0)
+    svc2 = mb.MembershipService(t, 2, lease_s=10.0,
+                                suspect_grace_s=10.0)
+    svc2.publish_control(epoch=5, width=2, alive_mask=3)
+    client = mb.MembershipClient(slot=0, n_slots=2, table=t)
+    assert client.read_control()[0] == 5
+    assert client.ctrl_inc == svc2.ctrl_incarnation
+    # a zombie write: epoch moves backwards under the OLD incarnation
+    row = np.zeros((1, mb.MEMBER_DIM), np.float32)
+    row[0, mb.C_EPOCH] = 99
+    row[0, mb.C_CTRL_INC] = svc.ctrl_incarnation  # the superseded one
+    t.sparse_set([2], row)
+    assert client.read_control()[0] == 5  # stale write ignored
+    assert client.stale_control_reads == 1
+
+
+def test_member_detects_controller_silence_and_recovery():
+    t = _bb()
+    svc = mb.MembershipService(t, 2, lease_s=10.0, suspect_grace_s=10.0)
+    svc.publish_control(epoch=1, width=2, alive_mask=3)
+    client = mb.MembershipClient(slot=0, n_slots=2, table=t)
+    client.read_control()
+    assert not client.controller_silent(0.05)
+    time.sleep(0.08)  # no polls: the controller row froze
+    client.read_control()
+    assert client.controller_silent(0.05)
+    assert not client.controller_silent(None)  # disabled = never silent
+    svc.poll()  # the controller beats again (same incarnation)
+    client.read_control()
+    assert not client.controller_silent(0.05)
+    # a TAKEOVER beat (new incarnation) also unparks
+    time.sleep(0.08)
+    client.read_control()
+    assert client.controller_silent(0.05)
+    mb.MembershipService(t, 2, lease_s=10.0, suspect_grace_s=10.0)
+    client.read_control()
+    assert not client.controller_silent(0.05)
+
+
+# ---------------------------------------------------------------------------
+# fast lane: the controller ledger
+# ---------------------------------------------------------------------------
+
+def test_ledger_roundtrip_version_and_empty_read():
+    led = mb.ControllerLedger(table=FakeLedgerTable(64, 8), rows=64,
+                              dim=8)
+    assert led.read() is None  # never written
+    state = {"requests": {"7": {"msg": {"prompt": [1, 2, 3]},
+                                "member": 1, "retries": 0}},
+             "drains": {}, "rid": 7}
+    v1 = led.write(state, ctrl_inc=3)
+    got = led.read()
+    assert got["state"] == state
+    assert got["version"] == v1 == 1
+    assert got["ctrl_inc"] == 3
+    v2 = led.write({"rid": 8}, ctrl_inc=3)
+    assert v2 == 2
+    assert led.read()["state"] == {"rid": 8}  # shrink is clean (nbytes
+    # bounds the read; stale tail rows are never decoded)
+
+
+def test_ledger_write_is_fenced():
+    led = mb.ControllerLedger(table=FakeLedgerTable(64, 8), rows=64,
+                              dim=8)
+    led.write({"a": 1}, ctrl_inc=5)
+    with pytest.raises(mb.ControllerFenced):
+        led.write({"a": 2}, ctrl_inc=4)  # the zombie's snapshot
+    assert led.read()["state"] == {"a": 1}
+    led.write({"a": 3}, ctrl_inc=6)  # the successor clobbers freely
+    assert led.read()["ctrl_inc"] == 6
+
+
+def test_ledger_rejects_oversize_snapshot():
+    led = mb.ControllerLedger(table=FakeLedgerTable(4, 8), rows=4, dim=8)
+    assert led.capacity_bytes() == 48
+    with pytest.raises(ValueError, match="capacity"):
+        led.write({"blob": "x" * 200}, ctrl_inc=1)
+
+
+def test_ledger_roundtrips_non_ascii_and_odd_lengths():
+    led = mb.ControllerLedger(table=FakeLedgerTable(64, 8), rows=64,
+                              dim=8)
+    for state in ({"s": "abc"}, {"s": "abcd"}, {"s": "π∂η"},
+                  {}, {"n": [1, 2, 3], "f": 1.5}):
+        led.write(state, ctrl_inc=1)
+        assert led.read()["state"] == state
+
+
+# ---------------------------------------------------------------------------
+# fast lane: timeline pairing + shared straggler plane
+# ---------------------------------------------------------------------------
+
+def test_controller_fault_timeline_pairing_and_report_coverage():
+    evs = [
+        {"ph": "i", "name": "fault.controller_kill", "ts": 100.0,
+         "seq": 0, "args": {"kind": "controller_kill", "step": 3}},
+        {"ph": "i", "name": "fault.controller_suspend", "ts": 500.0,
+         "seq": 1, "args": {"kind": "controller_suspend", "step": 5}},
+        {"ph": "X", "name": "ctrl.takeover", "ts": 200.0, "dur": 90.0,
+         "seq": 2, "args": {"plane": "serving", "incarnation": 2}},
+        {"ph": "X", "name": "ctrl.takeover", "ts": 600.0, "dur": 50.0,
+         "seq": 3, "args": {"plane": "elastic", "incarnation": 3}},
+    ]
+    pairs = timeline.correlate(evs)
+    by = {p.kind: p for p in pairs}
+    assert by["controller_kill"].paired
+    assert by["controller_kill"].recovery_name == "ctrl.takeover"
+    assert by["controller_suspend"].paired
+    rep = timeline.report(pairs)
+    for kind in ("controller_kill", "controller_suspend"):
+        assert rep[kind]["injected"] == 1 and rep[kind]["paired"] == 1
+
+
+def test_every_fault_kind_still_has_a_recovery_mapping():
+    from hetu_tpu.resilience.faults import KINDS
+    for kind in KINDS:
+        assert kind in timeline.RECOVERY_FOR, kind
+
+
+def test_supervisor_straggler_plane_inject_heal_observe():
+    """The dedupe satellite: the shared plane reproduces the glue both
+    supervisors used to carry — set_slow injection, heal applied only
+    at a poll past due time, and load/committed extraction feeding the
+    shared detector."""
+    from hetu_tpu.resilience.straggler import SupervisorStragglerPlane
+
+    class FakeSvc:
+        def __init__(self):
+            self.slow_calls = []
+            self.loads = {0: 10.0, 1: 11.0, 2: 120.0}
+            self.committed = {0: 5, 1: 5, 2: 5}
+
+        def set_slow(self, slot, ms):
+            self.slow_calls.append((slot, ms))
+
+        def state_of(self, slot):
+            class _S:
+                pass
+            s = _S()
+            s.load = self.loads[slot]
+            s.committed = self.committed[slot]
+            return s
+
+    svc = FakeSvc()
+    plane = SupervisorStragglerPlane(svc, factor=4.0, subject="worker",
+                                     policy="evict", evict_after=1,
+                                     slow_ms=120)
+    plane.inject(2, duration_s=0.05)
+    assert svc.slow_calls == [(2, 120)]
+    plane.inject(1, duration_s=0.05, slow_ms=40)  # explicit override
+    assert svc.slow_calls[-1] == (1, 40)
+    plane.maybe_heal()
+    assert len(svc.slow_calls) == 2  # not due yet: no spurious heal
+    time.sleep(0.07)
+    plane.maybe_heal()
+    assert svc.slow_calls[-1] == (-1, 0)  # healed, exactly once
+    plane.maybe_heal()
+    assert len(svc.slow_calls) == 3
+    # detection: slot 2 is 10x the median of its peers
+    assert plane.observe([0, 1, 2]) == []  # opens the episode
+    svc.committed[2] = 7  # two slow committed steps later
+    crossed = plane.observe([0, 1, 2])
+    assert crossed == [2]
+    plane.close(2, resolution="evicted")
+    assert plane.records[-1]["resolution"] == "evicted"
+
+
+# ---------------------------------------------------------------------------
+# fast lane (needs lib): deaf_ack_s constructor plumb per plane
+# ---------------------------------------------------------------------------
+
+needs_lib = pytest.mark.skipif(not available(),
+                               reason="native PS lib unavailable")
+
+
+@needs_lib
+def test_deaf_ack_plumbs_through_serving_pool(tmp_path, monkeypatch):
+    """Satellite regression: the serving pool can now enable PR 11's
+    deaf-member detection (spawns patched out — this pins the
+    constructor plumb, not member behavior)."""
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+    monkeypatch.setattr(CrossProcessServingPool, "_spawn",
+                        lambda self, slot: None)
+    monkeypatch.setattr(CrossProcessServingPool, "_wait_joined",
+                        lambda self, slots, timeout_s=None: None)
+    pool = CrossProcessServingPool(2, workdir=tmp_path,
+                                   deaf_ack_s=1.5, start_poll=False)
+    try:
+        assert pool.svc.deaf_ack_s == 1.5
+    finally:
+        pool.close()
+
+
+@needs_lib
+def test_deaf_ack_plumbs_through_elastic_supervisor(tmp_path,
+                                                    monkeypatch):
+    from hetu_tpu.resilience.multicontroller import (
+        MultiControllerElasticSupervisor,
+    )
+    monkeypatch.setattr(MultiControllerElasticSupervisor, "_spawn",
+                        lambda self, slot: None)
+    monkeypatch.setattr(
+        MultiControllerElasticSupervisor, "_wait_joined",
+        lambda self, slots, timeout_s=None: None)
+    monkeypatch.setattr(MultiControllerElasticSupervisor, "_publish",
+                        lambda self, **kw: None)
+    sup = MultiControllerElasticSupervisor(
+        2, workdir=tmp_path, steps=2, global_batch=4, deaf_ack_s=2.5)
+    try:
+        assert sup.svc.deaf_ack_s == 2.5
+        # the parking bound rides the worker spec
+        assert sup.spec.ctrl_lease_s == 0.0
+    finally:
+        sup.close()
+
+
+# ---------------------------------------------------------------------------
+# real processes (slow + chaos): the acceptance per plane
+# ---------------------------------------------------------------------------
+
+TINY = {"vocab_size": 89, "hidden_size": 48, "num_layers": 2,
+        "num_heads": 4, "ffn_size": 96, "max_position": 96,
+        "num_slots": 8, "max_len": 80, "min_bucket": 8, "seed": 1}
+
+
+def _spawn_van(workdir):
+    from hetu_tpu.resilience.shardproc import (
+        free_port, spawn_shard_server,
+    )
+    port = free_port()
+    proc = spawn_shard_server(workdir, port, tag="ctrlvan")
+    return port, proc
+
+
+def _spawn_controller(workdir, module, cfg, tag="ctrl"):
+    from hetu_tpu.resilience.shardproc import spawn_module
+    cfg_path = Path(workdir) / f"{tag}.json"
+    cfg_path.write_text(json.dumps(cfg))
+    return spawn_module(workdir, tag, module,
+                        ["--controller", str(cfg_path)],
+                        extra_env={"JAX_PLATFORMS": "cpu"},
+                        timeout_s=180.0)
+
+
+def _wait_marker(proc, marker, timeout_s=120.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        text = Path(proc.log_path).read_text(errors="replace")
+        if marker in text:
+            return text
+        if proc.poll() is not None and marker not in text:
+            raise AssertionError(
+                f"controller exited rc={proc.returncode} before "
+                f"{marker!r}:\n{text[-2000:]}")
+        time.sleep(0.05)
+    raise TimeoutError(f"no {marker!r} within {timeout_s}s:\n"
+                       f"{Path(proc.log_path).read_text()[-2000:]}")
+
+
+def _count_marker(proc, prefix):
+    return sum(1 for ln in Path(proc.log_path).read_text(
+        errors="replace").splitlines() if ln.startswith(prefix))
+
+
+def _kill_all(procs, workdir=None):
+    for p in procs:
+        if p is not None and p.poll() is None:
+            p.kill()
+            p.wait()
+    if workdir is not None:
+        # member/worker/stage processes are children of the KILLED
+        # controller; if a test failed before takeover adopted them,
+        # nothing holds their handles — reap by cmdline (every spawned
+        # process names its workdir config on its argv)
+        try:
+            subprocess.run(["pkill", "-9", "-f", str(workdir)],
+                           capture_output=True, timeout=10)
+        except Exception:
+            pass
+
+
+def _engine_reference():
+    from hetu_tpu.serve import ContinuousBatchingScheduler, Request
+    from hetu_tpu.serve.crosshost import build_engine
+    _, _, engine = build_engine(TINY)
+    sched = ContinuousBatchingScheduler(engine)
+    memo = {}
+
+    def ref(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in memo:
+            r = Request(prompt=list(prompt), max_tokens=n,
+                        timeout_s=300.0)
+            sched.submit(r)
+            while not r.done.is_set():
+                sched.step()
+            assert r.status == "ok"
+            memo[key] = list(r.tokens)
+        return memo[key]
+    return ref
+
+
+def _drive_kill(proc, injector, schedule, *, progress_prefix,
+                timeout_s=120.0):
+    """Feed the injector the controller's observed progress (ACCEPTED /
+    STEP markers) until the seeded kill fires."""
+    kill_step = next(e.step for e in schedule.events)
+    fired = 0
+    deadline = time.monotonic() + timeout_s
+    while proc.poll() is None:
+        assert time.monotonic() < deadline, "seeded kill never fired"
+        cur = _count_marker(proc, progress_prefix)
+        for t in range(fired + 1, cur + 1):
+            injector.on_step(t)
+        fired = max(fired, cur)
+        if fired >= kill_step:
+            break
+        time.sleep(0.05)
+    deadline = time.monotonic() + 10.0
+    while proc.poll() is None:
+        assert time.monotonic() < deadline
+        time.sleep(0.02)
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_controller_kill_serving_takeover_token_exact(tmp_path):
+    """THE acceptance, serving plane: seeded controller SIGKILL
+    mid-traffic (van + controller are separate processes) → a new
+    incarnation takes over from blackboard + ledger, re-adopts the
+    still-serving members, and EVERY accepted request resolves 'ok'
+    token-exact — zero lost.  The fault pairs as ``ctrl.takeover``,
+    and the adopted pool keeps serving new traffic."""
+    from hetu_tpu.resilience.faults import FaultInjector, FaultSchedule
+    from hetu_tpu.serve.crosshost import (
+        CrossProcessServingPool, seeded_prompts,
+    )
+    from hetu_tpu.telemetry import trace
+
+    N_REQ = 8
+    schedule = FaultSchedule.generate(steps=N_REQ, seed=3,
+                                      controller_kills=1)
+    assert [e.kind for e in schedule.events] == ["controller_kill"]
+    assert schedule.to_json() == FaultSchedule.generate(
+        steps=N_REQ, seed=3, controller_kills=1).to_json()  # replayable
+    port, van_proc = _spawn_van(tmp_path)
+    pool = None
+    ctrl = None
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    try:
+        ctrl = _spawn_controller(
+            tmp_path, "hetu_tpu.serve.crosshost",
+            {"workdir": str(tmp_path), "port": port, "n_members": 2,
+             "model": TINY, "n_requests": N_REQ, "max_tokens": 40,
+             "submit_gap_s": 0.15, "hold_s": 600.0, "prompt_seed": 0,
+             "lease_s": 0.5, "suspect_grace_s": 0.4},
+            tag="serve_ctrl")
+        inj = FaultInjector(schedule, ctrl_procs=[ctrl])
+        _drive_kill(ctrl, inj, schedule, progress_prefix="ACCEPTED")
+        accepted = _count_marker(ctrl, "ACCEPTED")
+        assert inj.counters["controller_procs_killed"] == 1
+        assert accepted >= 1
+        pool = CrossProcessServingPool.takeover(
+            workdir=tmp_path, port=port, lease_s=0.5,
+            suspect_grace_s=0.4)
+        rep = pool.takeover_report
+        # accepted ⇒ durable: the ledger knew every accepted rid
+        assert rep["adopted_requests"] + rep["resolved_known"] >= \
+            accepted
+        results = pool.wait_adopted(timeout_s=120.0)
+        ref = _engine_reference()
+        prompts = seeded_prompts(N_REQ, 0, vocab=TINY["vocab_size"])
+        for rid, res in results.items():
+            assert res["status"] == "ok", (rid, res)
+            # rid i maps to prompt i-1 (rids are 1-based, in order)
+            assert res["tokens"] == ref(prompts[rid - 1], 40), rid
+        # zero lost: every accepted rid is either adopted-and-ok or was
+        # already resolved ok by the dead controller (journaled)
+        lost = [rid for rid in range(1, accepted + 1)
+                if rid not in results and
+                pool.takeover_report["resolved"].get(rid) != "ok"]
+        assert lost == []
+        # the adopted pool is a full controller: fresh traffic works
+        resp = pool.generate([5, 6, 7], max_tokens=6, timeout_s=60.0)
+        assert resp["status"] == "ok"
+        assert resp["tokens"] == ref([5, 6, 7], 6)
+    finally:
+        if pool is not None:
+            pool.close()
+        _kill_all([ctrl, van_proc], tmp_path)
+        trace.disable()
+    pairs = timeline.correlate(tracer.events)
+    kills = [p for p in pairs if p.kind == "controller_kill"]
+    assert len(kills) == 1 and kills[0].paired
+    assert kills[0].recovery_name == "ctrl.takeover"
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_controller_kill_mid_drain_aborts_to_source(tmp_path):
+    """Takeover edge case: the controller dies with a two-phase drain
+    HALF-EXPORTED (journaled 'begin', never committed).  The new
+    incarnation aborts it — the source re-adopts its export (the
+    PR 5/8 abort path) — and every accepted request still resolves
+    'ok' token-exact: zero request loss."""
+    from hetu_tpu.serve.crosshost import (
+        CrossProcessServingPool, seeded_prompts,
+    )
+
+    N_REQ = 8
+    port, van_proc = _spawn_van(tmp_path)
+    pool = None
+    ctrl = None
+    try:
+        ctrl = _spawn_controller(
+            tmp_path, "hetu_tpu.serve.crosshost",
+            {"workdir": str(tmp_path), "port": port, "n_members": 2,
+             "model": TINY, "n_requests": N_REQ, "max_tokens": 48,
+             "submit_gap_s": 0.05, "hold_s": 600.0, "prompt_seed": 4,
+             "drain_at": 6, "lease_s": 0.5, "suspect_grace_s": 0.4},
+            tag="drain_ctrl")
+        _wait_marker(ctrl, "DRAIN_SENT", timeout_s=90.0)
+        accepted = _count_marker(ctrl, "ACCEPTED")
+        ctrl.kill()
+        ctrl.wait()
+        pool = CrossProcessServingPool.takeover(
+            workdir=tmp_path, port=port, lease_s=0.5,
+            suspect_grace_s=0.4)
+        assert pool.takeover_report["drains_aborted"] == 1
+        results = pool.wait_adopted(timeout_s=120.0)
+        ref = _engine_reference()
+        prompts = seeded_prompts(N_REQ, 4, vocab=TINY["vocab_size"])
+        for rid, res in results.items():
+            assert res["status"] == "ok", (rid, res)
+            assert res["tokens"] == ref(prompts[rid - 1], 48), rid
+        for rid in range(1, accepted + 1):
+            assert rid in results or \
+                pool.takeover_report["resolved"].get(rid) == "ok", rid
+    finally:
+        if pool is not None:
+            pool.close()
+        _kill_all([ctrl, van_proc], tmp_path)
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_suspended_controller_is_fenced_after_takeover(tmp_path):
+    """The zombie: seeded controller SIGSTOP, takeover during the
+    pause, SIGCONT — the resumed controller observes the fence, prints
+    FENCED, and exits WITHOUT touching the members; the fleet stays
+    with the new incarnation and keeps serving token-exact."""
+    from hetu_tpu.resilience.faults import FaultInjector, FaultSchedule
+    from hetu_tpu.serve.crosshost import (
+        CrossProcessServingPool, seeded_prompts,
+    )
+
+    N_REQ = 4
+    schedule = FaultSchedule.generate(steps=8, seed=5,
+                                      controller_suspends=1,
+                                      controller_suspend_s=8.0)
+    assert [e.kind for e in schedule.events] == ["controller_suspend"]
+    port, van_proc = _spawn_van(tmp_path)
+    pool = None
+    ctrl = None
+    try:
+        ctrl = _spawn_controller(
+            tmp_path, "hetu_tpu.serve.crosshost",
+            {"workdir": str(tmp_path), "port": port, "n_members": 2,
+             "model": TINY, "n_requests": N_REQ, "max_tokens": 8,
+             "submit_gap_s": 0.02, "hold_s": 600.0, "prompt_seed": 9,
+             "lease_s": 0.5, "suspect_grace_s": 0.4},
+            tag="zombie_ctrl")
+        _wait_marker(ctrl, "ALLDONE", timeout_s=90.0)
+        inj = FaultInjector(schedule, ctrl_procs=[ctrl])
+        inj.on_step(next(e.step for e in schedule.events))
+        assert inj.counters["controller_procs_suspended"] == 1
+        pool = CrossProcessServingPool.takeover(
+            workdir=tmp_path, port=port, lease_s=0.5,
+            suspect_grace_s=0.4)
+        new_inc = pool.svc.ctrl_incarnation
+        # the injector's timer SIGCONTs the zombie; it must fence out
+        _wait_marker(ctrl, "FENCED", timeout_s=60.0)
+        deadline = time.monotonic() + 10.0
+        while ctrl.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert ctrl.poll() == 3  # the fenced exit code, members alive
+        # fleet state unchanged: both members still with the new owner
+        assert sorted(pool.svc.present_slots()) == [0, 1]
+        assert pool.svc.read_control_row()  # readable, and...
+        crow = pool._bb.sparse_pull([pool.n_members + 1])[0]
+        assert int(crow[mb.R_CINC]) == new_inc  # ...still ours
+        assert pool.metrics.count("pool_failovers") == 0
+        ref = _engine_reference()
+        resp = pool.generate([3, 1, 4], max_tokens=6, timeout_s=60.0)
+        assert resp["status"] == "ok"
+        assert resp["tokens"] == ref([3, 1, 4], 6)
+        assert pool.metrics.count("controller_fenced") == 0
+        # the prompts the zombie accepted were all resolved pre-suspend
+        prompts = seeded_prompts(N_REQ, 9, vocab=TINY["vocab_size"])
+        assert len(prompts) == N_REQ
+    finally:
+        if pool is not None:
+            pool.close()
+        _kill_all([ctrl, van_proc], tmp_path)
+
+
+def _elastic_cfg(workdir, port, **kw):
+    cfg = {"workdir": str(workdir), "port": port, "n_workers": 3,
+           "steps": 80, "global_batch": 12, "data_seed": 5,
+           "lease_s": 0.5, "suspect_grace_s": 0.4,
+           "step_sleep_s": 0.04, "ctrl_lease_s": 0.8}
+    cfg.update(kw)
+    return cfg
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_controller_kill_elastic_byte_identical(tmp_path):
+    """THE acceptance, elastic plane: seeded controller SIGKILL
+    mid-run → workers PARK at their next step boundary (ctrl_lease_s),
+    a new incarnation republishes the frozen membership with an exact
+    resume, and the run consumes global batches BYTE-IDENTICAL to an
+    un-killed same-seed run (complete cover per step — this plane's
+    determinism contract since PR 8).
+
+    Weights are asserted close, not bitwise: N workers' gradient
+    pushes land at the PS in nondeterministic ORDER and f32
+    subtraction is not associative, so even two un-killed same-seed
+    runs differ at ~1e-3 (measured) — bitwise params are the MPMD
+    plane's contract (exactly-once double buffer), covered by
+    ``test_chaos_controller_kill_mpmd_byte_identical``."""
+    from hetu_tpu.resilience.faults import FaultInjector, FaultSchedule
+    from hetu_tpu.resilience.multicontroller import (
+        MultiControllerElasticSupervisor,
+    )
+    from hetu_tpu.telemetry import trace
+
+    # ---- clean arm: same seed, no kill (in-process controller)
+    clean_dir = tmp_path / "clean"
+    clean_dir.mkdir()
+    sup = MultiControllerElasticSupervisor(
+        3, workdir=clean_dir, steps=80, global_batch=12, data_seed=5,
+        lease_s=0.5, suspect_grace_s=0.4, step_sleep_s=0.04,
+        ctrl_lease_s=0.8)
+    try:
+        clean = sup.run(deadline_s=240.0)
+        sup.verify_consumed(clean["consumed"])
+    finally:
+        sup.close()
+
+    # ---- chaos arm: external van, controller its own process
+    schedule = FaultSchedule.generate(steps=80, seed=11,
+                                      controller_kills=1)
+    (ev,) = schedule.events
+    assert ev.kind == "controller_kill"
+    chaos_dir = tmp_path / "chaos"
+    chaos_dir.mkdir()
+    port, van_proc = _spawn_van(chaos_dir)
+    new_sup = None
+    ctrl = None
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    try:
+        ctrl = _spawn_controller(chaos_dir,
+                                 "hetu_tpu.resilience.multicontroller",
+                                 _elastic_cfg(chaos_dir, port),
+                                 tag="elastic_ctrl")
+        inj = FaultInjector(schedule, ctrl_procs=[ctrl])
+        _drive_kill(ctrl, inj, schedule, progress_prefix="STEP")
+        assert inj.counters["controller_procs_killed"] == 1
+        time.sleep(2.0)  # > ctrl_lease_s: every worker is parked
+        new_sup = MultiControllerElasticSupervisor.takeover(
+            workdir=chaos_dir, port=port, lease_s=0.5,
+            suspect_grace_s=0.4)
+        assert new_sup.takeover_report["incarnation"] >= 2
+        chaos = new_sup.run(deadline_s=240.0)
+        # THE byte-identity evidence on this plane: every step a
+        # complete cover of the width-invariant schedule's exact bytes
+        new_sup.verify_consumed(chaos["consumed"])
+        # weights: same trajectory within push-order rounding noise
+        # (see docstring — bitwise is the MPMD plane's contract)
+        np.testing.assert_allclose(chaos["final_weights"],
+                                   clean["final_weights"],
+                                   rtol=0.05, atol=0.01)
+        # the takeover republish is recorded as a reshard-style epoch
+        assert any(r["kind"] == "takeover"
+                   for r in chaos["resizes"])
+    finally:
+        if new_sup is not None:
+            new_sup.close()
+        _kill_all([ctrl, van_proc], chaos_dir)
+        trace.disable()
+    pairs = timeline.correlate(tracer.events)
+    kills = [p for p in pairs if p.kind == "controller_kill"]
+    assert len(kills) == 1 and kills[0].paired
+    assert kills[0].recovery_name == "ctrl.takeover"
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_controller_killed_between_prepare_and_ack(tmp_path):
+    """Takeover edge case: the controller publishes a PREPARE freeze
+    and dies before collecting the acks.  The new incarnation's fresh
+    epoch supersedes the half-open one, re-freezes, and resumes at the
+    exact step — the run completes with a complete byte-identical
+    cover."""
+    from hetu_tpu.resilience.multicontroller import (
+        MultiControllerElasticSupervisor,
+    )
+
+    port, van_proc = _spawn_van(tmp_path)
+    new_sup = None
+    ctrl = None
+    try:
+        ctrl = _spawn_controller(
+            tmp_path, "hetu_tpu.resilience.multicontroller",
+            _elastic_cfg(tmp_path, port, steps=30, step_sleep_s=0.02,
+                         prepare_hang_at=5),
+            tag="prepare_ctrl")
+        _wait_marker(ctrl, "PREPARED", timeout_s=90.0)
+        ctrl.kill()
+        ctrl.wait()
+        time.sleep(0.5)
+        new_sup = MultiControllerElasticSupervisor.takeover(
+            workdir=tmp_path, port=port, lease_s=0.5,
+            suspect_grace_s=0.4)
+        rep = new_sup.takeover_report
+        # the control row the dead controller left was mid-PREPARE
+        assert rep["epoch"] > 1
+        chaos = new_sup.run(deadline_s=240.0)
+        new_sup.verify_consumed(chaos["consumed"])  # exact resume: no
+        # step re-run into the committed sequence, none skipped
+    finally:
+        if new_sup is not None:
+            new_sup.close()
+        _kill_all([ctrl, van_proc], tmp_path)
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_controller_kill_mpmd_byte_identical(tmp_path):
+    """THE acceptance, MPMD plane: seeded controller SIGKILL mid-run on
+    a 3-stage 1F1B pipeline → stages park, a new incarnation
+    re-freezes with an exact resume, and final per-stage params are
+    BYTE-IDENTICAL to an un-killed same-seed run."""
+    from hetu_tpu.parallel.mpmd_elastic import MPMDPipelineSupervisor
+    from hetu_tpu.resilience.faults import FaultInjector, FaultSchedule
+    from hetu_tpu.telemetry import trace
+
+    base = dict(steps=24, n_microbatches=4, width=8, batch=8,
+                schedule="1f1b", wire="bf16", data_seed=3,
+                lease_s=0.5, suspect_grace_s=0.4, step_sleep_s=0.08,
+                ctrl_lease_s=0.8)
+    clean_dir = tmp_path / "clean"
+    clean_dir.mkdir()
+    sup = MPMDPipelineSupervisor(3, workdir=clean_dir, **base)
+    try:
+        clean = sup.run(deadline_s=240.0)
+    finally:
+        sup.close()
+
+    schedule = FaultSchedule.generate(steps=24, seed=1,
+                                      controller_kills=1)
+    chaos_dir = tmp_path / "chaos"
+    chaos_dir.mkdir()
+    port, van_proc = _spawn_van(chaos_dir)
+    new_sup = None
+    ctrl = None
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    try:
+        ctrl = _spawn_controller(
+            chaos_dir, "hetu_tpu.parallel.mpmd_elastic",
+            {"workdir": str(chaos_dir), "port": port, "n_stages": 3,
+             **{k: v for k, v in base.items()}},
+            tag="mpmd_ctrl")
+        inj = FaultInjector(schedule, ctrl_procs=[ctrl])
+        _drive_kill(ctrl, inj, schedule, progress_prefix="STEP")
+        assert inj.counters["controller_procs_killed"] == 1
+        time.sleep(2.0)  # > ctrl_lease_s: every stage is parked
+        new_sup = MPMDPipelineSupervisor.takeover(
+            workdir=chaos_dir, port=port, lease_s=0.5,
+            suspect_grace_s=0.4)
+        chaos = new_sup.run(deadline_s=240.0)
+        for s in clean["final_params"]:
+            np.testing.assert_array_equal(clean["final_params"][s],
+                                          chaos["final_params"][s])
+        assert new_sup.takeover_report["incarnation"] >= 2
+    finally:
+        if new_sup is not None:
+            new_sup.close()
+        _kill_all([ctrl, van_proc], tmp_path)
+        trace.disable()
+    pairs = timeline.correlate(tracer.events)
+    kills = [p for p in pairs if p.kind == "controller_kill"]
+    assert len(kills) == 1 and kills[0].paired
+    assert kills[0].recovery_name == "ctrl.takeover"

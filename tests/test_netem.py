@@ -134,7 +134,8 @@ class FlakyTable:
     controller-side half of an asymmetric partition."""
 
     def __init__(self, n_slots):
-        self.rows = np.zeros((n_slots + 1, mb.MEMBER_DIM), np.float32)
+        # n member rows + control row + controller row
+        self.rows = np.zeros((n_slots + 2, mb.MEMBER_DIM), np.float32)
         self.down = False
 
     def sparse_set(self, idx, vals):

@@ -166,6 +166,33 @@ def test_schedule_stage_fault_kinds_draw_after_everything():
         grown.to_json()
 
 
+def test_schedule_controller_fault_kinds_draw_after_everything():
+    """FIFTH extension of the frozen-bytes contract (ISSUE 12): the
+    control-plane kinds (controller_kill/controller_suspend) must draw
+    from the rng AFTER every pre-existing kind — including the
+    pipeline-stage kinds PR 11 added — so every recorded chaos seed
+    still replays byte-for-byte."""
+    old = dict(steps=50, seed=7, van_errors=2, kill_shards=1, n_shards=2,
+               serve_preempts=1, n_members=2, member_kills=1,
+               member_suspends=1, worker_proc_kills=1, n_workers=3,
+               netem_partitions=1, netem_degrades=1, stragglers=1,
+               stage_kills=1, stage_slows=1, n_stages=3)
+    base = FaultSchedule.generate(**old)
+    ctrl_kinds = ("controller_kill", "controller_suspend")
+    grown = FaultSchedule.generate(**old, controller_kills=1,
+                                   controller_suspends=1,
+                                   controller_suspend_s=1.5,
+                                   n_controllers=1)
+    old_events = [e for e in grown.events if e.kind not in ctrl_kinds]
+    assert old_events == base.events
+    new = {e.kind: e for e in grown.events if e.kind in ctrl_kinds}
+    assert sorted(new) == sorted(ctrl_kinds)
+    assert new["controller_suspend"].arg2 == 1.5
+    assert new["controller_kill"].arg == 0.0  # n_controllers=1
+    assert FaultSchedule.from_json(grown.to_json()).to_json() == \
+        grown.to_json()
+
+
 def test_schedule_at_and_validation():
     s = FaultSchedule([FaultEvent(3, "nan_grad"), FaultEvent(3, "van_error"),
                        FaultEvent(5, "preempt")])
