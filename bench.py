@@ -606,6 +606,167 @@ def bench_serve():
     })
 
 
+def bench_paged():
+    """Paged KV cache (prefix sharing + chunked prefill) vs the slot
+    engine at MATCHED HBM budget — the ISSUE 13 acceptance A/B.
+
+    A/B 1 (throughput, shared-prefix workload): both engines get the
+    same K/V token capacity (slot: ``SLOTS x MAXLEN``; paged: the same
+    token count as a page pool).  Requests share one system prompt with
+    short unique suffixes — the pool's realistic traffic shape.  The
+    slot engine admits at most SLOTS sequences and caches the shared
+    prefix once PER SLOT; the paged engine dedups the prefix to one
+    physical copy and allocates only live pages, so far more sequences
+    decode concurrently in the same memory → higher sustained decode
+    tokens/sec.
+
+    A/B 2 (p99 decode latency under a long-prompt arrival): while short
+    requests decode, a MAXLEN-scale prompt arrives.  The slot engine
+    prefills it monolithically inside one scheduler step (every
+    in-flight decode stalls behind it); the paged engine interleaves
+    page-aligned chunks with decode rounds, so the worst step latency
+    stays bounded at ~one chunk.
+
+    Also reports the prefix-dedup bytes saved (hit tokens x per-token
+    K/V bytes) and the prefix hit rate.
+    """
+    import os
+
+    from hetu_tpu import models
+    from hetu_tpu.serve import (
+        ContinuousBatchingScheduler, PagedServeEngine, Request,
+        ServeEngine,
+    )
+
+    V, H, L, NH, SLOTS, MAXLEN, NREQ, PAGE = (
+        50304, 768, 12, 12, 8, 512, 64, 64)
+    if os.environ.get("HETU_BENCH_SMOKE"):  # CI/CPU smoke: same code path
+        V, H, L, NH, SLOTS, MAXLEN, NREQ, PAGE = (
+            512, 64, 2, 4, 4, 128, 32, 16)
+    cfg = models.GPTConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=NH,
+        ffn_size=4 * H, max_position=MAXLEN, dropout_rate=0.0,
+        dtype=jnp.bfloat16)
+    model = models.GPTModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0))
+    g = np.random.default_rng(0)
+    # system-prompt-heavy traffic (the dedup-relevant shape): 3/4 of the
+    # context is a shared prefix, short unique question, short answer
+    prefix = [int(t) for t in g.integers(0, V, 3 * MAXLEN // 4)]
+    gen = MAXLEN // 32
+
+    def shared_requests():
+        rng = np.random.default_rng(1)
+        return [Request(prompt=prefix + [int(t) for t in
+                                         rng.integers(0, V, 8)],
+                        max_tokens=gen) for _ in range(NREQ)]
+
+    # matched HBM budget: same cached-token capacity on both arms
+    budget_tokens = SLOTS * MAXLEN
+
+    def slot_engine():
+        return ServeEngine(model, variables, num_slots=SLOTS,
+                           max_len=MAXLEN)
+
+    def paged_engine():
+        return PagedServeEngine(
+            model, variables, num_slots=2 * SLOTS, max_len=MAXLEN,
+            page_size=PAGE, num_pages=1 + budget_tokens // PAGE)
+
+    def throughput(make):
+        engine = make()
+        sch = ContinuousBatchingScheduler(engine,
+                                          prefill_chunks_per_step=2)
+        # warm TWICE: the first pass compiles cold-index buckets, the
+        # second mirrors the timed pass's admission pattern (the prefix
+        # index is populated by then, which changes bucket traffic)
+        sch.run(shared_requests())
+        sch.run(shared_requests())
+        best = 0.0
+        for _ in range(3):  # best-of-3: the region is ~100ms, box noise
+            rs = shared_requests()  # is not
+            t0 = time.perf_counter()
+            sch.run(rs)
+            dt = time.perf_counter() - t0
+            best = max(best, sum(len(r.tokens) for r in rs) / dt)
+        return best, engine
+
+    tps_slot, _ = throughput(slot_engine)
+    tps_paged, pe = throughput(paged_engine)
+    snap = pe.metrics.snapshot()
+    spec = pe.cache.spec
+    per_tok = (2 * spec.num_layers * spec.num_kv_heads * spec.head_dim
+               * np.dtype(jnp.bfloat16).itemsize)
+    dedup_bytes = int(snap.get("prefix_hit_tokens", 0)) * per_tok
+
+    def p99_under_arrival(make, warm_steps=4):
+        """Max/p99 per-step latency of an engine decoding short
+        requests while one MAXLEN-scale prompt arrives.  The identical
+        workload runs once UNMEASURED first so every executable (chunk
+        buckets, page/batch buckets, the long prefill bucket) is warm —
+        the timed pass isolates the scheduling policy, not XLA."""
+        engine = make()
+        sch = ContinuousBatchingScheduler(engine,
+                                          prefill_chunks_per_step=2)
+
+        def workload(seed, timed):
+            rng = np.random.default_rng(seed)
+            short = [Request(
+                prompt=[int(t) for t in rng.integers(0, V, 12)],
+                max_tokens=MAXLEN // 2) for _ in range(3)]
+            for r in short:
+                sch.submit(r)
+            for _ in range(warm_steps):
+                sch.step()
+            long_req = Request(
+                prompt=[int(t) for t in
+                        rng.integers(0, V, MAXLEN - gen - 2)],
+                max_tokens=4)
+            sch.submit(long_req)
+            lats = []
+            while sch.has_work():
+                t0 = time.perf_counter()
+                sch.step()
+                lats.append(time.perf_counter() - t0)
+            return lats
+
+        workload(2, timed=False)  # warm every bucket the timed pass hits
+        p99s, maxes = [], []
+        for _ in range(3):  # median-of-3 against box noise
+            lats = sorted(workload(2, timed=True))
+            p99s.append(lats[min(int(0.99 * len(lats)), len(lats) - 1)])
+            maxes.append(lats[-1])
+        return sorted(p99s)[1], sorted(maxes)[1]
+
+    p99_slot, max_slot = p99_under_arrival(slot_engine)
+    p99_paged, max_paged = p99_under_arrival(paged_engine)
+
+    speedup = tps_paged / max(tps_slot, 1e-9)
+    _emit({
+        "metric": "serve_paged_vs_slot_decode_throughput_x",
+        "value": round(speedup, 3),
+        "unit": "x_decode_tokens_per_sec_matched_hbm_shared_prefix",
+        "extra": {
+            "paged_tokens_per_s": round(tps_paged, 1),
+            "slot_tokens_per_s": round(tps_slot, 1),
+            "budget_tokens": budget_tokens,
+            "page_size": PAGE,
+            "requests": NREQ,
+            "prefix_hit_rate": round(snap.get("prefix_hit_rate", 0.0), 3),
+            "prefix_dedup_bytes_saved": dedup_bytes,
+            "cow_copies": int(snap.get("cow_copies", 0)),
+            "long_prompt_arrival": {
+                "p99_step_s_slot_monolithic": round(p99_slot, 4),
+                "p99_step_s_paged_chunked": round(p99_paged, 4),
+                "max_step_s_slot_monolithic": round(max_slot, 4),
+                "max_step_s_paged_chunked": round(max_paged, 4),
+                "p99_flatness_x": round(p99_slot / max(p99_paged, 1e-9),
+                                        3),
+            },
+        },
+    })
+
+
 def bench_migrate():
     """Live KV-slot migration vs re-prefill: the failover-cost crossover.
 
@@ -2225,6 +2386,7 @@ _METRIC_BY_CMD = {
     "ctr": "wdl_criteo_device_sparse_samples_per_sec_per_chip",
     "moe": "moe_block_bf16_train_mfu_1chip",
     "serve": "gpt_serve_decode_tokens_per_sec_1chip",
+    "paged": "serve_paged_vs_slot_decode_throughput_x",
     "ctr_serve": "ctr_serve_p99_speedup_vs_cacheless",
     "migrate": "serve_migrate_speedup_vs_reprefill_longest_ctx",
     "quant": "quant_int8_ps_gradient_wire_reduction",
@@ -2269,6 +2431,7 @@ def main():
         _emit_stale_or_die(_METRIC_BY_CMD.get(cmd, _METRIC_BY_CMD["gpt"]))
     {"resnet": bench_resnet, "ctr": bench_ctr, "moe": bench_moe,
      "gpt_sweep": bench_gpt_sweep, "serve": bench_serve,
+     "paged": bench_paged,
      "ctr_serve": bench_ctr_serve,
      "migrate": bench_migrate,
      "quant": bench_quant,

@@ -128,6 +128,14 @@ enum VanOp : uint8_t {
   // rc=-100 (unknown op); the client treats that as "speak f32" — that
   // single round trip IS the negotiation, no capability handshake op.
   OP_DENSE_PUSH_W = 31, OP_DENSE_PULL_W = 32, OP_SPARSE_PUSH_W = 33,
+  // single-row compare-and-set: atomically (vs other CAS ops) compare
+  // one f32 field of a row against an expected value and, on match,
+  // write the whole row.  The leader-election primitive the membership
+  // plane's controller-incarnation claim needs — read-then-write lets
+  // two simultaneous claimants tie; CAS makes exactly one win.  The
+  // response always carries the row AFTER the operation, so a losing
+  // claimant learns the winner's value in the same round trip.
+  OP_ROW_CAS = 34,
 };
 
 // Per-table bounded set of recently applied push request-ids.  A repeated
@@ -492,7 +500,7 @@ void handle_conn(int fd) {
         0, 48, 28, 4, 4, 13, 12, 12, 8, 8, 0, 12, 20,
         20, 36, 12, 12, 8, 16, 8, 0, 8, 4,
         24, 20, 16, 16, 0, 4, 12, 12,
-        13, 5, 21};
+        13, 5, 21, 20};
     if (op < sizeof(kMinBody) / sizeof(uint32_t) &&
         blen < 1 + kMinBody[op]) {
       send_resp(fd, -3, nullptr, 0);
@@ -684,6 +692,45 @@ void handle_conn(int fd) {
         }
         if (dedup) g_push_dedup.finish(id, req, rc == 0);
         send_resp(fd, rc, nullptr, 0);
+        break;
+      }
+      case OP_ROW_CAS: {
+        // [i32 id][i64 row][i32 field][f32 expected][f32 desired x dim]
+        // resp: [u8 swapped][f32 row x dim] (the row AFTER the op).
+        // g_cas_mu serializes the read-compare-write against OTHER CAS
+        // ops — claimants all speak CAS, so ties are impossible among
+        // them; plain sparse_set writers are outside the contract.
+        static std::mutex g_cas_mu;
+        int id = rd<int32_t>(p);
+        int64_t row = rd<int64_t>(p);
+        int32_t field = rd<int32_t>(p);
+        float expected = rd<float>(p);
+        int64_t dim = ps_table_dim(id);
+        int64_t have = body.data() + blen - p;
+        if (dim < 0) { send_resp(fd, -1, nullptr, 0); break; }
+        if (dim == 0 || field < 0 || field >= dim ||
+            have < dim * (int64_t)sizeof(float)) {
+          send_resp(fd, -3, nullptr, 0); break;
+        }
+        const float* desired = (const float*)p;
+        std::vector<char> out(1 + dim * sizeof(float));
+        float* cur = (float*)(out.data() + 1);
+        int rc;
+        {
+          std::lock_guard<std::mutex> lk(g_cas_mu);
+          rc = ps_sparse_pull(id, &row, 1, cur, nullptr);
+          if (rc == 0) {
+            bool match = cur[field] == expected;
+            if (match) {
+              rc = ps_sparse_set(id, &row, desired, 1);
+              if (rc == 0)
+                std::memcpy(cur, desired, dim * sizeof(float));
+            }
+            out[0] = (rc == 0 && match) ? 1 : 0;
+          }
+        }
+        if (rc != 0) { send_resp(fd, rc, nullptr, 0); break; }
+        send_resp(fd, 0, out.data(), out.size());
         break;
       }
       case OP_SAVE: case OP_LOAD: {
@@ -1363,6 +1410,28 @@ int ps_van_sparse_push(int fd, int id, const int64_t* idx,
 int ps_van_sparse_set(int fd, int id, const int64_t* idx,
                       const float* vals, int64_t n, int64_t dim) {
   return van_sparse_write(OP_SPARSE_SET, fd, id, idx, vals, n, dim);
+}
+
+// Single-row compare-and-set (OP_ROW_CAS): returns 0 when the swap
+// happened, 1 on a compare mismatch (actual_out then holds the current
+// row — the loser of a claim race reads the winner's value from the
+// same round trip), negative on server/transport errors.  An OLD server
+// answers -100 (unknown op); callers fall back to read-then-write.
+int ps_van_row_cas(int fd, int id, int64_t row, int field, float expected,
+                   const float* desired, int64_t dim, float* actual_out) {
+  std::vector<char> b{(char)OP_ROW_CAS}, pay;
+  put<int32_t>(b, id); put<int64_t>(b, row); put<int32_t>(b, field);
+  put<float>(b, expected);
+  size_t o = b.size();
+  b.resize(o + dim * sizeof(float));
+  std::memcpy(b.data() + o, desired, dim * sizeof(float));
+  int32_t rc = kTransportErr;
+  if (!request(fd, b, &rc, &pay)) return kTransportErr;
+  if (rc != 0) return rc;
+  if ((int64_t)pay.size() != 1 + dim * (int64_t)sizeof(float)) return -5;
+  if (actual_out)
+    std::memcpy(actual_out, pay.data() + 1, dim * sizeof(float));
+  return pay[0] ? 0 : 1;
 }
 
 int ps_van_dense_pull(int fd, int id, float* out, int64_t count) {

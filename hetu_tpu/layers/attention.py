@@ -128,6 +128,28 @@ class MultiHeadAttention(Module):
                                   for t in (q, k, v)))
         return self._out(p, out, b, s), k, v
 
+    def prefill_chunk_step(self, variables, x, k_cache, v_cache, starts):
+        """Chunked prefill against a cache (the paged engine's prefill).
+
+        x: [B, S_c, H] — a chunk whose token ``i`` sits at absolute
+        position ``starts[b] + i``; k_cache/v_cache: [B, T, nh, hd]
+        already holding the tokens before the chunk (a shared prefix,
+        earlier chunks).  Writes the chunk's K/V at ``starts`` and
+        attends over history + the chunk's causal triangle.  Returns
+        (y [B, S_c, H], new_k_cache, new_v_cache).  With starts == 0 and
+        S_c == T the numerics match :meth:`prefill_step` token-for-token.
+        """
+        if not self.causal:
+            raise NotImplementedError("KV-cache decode is causal-LM only")
+        p = variables["params"]
+        b, s, _ = x.shape
+        x = x.astype(self.dtype)
+        q, k, v = self._qkv(p, x)
+        k_cache, v_cache = ops.cache_update(k_cache, v_cache, k, v, starts)
+        out = ops.chunk_attention(jnp.moveaxis(q, 1, 2), k_cache, v_cache,
+                                  starts)
+        return self._out(p, out, b, s), k_cache, v_cache
+
     def decode_step(self, variables, x, k_cache, v_cache, lengths):
         """One-token decode against a slot cache.
 

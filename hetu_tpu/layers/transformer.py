@@ -100,6 +100,18 @@ class TransformerBlock(Module):
             self._mod(p, self.ln1, "ln1", x))
         return self._mlp(p, x + a), k, v
 
+    def prefill_chunk_step(self, variables, x, k_cache, v_cache, starts):
+        """Chunked prefill: x [B,S_c,H] at absolute positions
+        ``starts[b] + i``, caches [B,T,nh,hd] holding everything before
+        the chunk → (out, new_k_cache, new_v_cache)."""
+        if not self.pre_norm:
+            raise NotImplementedError("KV-cache decode needs pre-LN blocks")
+        p = variables["params"]
+        a, k_cache, v_cache = self.attn.prefill_chunk_step(
+            {"params": p["attn"], "state": {}},
+            self._mod(p, self.ln1, "ln1", x), k_cache, v_cache, starts)
+        return self._mlp(p, x + a), k_cache, v_cache
+
     def decode_step(self, variables, x, k_cache, v_cache, lengths):
         """x [B,1,H], caches [B,T,nh,hd] → (out, new_k_cache, new_v_cache)."""
         if not self.pre_norm:

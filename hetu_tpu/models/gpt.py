@@ -134,6 +134,50 @@ class GPTModel(Module):
         logits = ops.linear(h, p["tok_emb"].T.astype(c.dtype))
         return logits, ks, vs
 
+    def prefill_chunk_with_cache(self, variables, input_ids, k_cache,
+                                 v_cache, start, *, last_index=None):
+        """Chunked prefill: forward ONE chunk of the prompt against a
+        cache already holding everything before it (earlier chunks, or a
+        shared prefix adopted from the prefix cache).
+
+        input_ids: [B, S_c] at absolute positions ``start .. start+S_c-1``
+        (right-padded within the chunk bucket; pad positions produce junk
+        K/V that decode masks/overwrites).  k_cache/v_cache:
+        [L, B, T, nh, hd] with positions ``< start`` already written.
+        Returns (logits [B, V] at chunk-relative ``last_index``
+        (default S_c - 1), new_k, new_v).  With start == 0 and one chunk
+        covering the prompt, the numerics match
+        :meth:`prefill_with_cache` token-for-token.
+        """
+        p = variables["params"]
+        c = self.c
+        b, s = input_ids.shape
+        h = ops.embedding_lookup(p["tok_emb"], input_ids)
+        # per-index gather (not dynamic_slice): a final chunk's PAD tail
+        # may run past max_position, and slice-start clamping would shift
+        # the REAL tokens' positions.  mode="clip" is load-bearing: the
+        # default gather fills out-of-range rows with NaN, and a NaN pad
+        # K/V row poisons real queries through 0 * NaN in the masked
+        # attention product
+        pos = jnp.take(p["pos_emb"], start + jnp.arange(s), axis=0,
+                       mode="clip")
+        h = (h + pos[None]).astype(c.dtype)
+        starts = jnp.full((b,), start, jnp.int32)
+
+        def layer(carry, xs):
+            p_l, k_l, v_l = xs
+            out, k_l, v_l = self.block.prefill_chunk_step(
+                {"params": p_l, "state": {}}, carry, k_l, v_l, starts)
+            return out, (k_l, v_l)
+
+        h, (k_cache, v_cache) = jax.lax.scan(
+            layer, h, (p["blocks"], k_cache, v_cache))
+        h = ops.layer_norm(h, p["ln_f_scale"], p["ln_f_bias"])
+        idx = s - 1 if last_index is None else last_index
+        h = jax.lax.dynamic_index_in_dim(h, idx, axis=1, keepdims=False)
+        logits = ops.linear(h, p["tok_emb"].T.astype(c.dtype))
+        return logits, k_cache, v_cache
+
     def decode_with_cache(self, variables, input_ids, k_cache, v_cache,
                           lengths):
         """One decode step for a batch of cached sequences.

@@ -165,6 +165,27 @@ class LlamaBlock(Module):
             cos, sin)
         return self._mlp(p, x + a), k, v
 
+    def prefill_chunk_step(self, variables, x, k_cache, v_cache, starts,
+                           cos, sin):
+        """Chunked prefill; cos/sin are FULL tables [T_max, hd/2] gathered
+        at each token's absolute position (``starts[b] + i``).
+        x [B,S_c,H]; caches [B,T,nkv,hd] holding everything before the
+        chunk.  Returns (out, new_k, new_v)."""
+        p = variables["params"]
+        c = self.c
+        b, s, _ = x.shape
+        hn = ops.rms_norm(x, p["rms1_scale"], eps=c.rms_eps)
+        q, k, v = self._qkv(p["attn"], hn)
+        q = ops.apply_rope_at(jnp.moveaxis(q, 1, 2), cos, sin, starts)
+        k = ops.apply_rope_at(jnp.moveaxis(k, 1, 2), cos, sin, starts)
+        k_cache, v_cache = ops.cache_update(
+            k_cache, v_cache, jnp.moveaxis(k, 1, 2), v, starts)
+        out = ops.chunk_attention(q, k_cache, v_cache, starts)
+        out = jnp.moveaxis(out, 1, 2).reshape(b, s, c.hidden_size)
+        a = ops.linear(out.astype(c.dtype),
+                       p["attn"]["out_weight"].astype(c.dtype))
+        return self._mlp(p, x + a), k_cache, v_cache
+
     def decode_step(self, variables, x, k_cache, v_cache, lengths,
                     cos, sin):
         """One-token decode; cos/sin are FULL tables [T_max, hd/2] gathered
@@ -261,6 +282,36 @@ class LlamaModel(Module):
                                              keepdims=False)  # [B, H]
         logits = ops.linear(h, p["lm_head"].T.astype(c.dtype))
         return logits, ks, vs
+
+    def prefill_chunk_with_cache(self, variables, input_ids, k_cache,
+                                 v_cache, start, *, last_index=None):
+        """Chunked prefill (see GPTModel.prefill_chunk_with_cache):
+        input_ids [B, S_c] at absolute positions ``start..start+S_c-1``,
+        caches [L, B, T, nkv, hd] with positions < start written.
+        Returns (logits [B, V] at chunk-relative ``last_index``, new_k,
+        new_v)."""
+        p = variables["params"]
+        c = self.c
+        b, s = input_ids.shape
+        h = ops.embedding_lookup(p["tok_emb"], input_ids).astype(c.dtype)
+        # full tables, gathered per token at its absolute position
+        cos, sin = self._tables(c.max_position)
+        starts = jnp.full((b,), start, jnp.int32)
+
+        def layer(carry, xs):
+            p_l, k_l, v_l = xs
+            out, k_l, v_l = self.block.prefill_chunk_step(
+                {"params": p_l, "state": {}}, carry, k_l, v_l, starts,
+                cos, sin)
+            return out, (k_l, v_l)
+
+        h, (k_cache, v_cache) = jax.lax.scan(
+            layer, h, (p["blocks"], k_cache, v_cache))
+        h = ops.rms_norm(h, p["rms_f_scale"], eps=c.rms_eps)
+        idx = s - 1 if last_index is None else last_index
+        h = jax.lax.dynamic_index_in_dim(h, idx, axis=1, keepdims=False)
+        logits = ops.linear(h, p["lm_head"].T.astype(c.dtype))
+        return logits, k_cache, v_cache
 
     def decode_with_cache(self, variables, input_ids, k_cache, v_cache,
                           lengths):

@@ -63,7 +63,8 @@ from hetu_tpu.ops.moe_ops import (
     balance_assignment, make_slot_routing, gather_dispatch, gather_combine,
 )
 from hetu_tpu.ops.attention import (
-    attention, cache_update, causal_attention, decode_attention,
+    attention, cache_update, causal_attention, chunk_attention,
+    decode_attention,
 )
 from hetu_tpu.ops.graph_ops import (
     coo_spmm, gcn_norm, gcn_conv,

@@ -52,6 +52,44 @@ def cache_update(k_cache, v_cache, k_new, v_new, lengths):
     return write(k_cache, k_new, lengths), write(v_cache, v_new, lengths)
 
 
+def chunk_attention(q, k_cache, v_cache, starts, *, scale=None):
+    """Multi-token chunk attention against a cache (the chunked-prefill /
+    prefix-sharing core, GQA-aware).
+
+    q: [B, heads, S_c, D] — a CHUNK of queries whose token ``i`` sits at
+    absolute position ``starts[b] + i``; its K/V must already be written
+    into the cache (:func:`cache_update` handles multi-row writes).
+    k_cache/v_cache: [B, T, kv_heads, D] holding the tokens BEFORE the
+    chunk (a shared prefix, earlier chunks) plus the chunk itself.
+    starts: [B] int32 — the chunk's first absolute position.  Query ``i``
+    attends to cache positions ``<= starts[b] + i`` (history + the
+    chunk's own causal triangle in one mask); later positions (unwritten,
+    or stale from a previous page occupant) are masked out.
+
+    With ``starts == 0`` and S_c == T this reduces to causal attention —
+    the property the paged-vs-slot token-parity tests ride on.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    nh, nkv = q.shape[1], k_cache.shape[2]
+    k = jnp.moveaxis(k_cache, 1, 2)  # [B, kv_heads, T, D]
+    v = jnp.moveaxis(v_cache, 1, 2)
+    if nkv != nh:
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    t = k_cache.shape[1]
+    s_c = q.shape[-2]
+    pos = starts[:, None] + jnp.arange(s_c)                  # [B, S_c]
+    valid = jnp.arange(t)[None, None, :] <= pos[:, :, None]  # [B, S_c, T]
+    scores = jnp.where(valid[:, None], scores,
+                       jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
 def decode_attention(q, k_cache, v_cache, lengths, *, scale=None):
     """Single-token attention against a slot cache (GQA-aware).
 

@@ -52,6 +52,11 @@ def apply_rope_at(x, cos, sin, positions):
     """
     s = x.shape[-2]
     pos = positions[:, None] + jnp.arange(s)        # [B, S]
+    # clamp: a padded chunk's tail can run past the table (chunked
+    # prefill near max_len), and the default out-of-range gather FILLS
+    # NaN — which would poison real lanes through 0 * NaN in masked
+    # attention.  Clamping only ever touches pad positions.
+    pos = jnp.clip(pos, 0, cos.shape[0] - 1)
     c = cos[pos][:, None].astype(x.dtype)           # [B, 1, S, D/2]
     sn = sin[pos][:, None].astype(x.dtype)
     d2 = x.shape[-1] // 2

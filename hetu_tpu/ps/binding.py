@@ -129,6 +129,9 @@ def _load():
             "ps_van_sparse_push_id": ([c.c_int, c.c_int, i64p, f32p,
                                        c.c_int64, c.c_int64, c.c_uint64],
                                       c.c_int),
+            # single-row compare-and-set (controller-claim primitive)
+            "ps_van_row_cas": ([c.c_int, c.c_int, c.c_int64, c.c_int,
+                                c.c_float, f32p, c.c_int64, f32p], c.c_int),
             "ps_van_table_clear": ([c.c_int, c.c_int], c.c_int),
             "ps_van_table_save": ([c.c_int, c.c_int, c.c_char_p], c.c_int),
             "ps_van_table_load": ([c.c_int, c.c_int, c.c_char_p], c.c_int),

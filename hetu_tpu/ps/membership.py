@@ -460,14 +460,51 @@ class MembershipService:
         incarnation attempts from here on is rejectable by comparison
         alone.  Returns the claimed incarnation.
 
-        The claim is read-then-write (the van has no CAS op), so two
-        SIMULTANEOUS claimants could compute the same incarnation —
-        the write is therefore VERIFIED: re-read the row, and if the
-        pid on it is not ours, someone tied; re-claim one higher.
-        This converges (each retry strictly raises the incarnation,
-        and the last writer of a tie keeps it), narrowing the
-        split-brain window to sub-RPC scheduling — a true CAS is the
-        ROADMAP's residual."""
+        The claim is a van-side COMPARE-AND-SET (``OP_ROW_CAS`` on the
+        incarnation field): of two SIMULTANEOUS claimants exactly one
+        swap lands — ties are impossible, not merely converged-away —
+        and the loser reads the winner's incarnation from the CAS
+        response and re-claims one higher.  Against an old van that
+        does not speak the op, falls back to the verified
+        read-then-write loop (re-read, check the pid, re-claim on a
+        tie), which converges but leaves a sub-RPC split-brain window.
+        """
+        row = control_rpc(
+            lambda: self.table.sparse_pull([self.n_slots + 1]),
+            rng=self._rng, op="controller_claim", link=self.link,
+            deadline_s=self.rpc_deadline_s)
+        observed = int(row[0, R_CINC])
+        for _ in range(16):
+            want = max(observed + 1, self.ctrl_incarnation + 1)
+            desired = np.zeros(MEMBER_DIM, np.float32)
+            desired[R_CINC] = want
+            desired[R_CBEAT] = 1
+            desired[R_CEPOCH] = self._published_epoch
+            desired[R_CPID] = os.getpid() % (1 << 24)
+            try:
+                swapped, actual = control_rpc(
+                    lambda: self.table.row_cas(
+                        self.n_slots + 1, R_CINC, float(observed),
+                        desired),
+                    rng=self._rng, op="controller_claim_cas",
+                    link=self.link, deadline_s=self.rpc_deadline_s)
+            except (NotImplementedError, AttributeError):
+                return self._claim_controller_rmw()
+            if swapped:
+                self.ctrl_incarnation = want
+                self.ctrl_beat = 1
+                return want
+            # lost the race: the response carries the winner's row
+            observed = int(actual[R_CINC])
+        raise ControllerFenced(
+            "could not claim the controller row: persistent claim "
+            "contention (another controller keeps out-claiming us)")
+
+    def _claim_controller_rmw(self) -> int:
+        """Pre-CAS fallback claim (old van servers): read-then-write
+        with a verify re-read — two simultaneous claimants can tie for
+        one RPC, but each retry strictly raises the incarnation and the
+        last writer keeps it."""
         for _ in range(8):
             row = control_rpc(
                 lambda: self.table.sparse_pull([self.n_slots + 1]),

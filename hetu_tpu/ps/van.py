@@ -455,6 +455,35 @@ class RemotePSTable:
                                             self._dt),
                    "van_sparse_set")
 
+    def row_cas(self, row: int, field: int, expected: float, desired):
+        """Single-row compare-and-set: atomically (among CAS callers)
+        compare field ``field`` of ``row`` against ``expected`` and, on
+        match, write the whole ``desired`` row.  Returns ``(swapped,
+        actual_row)`` — ``actual_row`` is the row AFTER the operation,
+        so a losing claimant reads the winner's value from the same
+        round trip.  The leader-election primitive the membership
+        plane's controller-incarnation claim rides on.
+
+        Raises :class:`NotImplementedError` against an old server that
+        does not speak the op (rc=-100) — callers fall back to the
+        verified read-then-write claim."""
+        d = np.ascontiguousarray(
+            np.asarray(desired, np.float32).reshape(-1))
+        if d.shape[0] != self.dim:
+            raise ValueError(f"desired row has {d.shape[0]} fields; "
+                             f"table dim is {self.dim}")
+        actual = np.empty(self.dim, np.float32)
+        with _op_span("van_row_cas", d.nbytes):
+            rc = lib.ps_van_row_cas(self.fd, self.id, int(row), int(field),
+                                    float(expected), _f32p(d), self.dim,
+                                    _f32p(actual))
+        if rc == -100:
+            raise NotImplementedError(
+                "van server does not speak OP_ROW_CAS")
+        if rc not in (0, 1):
+            _check(rc, "van_row_cas")
+        return rc == 0, actual
+
     def clear(self) -> None:
         """Zero the table in place (ParamClear analog); bumps versions so
         caches re-pull.  Reusable accumulators clear between steps instead

@@ -116,6 +116,15 @@ class WorkerSpec:
     # and resumes on the first beat from ANY controller incarnation —
     # the member half of fenced control-plane takeover
     ctrl_lease_s: float = 0.0
+    # rank-ordered gradient application: workers STAGE their gradients
+    # (idempotent sparse_set into per-rank rows of `staging_table`),
+    # barrier, then rank 0 applies them to the weights IN RANK ORDER
+    # over one connection — f32 addition is not associative, so
+    # arrival-order pushes reproduce same-seed runs only to ~1e-3;
+    # rank order makes clean same-seed dp runs BITWISE identical
+    # (the byte-identity level the MPMD plane already has)
+    ordered_grads: bool = False
+    staging_table: int = 0
     log_path: str = ""
 
     def to_json(self) -> str:
@@ -175,6 +184,12 @@ class WorkerProcess(ControlPlaneMember):
         self.table = van.RemotePSTable(
             "127.0.0.1", spec.port, spec.features, spec.out_dim,
             table_id=spec.weights_table, create=False)
+        self._staging = None
+        if spec.ordered_grads and spec.staging_table:
+            self._staging = van.RemotePSTable(
+                "127.0.0.1", spec.port, spec.n_slots * spec.features,
+                spec.out_dim, table_id=spec.staging_table, create=False)
+        self._sbar = None  # (epoch, stage barrier) — ordered_grads only
         self._init_control_plane(van=van, netem_local=f"w{spec.slot}",
                                  my_slot=spec.slot)
         # straggler plane: per-phase wall timing, logged per step
@@ -258,7 +273,10 @@ class WorkerProcess(ControlPlaneMember):
                 # summed global-mean gradient
                 grad = (2.0 / spec.global_batch) * (Xb.T @ err)
                 t3 = time.perf_counter()
-                self.table.dense_push(grad)
+                if self._staging is not None:
+                    self._push_ordered(grad, rank, width)
+                else:
+                    self.table.dense_push(grad)
                 t4 = time.perf_counter()
                 # the WORK phases only (pull/grad/push) feed the
                 # heartbeat's load field: barrier waits are time spent
@@ -304,6 +322,45 @@ class WorkerProcess(ControlPlaneMember):
                 self._stop.wait(spec.step_sleep_s)
         self.close()
 
+    def _stage_barrier(self, width: int):
+        """The ordered-apply barrier for the current epoch, cached like
+        ``_epoch_barriers`` — in a DISJOINT id band (the epoch pair
+        occupies ``base + 2*epoch + phase``, so a third phase would
+        collide with the next epoch's sync barrier)."""
+        if self._sbar is None or self._sbar[0] != self.epoch:
+            if self._sbar is not None:
+                try:
+                    self._sbar[1].close()
+                except Exception:
+                    pass
+            bid = self.spec.barrier_base + (1 << 20) + self.epoch
+            self._sbar = (self.epoch, self._van.RemoteBarrier(
+                "127.0.0.1", self.spec.port, bid, width))
+        return self._sbar[1]
+
+    def _push_ordered(self, grad, rank: int, width: int) -> None:
+        """Rank-ordered gradient application: stage this rank's gradient
+        (idempotent ``sparse_set`` into its staging rows — a crash-step
+        re-run overwrites, never double-stages), barrier until every
+        rank of the epoch staged, then rank 0 pulls all staged slices
+        and applies them to the weights IN RANK ORDER over its single
+        connection (the van serves one connection's requests in order).
+        The commit barrier that follows in the step body fences the
+        applies before anyone's next pull.  Determinism: the PS-side
+        SGD now always sums the same f32 values in the same order, so
+        clean same-seed runs produce bitwise-identical weights.  Crash
+        semantics are unchanged (at-least-once across a discarded
+        epoch, tolerated exactly like a re-pushed slice)."""
+        f = self.spec.features
+        rows = np.arange(rank * f, (rank + 1) * f, dtype=np.int64)
+        self._staging.sparse_set(rows, grad.astype(np.float32))
+        self._await_barrier(self._stage_barrier(width))
+        if rank == 0:
+            idx = np.arange(width * f, dtype=np.int64)
+            staged = self._staging.sparse_pull(idx)
+            for r in range(width):
+                self.table.dense_push(staged[r * f:(r + 1) * f])
+
     def close(self) -> None:
         if self._stop.is_set():
             return
@@ -315,6 +372,16 @@ class WorkerProcess(ControlPlaneMember):
             pass
         self._log.close()
         self.table.close()
+        if self._staging is not None:
+            try:
+                self._staging.close()
+            except Exception:
+                pass
+        if self._sbar is not None:
+            try:
+                self._sbar[1].close()
+            except Exception:
+                pass
         self._close_control_plane()
 
 
@@ -431,6 +498,7 @@ class MultiControllerElasticSupervisor:
                  straggler_evict_after: int = 3,
                  straggler_slow_ms: int = 120,
                  straggler_readmit_after: int = 3,
+                 ordered_grads: bool = False,
                  _takeover_spec: Optional[WorkerSpec] = None):
         from hetu_tpu.ps import van
         if n_workers < 1:
@@ -538,6 +606,7 @@ class MultiControllerElasticSupervisor:
         # state between two fleets built in one process (tests, benches)
         weights_table = _mb.fresh_table_id()
         membership_table = _mb.fresh_table_id()
+        staging_table = _mb.fresh_table_id() if ordered_grads else 0
         barrier_base = BARRIER_BASE + (_mb.fresh_table_id() << 8)
         self.spec = WorkerSpec(
             port=self.port, slot=-1, n_slots=n_workers, steps=self.steps,
@@ -547,7 +616,9 @@ class MultiControllerElasticSupervisor:
             membership_table=membership_table,
             weights_table=weights_table, barrier_base=barrier_base,
             step_sleep_s=float(step_sleep_s),
-            ctrl_lease_s=float(ctrl_lease_s))
+            ctrl_lease_s=float(ctrl_lease_s),
+            ordered_grads=bool(ordered_grads),
+            staging_table=staging_table)
         # everything after van.serve is guarded: a table/blackboard/
         # spawn failure must stop the in-process van server (and close
         # what was created) instead of leaking it for the process's life
@@ -556,6 +627,15 @@ class MultiControllerElasticSupervisor:
                 "127.0.0.1", self.port, int(features), int(out_dim),
                 table_id=weights_table, create=True, init="zeros",
                 optimizer="sgd", lr=float(lr))
+            if ordered_grads:
+                # gradient staging area: one block of `features` rows
+                # per rank, lr=0 SGD so sparse_set writes verbatim (the
+                # blackboard convention) — workers stage here and rank 0
+                # applies to the weights table in rank order
+                self._staging = van.RemotePSTable(
+                    "127.0.0.1", self.port, n_workers * int(features),
+                    int(out_dim), table_id=staging_table, create=True,
+                    init="zeros", optimizer="sgd", lr=0.0)
             self._bb = _mb.create_blackboard(
                 "127.0.0.1", self.port,
                 table_id=membership_table, n_slots=n_workers)
@@ -994,7 +1074,8 @@ class MultiControllerElasticSupervisor:
                 os.kill(pid, _signal.SIGKILL)
             except OSError:
                 pass
-        for t in (getattr(self, "table", None), getattr(self, "_bb", None)):
+        for t in (getattr(self, "table", None), getattr(self, "_bb", None),
+                  getattr(self, "_staging", None)):
             if t is not None:
                 try:
                     t.close()

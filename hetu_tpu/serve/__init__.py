@@ -3,14 +3,19 @@
 The serving layer the ROADMAP's "heavy traffic" north star needs on top
 of the training-only models:
 
-  * :mod:`kv_cache` — preallocated slot-based GQA-aware K/V cache with
-    alloc/free so finished sequences release memory to queued requests;
+  * :mod:`kv_cache` — GQA-aware K/V caches: the slot allocator
+    (:class:`KVCache`) and the PAGED allocator (:class:`PagedKVCache`)
+    with refcounted prefix sharing + copy-on-write, so finished
+    sequences release memory to queued requests and identical system
+    prompts dedup to one physical copy;
   * :mod:`engine` — bucketed jit-compiled prefill + fixed-shape
     single-token decode (bounded executable count) over the existing
-    GPT/Llama forwards, optionally tp-sharded over a mesh;
+    GPT/Llama forwards, optionally tp-sharded over a mesh; the paged
+    variant (:class:`PagedServeEngine`) adds page-table gather/scatter
+    steps and page-aligned chunked prefill;
   * :mod:`scheduler` — continuous batching: admit into free slots every
-    decode step, evict on EOS/max_tokens/deadline, token-budget
-    backpressure;
+    decode step, evict on EOS/max_tokens/deadline, token-budget (slot)
+    or page-budget (paged) backpressure, chunked-prefill interleave;
   * :mod:`server` — blob-channel front-end over the van transport with
     per-request timeouts, idempotent resubmission dedup, and graceful
     shutdown;
@@ -33,8 +38,10 @@ examples/ctr_serve.py for the end-to-end paths.
 """
 
 from hetu_tpu.serve.crosshost import CrossProcessServingPool
-from hetu_tpu.serve.engine import ServeEngine
-from hetu_tpu.serve.kv_cache import KVCache, KVCacheSpec, KVSlotSnapshot
+from hetu_tpu.serve.engine import PagedServeEngine, ServeEngine
+from hetu_tpu.serve.kv_cache import (
+    KVCache, KVCacheSpec, KVSlotSnapshot, PagedKVCache,
+)
 from hetu_tpu.serve.metrics import ServeMetrics
 from hetu_tpu.serve.migrate import MigrationError
 from hetu_tpu.serve.pool import ServingPool
@@ -48,7 +55,8 @@ from hetu_tpu.serve.server import (
 )
 
 __all__ = [
-    "ServeEngine", "KVCache", "KVCacheSpec", "KVSlotSnapshot",
+    "ServeEngine", "PagedServeEngine", "KVCache", "PagedKVCache",
+    "KVCacheSpec", "KVSlotSnapshot",
     "ServeMetrics", "MigrationError", "ServingPool",
     "CrossProcessServingPool",
     "ContinuousBatchingScheduler", "Request",

@@ -1,12 +1,18 @@
 """Bucketed prefill + single-token decode over the model forwards.
 
+Two engines share one serving surface: :class:`ServeEngine` over the
+slot cache, and :class:`PagedServeEngine` over the paged pool (page
+tables, prefix sharing, chunked prefill — see kv_cache.py).
+
 Compilation discipline is the whole point of this module: serving traffic
 has arbitrary prompt lengths, and a naive jit would compile one executable
 per distinct length.  Instead prompts are right-padded to power-of-two
 BUCKETS (plus the cache's max_len as the last bucket), so the engine
 compiles at most ``len(buckets)`` prefill executables + 1 decode
 executable for the whole life of the server — asserted in
-tests/test_serve.py via :meth:`compiled_executables`.
+tests/test_serve.py via :meth:`compiled_executables`.  (The paged
+engine's analog: pow2 chunk buckets for prefill, pow2 active-batch x
+page-count buckets for decode — tests/test_paged_kv.py.)
 
 Prefill runs one request at a time (batch 1, bounded compile count);
 decode steps ALL cache slots at once with fixed shapes (``[num_slots]``
@@ -33,7 +39,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from hetu_tpu.parallel.mesh import AXIS_TP
 from hetu_tpu.parallel.strategies.simple import MegatronLM
-from hetu_tpu.serve.kv_cache import KVCache, KVCacheSpec
+from hetu_tpu.serve.kv_cache import (
+    KVCache, KVCacheSpec, PagedKVCache, pow2_ceil,
+)
 from hetu_tpu.serve.metrics import ServeMetrics
 from hetu_tpu.telemetry import trace
 
@@ -81,17 +89,10 @@ class ServeEngine:
         self.buckets = _pow2_buckets(min(min_bucket, max_len), max_len)
 
         self.mesh = mesh
-        params = variables["params"] if "params" in variables else variables
-        cache_sharding = None
-        if mesh is not None:
-            tp = mesh.shape.get(AXIS_TP, 1)
-            params = _DecodeTP().place(params, mesh)
-            # kv-head sharded cache when GQA heads divide tp, else
-            # replicated (graceful, same policy as Strategy._fit)
-            axes = (None, None, None,
-                    AXIS_TP if spec.num_kv_heads % tp == 0 else None, None)
-            cache_sharding = NamedSharding(mesh, P(*axes))
-        self.params = params
+        # kv-head sharded cache when GQA heads divide tp, else
+        # replicated (graceful, same policy as Strategy._fit)
+        self.params, cache_sharding = _place_params_and_cache_spec(
+            model, variables, mesh, spec)
         self.cache = KVCache(spec, num_slots, max_len,
                              sharding=cache_sharding)
 
@@ -298,4 +299,499 @@ class ServeEngine:
     def release(self, slot: int) -> None:
         self.active[slot] = False
         self.last_tokens[slot] = 0
+        self.cache.free(slot)
+
+
+def _place_params_and_cache_spec(model, variables, mesh, spec):
+    """The tp placement both engines share: Megatron split points on the
+    params, kv-head-sharded cache when GQA heads divide tp."""
+    params = variables["params"] if "params" in variables else variables
+    cache_sharding = None
+    if mesh is not None:
+        tp = mesh.shape.get(AXIS_TP, 1)
+        params = _DecodeTP().place(params, mesh)
+        axes = (None, None, None,
+                AXIS_TP if spec.num_kv_heads % tp == 0 else None, None)
+        cache_sharding = NamedSharding(mesh, P(*axes))
+    return params, cache_sharding
+
+
+class _PrefillCursor:
+    """Host-side state of one in-progress chunked prefill."""
+
+    __slots__ = ("prompt", "pos", "n", "max_tokens", "matched")
+
+    def __init__(self, prompt: np.ndarray, max_tokens: int):
+        self.prompt = prompt
+        self.pos = 0             # next un-prefilled position
+        self.n = int(prompt.shape[0])
+        self.max_tokens = int(max_tokens)
+        self.matched = False     # prefix match ran (first chunk)
+
+    @property
+    def done(self) -> bool:
+        return self.pos >= self.n
+
+
+class PagedServeEngine:
+    """ServeEngine over a :class:`PagedKVCache`: paged gather/scatter
+    decode, chunked prefill, prefix sharing with copy-on-write.
+
+    Drop-in for :class:`ServeEngine` everywhere the scheduler/pool/
+    migration stack touches an engine (same prefill/decode/export/adopt/
+    release surface, same ``cache.lengths``/``max_len``/``num_free``
+    geometry) plus the paged additions the scheduler's page-budget
+    admission and chunked-prefill interleave use: :meth:`admission_ok`,
+    :meth:`begin_prefill`, :meth:`prefill_step`.
+
+    Compilation discipline: chunked prefill compiles one executable per
+    power-of-two CHUNK bucket (the page table always gathers the full
+    per-slot table, so chunk width is the only specializing shape);
+    decode compiles one executable per power-of-two PAGE-COUNT bucket —
+    short sequences gather (and write back) a fraction of ``max_len``
+    instead of every slot's worst case, which is where paged decode's
+    per-step byte traffic win comes from.  Both are asserted via
+    :meth:`compiled_executables` like the slot engine.
+    """
+
+    def __init__(self, model, variables, *, num_slots: int = 8,
+                 max_len: Optional[int] = None, mesh=None,
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 min_bucket: int = 16, prefix_sharing: bool = True,
+                 max_prefix_entries: int = 256,
+                 metrics: Optional[ServeMetrics] = None):
+        self.model = model
+        self.metrics = metrics or ServeMetrics()
+        c = model.c
+        max_len = int(max_len or c.max_position)
+        if max_len > c.max_position:
+            raise ValueError(f"max_len {max_len} exceeds the model's "
+                             f"max_position {c.max_position}")
+        spec = KVCacheSpec.from_model(model)
+        self.mesh = mesh
+        self.params, cache_sharding = _place_params_and_cache_spec(
+            model, variables, mesh, spec)
+        self.cache = PagedKVCache(
+            spec, num_slots, max_len, page_size=page_size,
+            num_pages=num_pages, sharding=cache_sharding,
+            max_prefix_entries=max_prefix_entries if prefix_sharing else 0)
+        # chunked prefill: chunk ends align to prefill_chunk boundaries
+        # (a multiple of page_size), so chunks fill whole pages and the
+        # prefix index's page-aligned entries match cleanly
+        if prefill_chunk is None:
+            prefill_chunk = max(4 * page_size, min_bucket)
+        ps = self.cache.page_size
+        self.prefill_chunk = -(-int(prefill_chunk) // ps) * ps
+        self.chunk_buckets = _pow2_buckets(
+            min(min_bucket, self.prefill_chunk), self.prefill_chunk)
+
+        self.last_tokens = np.zeros(num_slots, np.int32)
+        self.active = np.zeros(num_slots, bool)
+        self._cursors: dict = {}  # slot -> _PrefillCursor
+
+        self._chunk_fn = None      # hot path, specialized per chunk bucket
+        self._chunk_fn_ext = None  # extended-view boundary path
+        self._decode_fn = None     # one jit, specialized per page bucket
+        self._seen_chunk_buckets = set()
+        self._seen_page_buckets = set()
+
+    # ---- compile accounting ----
+    def compiled_executables(self) -> int:
+        return sum(fn._cache_size()
+                   for fn in (self._chunk_fn, self._chunk_fn_ext,
+                              self._decode_fn)
+                   if fn is not None)
+
+    @property
+    def max_executables(self) -> int:
+        """One per chunk bucket per view family (hot + extended
+        boundary) + one per (pow2 active-batch, pow2 page-count) decode
+        bucket pair."""
+        n_page_buckets = 1
+        b = 1
+        while b < self.cache.pages_per_slot:
+            b *= 2
+            n_page_buckets += 1
+        n_batch_buckets = 1
+        b = 1
+        while b < self.cache.num_slots:
+            b *= 2
+            n_batch_buckets += 1
+        return (2 * len(self.chunk_buckets)
+                + n_page_buckets * n_batch_buckets)
+
+    def chunk_bucket_for(self, n: int) -> int:
+        for b in self.chunk_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"chunk of {n} tokens exceeds prefill_chunk "
+                         f"{self.prefill_chunk}")
+
+    # ---- jitted step builders ----
+    def _build_chunk(self, n_table: int):
+        """One chunk executable family over a gathered view of
+        ``n_table`` pages.  TWO families exist: the hot path gathers
+        exactly ``pages_per_slot`` pages, and a BOUNDARY path
+        (:attr:`_chunk_fn_ext`) extends the view by one max-chunk of
+        scratch columns — a padded final chunk near max_len writes (and
+        re-extracts) rows at ``start + bucket``, which can run past
+        ``pages_per_slot * ps``, and without the extension
+        dynamic_update_slice/dynamic_slice would CLAMP the start and
+        silently smear pad junk over real history (wrong tokens on
+        exactly the near-full-context shared-prompt resubmit).  Keeping
+        the extension off the hot path keeps the common chunk's gather
+        at its minimum width."""
+        model = self.model
+        cache = self.cache
+        ps = cache.page_size
+        L = cache.spec.num_layers
+        H, D = cache.spec.num_kv_heads, cache.spec.head_dim
+
+        def fn(params, k_pool, v_pool, aux):
+            # aux [3*sc + n_table + 2] int32 packs the chunk's host
+            # operands (ids | write pages | write offsets | page table |
+            # start | last) into one device_put, like the decode step
+            sc = (aux.shape[0] - n_table - 2) // 3
+            ids = aux[:sc][None]
+            wpage = aux[sc:2 * sc]
+            woff = aux[2 * sc:3 * sc]
+            table = aux[3 * sc:3 * sc + n_table]
+            start = aux[3 * sc + n_table]
+            last = aux[3 * sc + n_table + 1]
+            k_seq = k_pool[:, table].reshape(L, 1, n_table * ps, H, D)
+            v_seq = v_pool[:, table].reshape(L, 1, n_table * ps, H, D)
+            logits, k_seq, v_seq = model.prefill_chunk_with_cache(
+                {"params": params, "state": {}}, ids, k_seq, v_seq,
+                start, last_index=last)
+            tok = jnp.argmax(logits[0], -1).astype(jnp.int32)
+            rows_k = jax.lax.dynamic_slice_in_dim(k_seq[:, 0], start, sc,
+                                                  axis=1)
+            rows_v = jax.lax.dynamic_slice_in_dim(v_seq[:, 0], start, sc,
+                                                  axis=1)
+            # per-token scatter through the host-built write map: real
+            # positions land in their pages, pad positions in scratch 0
+            k_pool = k_pool.at[:, wpage, woff].set(rows_k)
+            v_pool = v_pool.at[:, wpage, woff].set(rows_v)
+            return k_pool, v_pool, tok
+
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    def _build_decode(self):
+        model = self.model
+        cache = self.cache
+        ps = cache.page_size
+        L = cache.spec.num_layers
+        H, D = cache.spec.num_kv_heads, cache.spec.head_dim
+
+        def fn(params, k_pool, v_pool, aux):
+            # aux [B, n_pg + 4] int32 packs every host-side operand of
+            # the step (page table | length | token | write page | write
+            # offset) into ONE device_put — five small uploads per step
+            # cost more wall time than the decode math at serving batch
+            # sizes
+            b = aux.shape[0]
+            n_pg = aux.shape[1] - 4
+            tables = aux[:, :n_pg]
+            lengths = aux[:, n_pg]
+            tokens = aux[:, n_pg + 1]
+            wpage = aux[:, n_pg + 2]
+            woff = aux[:, n_pg + 3]
+            k_seq = k_pool[:, tables].reshape(L, b, n_pg * ps, H, D)
+            v_seq = v_pool[:, tables].reshape(L, b, n_pg * ps, H, D)
+            logits, k_seq, v_seq = model.decode_with_cache(
+                {"params": params, "state": {}}, tokens, k_seq, v_seq,
+                lengths)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            # only the newly written token row goes back to the pool —
+            # a decode step moves O(B) token rows, never the gathered
+            # sequence view
+            tok_k = jax.vmap(
+                lambda kb, i: jax.lax.dynamic_index_in_dim(
+                    kb, i, axis=1, keepdims=False),
+                in_axes=(1, 0), out_axes=1)(k_seq, lengths)
+            tok_v = jax.vmap(
+                lambda vb, i: jax.lax.dynamic_index_in_dim(
+                    vb, i, axis=1, keepdims=False),
+                in_axes=(1, 0), out_axes=1)(v_seq, lengths)
+            k_pool = k_pool.at[:, wpage, woff].set(tok_k)
+            v_pool = v_pool.at[:, wpage, woff].set(tok_v)
+            return k_pool, v_pool, nxt
+
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    # ---- admission (the scheduler's page-budget backpressure) ----
+    def admission_pages(self, prompt_len: int, max_tokens: int,
+                        shared_tokens: int = 0) -> int:
+        """Worst-case pages an admission can touch: prompt + generation
+        (capped at max_len) minus already-shared pages, plus one page of
+        copy-on-write headroom."""
+        total = min(int(prompt_len) + int(max_tokens) + 1,
+                    self.cache.max_len)
+        return max(self.cache.pages_for_tokens(total)
+                   - self.cache.pages_for_tokens(int(shared_tokens)), 0) + 1
+
+    def admission_ok(self, prompt, max_tokens: int) -> bool:
+        """True when the page pool can hold this request's worst case
+        alongside every outstanding reservation.  Prefix-shared pages
+        are credited — the dedup is what lets a pool of identical system
+        prompts admit far past the slot cache's capacity.
+
+        The uncredited check runs first: when the worst case fits
+        anyway (the common uncontended admission), no prefix probe runs
+        at all — a backpressured queue head re-probes every scheduler
+        step, and hashing its full prompt each time is wasted work
+        unless the shared credit is what decides.  When the probe does
+        run it is LRU-neutral (``touch=False``): a request must not pin
+        index entries it never adopted."""
+        avail = self.cache.available_pages()
+        if self.admission_pages(len(prompt), max_tokens, 0) <= avail:
+            return True
+        n_shared, _ = self.cache.match_prefix(prompt, touch=False)
+        if not n_shared:
+            return False
+        return self.admission_pages(len(prompt), max_tokens,
+                                    n_shared) <= avail
+
+    # ---- chunked prefill ----
+    def begin_prefill(self, slot: int, prompt_ids, *,
+                      max_tokens: int = 0) -> None:
+        """Start a chunked prefill into ``slot``: reserve the worst-case
+        page budget and park a cursor for :meth:`prefill_step` to
+        advance.  The prefix match runs on the FIRST chunk, not here —
+        so a burst of identical prompts admitted in one scheduler sweep
+        still shares whenever an earlier request's prefill COMPLETES
+        (register_prefix runs on its final chunk) before a later
+        request's first chunk.  Multi-chunk prompts whose first chunks
+        all land in the same interleave window can still prefill
+        privately — the match is one-shot, and adopting a prefix after
+        a chunk has written would mean merging half-built tables (a
+        known residual, not attempted).  The slot stays INACTIVE (no
+        decode) until the final chunk emits the first token."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        n = prompt.shape[0]
+        if n < 1:
+            raise ValueError("empty prompt")
+        if n >= self.cache.max_len:
+            raise ValueError(f"prompt of {n} tokens leaves no room to "
+                             f"generate within max_len {self.cache.max_len}")
+        self.cache.reserve(slot, self.admission_pages(n, max_tokens, 0))
+        self._cursors[slot] = _PrefillCursor(prompt, max_tokens)
+        self.active[slot] = False
+
+    def _match_on_first_chunk(self, slot: int, cur: _PrefillCursor) -> None:
+        cur.matched = True
+        n_shared, pages = self.cache.match_prefix(cur.prompt)
+        if n_shared and not self.cache.tables[slot]:
+            self.cache.adopt_prefix(slot, n_shared, pages)
+            cur.pos = n_shared
+            # shrink the admission's reservation by the shared credit
+            self.cache.reserve(slot, self.admission_pages(
+                cur.n, cur.max_tokens, n_shared))
+            self.metrics.inc("prefix_hits")
+            self.metrics.inc("prefix_hit_tokens", n_shared)
+            trace.instant("serve.prefix_hit",
+                          {"slot": int(slot), "tokens": int(n_shared)})
+        self.metrics.inc("prefix_miss_tokens", cur.n - cur.pos)
+
+    def prefill_step(self, slot: int) -> Optional[int]:
+        """Run the next page-aligned chunk of ``slot``'s prefill.
+        Returns the first generated (greedy) token when the final chunk
+        completes (the slot then decodes), else None."""
+        cur = self._cursors.get(slot)
+        if cur is None:
+            raise ValueError(f"slot {slot} has no prefill in progress")
+        if not cur.matched:
+            self._match_on_first_chunk(slot, cur)
+        start = cur.pos
+        end = min(cur.n, (start // self.prefill_chunk + 1)
+                  * self.prefill_chunk)
+        size = end - start
+        s = self.chunk_bucket_for(size)
+        ps = self.cache.page_size
+        n_table = self.cache.pages_per_slot
+        # boundary path: the PADDED window [start, start+s) runs past
+        # the slot's own page view — use the extended-view executable
+        # family so nothing clamps (see _build_chunk)
+        if start + s > n_table * ps:
+            n_table += -(-self.prefill_chunk // ps)
+            if self._chunk_fn_ext is None:
+                self._chunk_fn_ext = self._build_chunk(n_table)
+            chunk_fn = self._chunk_fn_ext
+        else:
+            if self._chunk_fn is None:
+                self._chunk_fn = self._build_chunk(n_table)
+            chunk_fn = self._chunk_fn
+        if (s, n_table) not in self._seen_chunk_buckets:
+            self._seen_chunk_buckets.add((s, n_table))
+            self.metrics.inc("prefill_compiles")
+            trace.instant("serve.recompile",
+                          {"kind": "prefill_chunk", "bucket": s})
+        cow0 = self.cache.cow_copies
+        wp, wo = self.cache.prepare_write(slot, start, size)
+        wp, wo = self.cache.padded_write_map(wp, wo, s)
+        aux = np.zeros(3 * s + n_table + 2, np.int32)
+        aux[:size] = cur.prompt[start:end]
+        aux[s:2 * s] = wp
+        aux[2 * s:3 * s] = wo
+        t = self.cache.tables[slot]
+        aux[3 * s:3 * s + len(t)] = t
+        aux[3 * s + n_table] = start
+        aux[3 * s + n_table + 1] = size - 1
+        with trace.span("serve.prefill_chunk") as sp:
+            sp.set("slot", int(slot))
+            sp.set("start", int(start))
+            sp.set("tokens", int(size))
+            sp.set("bucket", int(s))
+            k, v, tok = chunk_fn(
+                self.params, self.cache.k, self.cache.v, jnp.asarray(aux))
+            tok = int(tok)  # sync point inside the span (see ServeEngine)
+        self.cache.update(k, v)
+        self.cache.lengths[slot] = end
+        cur.pos = end
+        self.metrics.inc("prefill_tokens", size)
+        self.metrics.inc("prefill_chunks")
+        if self.cache.cow_copies > cow0:
+            self.metrics.inc("cow_copies", self.cache.cow_copies - cow0)
+        if not cur.done:
+            return None
+        del self._cursors[slot]
+        self.cache.register_prefix(slot, cur.prompt)
+        self.last_tokens[slot] = tok
+        self.active[slot] = True
+        return tok
+
+    def prefill(self, slot: int, prompt_ids) -> int:
+        """Whole-prompt prefill (the slot-engine-compatible surface):
+        begin + advance every chunk in one call."""
+        self.begin_prefill(slot, prompt_ids)
+        while True:
+            tok = self.prefill_step(slot)
+            if tok is not None:
+                return tok
+
+    # ---- decode ----
+    def decode(self) -> dict:
+        """One decode step over the ACTIVE slots (paged gather/scatter);
+        returns {slot: token} for them.
+
+        Unlike the slot engine (which steps every slot, active or not —
+        its cache rows exist anyway), the paged decode gathers only a
+        power-of-two BUCKET of active slots: per-step work scales with
+        live traffic, not the engine's concurrency ceiling, which is
+        what lets a paged engine carry 4x the slots of a slot engine at
+        the same per-step cost.  Pad rows in the bucket duplicate a real
+        slot's table (harmless gather) but their write map points at the
+        scratch page, so they can never corrupt the pool."""
+        act = np.nonzero(self.active)[0]
+        if len(act) == 0:
+            return {}
+        if (self.cache.lengths[act] >= self.cache.max_len).any():
+            raise RuntimeError(
+                "an active slot is at max_len; the scheduler must evict "
+                "before decoding further")
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        cow0 = self.cache.cow_copies
+        bb = pow2_ceil(len(act), self.cache.num_slots)
+        sl = np.zeros(bb, np.int32)
+        sl[:len(act)] = act
+        # grow/COW the write target of every active slot BEFORE the step
+        wp = np.zeros(bb, np.int32)
+        wo = np.zeros(bb, np.int32)
+        for i, slot in enumerate(act):
+            p, o = self.cache.prepare_write(
+                int(slot), int(self.cache.lengths[slot]), 1)
+            wp[i], wo[i] = p[0], o[0]
+        # page bucket over ACTIVE slots only (after prepare_write grew
+        # them): an inactive mid-chunked-prefill long prompt must not
+        # inflate every interleaved decode's gather to its table width —
+        # that would re-create exactly the long-arrival latency spike
+        # the chunk interleave exists to remove
+        n_pg = pow2_ceil(
+            max(len(self.cache.tables[int(s)]) for s in act),
+            self.cache.pages_per_slot)
+        if (bb, n_pg) not in self._seen_page_buckets:
+            self._seen_page_buckets.add((bb, n_pg))
+            self.metrics.inc("decode_compiles")
+            trace.instant("serve.recompile",
+                          {"kind": "decode", "pages": int(n_pg),
+                           "batch": int(bb)})
+        aux = np.zeros((bb, n_pg + 4), np.int32)
+        for i, slot in enumerate(sl):
+            t = self.cache.tables[slot][:n_pg]
+            aux[i, :len(t)] = t
+        aux[:, n_pg] = self.cache.lengths[sl]
+        aux[:, n_pg + 1] = self.last_tokens[sl]
+        aux[:, n_pg + 2] = wp
+        aux[:, n_pg + 3] = wo
+        with trace.span("serve.decode") as sp:
+            if trace.enabled():
+                sp.set("active", int(len(act)))
+                sp.set("pages", int(n_pg))
+            k, v, nxt = self._decode_fn(
+                self.params, self.cache.k, self.cache.v,
+                jnp.asarray(aux))
+            nxt = np.asarray(nxt)  # host fetch = sync point, in the span
+        self.cache.update(k, v)
+        out = {}
+        for i, slot in enumerate(act):
+            self.cache.lengths[slot] += 1
+            self.last_tokens[slot] = nxt[i]
+            out[int(slot)] = int(nxt[i])
+        if self.cache.cow_copies > cow0:
+            self.metrics.inc("cow_copies", self.cache.cow_copies - cow0)
+        self.metrics.inc("decode_steps")
+        self.metrics.observe_decode(len(out))
+        self.metrics.set_gauge("pages_in_use", self.cache.pages_in_use)
+        self.metrics.set_gauge("prefix_entries", self.cache.prefix_entries)
+        return out
+
+    # ---- live-slot migration (same contract as ServeEngine) ----
+    def export_slots(self, slot_ids) -> list:
+        for slot in slot_ids:
+            if not self.active[int(slot)]:
+                raise ValueError(f"slot {int(slot)} is not mid-decode; "
+                                 f"nothing to migrate")
+        snaps = self.cache.export_slots(slot_ids)
+        for s in snaps:
+            s.meta["last_token"] = int(self.last_tokens[s.slot])
+        for slot in slot_ids:
+            self.active[int(slot)] = False
+        return snaps
+
+    def resume_slots(self, slot_ids) -> None:
+        slots = [int(s) for s in slot_ids]
+        for slot in slots:
+            if self.cache.lengths[slot] < 1:
+                raise ValueError(f"slot {slot} has no cached tokens to "
+                                 f"resume")
+        for slot in slots:
+            self.active[slot] = True
+
+    def adopt_slots(self, snapshots) -> dict:
+        snaps = list(snapshots)
+        for s in snaps:
+            if "last_token" not in s.meta:
+                raise ValueError(
+                    f"slot snapshot {s.slot} has no last_token meta — "
+                    f"exported from a cache, not an engine?")
+        slot_map = self.cache.import_slots(snaps)
+        for s in snaps:
+            slot = slot_map[s.slot]
+            self.last_tokens[slot] = int(s.meta["last_token"])
+            self.active[slot] = True
+        self.metrics.inc("slots_adopted", len(slot_map))
+        return slot_map
+
+    # ---- slot lifecycle ----
+    def alloc_slot(self) -> int:
+        slot = self.cache.alloc()
+        self.active[slot] = False
+        return slot
+
+    def release(self, slot: int) -> None:
+        self.active[slot] = False
+        self.last_tokens[slot] = 0
+        self._cursors.pop(slot, None)
         self.cache.free(slot)

@@ -1,11 +1,26 @@
-"""Preallocated slot-based KV cache for decoder-LM serving.
+"""KV caches for decoder-LM serving: slot-granular and paged.
 
-The serving analog of a paged allocator at sequence granularity: the cache
-is ONE pair of arrays ``[L, num_slots, max_len, kv_heads, head_dim]``
-allocated up front, and a host-side free list hands whole slots to
-admitted requests and reclaims them on eviction — finished sequences
-release their memory to queued requests immediately (continuous batching,
-scheduler.py) instead of waiting for a static batch to drain.
+Two allocators share one spec/snapshot vocabulary:
+
+* :class:`KVCache` — the original whole-sequence slot allocator: ONE
+  pair of arrays ``[L, num_slots, max_len, kv_heads, head_dim]``, a
+  free list of slots.  Simple, but every admitted sequence reserves
+  ``max_len`` tokens of HBM whether it uses them or not (internal
+  fragmentation), and identical prompts cache identical K/V twice.
+* :class:`PagedKVCache` — fixed-size PAGES (``page_size`` tokens) in a
+  device-resident pool ``[L, num_pages, page_size, kv_heads,
+  head_dim]``, per-request page tables, refcounted PREFIX SHARING
+  (hash-of-token-prefix → shared read-only pages, so identical system
+  prompts across a pool's traffic dedup to one physical copy) with
+  copy-on-write on the first divergent write, and an LRU prefix index
+  whose pages are reclaimed under pressure.  The vLLM/Gemma-on-TPU
+  serving memory model (PAPERS.md, arXiv 2605.25645), grafted onto the
+  same jitted-step engine discipline.
+
+Both hand whole slots to admitted requests and reclaim on eviction —
+finished sequences release their memory to queued requests immediately
+(continuous batching, scheduler.py) instead of waiting for a static
+batch to drain.
 
 GQA-aware: the cache stores the model's ``num_kv_heads`` heads un-repeated
 (half or a quarter of the MHA footprint for typical GQA configs);
@@ -239,3 +254,482 @@ class KVCache:
         """Tokens currently cached across occupied slots (the scheduler's
         token-budget currency)."""
         return int(self.lengths.sum())
+
+
+# ---------------------------------------------------------------------------
+# paged allocation + prefix sharing
+# ---------------------------------------------------------------------------
+
+def pow2_ceil(n: int, cap: int) -> int:
+    """Smallest power of two >= n, clamped to [1, cap] — the ONE
+    bucketing helper the paged engine's executables key on (chunk
+    widths, decode batch, page counts, import pads)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return max(min(b, cap), 1)
+
+
+@dataclass
+class _PrefixEntry:
+    """One cached token-prefix: ``pages`` hold the K/V of the first
+    ``n_tokens`` tokens whose sha256 is ``key``.  Entries hold an INDEX
+    reference on each page (``ref_index``); pages referenced only by the
+    index are reclaimable under pressure (LRU eviction)."""
+
+    key: bytes
+    pages: tuple
+    n_tokens: int
+
+
+class PagedKVCache:
+    """Paged K/V pool + per-slot page tables + refcounted prefix sharing.
+
+    ``k``/``v``: ``[L, num_pages, page_size, kv_heads, head_dim]`` jax
+    arrays, replaced wholesale by the engine after each jitted step.
+    Page 0 is a reserved SCRATCH page: jitted steps run over every slot
+    with fixed shapes, and inactive slots' (masked, garbage) writes need
+    a harmless landing zone — page 0 is never allocated to a request.
+
+    Ownership model: each page carries two refcounts — ``ref_table``
+    (how many slot page-tables reference it) and ``ref_index`` (how many
+    prefix-index entries do).  A page is WRITABLE by a slot only when it
+    is that slot's sole reference (``ref_table == 1 and ref_index ==
+    0``); any write into a shared page copies it first (copy-on-write,
+    counted in ``cow_copies``), so indexed prefix pages are immutable
+    and a forked request can never corrupt its sibling's (or the
+    cache's) prefix.  A page returns to the free list when BOTH counts
+    reach zero; eviction of LRU index entries under allocation pressure
+    is what turns "referenced only by the index" into free pages.
+
+    Reservations: :meth:`reserve`/``reserved_remaining`` implement the
+    scheduler's page-budget backpressure — an admission reserves the
+    worst-case pages its request can touch (prompt + generation + one
+    COW), and :meth:`available_pages` nets free + reclaimable pages
+    against outstanding reservations so admissions cannot oversubscribe
+    the pool out from under running decodes.
+    """
+
+    def __init__(self, spec: KVCacheSpec, num_slots: int, max_len: int, *,
+                 page_size: int = 16, num_pages=None, sharding=None,
+                 max_prefix_entries: int = 256):
+        if num_slots < 1 or max_len < 2:
+            raise ValueError(f"need >=1 slot and max_len >= 2, got "
+                             f"{num_slots}/{max_len}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.spec = spec
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.page_size = int(page_size)
+        self.pages_per_slot = -(-self.max_len // self.page_size)  # ceil
+        if num_pages is None:
+            # parity default: same token capacity as the slot cache,
+            # plus the scratch page
+            num_pages = 1 + self.num_slots * self.pages_per_slot
+        self.num_pages = int(num_pages)
+        if self.num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is scratch)")
+        shape = (spec.num_layers, self.num_pages, self.page_size,
+                 spec.num_kv_heads, spec.head_dim)
+        self.k = jnp.zeros(shape, spec.dtype)
+        self.v = jnp.zeros(shape, spec.dtype)
+        if sharding is not None:
+            import jax
+            self.k = jax.device_put(self.k, sharding)
+            self.v = jax.device_put(self.v, sharding)
+        self.lengths = np.zeros(self.num_slots, np.int32)
+        self.tables: list = [[] for _ in range(self.num_slots)]
+        self.ref_table = np.zeros(self.num_pages, np.int32)
+        self.ref_index = np.zeros(self.num_pages, np.int32)
+        self._free_slots = list(range(self.num_slots - 1, -1, -1))
+        # LIFO like the slot cache: recently-touched pages stay hot.
+        # Page 0 excluded — the scratch page is never allocated.
+        self._free_pages = list(range(self.num_pages - 1, 0, -1))
+        self._reserve = np.zeros(self.num_slots, np.int32)
+        self.max_prefix_entries = int(max_prefix_entries)
+        from collections import OrderedDict
+        self._prefix: "OrderedDict[bytes, _PrefixEntry]" = OrderedDict()
+        # host-side counters the engine mirrors into ServeMetrics
+        self.cow_copies = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_evictions = 0
+        self._copy_fn = None     # lazily jitted page copy (COW)
+        self._import_fn = None   # lazily jitted page writer (import_slots)
+
+    # ---- geometry helpers ----
+    def pages_for_tokens(self, n: int) -> int:
+        return -(-int(n) // self.page_size)
+
+    @property
+    def num_free(self) -> int:
+        """Free REQUEST slots (admission gate, same name as KVCache)."""
+        return len(self._free_slots)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - 1 - len(self._free_pages)
+
+    @property
+    def reclaimable_pages(self) -> int:
+        """Pages held only by the prefix index — allocatable after an
+        LRU eviction, so admission counts them as available."""
+        return int(np.sum((self.ref_table == 0) & (self.ref_index > 0)))
+
+    def available_pages(self) -> int:
+        """Pages an admission may still claim: free + reclaimable, net
+        of every running slot's outstanding reservation."""
+        return (len(self._free_pages) + self.reclaimable_pages
+                - int(self._reserve.sum()))
+
+    @property
+    def occupancy(self) -> float:
+        return self.pages_in_use / max(self.num_pages - 1, 1)
+
+    @property
+    def active_tokens(self) -> int:
+        return int(self.lengths.sum())
+
+    # ---- slot lifecycle ----
+    def alloc(self) -> int:
+        if not self._free_slots:
+            raise RuntimeError("paged KV cache has no free slots")
+        slot = self._free_slots.pop()
+        self.lengths[slot] = 0
+        self.tables[slot] = []
+        self._reserve[slot] = 0
+        return slot
+
+    def free(self, slot: int) -> None:
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range")
+        if slot in self._free_slots:
+            raise ValueError(f"slot {slot} double-freed")
+        for page in self.tables[slot]:
+            self._unref_table(page)
+        self.tables[slot] = []
+        self.lengths[slot] = 0
+        self._reserve[slot] = 0
+        self._free_slots.append(slot)
+
+    def reserve(self, slot: int, n_pages: int) -> None:
+        """Record the admission's worst-case page claim for ``slot``;
+        every page the slot later allocates draws it down."""
+        self._reserve[slot] = max(int(n_pages), 0)
+
+    def update(self, k, v) -> None:
+        """Swap in the pool arrays a jitted step returned."""
+        self.k, self.v = k, v
+
+    # ---- page lifecycle (internal) ----
+    def _unref_table(self, page: int) -> None:
+        self.ref_table[page] -= 1
+        if self.ref_table[page] < 0:
+            raise AssertionError(f"page {page} table-ref underflow")
+        if self.ref_table[page] == 0 and self.ref_index[page] == 0:
+            self._free_pages.append(page)
+
+    def _evict_one_entry(self) -> bool:
+        """Drop the least-recently-used prefix entry; True if any entry
+        was evicted (its index refs released — pages with no table refs
+        return to the free list)."""
+        if not self._prefix:
+            return False
+        _, entry = self._prefix.popitem(last=False)
+        for page in entry.pages:
+            self.ref_index[page] -= 1
+            if self.ref_table[page] == 0 and self.ref_index[page] == 0:
+                self._free_pages.append(page)
+        self.prefix_evictions += 1
+        return True
+
+    def _alloc_page(self, slot: int) -> int:
+        """Claim a free page for ``slot`` (evicting LRU prefix entries
+        under pressure), charging its reservation."""
+        while not self._free_pages:
+            if not self._evict_one_entry():
+                raise RuntimeError(
+                    "KV page pool exhausted: no free pages and nothing "
+                    "reclaimable — the scheduler's page budget "
+                    "under-reserved")
+        page = self._free_pages.pop()
+        self.ref_table[page] = 1
+        self.ref_index[page] = 0
+        if self._reserve[slot] > 0:
+            self._reserve[slot] -= 1
+        return page
+
+    def _cow(self, slot: int, idx: int) -> int:
+        """Copy-on-write: replace ``tables[slot][idx]`` (shared) with a
+        private copy; the page bytes move on device (donated, in place
+        in the pool)."""
+        src = self.tables[slot][idx]
+        dst = self._alloc_page(slot)
+        if self._copy_fn is None:
+            import jax
+
+            def copy(k, v, src, dst):
+                k_page = jax.lax.dynamic_slice_in_dim(k, src, 1, axis=1)
+                v_page = jax.lax.dynamic_slice_in_dim(v, src, 1, axis=1)
+                k = jax.lax.dynamic_update_slice_in_dim(k, k_page, dst,
+                                                        axis=1)
+                v = jax.lax.dynamic_update_slice_in_dim(v, v_page, dst,
+                                                        axis=1)
+                return k, v
+
+            self._copy_fn = jax.jit(copy, donate_argnums=(0, 1))
+        self.k, self.v = self._copy_fn(self.k, self.v, jnp.int32(src),
+                                       jnp.int32(dst))
+        self.tables[slot][idx] = dst
+        self._unref_table(src)
+        self.cow_copies += 1
+        return dst
+
+    def prepare_write(self, slot: int, start: int, n: int):
+        """Make positions ``[start, start + n)`` of ``slot`` writable:
+        append fresh pages as the range grows the table, COW any shared
+        page the range touches.  Returns ``(write_page, write_off)``
+        int32 arrays of length ``n`` mapping each position to its
+        physical (page, offset) — the scatter map the jitted steps take.
+        """
+        ps = self.page_size
+        if start + n > self.max_len:
+            raise ValueError(f"write [{start}, {start + n}) overruns "
+                             f"max_len {self.max_len}")
+        table = self.tables[slot]
+        pages = np.empty(n, np.int32)
+        offs = np.empty(n, np.int32)
+        for i in range(n):
+            pos = start + i
+            pi = pos // ps
+            if pi == len(table):
+                table.append(self._alloc_page(slot))
+            elif pi > len(table):
+                raise AssertionError(
+                    f"write at {pos} skips pages (table has {len(table)})")
+            page = table[pi]
+            if self.ref_table[page] + self.ref_index[page] > 1:
+                page = self._cow(slot, pi)
+            pages[i] = page
+            offs[i] = pos % ps
+        return pages, offs
+
+    def padded_write_map(self, pages, offs, total: int):
+        """Extend a :meth:`prepare_write` map to a padded chunk bucket:
+        pad positions scatter into the scratch page (0, 0)."""
+        n = len(pages)
+        wp = np.zeros(total, np.int32)
+        wo = np.zeros(total, np.int32)
+        wp[:n] = pages
+        wo[:n] = offs
+        return wp, wo
+
+    def table_array(self, n_pages: int):
+        """Page tables as one ``[num_slots, n_pages]`` int32 array,
+        scratch-padded — the gather operand of the jitted decode."""
+        out = np.zeros((self.num_slots, n_pages), np.int32)
+        for s, table in enumerate(self.tables):
+            t = table[:n_pages]
+            out[s, :len(t)] = t
+        return out
+
+    def max_table_pages(self) -> int:
+        return max((len(t) for t in self.tables), default=0)
+
+    # ---- prefix sharing ----
+    @staticmethod
+    def _digests(tokens, page_size: int):
+        """sha256 digests of every page-aligned prefix of ``tokens``
+        plus the full (possibly partial-page) prompt, computed
+        incrementally: ``{n_tokens: digest}``."""
+        import hashlib
+        arr = np.ascontiguousarray(np.asarray(tokens, np.int32))
+        h = hashlib.sha256()
+        out = {}
+        n = len(arr)
+        for j in range(page_size, n + 1, page_size):
+            h.update(arr[j - page_size:j].tobytes())
+            out[j] = h.digest()
+        if n % page_size:
+            h.update(arr[(n // page_size) * page_size:].tobytes())
+            out[n] = h.digest()
+        return out
+
+    def match_prefix(self, tokens, *, touch: bool = True):
+        """Longest cached prefix of ``tokens``: ``(n_shared, pages)``.
+
+        Tries the exact-prompt entry first (full dedup — identical
+        prompts share even the partial tail page), then page-aligned
+        chains, longest first.  The match is CAPPED at ``len(tokens) -
+        1``: at least one token always prefills, because the first
+        generated token needs the last prompt position's logits — when
+        the cap bites, that one token recomputes into a shared page and
+        copy-on-writes it (bitwise-identical K/V, private copy).
+        ``(0, [])`` when nothing matches.
+
+        ``touch=False`` (the admission-backpressure probe): report the
+        match WITHOUT refreshing the entry's LRU position — a queued
+        request re-probing every scheduler step must not pin entries it
+        has not actually adopted against eviction."""
+        n = len(tokens)
+        if n < 2 or not self.max_prefix_entries:
+            return 0, []
+        digests = self._digests(tokens, self.page_size)
+        for cand in sorted(digests, reverse=True):
+            entry = self._prefix.get(digests[cand])
+            if entry is None or entry.n_tokens != cand:
+                continue
+            if touch:
+                self._prefix.move_to_end(digests[cand])  # LRU refresh
+            return min(cand, n - 1), list(entry.pages)
+        return 0, []
+
+    def adopt_prefix(self, slot: int, n_shared: int, pages) -> None:
+        """Attach a matched prefix to ``slot``: its table starts as the
+        shared pages (read-only — any write COWs), with ``n_shared``
+        tokens already valid."""
+        if self.tables[slot]:
+            raise ValueError(f"slot {slot} already has pages")
+        self.tables[slot] = list(pages)
+        for page in pages:
+            self.ref_table[page] += 1
+        self.lengths[slot] = int(n_shared)
+        self.prefix_hit_tokens += int(n_shared)
+
+    def register_prefix(self, slot: int, tokens) -> None:
+        """Index ``slot``'s freshly prefilled prompt so later arrivals
+        can share it: one entry per page-aligned prefix plus the partial
+        tail.  Registered pages become IMMUTABLE (index refs make them
+        COW-on-write) — including for ``slot`` itself, whose first
+        decode into a registered partial page copies it, leaving the
+        indexed prompt K/V pristine."""
+        if not self.max_prefix_entries:
+            return
+        table = self.tables[slot]
+        for n_tok, digest in self._digests(tokens, self.page_size).items():
+            if digest in self._prefix:
+                self._prefix.move_to_end(digest)
+                continue
+            pages = tuple(table[:self.pages_for_tokens(n_tok)])
+            self._prefix[digest] = _PrefixEntry(
+                key=digest, pages=pages, n_tokens=int(n_tok))
+            for page in pages:
+                self.ref_index[page] += 1
+            while len(self._prefix) > self.max_prefix_entries:
+                self._evict_one_entry()
+
+    @property
+    def prefix_entries(self) -> int:
+        return len(self._prefix)
+
+    # ---- live-slot migration (serve/migrate.py rides on these) ----
+    def export_slots(self, slot_ids) -> list:
+        """Snapshot occupied slots as CONTIGUOUS truncated K/V rows —
+        the same :class:`KVSlotSnapshot` wire form as the slot cache
+        (codec-compatible), assembled by gathering each slot's LIVE
+        pages only: sharing means a page can back many slots, but a
+        migration payload ships each slot's logical tokens (the adopter
+        rebuilds page tables locally; re-dedup on import is the
+        adopter's prefix index's job)."""
+        snaps = []
+        ps = self.page_size
+        for slot in slot_ids:
+            slot = int(slot)
+            if not 0 <= slot < self.num_slots:
+                raise ValueError(f"slot {slot} out of range")
+            if slot in self._free_slots:
+                raise ValueError(f"slot {slot} is free; nothing to export")
+            n = int(self.lengths[slot])
+            if n < 1:
+                raise ValueError(f"slot {slot} has no cached tokens")
+            pages = np.asarray(self.tables[slot][:self.pages_for_tokens(n)],
+                               np.int32)
+            L = self.spec.num_layers
+            k_pg = np.asarray(self.k[:, pages])  # [L, P, ps, H, D]
+            v_pg = np.asarray(self.v[:, pages])
+            k_rows = k_pg.reshape(L, len(pages) * ps, *k_pg.shape[3:])[:, :n]
+            v_rows = v_pg.reshape(L, len(pages) * ps, *v_pg.shape[3:])[:, :n]
+            snaps.append(KVSlotSnapshot(
+                slot=slot, length=n, k=np.ascontiguousarray(k_rows),
+                v=np.ascontiguousarray(v_rows)))
+        return snaps
+
+    def import_slots(self, snapshots) -> dict:
+        """Adopt peer-exported snapshots into fresh pages; returns
+        ``{source_slot: slot}``.  Validates EVERYTHING (geometry, dtype,
+        slot and page headroom) before allocating anything — a
+        mismatched migration errors loudly and adopts nothing."""
+        snaps = list(snapshots)
+        if len(snaps) > self.num_free:
+            raise RuntimeError(
+                f"cannot adopt {len(snaps)} slots: only {self.num_free} "
+                f"free")
+        spec = self.spec
+        dt = np.dtype(spec.dtype)
+        need_pages = 0
+        for s in snaps:
+            if s.length < 1 or s.length >= self.max_len:
+                raise ValueError(
+                    f"slot snapshot of {s.length} tokens does not leave "
+                    f"room to decode within max_len {self.max_len}")
+            want = (spec.num_layers, s.length, spec.num_kv_heads,
+                    spec.head_dim)
+            for name, arr in (("k", s.k), ("v", s.v)):
+                if tuple(arr.shape) != want:
+                    raise ValueError(
+                        f"{name} geometry mismatch: snapshot "
+                        f"{tuple(arr.shape)} vs cache spec {want} "
+                        f"(layers/kv_heads/head_dim must match exactly)")
+                if np.dtype(arr.dtype) != dt:
+                    raise ValueError(
+                        f"{name} dtype mismatch: snapshot "
+                        f"{np.dtype(arr.dtype).name} vs cache {dt.name}")
+            need_pages += self.pages_for_tokens(s.length)
+        # net of outstanding reservations (available_pages), not just
+        # free+reclaimable: an adoption must not consume the headroom an
+        # in-flight chunked prefill's admission was promised
+        if need_pages > self.available_pages():
+            raise RuntimeError(
+                f"cannot adopt {need_pages} pages: only "
+                f"{self.available_pages()} available "
+                f"(free + reclaimable - reserved)")
+        if self._import_fn is None:
+            import jax
+
+            def write(k, v, k_pages, v_pages, pages):
+                k = k.at[:, pages].set(k_pages)
+                v = v.at[:, pages].set(v_pages)
+                return k, v
+
+            self._import_fn = jax.jit(write, donate_argnums=(0, 1))
+        ps = self.page_size
+        slot_map: dict = {}
+        allocated: list = []
+        try:
+            for s in snaps:
+                slot = self.alloc()
+                allocated.append(slot)
+                n_pg = self.pages_for_tokens(s.length)
+                # pow2 page-count bucket keeps the import executable
+                # count bounded, like the slot cache's import
+                pad = pow2_ceil(n_pg, self.pages_per_slot)
+                table = [self._alloc_page(slot) for _ in range(n_pg)]
+                pages = np.zeros(pad, np.int32)  # surplus -> scratch 0
+                pages[:n_pg] = table
+                L = spec.num_layers
+                shape = (L, pad, ps, spec.num_kv_heads, spec.head_dim)
+                k_pg = np.zeros(shape, dt)
+                v_pg = np.zeros(shape, dt)
+                k_pg.reshape(L, pad * ps, *shape[3:])[:, :s.length] = s.k
+                v_pg.reshape(L, pad * ps, *shape[3:])[:, :s.length] = s.v
+                self.k, self.v = self._import_fn(
+                    self.k, self.v, jnp.asarray(k_pg), jnp.asarray(v_pg),
+                    jnp.asarray(pages))
+                self.tables[slot] = table
+                self.lengths[slot] = s.length
+                slot_map[s.slot] = slot
+        except Exception:
+            for slot in allocated:
+                self.free(slot)
+            raise
+        return slot_map

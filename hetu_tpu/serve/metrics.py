@@ -105,6 +105,13 @@ class ServeMetrics:
             dt = max(decode_now - decode_t0, 1e-9)
             if dt > 0 and decode_tokens:
                 out["tokens_per_sec"] = decode_tokens / dt
+        # paged-engine derived rate: what fraction of prompt tokens were
+        # served from the prefix cache instead of prefilled (the dedup
+        # telemetry the paged A/B bench and dashboards read)
+        hit = out.get("prefix_hit_tokens", 0)
+        miss = out.get("prefix_miss_tokens", 0)
+        if hit or miss:
+            out["prefix_hit_rate"] = hit / (hit + miss)
         return out
 
     def report(self, logger, step=None) -> dict:

@@ -38,6 +38,17 @@ fold watermark, deadline (as elapsed time — monotonic clocks do not
 compare across processes), requeue count.  Decoding is greedy argmax
 today, so there is no sampler/RNG state to carry; a sampling engine
 extends the record here.
+
+Paged engines (serve/kv_cache.py:PagedKVCache) speak this wire
+unchanged: a paged export assembles each slot's LIVE pages into the
+same contiguous truncated-rows snapshot (page ids are process-local
+and meaningless on the wire — the adopter rebuilds page tables as it
+imports), so payload size scales with live tokens either way, every
+codec applies, and slot↔paged CROSS-ALLOCATOR drains work — the
+rolling-upgrade path from a slot-engine fleet to a paged one.
+Residual: an adopter does not re-dedup imported slots into its prefix
+index; shared-prefix requests that migrate together re-materialize
+their prefix per slot until their pages age out.
 """
 
 from __future__ import annotations

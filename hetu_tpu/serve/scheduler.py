@@ -136,7 +136,8 @@ class Request:
 class ContinuousBatchingScheduler:
     def __init__(self, engine, *, token_budget: Optional[int] = None,
                  metrics=None, max_requeues: int = 3,
-                 shed: bool = False, shed_headroom: float = 1.0):
+                 shed: bool = False, shed_headroom: float = 1.0,
+                 prefill_chunks_per_step: int = 1):
         self.engine = engine
         self.metrics = metrics or engine.metrics
         # engine-failover requeue budget per request: a request whose
@@ -145,9 +146,18 @@ class ContinuousBatchingScheduler:
         self.max_requeues = int(max_requeues)
         cache = engine.cache
         # default budget: the cache itself (backpressure only kicks in
-        # when admission would overrun physical capacity anyway)
+        # when admission would overrun physical capacity anyway).  For a
+        # PAGED engine the token budget is vestigial: admission gates on
+        # the engine's page ledger instead (admission_ok), which credits
+        # prefix-shared pages and nets out outstanding reservations.
         self.token_budget = int(token_budget or
                                 cache.num_slots * cache.max_len)
+        # chunked-prefill interleave (paged engines): per step, at most
+        # this many prefill chunks advance before the decode round, so a
+        # 4k-context arrival adds ONE bounded chunk of latency per step
+        # to in-flight decodes instead of a whole-prompt stall
+        self.prefill_chunks_per_step = int(prefill_chunks_per_step)
+        self._prefilling = {}  # slot -> Request (chunked prefill running)
         # overload shedding (admission control): with ``shed`` on, a
         # submit whose PROJECTED completion (queue-delay model below)
         # already blows its deadline resolves instantly as 'shed' —
@@ -178,7 +188,7 @@ class ContinuousBatchingScheduler:
         if ewma is None:
             return 0.0
         slots = max(self.engine.cache.num_slots, 1)
-        ahead = len(self._queue) + len(self._running)
+        ahead = len(self._queue) + len(self._running) + len(self._prefilling)
         # `ahead/slots` service generations drain before its turn, then
         # its own service — the M/M/c-flavored projection that needs
         # only numbers already on hand
@@ -261,11 +271,15 @@ class ContinuousBatchingScheduler:
             requeued = 0
             # newest-submitted first + appendleft = oldest request ends up
             # at the queue head (slot index is NOT admission order once
-            # slots get reused; submission time is)
+            # slots get reused; submission time is).  Mid-chunked-prefill
+            # requests requeue the same way — their partial KV died with
+            # the engine, so they re-prefill from the prompt like anyone
             for slot, req in sorted(
-                    self._running.items(), reverse=True,
+                    list(self._running.items())
+                    + list(self._prefilling.items()), reverse=True,
                     key=lambda kv: (kv[1].submitted_at or 0.0, kv[1].rid)):
-                del self._running[slot]
+                self._running.pop(slot, None)
+                self._prefilling.pop(slot, None)
                 self._release_slot_locked(slot)
                 if self._requeue_locked(req, cap):
                     requeued += 1
@@ -404,6 +418,22 @@ class ContinuousBatchingScheduler:
             else:
                 req.state = "migrating"
                 out.append((req, slot))
+        # mid-chunked-prefill requests export as QUEUED either way: a
+        # partial prefill has no last_token to resume from, so the peer
+        # re-prefills — from the prompt alone, so no requeue is charged
+        # on the planned path (nothing emitted was lost)
+        for slot, req in sorted(
+                self._prefilling.items(),
+                key=lambda kv: (kv[1].submitted_at or 0.0, kv[1].rid)):
+            del self._prefilling[slot]
+            self._release_slot_locked(slot)
+            if fold:
+                if self._fold_locked(req, self.max_requeues):
+                    out.append((req, None))
+            else:
+                req.state = "queued"
+                req.slot = None
+                out.append((req, None))
         while self._queue:
             out.append((self._queue.popleft(), None))
         for req, _ in out:
@@ -530,7 +560,7 @@ class ContinuousBatchingScheduler:
         here would stall all routing behind any one member's in-flight
         decode step — and deadlock failover DETECTION behind a wedged
         one."""
-        return len(self._queue) + len(self._running)
+        return len(self._queue) + len(self._running) + len(self._prefilling)
 
     @property
     def running_count(self) -> int:
@@ -548,7 +578,8 @@ class ContinuousBatchingScheduler:
         with self._lock:
             return request in self._queue or (
                 request.slot is not None and
-                self._running.get(request.slot) is request)
+                (self._running.get(request.slot) is request or
+                 self._prefilling.get(request.slot) is request))
 
     def replace_engine(self, engine) -> None:
         """Swap in a (restarted) engine and reopen intake.  Any requests
@@ -581,6 +612,10 @@ class ContinuousBatchingScheduler:
                 # a dead engine must not abort the cancel: the caller's
                 # whole point is resolving the request
                 self._release_slot_locked(request.slot)
+            elif request.slot is not None and \
+                    self._prefilling.get(request.slot) is request:
+                del self._prefilling[request.slot]
+                self._release_slot_locked(request.slot)
             if not already:
                 self._finish(request, status)
 
@@ -601,6 +636,9 @@ class ContinuousBatchingScheduler:
         completed = []
         with self._lock, trace.span("serve.step") as sp:
             progressed, admit_exc = self._admit(completed)
+            pf_progressed, pf_exc = self._advance_prefills(completed)
+            progressed = progressed or pf_progressed
+            admit_exc = admit_exc or pf_exc
             if self._running:
                 toks = self.engine.decode()
                 progressed = True
@@ -623,7 +661,7 @@ class ContinuousBatchingScheduler:
 
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self._queue or self._running)
+            return bool(self._queue or self._running or self._prefilling)
 
     # ---- internals (called under the lock) ----
     def _admit(self, completed: list):
@@ -652,9 +690,19 @@ class ContinuousBatchingScheduler:
                 self._finish(req, "overflow")
                 completed.append(req)
                 continue
-            # token-budget backpressure: the working set after admission
-            # (fits eventually — running sequences will finish and free it)
-            if self.engine.cache.active_tokens + n + 1 > self.token_budget:
+            paged = hasattr(self.engine, "begin_prefill")
+            if paged:
+                # page-budget backpressure: the engine's ledger knows
+                # what the request's worst case costs AFTER prefix
+                # sharing and what outstanding reservations still claim
+                if not self.engine.admission_ok(req.prompt,
+                                                req.max_tokens):
+                    break
+            elif self.engine.cache.active_tokens + n + 1 > \
+                    self.token_budget:
+                # token-budget backpressure: the working set after
+                # admission (fits eventually — running sequences will
+                # finish and free it)
                 break
             self._queue.popleft()
             try:
@@ -670,6 +718,26 @@ class ContinuousBatchingScheduler:
                 break
             req.slot = slot
             req.state = "running"
+            if paged:
+                # chunked-prefill interleave: admission only ADOPTS the
+                # shared prefix, reserves pages, and parks a cursor —
+                # the chunks themselves advance one per step
+                # (_advance_prefills), interleaved with decode rounds
+                try:
+                    self.engine.begin_prefill(slot, req.prompt,
+                                              max_tokens=req.max_tokens)
+                except Exception as e:
+                    admit_exc = e
+                    if not self._requeue_locked(req, self.max_requeues,
+                                                tail=True):
+                        completed.append(req)
+                    try:
+                        self.engine.release(slot)
+                    except Exception:
+                        pass
+                    continue
+                self._prefilling[slot] = req
+                continue
             try:
                 first = self.engine.prefill(slot, req.prompt)
             except Exception as e:
@@ -708,6 +776,69 @@ class ContinuousBatchingScheduler:
                 self._finish(req, req.status or "ok")
                 completed.append(req)
         return progressed, admit_exc
+
+    def _advance_prefills(self, completed: list):
+        """Advance chunked prefills (paged engines), at most
+        ``prefill_chunks_per_step`` chunks per step — the interleave
+        policy that keeps a long-prompt arrival from spiking in-flight
+        decode latency.  A prefill whose final chunk completes emits its
+        first token and the request joins ``_running`` for the decode
+        round below.  Returns ``(progressed, exc)`` like :meth:`_admit`
+        (chunk failures are charged to the request; step() re-raises
+        only on zero overall progress)."""
+        if not self._prefilling:
+            return False, None
+        progressed = False
+        exc = None
+        # the timeout sweep runs over EVERY prefilling request BEFORE the
+        # chunk budget gates anything: timing out costs no chunk, and a
+        # deadline-blown request behind slower prefills must resolve (and
+        # release its slot + page reservation) this step, not when the
+        # queue ahead of it drains
+        now = time.monotonic()
+        for slot, req in list(self._prefilling.items()):
+            if req.timeout_s is not None and \
+                    now - req.submitted_at > req.timeout_s:
+                del self._prefilling[slot]
+                self._release_slot_locked(slot)
+                self._finish(req, "timeout")
+                completed.append(req)
+        budget = max(self.prefill_chunks_per_step, 1)
+        for slot, req in sorted(
+                self._prefilling.items(),
+                key=lambda kv: (kv[1].submitted_at or 0.0, kv[1].rid)):
+            if budget <= 0:
+                break
+            try:
+                tok = self.engine.prefill_step(slot)
+            except Exception as e:
+                # same containment as a monolithic prefill blow-up: the
+                # request goes back to the TAIL (or fails past its
+                # requeue cap), the slot frees, everyone else continues
+                exc = e
+                del self._prefilling[slot]
+                if not self._requeue_locked(req, self.max_requeues,
+                                            tail=True):
+                    completed.append(req)
+                self._release_slot_locked(slot)
+                continue
+            budget -= 1
+            progressed = True
+            if tok is None:
+                continue
+            del self._prefilling[slot]
+            req.tokens.append(tok)
+            now_t = time.monotonic()
+            if req.first_token_at is None:
+                req.first_token_at = now_t
+                self.metrics.observe_ttft(req.ttft_s)
+            self._running[slot] = req
+            if self._should_evict(req, now_t):
+                del self._running[slot]
+                self.engine.release(slot)
+                self._finish(req, req.status or "ok")
+                completed.append(req)
+        return progressed, exc
 
     def _should_evict(self, req: Request, now: float) -> bool:
         if req.eos_id is not None and req.tokens[-1] == req.eos_id:
@@ -781,3 +912,7 @@ class ContinuousBatchingScheduler:
                 self._release_slot_locked(slot)
                 self._finish(req, status)
             self._running.clear()
+            for slot, req in list(self._prefilling.items()):
+                self._release_slot_locked(slot)
+                self._finish(req, status)
+            self._prefilling.clear()

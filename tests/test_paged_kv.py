@@ -1,0 +1,523 @@
+"""Paged KV cache + prefix sharing + chunked prefill (ISSUE 13).
+
+The contract under test: greedy decode through the PAGED engine is
+TOKEN-FOR-TOKEN identical to the slot engine (which is itself
+token-exact against the training forward, tests/test_serve.py) — for
+GPT, for GQA-Llama, under a tp mesh, across chunked prefills of any
+chunk split, and through live migration (paged→paged and the
+cross-allocator slot→paged drain) — while prefix sharing dedups
+identical prefixes to one physical copy with copy-on-write isolation
+and exact refcount release, and the whole engine compiles a BOUNDED
+number of executables.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.models.gpt import GPTConfig, GPTModel
+from hetu_tpu.models.llama import LlamaConfig, LlamaModel
+from hetu_tpu.serve import (
+    ContinuousBatchingScheduler, PagedServeEngine, Request, ServeEngine,
+)
+
+pytestmark = pytest.mark.paged
+
+
+def _gpt():
+    m = GPTModel(GPTConfig(
+        vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+        ffn_size=128, max_position=64, dropout_rate=0.0))
+    return m, m.init(jax.random.PRNGKey(0))
+
+
+def _llama_gqa():
+    m = LlamaModel(LlamaConfig(
+        vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, ffn_size=96, max_position=64))
+    return m, m.init(jax.random.PRNGKey(1))
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    return _gpt()
+
+
+@pytest.fixture(scope="module")
+def llama():
+    return _llama_gqa()
+
+
+def _engine_greedy(engine, prompt, n):
+    slot = engine.alloc_slot()
+    toks = [engine.prefill(slot, prompt)]
+    for _ in range(n - 1):
+        toks.append(engine.decode()[slot])
+    engine.release(slot)
+    return toks
+
+
+# ---- paged-vs-slot token parity (greedy decode) ----
+
+@pytest.mark.parametrize("prompt_len", [1, 5, 9, 17, 33])
+def test_gpt_paged_vs_slot_parity(gpt, prompt_len):
+    model, variables = gpt
+    g = np.random.default_rng(prompt_len)
+    prompt = [int(t) for t in g.integers(0, 97, prompt_len)]
+    slot = ServeEngine(model, variables, num_slots=2, max_len=64)
+    paged = PagedServeEngine(model, variables, num_slots=2, max_len=64,
+                             page_size=8)
+    assert _engine_greedy(slot, prompt, 12) == \
+        _engine_greedy(paged, prompt, 12)
+
+
+@pytest.mark.parametrize("prompt_len", [1, 7, 19])
+def test_llama_gqa_paged_vs_slot_parity(llama, prompt_len):
+    model, variables = llama
+    g = np.random.default_rng(100 + prompt_len)
+    prompt = [int(t) for t in g.integers(0, 97, prompt_len)]
+    slot = ServeEngine(model, variables, num_slots=2, max_len=64)
+    paged = PagedServeEngine(model, variables, num_slots=2, max_len=64,
+                             page_size=8)
+    assert _engine_greedy(slot, prompt, 10) == \
+        _engine_greedy(paged, prompt, 10)
+
+
+def test_parity_independent_of_chunk_split(gpt):
+    """The same prompt prefilled in one chunk vs many page-aligned
+    chunks must generate identical tokens — chunk boundaries never leak
+    into the numerics."""
+    model, variables = gpt
+    g = np.random.default_rng(42)
+    prompt = [int(t) for t in g.integers(0, 97, 37)]
+    one = PagedServeEngine(model, variables, num_slots=1, max_len=64,
+                           page_size=8, prefill_chunk=64)
+    many = PagedServeEngine(model, variables, num_slots=1, max_len=64,
+                            page_size=8, prefill_chunk=8)
+    assert _engine_greedy(one, prompt, 10) == _engine_greedy(many, prompt, 10)
+
+
+def test_tp_sharded_paged_matches_slot(llama):
+    model, variables = llama
+    prompt = [3, 14, 15, 9, 2, 6]
+    plain = ServeEngine(model, variables, num_slots=2, max_len=32,
+                        min_bucket=8)
+    mesh = ht.make_mesh(tp=2)  # nkv=2 → kv-head-sharded page pool
+    paged = PagedServeEngine(model, variables, num_slots=2, max_len=32,
+                             page_size=8, mesh=mesh)
+    assert _engine_greedy(plain, prompt, 8) == _engine_greedy(paged, prompt, 8)
+
+
+# ---- prefix sharing ----
+
+def test_shared_prefix_divergent_suffixes_token_exact(llama):
+    """System-prompt traffic: one shared prefix, divergent suffixes.
+    The paged engine dedups the prefix (hits counted) and every request
+    still decodes token-for-token like the unshared slot engine."""
+    model, variables = llama
+    g = np.random.default_rng(3)
+    prefix = [int(t) for t in g.integers(0, 97, 17)]
+    suffixes = [[int(t) for t in g.integers(0, 97, k)] for k in (5, 9, 3)]
+
+    def run(engine):
+        sch = ContinuousBatchingScheduler(engine)
+        reqs = [Request(prompt=prefix + s, max_tokens=8) for s in suffixes]
+        sch.run(reqs)
+        return [r.tokens for r in reqs]
+
+    want = run(ServeEngine(model, variables, num_slots=4, max_len=64))
+    paged = PagedServeEngine(model, variables, num_slots=4, max_len=64,
+                             page_size=8)
+    assert run(paged) == want
+    snap = paged.metrics.snapshot()
+    # the 2nd and 3rd requests share the prefix's full pages (17 tokens
+    # → two 8-token pages each)
+    assert snap["prefix_hits"] >= 2
+    assert snap["prefix_hit_tokens"] >= 2 * 16
+    assert 0.0 < snap["prefix_hit_rate"] < 1.0
+
+
+def test_identical_prompts_full_dedup_and_cow(gpt):
+    """Two identical prompts: the second shares everything except one
+    recomputed token (the logits source), which copy-on-writes the
+    shared tail page — and both decode the same tokens as an unshared
+    run."""
+    model, variables = gpt
+    g = np.random.default_rng(5)
+    prompt = [int(t) for t in g.integers(0, 97, 21)]
+    want = _engine_greedy(ServeEngine(model, variables, num_slots=1,
+                                      max_len=64), prompt, 8)
+    paged = PagedServeEngine(model, variables, num_slots=2, max_len=64,
+                             page_size=8)
+    sch = ContinuousBatchingScheduler(paged)
+    r1 = Request(prompt=list(prompt), max_tokens=8)
+    r2 = Request(prompt=list(prompt), max_tokens=8)
+    sch.run([r1, r2])
+    assert r1.tokens == want and r2.tokens == want
+    assert paged.cache.cow_copies >= 1
+    # full dedup: the sharer covered every full page of the prompt
+    assert paged.cache.prefix_hit_tokens >= len(prompt) - 1
+
+
+def test_cow_isolation_between_forks(gpt):
+    """Requests forked off one shared prefix must not corrupt each
+    other: interleaved decode of divergent suffixes equals each
+    sequence decoded alone."""
+    model, variables = gpt
+    g = np.random.default_rng(9)
+    prefix = [int(t) for t in g.integers(0, 97, 16)]  # page-aligned
+    sufa = [int(t) for t in g.integers(0, 97, 3)]
+    sufb = [int(t) for t in g.integers(0, 97, 3)]
+
+    def alone(suffix):
+        e = PagedServeEngine(model, variables, num_slots=1, max_len=64,
+                             page_size=8)
+        return _engine_greedy(e, prefix + suffix, 10)
+
+    want_a, want_b = alone(sufa), alone(sufb)
+    e = PagedServeEngine(model, variables, num_slots=2, max_len=64,
+                         page_size=8)
+    sa = e.alloc_slot()
+    ta = [e.prefill(sa, prefix + sufa)]
+    sb = e.alloc_slot()
+    tb = [e.prefill(sb, prefix + sufb)]  # shares the prefix pages
+    for _ in range(9):
+        out = e.decode()
+        ta.append(out[sa])
+        tb.append(out[sb])
+    assert ta == want_a and tb == want_b
+
+
+def test_refcount_release_on_free(gpt):
+    """Freeing every slot leaves only index-held (reclaimable) pages;
+    evicting the index returns the pool to empty — no leaked pages, no
+    double frees."""
+    model, variables = gpt
+    e = PagedServeEngine(model, variables, num_slots=3, max_len=64,
+                         page_size=8)
+    g = np.random.default_rng(11)
+    prefix = [int(t) for t in g.integers(0, 97, 16)]
+    slots = []
+    for k in (3, 5, 7):
+        s = e.alloc_slot()
+        e.prefill(s, prefix + [int(t) for t in g.integers(0, 97, k)])
+        slots.append(s)
+    for _ in range(4):
+        e.decode()
+    assert e.cache.pages_in_use > 0
+    for s in slots:
+        e.release(s)
+    c = e.cache
+    assert c.pages_in_use == c.reclaimable_pages  # only the index holds on
+    while c._evict_one_entry():
+        pass
+    assert c.pages_in_use == 0 and c.prefix_entries == 0
+    assert not np.any(c.ref_table) and not np.any(c.ref_index)
+
+
+def test_double_free_raises(gpt):
+    model, variables = gpt
+    e = PagedServeEngine(model, variables, num_slots=2, max_len=64,
+                         page_size=8)
+    s = e.alloc_slot()
+    e.release(s)
+    with pytest.raises(ValueError, match="double-freed"):
+        e.cache.free(s)
+
+
+# ---- compilation discipline + backpressure ----
+
+def test_bounded_executables_varied_paged_traffic(gpt):
+    model, variables = gpt
+    engine = PagedServeEngine(model, variables, num_slots=4, max_len=64,
+                              page_size=8)
+    sch = ContinuousBatchingScheduler(engine)
+    g = np.random.default_rng(0)
+    reqs = [Request(prompt=[int(t) for t in
+                            g.integers(0, 97, int(g.integers(1, 40)))],
+                    max_tokens=int(g.integers(2, 12)))
+            for _ in range(24)]
+    out = sch.run(reqs)
+    assert all(len(r.tokens) >= 1 for r in reqs)
+    assert len(out) == 24
+    assert engine.compiled_executables() <= engine.max_executables
+
+
+def test_page_budget_backpressure_queues_not_fails(gpt):
+    """A page pool far smaller than the workload's total footprint must
+    QUEUE admissions (page-budget backpressure), not fail them — every
+    request still completes."""
+    model, variables = gpt
+    # 17 pages of 8 tokens ≈ two concurrent 40-token working sets
+    engine = PagedServeEngine(model, variables, num_slots=4, max_len=64,
+                              page_size=8, num_pages=17,
+                              prefix_sharing=False)
+    sch = ContinuousBatchingScheduler(engine)
+    g = np.random.default_rng(1)
+    reqs = [Request(prompt=[int(t) for t in g.integers(0, 97, 20)],
+                    max_tokens=8) for _ in range(8)]
+    sch.run(reqs)
+    assert all(r.status == "ok" and len(r.tokens) == 8 for r in reqs)
+
+
+def test_chunked_prefill_interleaves_with_decode(gpt):
+    """While a long prompt prefills in chunks, in-flight requests keep
+    decoding: the long request's admission must not stall them for its
+    whole prompt."""
+    model, variables = gpt
+    engine = PagedServeEngine(model, variables, num_slots=3, max_len=64,
+                              page_size=8, prefill_chunk=8)
+    sch = ContinuousBatchingScheduler(engine, prefill_chunks_per_step=1)
+    short = Request(prompt=[1, 2, 3], max_tokens=30)
+    sch.submit(short)
+    sch.step()  # short is decoding
+    tokens_before = len(short.tokens)
+    g = np.random.default_rng(2)
+    long_req = Request(prompt=[int(t) for t in g.integers(0, 97, 40)],
+                       max_tokens=4)
+    sch.submit(long_req)
+    # 40 tokens / 8-token chunks = 5 chunked steps; the short request
+    # must gain a token on EVERY one of them
+    for i in range(4):
+        sch.step()
+        assert len(short.tokens) == tokens_before + i + 1
+        assert len(long_req.tokens) == 0  # still prefilling
+    sch.step()
+    assert len(long_req.tokens) >= 1  # final chunk emitted its token
+    while sch.has_work():
+        sch.step()
+    assert long_req.status == "ok" and short.status == "ok"
+
+
+# ---- migration: live pages only, codec-compatible ----
+
+def _oracle(model, variables, prompts, n):
+    out = []
+    for p in prompts:
+        e = ServeEngine(model, variables, num_slots=1, max_len=64)
+        out.append(_engine_greedy(e, p, n))
+    return out
+
+
+@pytest.mark.migrate
+def test_paged_to_paged_migration_token_parity(gpt):
+    from hetu_tpu.serve import migrate as mg
+    model, variables = gpt
+    g = np.random.default_rng(7)
+    prompts = [[int(t) for t in g.integers(0, 97, k)] for k in (11, 23, 6)]
+    want = _oracle(model, variables, prompts, 10)
+    src = ContinuousBatchingScheduler(PagedServeEngine(
+        model, variables, num_slots=4, max_len=64, page_size=8))
+    dst = ContinuousBatchingScheduler(PagedServeEngine(
+        model, variables, num_slots=4, max_len=64, page_size=8))
+    reqs = [Request(prompt=list(p), max_tokens=10) for p in prompts]
+    for r in reqs:
+        src.submit(r)
+    for _ in range(5):
+        src.step()  # mid-decode
+    mg.migrate_inflight(src, dst)
+    for _ in range(80):
+        if not dst.has_work():
+            break
+        dst.step()
+    assert [r.tokens for r in reqs] == want
+    # zero re-prefill on the adopter: adopted mid-decode slots continue
+    assert dst.engine.metrics.count("slots_adopted") >= 1
+
+
+@pytest.mark.migrate
+def test_slot_to_paged_cross_allocator_migration(gpt):
+    """The paged cache speaks the same snapshot wire form as the slot
+    cache: a slot engine's live export adopts into a paged engine (the
+    rolling-upgrade drain) with token parity preserved."""
+    from hetu_tpu.serve import migrate as mg
+    model, variables = gpt
+    g = np.random.default_rng(8)
+    prompts = [[int(t) for t in g.integers(0, 97, k)] for k in (9, 17)]
+    want = _oracle(model, variables, prompts, 10)
+    src = ContinuousBatchingScheduler(ServeEngine(
+        model, variables, num_slots=2, max_len=64))
+    dst = ContinuousBatchingScheduler(PagedServeEngine(
+        model, variables, num_slots=4, max_len=64, page_size=8))
+    reqs = [Request(prompt=list(p), max_tokens=10) for p in prompts]
+    for r in reqs:
+        src.submit(r)
+    for _ in range(4):
+        src.step()
+    mg.migrate_inflight(src, dst)
+    for _ in range(80):
+        if not dst.has_work():
+            break
+        dst.step()
+    assert [r.tokens for r in reqs] == want
+
+
+@pytest.mark.migrate
+def test_paged_payload_roundtrip_with_codec(gpt):
+    """export_payload/adopt_payload between paged schedulers through the
+    self-describing packed payload (live pages only on the wire), with
+    the int8 block-scaled codec accepted by the same unpack path."""
+    from hetu_tpu.serve import migrate as mg
+    model, variables = gpt
+    g = np.random.default_rng(13)
+    prompts = [[int(t) for t in g.integers(0, 97, k)] for k in (10, 19)]
+    src = ContinuousBatchingScheduler(PagedServeEngine(
+        model, variables, num_slots=2, max_len=64, page_size=8))
+    reqs = [Request(prompt=list(p), max_tokens=12) for p in prompts]
+    for r in reqs:
+        src.submit(r)
+    for _ in range(3):
+        src.step()
+    payload, pairs = mg.export_payload(src, codec="none")
+    # payload ships LIVE tokens only: far below the whole-slot footprint
+    spec = src.engine.cache.spec
+    per_tok = 2 * spec.num_layers * spec.num_kv_heads * spec.head_dim * 4
+    live = sum(int(n) for n in src.engine.cache.lengths)
+    assert len(payload) < live * per_tok + 4096
+    dst = ContinuousBatchingScheduler(PagedServeEngine(
+        model, variables, num_slots=2, max_len=64, page_size=8))
+    adopted, slot_map = mg.adopt_payload(dst, payload)
+    mg.release_exported(src, pairs)
+    assert len(adopted) == 2 and len(slot_map) == 2
+    for _ in range(80):
+        if not dst.has_work():
+            break
+        dst.step()
+    want = _oracle(model, variables, prompts, 12)
+    assert [sorted_r.tokens for sorted_r in adopted] == want
+
+
+# ---- scheduler-state coverage for the chunked path ----
+
+def test_requeue_mid_chunked_prefill_re_prefills(gpt):
+    """Engine failover while a chunked prefill is in flight: the request
+    requeues and re-prefills on the replacement engine, token-exact."""
+    model, variables = gpt
+    g = np.random.default_rng(21)
+    prompt = [int(t) for t in g.integers(0, 97, 30)]
+    want = _engine_greedy(PagedServeEngine(
+        model, variables, num_slots=1, max_len=64, page_size=8), prompt, 6)
+    engine = PagedServeEngine(model, variables, num_slots=2, max_len=64,
+                              page_size=8, prefill_chunk=8)
+    sch = ContinuousBatchingScheduler(engine)
+    req = Request(prompt=list(prompt), max_tokens=6)
+    sch.submit(req)
+    sch.step()  # admitted; first chunk ran, prefill NOT complete
+    assert len(req.tokens) == 0 and sch._prefilling
+    fresh = PagedServeEngine(model, variables, num_slots=2, max_len=64,
+                             page_size=8, prefill_chunk=8)
+    sch.replace_engine(fresh)
+    while sch.has_work():
+        sch.step()
+    assert req.tokens == want and req.status == "ok"
+
+
+def test_cancel_mid_chunked_prefill_frees_pages(gpt):
+    model, variables = gpt
+    engine = PagedServeEngine(model, variables, num_slots=2, max_len=64,
+                              page_size=8, prefill_chunk=8,
+                              prefix_sharing=False)
+    sch = ContinuousBatchingScheduler(engine)
+    g = np.random.default_rng(22)
+    req = Request(prompt=[int(t) for t in g.integers(0, 97, 30)],
+                  max_tokens=6)
+    sch.submit(req)
+    sch.step()
+    assert engine.cache.pages_in_use > 0
+    sch.cancel(req)
+    assert req.status == "cancelled"
+    assert engine.cache.pages_in_use == 0
+    assert engine.cache.num_free == engine.cache.num_slots
+
+
+def test_full_dedup_near_max_len_no_clamp_corruption(gpt):
+    """Review regression: a near-max_len prompt resubmitted (full prefix
+    hit → one recomputed token at start = n-1) pads its chunk bucket
+    past the slot's own page window.  The extended gather view must
+    absorb the padding — a clamped window would smear pad junk over
+    real history and silently change the token."""
+    model, variables = gpt
+    g = np.random.default_rng(31)
+    # max_len 64, page 8: prompt 58 → full-hit resubmit runs one chunk
+    # at start=57 padded to bucket 16 → 73 > 64 without the extension
+    prompt = [int(t) for t in g.integers(0, 97, 58)]
+    want = _engine_greedy(ServeEngine(model, variables, num_slots=1,
+                                      max_len=64), prompt, 4)
+    paged = PagedServeEngine(model, variables, num_slots=2, max_len=64,
+                             page_size=8)
+    first = _engine_greedy(paged, prompt, 4)
+    assert first == want
+    again = _engine_greedy(paged, prompt, 4)  # the full-dedup resubmit
+    assert again == want
+    assert paged.cache.prefix_hit_tokens >= len(prompt) - 1
+
+
+def test_import_respects_outstanding_reservations(gpt):
+    """Review regression: a migration adoption must not consume pages
+    an in-flight chunked prefill's admission reserved."""
+    from hetu_tpu.serve.kv_cache import KVSlotSnapshot
+    model, variables = gpt
+    e = PagedServeEngine(model, variables, num_slots=4, max_len=64,
+                         page_size=8, num_pages=9, prefix_sharing=False)
+    slot = e.alloc_slot()
+    e.begin_prefill(slot, list(range(1, 30)), max_tokens=8)  # reserves
+    reserved = int(e.cache._reserve[slot])
+    assert reserved > 0
+    spec = e.cache.spec
+    n = 17
+    snap = KVSlotSnapshot(
+        slot=0, length=n,
+        k=np.zeros((spec.num_layers, n, spec.num_kv_heads,
+                    spec.head_dim), np.dtype(spec.dtype)),
+        v=np.zeros((spec.num_layers, n, spec.num_kv_heads,
+                    spec.head_dim), np.dtype(spec.dtype)),
+        meta={"last_token": 1})
+    # 8 usable pages, reservation holds `reserved`; adopting 3 more must
+    # refuse rather than eat the reserved headroom
+    if 3 > e.cache.available_pages():
+        with pytest.raises(RuntimeError, match="available"):
+            e.adopt_slots([snap])
+    # and the reserved prefill still completes
+    while e.prefill_step(slot) is None:
+        pass
+    assert e.active[slot]
+
+
+def test_prefill_timeout_resolves_behind_slower_prefills(gpt):
+    """Review regression: a deadline-blown mid-prefill request resolves
+    the same step even when older prefills consume the chunk budget."""
+    import time as _time
+    model, variables = gpt
+    engine = PagedServeEngine(model, variables, num_slots=3, max_len=64,
+                              page_size=8, prefill_chunk=8)
+    sch = ContinuousBatchingScheduler(engine, prefill_chunks_per_step=1)
+    g = np.random.default_rng(33)
+    slow = Request(prompt=[int(t) for t in g.integers(0, 97, 40)],
+                   max_tokens=4)
+    doomed = Request(prompt=[int(t) for t in g.integers(0, 97, 40)],
+                     max_tokens=4, timeout_s=0.01)
+    sch.submit(slow)
+    sch.submit(doomed)
+    sch.step()  # both admitted, budget goes to `slow`
+    _time.sleep(0.02)
+    sch.step()  # doomed's deadline has passed; budget still goes to slow
+    assert doomed.status == "timeout" and doomed.done.is_set()
+    while sch.has_work():
+        sch.step()
+    assert slow.status == "ok"
+
+
+def test_llama_full_dedup_near_max_len(llama):
+    """Same near-boundary clamp/NaN regression on the RoPE path: the
+    chunk's pad positions gather past the rope tables and must clamp,
+    not NaN-fill."""
+    model, variables = llama
+    g = np.random.default_rng(37)
+    prompt = [int(t) for t in g.integers(0, 97, 58)]
+    want = _engine_greedy(ServeEngine(model, variables, num_slots=1,
+                                      max_len=64), prompt, 4)
+    paged = PagedServeEngine(model, variables, num_slots=2, max_len=64,
+                             page_size=8)
+    assert _engine_greedy(paged, prompt, 4) == want
+    assert _engine_greedy(paged, prompt, 4) == want  # full-dedup resubmit

@@ -116,3 +116,101 @@ print("final", t.sparse_pull([7]).tolist())
     # still healthy after cross-process traffic
     assert t.ping()
     t.close()
+
+
+# ---- single-row compare-and-set (the controller-claim primitive) ----
+
+def test_row_cas_semantics(server_port):
+    """Swap on match (returns the new row), refuse on mismatch (returns
+    the current row) — one wire round trip either way."""
+    t = van.RemotePSTable("127.0.0.1", server_port, 6, 4, init="zeros",
+                          optimizer="sgd", lr=0.0)
+    desired = np.asarray([7.0, 1.0, 2.0, 3.0], np.float32)
+    ok, actual = t.row_cas(2, 0, 0.0, desired)
+    assert ok and np.array_equal(actual, desired)
+    # stale expected: no write, current row comes back
+    ok2, actual2 = t.row_cas(2, 0, 0.0, np.zeros(4, np.float32))
+    assert not ok2 and np.array_equal(actual2, desired)
+    # comparing a non-zero field works too
+    ok3, actual3 = t.row_cas(2, 3, 3.0, np.full(4, 9.0, np.float32))
+    assert ok3 and np.array_equal(actual3, np.full(4, 9.0))
+    t.close()
+
+
+def test_row_cas_validates(server_port):
+    t = van.RemotePSTable("127.0.0.1", server_port, 4, 3, init="zeros",
+                          optimizer="sgd", lr=0.0)
+    with pytest.raises(ValueError, match="fields"):
+        t.row_cas(0, 0, 0.0, np.zeros(5, np.float32))  # wrong dim
+    with pytest.raises(Exception):
+        t.row_cas(0, 7, 0.0, np.zeros(3, np.float32))  # field out of range
+    t.close()
+
+
+def test_row_cas_two_claimant_race(server_port):
+    """The satellite acceptance: two simultaneous claimants CAS the same
+    expected value — EXACTLY one wins every round (ties impossible), the
+    loser reads the winner's row from the CAS response."""
+    import threading
+    t1 = van.RemotePSTable("127.0.0.1", server_port, 4, 4, init="zeros",
+                           optimizer="sgd", lr=0.0)
+    t2 = van.RemotePSTable("127.0.0.1", server_port, 4, 4, create=False,
+                           table_id=t1.id)
+    for rnd in range(30):
+        cur = float(t1.sparse_pull([1])[0][0])
+        barrier = threading.Barrier(2)
+        res = [None, None]
+
+        def claim(i, tbl):
+            barrier.wait()
+            d = np.zeros(4, np.float32)
+            d[0] = cur + 1
+            d[1] = i  # distinguishable writer
+            res[i] = tbl.row_cas(1, 0, cur, d)
+
+        ts = [threading.Thread(target=claim, args=(i, tt))
+              for i, tt in enumerate((t1, t2))]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+        wins = [r[0] for r in res]
+        assert sum(wins) == 1, (rnd, wins)
+        # the loser's response row names the winner
+        loser = res[wins.index(False)][1]
+        assert loser[0] == cur + 1 and loser[1] == wins.index(True)
+    t1.close()
+    t2.close()
+
+
+def test_controller_claim_race_distinct_incarnations(server_port):
+    """Two MembershipServices claiming the controller row CONCURRENTLY
+    end with distinct incarnations (the CAS makes a tie impossible) and
+    the row holds the higher claim."""
+    import threading
+    from hetu_tpu.ps import membership as mb
+    tid = mb.fresh_table_id()
+    bb1 = mb.create_blackboard("127.0.0.1", server_port, table_id=tid,
+                               n_slots=2)
+    bb2 = mb.attach_blackboard("127.0.0.1", server_port, table_id=tid,
+                               n_slots=2)
+    svcs = [None, None]
+    barrier = threading.Barrier(2)
+
+    def claim(i, bb):
+        barrier.wait()
+        svcs[i] = mb.MembershipService(bb, 2, lease_s=5.0,
+                                       suspect_grace_s=5.0)
+
+    ts = [threading.Thread(target=claim, args=(i, bb))
+          for i, bb in enumerate((bb1, bb2))]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    incs = sorted(s.ctrl_incarnation for s in svcs)
+    assert incs[0] != incs[1]
+    row = bb1.sparse_pull([2 + 1])[0]
+    assert int(row[mb.R_CINC]) == incs[1]
+    bb1.close()
+    bb2.close()
