@@ -2379,6 +2379,144 @@ def bench_ctrlchaos():
     })
 
 
+def bench_obs():
+    """Fleet observability overhead: what the always-on flight recorder
+    costs on the serving path.
+
+    A/B on the SAME cross-process serving pool shape (2 member
+    processes, CPU-pinned, seeded model): arm A runs with the whole
+    observability plane OFF (no span streams, no controller tracer, no
+    fleet scrape — ``HETU_OBS_STREAM=0`` in the members); arm B runs
+    with everything ON — every process streaming spans to disk
+    line-by-line, the controller scraping member registries on a tight
+    cadence, tenant-tagged submits.  Both arms serve the same prompt
+    set and measure per-request wall latency at the client.
+
+    The contract printed against a budget: p50 request latency with the
+    full plane on must stay within ``overhead_budget_pct`` of
+    telemetry-off — the bench RAISES past it, because an observability
+    plane that taxes the serving path double-digit percent would never
+    be left on in production, and an off-by-default plane records
+    nothing the night the member dies.  The ON arm also proves it
+    measured the real thing: the merged fleet trace must contain a
+    cross-process flow chain for every request."""
+    import os
+    import tempfile
+    import threading
+
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+    from hetu_tpu.telemetry import fleet, trace
+
+    smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
+    if smoke:
+        H, L, MAXLEN, N_REQ, GEN, ROUNDS = 64, 2, 64, 6, 16, 1
+    else:
+        H, L, MAXLEN, N_REQ, GEN, ROUNDS = 128, 4, 128, 8, 32, 2
+    model_spec = {"vocab_size": 256, "hidden_size": H, "num_layers": L,
+                  "num_heads": 4, "ffn_size": 4 * H,
+                  "max_position": MAXLEN, "num_slots": N_REQ,
+                  "max_len": MAXLEN, "min_bucket": 8, "seed": 0}
+    prompts = [[(7 * i) % 251 + 1, (3 * i) % 251 + 1, 5]
+               for i in range(N_REQ)]
+    TENANTS = ("gold", "free")
+
+    def run_arm(obs_on: bool, wd: str):
+        env = {"JAX_PLATFORMS": "cpu"}
+        if not obs_on:
+            env["HETU_OBS_STREAM"] = "0"
+        if obs_on:
+            trace.enable(jsonl_path=os.path.join(
+                wd, "controller.trace.jsonl"))
+        pool = CrossProcessServingPool(
+            2, workdir=wd, model=model_spec, request_timeout_s=300.0,
+            telemetry_streams=obs_on,
+            scrape_s=0.25 if obs_on else 0.0, member_env=env)
+        lats = []
+        try:
+            def round_once(record):
+                out = {}
+
+                def worker(i):
+                    t0 = time.perf_counter()
+                    out[i] = pool.generate(
+                        prompts[i], max_tokens=GEN, timeout_s=300.0,
+                        tenant=TENANTS[i % 2] if obs_on else None)
+                    if record:
+                        lats.append(time.perf_counter() - t0)
+                ts = [threading.Thread(target=worker, args=(i,))
+                      for i in range(N_REQ)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(300)
+                assert len(out) == N_REQ and \
+                    all(r["status"] == "ok" for r in out.values()), out
+            round_once(record=False)  # warm both members' executables
+            for _ in range(ROUNDS):
+                round_once(record=True)
+            extra = {}
+            if obs_on:
+                reg = pool.fleet_metrics(timeout_s=5.0)
+                extra["fleet_requests_submitted"] = \
+                    reg.counter("requests_submitted").value
+                extra["scraped_members"] = \
+                    len(pool.member_metric_dumps)
+        finally:
+            pool.close()
+            if obs_on:
+                trace.disable()
+        if obs_on:
+            xp = fleet.cross_process_flow_rids(
+                fleet.merge_streams(wd)[0])
+            # EVERY request this arm served (warm round included — the
+            # rids are distinct) must appear as a stitched cross-process
+            # chain, or the ON arm measured a broken stitcher
+            served = N_REQ * (ROUNDS + 1)
+            assert len(xp) >= served, (len(xp), served)
+            extra["cross_process_rids"] = len(xp)
+            extra["streams"] = len(fleet.discover_streams(wd))
+        return lats, extra
+
+    with tempfile.TemporaryDirectory(prefix="bench_obs_off_") as wd:
+        off, _ = run_arm(False, wd)
+    with tempfile.TemporaryDirectory(prefix="bench_obs_on_") as wd:
+        on, on_extra = run_arm(True, wd)
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(int(q * len(xs)), len(xs) - 1)]
+
+    off_p50, on_p50 = pct(off, 0.5), pct(on, 0.5)
+    overhead_pct = (on_p50 - off_p50) / off_p50 * 100
+    budget_pct = 25.0  # generous: loopback CPU decode steps are ms-
+    # scale, so scheduler jitter dwarfs the per-span write; a real
+    # regression (sync I/O on the decode path) blows WAY past this
+    if overhead_pct > budget_pct:
+        raise AssertionError(
+            f"observability overhead {overhead_pct:.1f}% p50 exceeds "
+            f"the {budget_pct:.0f}% budget")
+    _emit({
+        "metric": "obs_stream_scrape_overhead_pct",
+        "value": round(overhead_pct, 3),
+        "unit": "percent_p50_request_latency_obs_on_vs_off",
+        "vs_baseline": round(off_p50 / on_p50, 4),
+        "extra": {
+            "overhead_budget_pct": budget_pct,
+            "within_budget": True,
+            "p50_s": {"off": round(off_p50, 4), "on": round(on_p50, 4)},
+            "p99_s": {"off": round(pct(off, 0.99), 4),
+                      "on": round(pct(on, 0.99), 4)},
+            "requests_per_round": N_REQ, "rounds": ROUNDS,
+            "gen_tokens": GEN,
+            **on_extra,
+            # vs_baseline = obs-on speed / obs-off speed (~1.0 when the
+            # plane is cheap), per the file convention
+            "ab": {"optimized": "streams_plus_scrape_plus_flows_on",
+                   "baseline": "all_telemetry_off"},
+        },
+    })
+
+
 _METRIC_BY_CMD = {
     "gpt": "gpt2s_bf16_train_mfu_1chip",
     "gpt_sweep": "gpt_config_sweep_best_mfu_1chip",
@@ -2397,6 +2535,7 @@ _METRIC_BY_CMD = {
     "netchaos": "netchaos_shed_vs_noshed_p99_x",
     "mpmd": "mpmd_gpipe_over_1f1b_bubble_x",
     "ctrlchaos": "ctrlchaos_takeover_p50_s",
+    "obs": "obs_stream_scrape_overhead_pct",
 }
 
 
@@ -2441,6 +2580,7 @@ def main():
      "netchaos": bench_netchaos,
      "mpmd": bench_mpmd,
      "ctrlchaos": bench_ctrlchaos,
+     "obs": bench_obs,
      "telemetry": bench_telemetry}.get(cmd, bench_gpt)()
 
 

@@ -438,6 +438,10 @@ class PipelineStageProcess(ControlPlaneMember):
 
 def stage_main(config_path: str) -> int:
     spec = StageSpec.from_json(open(config_path).read())
+    # crash-durable span stream in the run workdir: the pipeline
+    # stage's flight recorder (a SIGKILLed stage keeps its evidence)
+    trace.open_process_stream(Path(config_path).resolve().parent,
+                              f"stage_s{spec.stage}_p{os.getpid()}")
     worker = PipelineStageProcess(spec)
     print("READY", spec.stage, flush=True)
     worker.run()
@@ -542,7 +546,11 @@ class MPMDPipelineSupervisor:
                     subject="stage", policy="wait",
                     slow_ms=self.straggler_slow_ms)
                 self.log_paths = sorted(
-                    str(p) for p in self.workdir.glob("stage_*_*.jsonl"))
+                    str(p) for p in self.workdir.glob("stage_*_*.jsonl")
+                    # the stages' telemetry span streams live in the
+                    # same workdir and match the stem — they are NOT
+                    # recompute/commit logs
+                    if not p.name.endswith(".trace.jsonl"))
                 self._incarnations = len(
                     list(self.workdir.glob("stage_*_*.json")))
                 self._adopt()
@@ -994,6 +1002,8 @@ def controller_main(config_path: str) -> int:
     harness keys on (``STEP k`` per committed-high-water advance,
     ``ALLDONE``, ``FENCED``)."""
     cfg = json.loads(open(config_path).read())
+    trace.open_process_stream(cfg["workdir"],
+                              f"controller_p{os.getpid()}")
     sup = MPMDPipelineSupervisor(
         int(cfg["n_stages"]), workdir=cfg["workdir"],
         steps=int(cfg["steps"]),

@@ -620,6 +620,15 @@ class MembershipService:
         control_rpc(lambda: self.table.sparse_set([self.n_slots], row),
                     rng=self._rng, op="publish_control", link=self.link,
                     deadline_s=self.rpc_deadline_s)
+        # control-plane ids into the trace: every epoch/phase/width
+        # published, stamped with the publishing incarnation — on a
+        # merged fleet trace these instants are the controller-side
+        # markers member spans' ``ci`` args line up against
+        from hetu_tpu.telemetry import trace as _trace
+        _trace.instant("ctrl.publish",
+                       {"epoch": int(epoch), "width": int(width),
+                        "phase": int(phase),
+                        "inc": int(self.ctrl_incarnation)}, cat="ctrl")
 
     def adopt_slow(self, slot: int, ms: int) -> None:
         """Takeover path: seed the straggler-injection fields from the

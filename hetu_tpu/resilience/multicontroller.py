@@ -387,6 +387,10 @@ class WorkerProcess(ControlPlaneMember):
 
 def worker_main(config_path: str) -> int:
     spec = WorkerSpec.from_json(open(config_path).read())
+    # crash-durable span stream in the run workdir (the spawn config's
+    # directory IS the workdir): the elastic worker's flight recorder
+    trace.open_process_stream(Path(config_path).resolve().parent,
+                              f"worker_s{spec.slot}_p{os.getpid()}")
     worker = WorkerProcess(spec)
     print("READY", spec.slot, flush=True)
     worker.run()
@@ -592,7 +596,11 @@ class MultiControllerElasticSupervisor:
                     evict_after=self.straggler_evict_after,
                     slow_ms=self.straggler_slow_ms)
                 self.log_paths = sorted(
-                    str(p) for p in self.workdir.glob("worker_*_*.jsonl"))
+                    str(p) for p in self.workdir.glob("worker_*_*.jsonl")
+                    # the workers' telemetry span streams live in the
+                    # same workdir and match the stem — they are NOT
+                    # consumed-batch logs
+                    if not p.name.endswith(".trace.jsonl"))
                 self._incarnations = len(
                     list(self.workdir.glob("worker_*_*.json")))
                 self._adopt()
@@ -1099,6 +1107,8 @@ def controller_main(config_path: str) -> int:
     hangs — the takeover must finish the half-open epoch with an exact
     resume."""
     cfg = json.loads(open(config_path).read())
+    trace.open_process_stream(cfg["workdir"],
+                              f"controller_p{os.getpid()}")
     sup = MultiControllerElasticSupervisor(
         int(cfg["n_workers"]), workdir=cfg["workdir"],
         steps=int(cfg["steps"]), global_batch=int(cfg["global_batch"]),

@@ -150,6 +150,13 @@ class MemberSpec:
     # find every durable control-plane id from any member's spawn
     # config on disk; members themselves never read the ledger
     ledger_table: int = 0
+    # fleet observability: non-empty = the member opens a crash-durable
+    # span/metric stream (<trace_dir>/member_sN_pPID.trace.jsonl) at
+    # startup — the flight recorder a SIGKILL cannot erase.  scrape_s
+    # is recorded so a controller TAKEOVER restores the pool's scrape
+    # cadence, not the constructor default
+    trace_dir: str = ""
+    scrape_s: float = 1.0
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -211,6 +218,13 @@ class MemberHarness:
         from hetu_tpu.serve.server import InferenceServer
         self.spec = spec
         self._van = van
+        # the flight recorder FIRST: every span this process ever
+        # records (engine prefill/decode, per-request lifecycle, drain
+        # legs) streams to disk line-by-line, so a SIGKILL loses at most
+        # one torn line (trace.load_jsonl skips it)
+        if spec.trace_dir:
+            trace.open_process_stream(
+                spec.trace_dir, f"member_s{spec.slot}_p{os.getpid()}")
         _, _, engine = build_engine(spec.model)
         self.scheduler = ContinuousBatchingScheduler(
             engine, shed=spec.shed, shed_headroom=spec.shed_headroom)
@@ -364,7 +378,46 @@ class MemberHarness:
                 # thread — silence IS the loss signal, so keep trying
                 time.sleep(period)
 
-    def _watch(self, req) -> None:
+    def _record_request_span(self, req, tenant) -> None:
+        """One retroactive ``serve.request`` span per resolved rid: the
+        member-side anchor of the cross-process causal chain (the fleet
+        stitcher links controller ``serve.submit`` → this → controller
+        ``serve.resolve`` by the shared rid) PLUS the in-process latency
+        decomposition — queue wait (submit→slot), prefill (slot→first
+        token), decode (first→last token) — measured where the clocks
+        are local and exact.  Control-plane ids ride as args (``ci`` =
+        controller incarnation, ``slot``) so a trace of a takeover run
+        shows which incarnation owned each leg."""
+        t = trace.get_tracer()
+        if t is None or req.submitted_at is None:
+            return
+        # request stamps are time.monotonic(); anchor them to the
+        # tracer's clock via a (now_monotonic, now_track) pair so no
+        # cross-clock epoch assumption is needed
+        now_m, now_us = time.monotonic(), t._now_us()
+
+        def at(stamp):
+            return max(now_us - max(now_m - stamp, 0.0) * 1e6, 0.0)
+
+        attrs = {"rid": int(req.rid), "status": req.status or "ok",
+                 "slot": int(self.spec.slot),
+                 "ci": int(self._ctrl_gen), "tokens": len(req.tokens)}
+        if tenant:
+            attrs["tenant"] = tenant
+        if req.admitted_at is not None:
+            attrs["queue_s"] = round(req.admitted_at - req.submitted_at, 6)
+            if req.first_token_at is not None:
+                attrs["prefill_s"] = round(
+                    req.first_token_at - req.admitted_at, 6)
+        if req.ttft_s is not None:
+            attrs["ttft_s"] = round(req.ttft_s, 6)
+        end = req.finished_at if req.finished_at is not None else now_m
+        if req.first_token_at is not None:
+            attrs["decode_s"] = round(end - req.first_token_at, 6)
+        t.complete("serve.request", at(req.submitted_at), attrs,
+                   cat="serve", end_us=at(end))
+
+    def _watch(self, req, tenant=None) -> None:
         """Report the request's terminal state to the controller once it
         resolves — unless it migrated away (the adopter reports it).
         The record survives in ``_done_log`` so a controller takeover
@@ -373,6 +426,11 @@ class MemberHarness:
             req.done.wait()
             if req.status == "migrated" or req.rid in self._migrated:
                 return
+            try:
+                self._record_request_span(req, tenant)
+            except Exception:
+                traceback.print_exc()  # telemetry must never block a
+                # completion from reaching the controller
             ev = {"type": "done", "rid": int(req.rid),
                   "status": req.status or "ok",
                   "tokens": [int(t) for t in req.tokens],
@@ -473,7 +531,9 @@ class MemberHarness:
                               "timeout_s", self.spec.request_timeout_s)))
             req.rid = int(msg["rid"])  # controller-global id: completion
             # events and cross-process drains correlate on it
-            self._watch(req)
+            req.tenant = msg.get("tenant")  # rides the migration record
+            # too, so an adopter keeps the attribution
+            self._watch(req, tenant=req.tenant)
             self.scheduler.submit(req)
         elif cmd == "recv_migration":
             self._recv_migration(int(msg["ch"]), int(msg["xfer"]),
@@ -490,9 +550,24 @@ class MemberHarness:
             self._drain_abort(int(msg["xfer"]))
         elif cmd == "netem":
             self._apply_netem(msg)
+        elif cmd == "metrics":
+            self._emit_metrics()
         elif cmd == "shutdown":
             return False
         return True
+
+    def _emit_metrics(self) -> None:
+        """Answer a fleet scrape: ship the FULL registry state (raw
+        histogram buckets, not percentiles — the controller's merge is
+        bucket-wise) over the event channel, and mirror it into the span
+        stream as a black-box record so a later SIGKILL cannot erase
+        the last scraped numbers."""
+        dump = self.scheduler.metrics.registry.dump()
+        t = trace.get_tracer()
+        if t is not None:
+            t.metric_dump(dump)
+        self._emit({"type": "metrics", "slot": int(self.spec.slot),
+                    "dump": dump})
 
     def _apply_netem(self, msg: dict) -> None:
         """Install (or clear) a link policy on this member's van wire.
@@ -511,25 +586,37 @@ class MemberHarness:
     # ---- migration (two-phase, source side holds until commit) ----
     def _drain(self, ch_id: int, xfer: int, codec: str,
                timeout_s: float) -> None:
-        pairs = None
-        try:
-            payload, pairs = _migrate.export_payload(self.scheduler,
-                                                     codec=codec)
-            tx = self._van.BlobChannel("127.0.0.1", self.spec.port, ch_id)
+        # the MEMBER-side half of the drain recovery, recorded in THIS
+        # process's stream: a preemption fault injected controller-side
+        # pairs with this span on the merged fleet trace (the xfer id is
+        # the drain's control-plane correlation key).  A failed export
+        # carries args.error, so the timeline never claims it as a
+        # recovery that repaired anything.
+        with trace.span("serve.migrate",
+                        {"xfer": int(xfer), "member": int(self.spec.slot),
+                         "ci": int(self._ctrl_gen)}, cat="serve") as sp:
+            pairs = None
             try:
-                _migrate.send_payload(tx, payload, timeout_s=timeout_s)
-            finally:
-                tx.close()
-        except Exception as e:
-            traceback.print_exc()
-            if pairs is not None:
+                payload, pairs = _migrate.export_payload(self.scheduler,
+                                                         codec=codec)
+                tx = self._van.BlobChannel("127.0.0.1", self.spec.port,
+                                           ch_id)
                 try:
-                    self.scheduler.adopt_inflight(pairs)  # resume serving
-                except Exception:
-                    traceback.print_exc()
-            self._emit({"type": "drain_failed", "xfer": xfer,
-                        "error": repr(e)})
-            return
+                    _migrate.send_payload(tx, payload, timeout_s=timeout_s)
+                finally:
+                    tx.close()
+            except Exception as e:
+                traceback.print_exc()
+                sp.set("error", type(e).__name__)
+                if pairs is not None:
+                    try:
+                        self.scheduler.adopt_inflight(pairs)  # resume
+                    except Exception:
+                        traceback.print_exc()
+                self._emit({"type": "drain_failed", "xfer": xfer,
+                            "error": repr(e)})
+                return
+            sp.set("requests", len(pairs))
         self._pending_drain = (xfer, pairs)
         self._emit({"type": "drained", "xfer": xfer, "n": len(pairs)})
 
@@ -566,20 +653,26 @@ class MemberHarness:
         # ack FIRST: the controller must not start the source's send
         # before this member is committed to receiving
         self._emit({"type": "mig_ready", "xfer": xfer})
-        try:
-            rx = self._van.BlobChannel("127.0.0.1", self.spec.port, ch_id)
+        with trace.span("serve.adopt",
+                        {"xfer": int(xfer), "member": int(self.spec.slot),
+                         "ci": int(self._ctrl_gen)}, cat="serve") as sp:
             try:
-                got = _migrate.recv_payload(rx, timeout_s=timeout_s)
-            finally:
-                rx.close()
-            reqs, slot_map = _migrate.adopt_payload(self.scheduler, got)
-        except Exception as e:
-            traceback.print_exc()
-            self._emit({"type": "adopt_failed", "xfer": xfer,
-                        "error": repr(e)})
-            return
+                rx = self._van.BlobChannel("127.0.0.1", self.spec.port,
+                                           ch_id)
+                try:
+                    got = _migrate.recv_payload(rx, timeout_s=timeout_s)
+                finally:
+                    rx.close()
+                reqs, slot_map = _migrate.adopt_payload(self.scheduler, got)
+            except Exception as e:
+                traceback.print_exc()
+                sp.set("error", type(e).__name__)
+                self._emit({"type": "adopt_failed", "xfer": xfer,
+                            "error": repr(e)})
+                return
+            sp.set("requests", len(reqs))
         for req in reqs:
-            self._watch(req)
+            self._watch(req, tenant=getattr(req, "tenant", None))
         self._emit({"type": "adopted", "xfer": xfer, "n": len(reqs),
                     "slots": len(slot_map)})
 
@@ -587,6 +680,14 @@ class MemberHarness:
         if self._stop.is_set():
             return
         self._stop.set()
+        t = trace.get_tracer()
+        if t is not None:
+            try:  # final black-box record + flush (clean exits; kills
+                # rely on the per-line flush)
+                t.metric_dump(self.scheduler.metrics.registry.dump())
+                t.flush()
+            except Exception:
+                pass
         try:
             self.member.leave()
         except Exception:
@@ -675,6 +776,8 @@ class CrossProcessServingPool:
                  shed: bool = False, shed_headroom: float = 1.0,
                  rtt_degraded_x: float = 5.0,
                  start_poll: bool = True,
+                 telemetry_streams: bool = True,
+                 scrape_s: float = 1.0,
                  _takeover: bool = False):
         from hetu_tpu.ps import van
         if n_members < 1:
@@ -738,6 +841,25 @@ class CrossProcessServingPool:
         self._xfers: dict = {}          # xfer id -> {"evt", "events"}
         self._out: dict = {}            # slot -> (channel, lock, [seq])
         self._listeners: dict = {}      # slot -> (thread, stop)
+        # fleet observability: members stream spans to workdir when
+        # telemetry_streams, and the poll loop scrapes their registry
+        # dumps every scrape_s (0 disables the cadence; scrape() still
+        # works on demand).  The scrape round runs in a ONE-SHOT side
+        # thread so a wedged member's control channel can never stall
+        # the membership sweep that would declare it lost.
+        self._telemetry_streams = bool(telemetry_streams)
+        self._scrape_s = float(scrape_s)
+        self._member_metrics: dict = {}  # slot -> last registry dump
+        self._metrics_replies: dict = {}  # slot -> reply count
+        self._scrape_pending: dict = {}  # slot -> unanswered ask time
+        # counters/histograms of DEAD member incarnations, folded in at
+        # revive time: without this, a replacement's first scrape reply
+        # would overwrite the victim's last dump and the fleet's
+        # request counters would go BACKWARD (a broken Prometheus
+        # counter) while silently dropping the dead incarnation's work
+        self._retired_metrics: dict = {}
+        self._last_scrape = 0.0
+        self._scrape_busy = threading.Event()
         self.procs: list = [None] * self.n_members
         self.adopted: dict = {}         # takeover: rid -> PoolRequest
         self.takeover_report: dict = {}
@@ -826,6 +948,8 @@ class CrossProcessServingPool:
                    deaf_ack_s=deaf_ack_s, metrics=metrics,
                    spawn_timeout_s=spawn_timeout_s,
                    shed=spec.shed, shed_headroom=spec.shed_headroom,
+                   telemetry_streams=bool(spec.trace_dir),
+                   scrape_s=spec.scrape_s,
                    start_poll=start_poll, _takeover=True)
 
     def _adopt(self) -> None:
@@ -1003,7 +1127,9 @@ class CrossProcessServingPool:
             membership_table=self._membership_table, hb_ms=self.hb_ms,
             request_timeout_s=self.request_timeout_s, model=self.model,
             shed=self._shed, shed_headroom=self._shed_headroom,
-            ledger_table=self._ledger_table)
+            ledger_table=self._ledger_table,
+            trace_dir=str(self.workdir) if self._telemetry_streams
+            else "", scrape_s=self._scrape_s)
         from pathlib import Path
         cfg = Path(self.workdir) / f"member_{slot}_{cid}.json"
         cfg.write_text(spec.to_json())
@@ -1021,6 +1147,9 @@ class CrossProcessServingPool:
             self._inflight[slot] = 0
             self._ch_bases[slot] = (spec.submit_ch, spec.event_ch)
             self._member_pids.pop(slot, None)
+            self._scrape_pending.pop(slot, None)  # fresh incarnation:
+            # the old unanswered ask died with the old process
+            self._retire_member_metrics_locked(slot)
         if old is not None:  # a revived slot's previous control channel
             try:
                 old[0].close()
@@ -1115,11 +1244,18 @@ class CrossProcessServingPool:
 
     # ---- wire helpers ----
     def _send(self, slot: int, msg: dict, *, timeout_s: float = 2.0,
-              attempts: int = 2) -> None:
+              attempts: int = 2, observe_rtt: bool = True) -> None:
         """One ordered control send with bounded retry: same-seq blob
         resend is idempotent, so a transport wobble retries safely; a
         member that stays unreadable (suspended/dead) surfaces as the
-        TimeoutError the router treats as 'pick someone else'."""
+        TimeoutError the router treats as 'pick someone else'.
+
+        ``observe_rtt=False`` keeps a send out of the link-health EWMA:
+        the fleet scrape uses a deliberately tiny timeout, and letting
+        its routine timeout against a momentarily busy member read as
+        evidence of a GRAY LINK would open the degrade window — whose
+        active probe pings then stall every poll sweep for members
+        that were never degraded at all."""
         if self._fenced:
             raise ConnectionError(
                 "controller fenced: a newer incarnation owns the fleet")
@@ -1145,7 +1281,8 @@ class CrossProcessServingPool:
             # every control send doubles as a link probe — failures
             # included (a send that burned its whole retry budget is the
             # strongest degradation signal there is)
-            self._observe_rtt(slot, time.monotonic() - t0)
+            if observe_rtt:
+                self._observe_rtt(slot, time.monotonic() - t0)
 
     def _observe_rtt(self, slot: int, rtt_s: float) -> None:
         prev = self._rtt.get(slot)
@@ -1223,10 +1360,162 @@ class CrossProcessServingPool:
         if kind == "done":
             self._on_done(slot, ev)
             return
+        if kind == "metrics":
+            with self._lock:
+                self._member_metrics[slot] = ev.get("dump") or {}
+                self._metrics_replies[slot] = \
+                    self._metrics_replies.get(slot, 0) + 1
+                self._scrape_pending.pop(slot, None)
+            return
         xfer = self._xfers.get(int(ev.get("xfer", -1)))
         if xfer is not None:
             xfer["events"][kind] = ev
             xfer["evt"].set()
+
+    # ---- fleet metric aggregation ----
+    def _retire_member_metrics_locked(self, slot: int) -> None:
+        """Caller holds ``self._lock``.  Fold the slot's last dump into
+        the retired accumulator before a replacement incarnation's
+        first reply overwrites it — counters and histograms only (sums
+        stay monotone); a dead process's GAUGE is a stale level with
+        nothing to aggregate into."""
+        dump = self._member_metrics.pop(slot, None)
+        if not dump:
+            return
+        from hetu_tpu.telemetry.registry import MetricsRegistry
+        reg = MetricsRegistry.from_dump(self._retired_metrics)
+        reg.merge({k: v for k, v in dump.items()
+                   if v.get("type") != "gauge"})
+        self._retired_metrics = reg.dump()
+
+    def _drain_busy_slots(self) -> set:
+        """Both ends of every active two-phase drain: off-limits to the
+        scrape — a scrape frame queued ahead of (or holding the channel
+        lock against) recv_migration/drain commands would stretch the
+        preemption-critical hand-off for a routine metrics ask."""
+        with self._lock:
+            busy = set(self._draining)
+            for d in self._drain_journal.values():
+                busy.add(int(d.get("source", -1)))
+                busy.add(int(d.get("target", -1)))
+        return busy
+
+    def _scrape_once(self, timeout_s: float = 0.1) -> list:
+        """Ask every routable member for a registry dump (replies land
+        asynchronously via the event loop).  A scrape is advisory, so
+        the wire discipline is strict: VERY short timeout, one attempt,
+        failures swallowed, and a member with an UNANSWERED ask is
+        skipped until it replies (or a 3 s re-ask window lapses) — a
+        put to a frozen member parks the van connection until the
+        member reads it, and the single-threaded van would stall every
+        other caller (including the lease sweep that is about to
+        notice that very freeze) for the whole timeout.  The LAST dump
+        stays current for a member that misses rounds."""
+        now = time.monotonic()
+        busy = self._drain_busy_slots()
+        targets = []
+        for s in self.svc.alive_slots():
+            if not self.svc.state_of(s).healthy or s in busy:
+                continue
+            pending = self._scrape_pending.get(s)
+            if pending is not None and now - pending < 3.0:
+                continue  # don't pile blocking puts on a silent member
+            targets.append(s)
+        for slot in targets:
+            self._scrape_pending[slot] = now
+            try:
+                self._send(slot, {"cmd": "metrics"}, timeout_s=timeout_s,
+                           attempts=1, observe_rtt=False)
+            except Exception:
+                # the ask (very likely) never landed: re-ask after a
+                # SHORT window, not the full reply window — a member
+                # mid-jit-compile at its first ask would otherwise be
+                # excluded from a whole synchronous scrape() budget
+                self._scrape_pending[slot] = now - 2.5
+        return targets
+
+    def _scrape_guarded(self) -> None:
+        try:
+            self._scrape_once()
+        except Exception:
+            traceback.print_exc()
+        finally:
+            self._scrape_busy.clear()
+
+    def scrape(self, timeout_s: float = 3.0) -> dict:
+        """One SYNCHRONOUS scrape: keep asking (under the same
+        pending-window discipline as the cadence — a cadence ask
+        already in flight counts, it is not re-sent) until every
+        routable member has replied SINCE THIS CALL or the budget
+        lapses.  Returns ``{slot: dump}`` of everything known —
+        including the last dump of members that no longer answer."""
+        if self._fenced:
+            # fail FAST like every other fenced operation: spinning the
+            # full budget on sends a newer incarnation rejects would
+            # return pre-fence dumps dressed up as a fresh scrape
+            raise ConnectionError(
+                "controller fenced: a newer incarnation owns the fleet")
+        with self._lock:
+            before = dict(self._metrics_replies)
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            self._scrape_once()
+            # recomputed every sweep: a member that dies (or enters a
+            # drain window) mid-scrape drops out instead of pinning the
+            # wait on a slot that will not be asked
+            busy = self._drain_busy_slots()
+            want = [s for s in self.svc.alive_slots()
+                    if self.svc.state_of(s).healthy and s not in busy]
+            with self._lock:
+                done = all(self._metrics_replies.get(s, 0) >
+                           before.get(s, 0) for s in want)
+            if done or time.monotonic() >= deadline:
+                break
+            time.sleep(0.05)
+        return self.member_metric_dumps
+
+    @property
+    def member_metric_dumps(self) -> dict:
+        """Last known registry dump per member slot (what the fleet
+        export sums).  A SIGKILLed member keeps its final pre-kill
+        dump here — and the same record sits in its span stream as the
+        ``hetu_metrics`` black box."""
+        with self._lock:
+            return {s: dict(d) for s, d in self._member_metrics.items()}
+
+    def fleet_metrics(self, *, scrape: bool = True,
+                      timeout_s: float = 3.0):
+        """ONE fleet-level registry over the whole pool: member
+        counters and histograms merged under their own names (a
+        counter here is the SUM across members; a histogram percentile
+        is computed from summed buckets), member GAUGES under
+        ``m<slot>.`` (a level like queue_depth has no fleet-wide sum —
+        last-write-wins across members would silently report whichever
+        slot merged last), and the controller's own metrics under
+        ``ctrl.`` (its ``requests_ok`` and a member's are different
+        events — summing them would double-count).  Export with
+        ``.write_prometheus(path)`` / ``.prometheus_text()``."""
+        from hetu_tpu.telemetry.registry import MetricsRegistry
+        if scrape:
+            self.scrape(timeout_s=timeout_s)
+        reg = MetricsRegistry()
+        with self._lock:
+            retired = dict(self._retired_metrics)
+        reg.merge(retired)  # dead incarnations' counters stay counted
+        dumps = self.member_metric_dumps
+        for slot in sorted(dumps):
+            dump = dumps[slot]
+            gauges = {k: v for k, v in dump.items()
+                      if v.get("type") == "gauge"}
+            reg.merge({k: v for k, v in dump.items()
+                       if v.get("type") != "gauge"})
+            reg.merge(gauges, prefix=f"m{slot}.")
+        reg.merge(self.metrics.registry.dump(), prefix="ctrl.")
+        reg.gauge("fleet.members_reporting",
+                  help="member slots with a scraped registry dump"
+                  ).set(len(dumps))
+        reg.gauge("fleet.members_alive").set(len(self.svc.alive_slots()))
+        return reg
 
     def _on_done(self, slot: int, ev: dict) -> None:
         req = self._requests.get(int(ev.get("rid", -1)))
@@ -1251,6 +1540,7 @@ class CrossProcessServingPool:
 
     def _resolve(self, req: PoolRequest, status: str, *, tokens=(),
                  ttft_s=None) -> None:
+        t0 = trace.now_us()
         with self._lock:
             if req.done.is_set():
                 return
@@ -1269,6 +1559,18 @@ class CrossProcessServingPool:
             while len(self._resolved) > 1024:
                 self._resolved.popitem(last=False)
         self.metrics.inc(f"requests_{status}")
+        tenant = req.msg.get("tenant")
+        if tenant:
+            self.metrics.note_tenant(tenant, f"requests_{status}")
+            if status == "shed":
+                self.metrics.note_tenant(tenant, "shed")
+        if ttft_s is not None:
+            self.metrics.observe_ttft(float(ttft_s), tenant=tenant)
+        # the terminal leg of the rid's causal chain (a SPAN, not an
+        # instant: the fleet stitcher binds flow arrows to slices)
+        trace.complete("serve.resolve",
+                       t0, {"rid": req.rid, "status": status},
+                       cat="serve")
         # resolution journaling is COALESCED (flushed by the poll loop,
         # or by the next synchronous accept/route/drain journal): this
         # write only narrows the duplicate-replay window — a resolution
@@ -1313,6 +1615,9 @@ class CrossProcessServingPool:
                 self._send(slot, {"cmd": "submit", "rid": req.rid,
                                   **req.msg})
                 req.sent = True
+                trace.instant("serve.route",
+                              {"rid": req.rid, "member": int(slot)},
+                              cat="serve")
                 # ownership journaling is coalesced like resolutions:
                 # by the snapshot's own invariant, losing it is safe —
                 # an unjournaled owner reads member=None, the takeover
@@ -1331,33 +1636,48 @@ class CrossProcessServingPool:
         self.metrics.inc("requests_rejected_no_member")
 
     def submit(self, prompt, *, max_tokens: int = 16, eos_id=None,
-               timeout_s: Optional[float] = None) -> PoolRequest:
+               timeout_s: Optional[float] = None,
+               tenant: Optional[str] = None) -> PoolRequest:
         rid = self._next_rid()
         msg = {"prompt": [int(t) for t in prompt],
                "max_tokens": int(max_tokens), "eos_id": eos_id,
                "timeout_s": float(timeout_s if timeout_s is not None
                                   else self.request_timeout_s)}
+        if tenant is not None:
+            # the tenant tag rides the wire into the member (span args)
+            # and the journal (a takeover keeps the attribution)
+            msg["tenant"] = str(tenant)
         req = PoolRequest(rid, msg)
-        with self._lock:
-            self._requests[rid] = req
-        # accepted ⇒ durable, BEFORE routing: once this journal write
-        # lands, a controller death at ANY later point still resolves
-        # the request (the zero-lost-accepted-requests contract).  A
-        # journal failure therefore REFUSES the accept.
-        try:
-            self._journal()
-        except Exception:
+        # the controller-side head of the rid's causal chain: the fleet
+        # stitcher links this span to the member-side serve.request and
+        # the terminal serve.resolve by the shared rid arg
+        attrs = {"rid": rid}
+        if tenant is not None:
+            attrs["tenant"] = str(tenant)
+        with trace.span("serve.submit", attrs, cat="serve"):
             with self._lock:
-                self._requests.pop(rid, None)
-            raise
-        self.metrics.inc("pool_requests")
-        self._route(req)
+                self._requests[rid] = req
+            # accepted ⇒ durable, BEFORE routing: once this journal
+            # write lands, a controller death at ANY later point still
+            # resolves the request (the zero-lost-accepted-requests
+            # contract).  A journal failure therefore REFUSES the
+            # accept.
+            try:
+                self._journal()
+            except Exception:
+                with self._lock:
+                    self._requests.pop(rid, None)
+                raise
+            self.metrics.inc("pool_requests")
+            self.metrics.note_tenant(tenant, "requests")
+            self._route(req)
         return req
 
     def generate(self, prompt, *, max_tokens: int = 16, eos_id=None,
-                 timeout_s: Optional[float] = None) -> dict:
+                 timeout_s: Optional[float] = None,
+                 tenant: Optional[str] = None) -> dict:
         req = self.submit(prompt, max_tokens=max_tokens, eos_id=eos_id,
-                          timeout_s=timeout_s)
+                          timeout_s=timeout_s, tenant=tenant)
         # generous backstop over the serving deadline: a failover or a
         # suspended-then-resumed member must not strand the waiter
         if not req.done.wait(timeout=req.msg["timeout_s"] + 30.0):
@@ -1372,6 +1692,17 @@ class CrossProcessServingPool:
                 self.poll()
             except Exception:
                 traceback.print_exc()  # the poll must survive anything
+            # fleet scrape on its cadence: triggered here (the poll loop
+            # is the controller's one clock) but RUN in a one-shot side
+            # thread — a member whose control channel is wedged must
+            # stall the scrape, never the lease state machine
+            if self._scrape_s > 0 and not self._fenced and \
+                    time.monotonic() - self._last_scrape >= \
+                    self._scrape_s and not self._scrape_busy.is_set():
+                self._last_scrape = time.monotonic()
+                self._scrape_busy.set()
+                threading.Thread(target=self._scrape_guarded,
+                                 daemon=True).start()
             if self._journal_dirty and not self._fenced:
                 try:
                     self._journal()
@@ -1814,6 +2145,11 @@ def controller_main(config_path: str) -> int:
     fenced wake-up (SIGSTOP → takeover → SIGCONT) exits WITHOUT
     touching the members the new incarnation owns."""
     cfg = json.loads(open(config_path).read())
+    # the controller's own flight recorder, next to its members' (the
+    # chaos harness SIGKILLs this process too — its accepted-request
+    # spans must survive for the merged post-mortem)
+    trace.open_process_stream(cfg["workdir"],
+                              f"controller_p{os.getpid()}")
     pool = CrossProcessServingPool(
         int(cfg.get("n_members", 2)), workdir=cfg["workdir"],
         model=cfg.get("model"), port=int(cfg["port"]), own_van=False,

@@ -53,9 +53,34 @@ class ServeMetrics:
     def count(self, name: str) -> int:
         return self.registry.counter(name).value
 
+    # ---- per-tenant accounting (SLO-class groundwork) ----
+    @staticmethod
+    def _tenant_slug(tenant) -> str:
+        """Tenant tags are FREE-FORM caller input but become metric
+        name segments: anything outside [A-Za-z0-9_.-] (a space, a
+        brace, a newline) would produce an invalid Prometheus
+        exposition line — a hostile tag could even inject extra metric
+        lines — so non-name characters collapse to '_' and the slug is
+        length-capped.  (Cardinality bounding — a cap on DISTINCT
+        tenants — belongs to the SLO-class admission layer, not here.)"""
+        s = "".join(c if (c.isalnum() or c in "_.-") else "_"
+                    for c in str(tenant))
+        return s[:64] or "_"
+
+    def note_tenant(self, tenant, event: str, n: int = 1) -> None:
+        """Per-tenant counter (``tenant.<t>.<event>``): requests, sheds,
+        status outcomes — the accounting surface per-tenant SLO classes
+        will be enforced against.  No-op for untagged traffic."""
+        if tenant:
+            self.registry.counter(
+                f"tenant.{self._tenant_slug(tenant)}.{event}").inc(n)
+
     # ---- latency / throughput ----
-    def observe_ttft(self, seconds: float) -> None:
-        """Time-to-first-token: request admission → prefill's first token."""
+    def observe_ttft(self, seconds: float, *, tenant=None) -> None:
+        """Time-to-first-token: request admission → prefill's first token.
+        A ``tenant`` tag ALSO records into that tenant's own histogram
+        (``tenant.<t>.ttft_s``) so per-tenant TTFT rides the same fleet
+        scrape as the counters."""
         s = float(seconds)
         with self._lock:
             self._ttft.append(s)
@@ -63,6 +88,11 @@ class ServeMetrics:
         # only reader is the prometheus exposition — snapshot() derives
         # every ttft_* key from the ring alone
         self._ttft_hist.observe(s)
+        if tenant:
+            self.registry.histogram(
+                f"tenant.{self._tenant_slug(tenant)}.ttft_s",
+                DEFAULT_LATENCY_BUCKETS,
+                help="per-tenant TTFT").observe(s)
 
     def observe_decode(self, n_tokens: int) -> None:
         """One decode step produced ``n_tokens`` (tokens/sec derives from

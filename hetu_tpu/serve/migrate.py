@@ -118,6 +118,9 @@ def request_record(req, *, now: float | None = None) -> dict:
         else max(now - req.submitted_at, 0.0),
         "requeues": int(req.requeues),
         "had_first_token": req.first_token_at is not None,
+        # per-tenant attribution must survive the hand-off: the
+        # adopter's serve.request span and accounting carry it forward
+        "tenant": getattr(req, "tenant", None),
     }
 
 
@@ -131,6 +134,7 @@ def request_from_record(rec: dict, *, now: float | None = None):
         prompt=list(rec["prompt"]), max_tokens=int(rec["max_tokens"]),
         eos_id=rec.get("eos_id"), timeout_s=rec.get("timeout_s"))
     req.rid = int(rec["rid"])
+    req.tenant = rec.get("tenant")
     req.tokens = list(rec["tokens"])
     req.folded = int(rec.get("folded", 0))
     req.requeues = int(rec.get("requeues", 0))

@@ -122,6 +122,7 @@ class Request:
                                        repr=False)
     folded: int = 0           # tokens already folded into prompt on requeue
     submitted_at: Optional[float] = None
+    admitted_at: Optional[float] = None   # queue → slot (prefill starts)
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     done: threading.Event = field(default_factory=threading.Event)
@@ -718,6 +719,10 @@ class ContinuousBatchingScheduler:
                 break
             req.slot = slot
             req.state = "running"
+            if req.admitted_at is None:
+                # first admission only: the queue-wait number a requeue
+                # must not rewrite (same rule as first_token_at)
+                req.admitted_at = time.monotonic()
             if paged:
                 # chunked-prefill interleave: admission only ADOPTS the
                 # shared prefix, reserves pages, and parks a cursor —

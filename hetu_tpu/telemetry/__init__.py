@@ -26,13 +26,14 @@ instrumentation (van RPC latency/bytes, serve compiles) records into;
 ``prometheus_text()`` snapshots it for a file-based scrape.
 """
 
-from hetu_tpu.telemetry import registry, timeline, trace
+from hetu_tpu.telemetry import costs, fleet, registry, timeline, trace
+from hetu_tpu.telemetry.costs import calibration_ratio, measured_op_costs
 from hetu_tpu.telemetry.registry import (
     Counter, Gauge, Histogram, MetricsRegistry,
 )
 from hetu_tpu.telemetry.trace import (
     Tracer, complete, disable, enable, enabled, get_tracer, instant,
-    load_jsonl, now_us, span,
+    load_jsonl, now_us, open_process_stream, span,
 )
 
 # the process-default metrics registry: built-in instrumentation (ps/van,
@@ -45,9 +46,10 @@ def prometheus_text() -> str:
 
 
 __all__ = [
-    "trace", "registry", "timeline",
+    "trace", "registry", "timeline", "fleet", "costs",
     "Tracer", "enable", "disable", "enabled", "get_tracer",
     "span", "instant", "complete", "now_us", "load_jsonl",
+    "open_process_stream", "measured_op_costs", "calibration_ratio",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "default_registry", "prometheus_text",
 ]

@@ -213,6 +213,84 @@ class MetricsRegistry:
         with self._lock:
             return dict(self._metrics)
 
+    # ---- fleet aggregation ----
+    def dump(self) -> dict:
+        """Full-state, JSON-able export: unlike :meth:`snapshot` (which
+        collapses histograms to percentiles), this keeps raw bucket
+        counts — the form :meth:`merge` can fold LOSSLESSLY, which is
+        what lets a controller aggregate member registries shipped over
+        a wire into one fleet registry whose percentiles are computed
+        from the SUMMED buckets, not averaged member percentiles."""
+        out = {}
+        for name, m in sorted(self.metrics().items()):
+            if isinstance(m, Counter):
+                out[name] = {"type": "counter", "value": m.value,
+                             "help": m.help}
+            elif isinstance(m, Gauge):
+                out[name] = {"type": "gauge", "value": m.value,
+                             "help": m.help}
+            elif isinstance(m, Histogram):
+                with m._lock:
+                    out[name] = {"type": "histogram",
+                                 "buckets": list(m.buckets),
+                                 "counts": list(m._counts),
+                                 "sum": m._sum, "count": m._count,
+                                 "min": m._min, "max": m._max,
+                                 "help": m.help}
+        return out
+
+    @classmethod
+    def from_dump(cls, dump: dict) -> "MetricsRegistry":
+        reg = cls()
+        reg.merge(dump)
+        return reg
+
+    def merge(self, other, *, prefix: str = "") -> "MetricsRegistry":
+        """Fold another registry (or a :meth:`dump` dict, e.g. one that
+        crossed a process boundary as JSON) into this one: counters SUM,
+        gauges LAST-WRITE-WINS, histograms add BUCKET-WISE — the bucket
+        schemas must match exactly (mismatched buckets cannot be merged
+        losslessly, so that is an error, not a best-effort).  ``prefix``
+        namespaces every merged name (``prefix="m0."``) so same-named
+        metrics from different processes can coexist when the caller
+        wants per-source attribution instead of a fleet sum."""
+        dump = other.dump() if isinstance(other, MetricsRegistry) else other
+        for raw_name, rec in dump.items():
+            name = prefix + raw_name
+            kind = rec["type"]
+            if kind == "counter":
+                self.counter(name, rec.get("help", "")).inc(rec["value"])
+            elif kind == "gauge":
+                self.gauge(name, rec.get("help", "")).set(rec["value"])
+            elif kind == "histogram":
+                want = tuple(float(b) for b in rec["buckets"])
+                h = self.histogram(name, want, rec.get("help", ""))
+                if h.buckets != want:
+                    raise ValueError(
+                        f"histogram {name!r}: incompatible buckets "
+                        f"{list(h.buckets)} vs {list(want)} — bucket-wise "
+                        f"merge needs one schema")
+                counts = rec["counts"]
+                if len(counts) != len(h._counts):
+                    raise ValueError(
+                        f"histogram {name!r}: {len(counts)} counts for "
+                        f"{len(h._counts)} buckets")
+                with h._lock:
+                    for i, c in enumerate(counts):
+                        h._counts[i] += int(c)
+                    h._sum += float(rec["sum"])
+                    h._count += int(rec["count"])
+                    for attr, pick in (("_min", min), ("_max", max)):
+                        v = rec.get(attr.lstrip("_"))
+                        if v is not None:
+                            cur = getattr(h, attr)
+                            setattr(h, attr, float(v) if cur is None
+                                    else pick(cur, float(v)))
+            else:
+                raise ValueError(f"unknown metric type {kind!r} "
+                                 f"for {name!r}")
+        return self
+
     # ---- exposition ----
     def snapshot(self) -> dict:
         """JSON-able flat dict: counters/gauges → scalar, histograms →

@@ -138,6 +138,11 @@ class FaultPair:
     recovery_name: Optional[str] = None
     recovery_start_us: Optional[float] = None
     recovery_end_us: Optional[float] = None
+    # pid of the process whose stream recorded the recovery — on a
+    # MERGED fleet trace (telemetry.fleet.merge_streams) this is how a
+    # test proves a controller-injected fault was answered by a span
+    # recorded in a MEMBER process
+    recovery_pid: Optional[int] = None
 
     @property
     def paired(self) -> bool:
@@ -162,9 +167,12 @@ def _end_ts(ev: dict) -> float:
 
 def correlate(events) -> list:
     """``events``: Chrome-trace event dicts (``Tracer.events``,
-    :func:`~hetu_tpu.telemetry.trace.load_jsonl`, or a loaded
-    ``traceEvents`` list).  Returns one :class:`FaultPair` per
-    ``fault.*`` instant, in injection order."""
+    :func:`~hetu_tpu.telemetry.trace.load_jsonl`, a loaded
+    ``traceEvents`` list, or a clock-aligned MERGED fleet stream from
+    :func:`hetu_tpu.telemetry.fleet.merge_streams` — pairing is
+    time-first, so a fault instant recorded in the controller's stream
+    claims a recovery span recorded in a member's).  Returns one
+    :class:`FaultPair` per ``fault.*`` instant, in injection order."""
     faults = []
     recoveries = []
     recovery_names = {n for names in RECOVERY_FOR.values() for n in names}
@@ -229,6 +237,7 @@ def correlate(events) -> list:
             pair.recovery_name = r["name"]
             pair.recovery_start_us = float(r.get("ts", 0.0))
             pair.recovery_end_us = _end_ts(r)
+            pair.recovery_pid = r.get("pid")
         pairs.append(pair)
     return pairs
 
@@ -255,7 +264,12 @@ def recovery_histograms(pairs, registry=None, *, buckets=None):
 
 def report(pairs) -> dict:
     """Per-fault-kind summary: counts, pairing rate, detect/recover
-    percentiles — the dict ``tools/trace_report.py`` renders."""
+    percentiles — the dict ``tools/trace_report.py`` renders.  Accepts
+    either :func:`correlate` pairs or a raw event list (including a
+    merged fleet stream), which it correlates first."""
+    pairs = list(pairs)
+    if pairs and isinstance(pairs[0], dict):
+        pairs = correlate(pairs)
     reg = recovery_histograms(pairs)
     by_kind: dict = {}
     for p in pairs:

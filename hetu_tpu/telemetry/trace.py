@@ -163,17 +163,35 @@ class _Span:
 class Tracer:
     """Thread-safe event recorder.  Events are Chrome-trace dicts from the
     moment they are recorded; ``seq`` (a lock-ordered sequence number) is
-    an extra field Perfetto ignores but the determinism tests key on."""
+    an extra field Perfetto ignores but the determinism tests key on.
+
+    **Clock anchors.**  Every tracer runs on its own ``perf_counter_ns``
+    epoch, so two processes' streams are not directly comparable.  The
+    tracer therefore records ``clock_sync`` metadata events — a
+    (track-relative ts, wall-clock ns) pair — at construction and then
+    every ``anchor_interval_s`` of recording, which is what lets
+    :mod:`hetu_tpu.telemetry.fleet` align N streams onto one wall-clock
+    axis (re-anchoring bounds perf/wall drift over long runs)."""
 
     def __init__(self, *, jsonl_path=None, pid: Optional[int] = None,
-                 process_name: str = "hetu_tpu"):
+                 process_name: str = "hetu_tpu",
+                 anchor_interval_s: float = 30.0,
+                 max_events: Optional[int] = None):
         self._lock = threading.Lock()
         self.events: list = []
+        # in-memory retention cap: when a JSONL stream is attached the
+        # DISK is the durable record, and a long-lived process (a
+        # serving member up for days) must not grow RSS one event dict
+        # per span forever.  None = unbounded (the in-process analysis
+        # pattern: record, then read .events).
+        self._max_events = int(max_events) if max_events else None
         self.pid = int(pid) if pid is not None else os.getpid()
         self._t0 = time.perf_counter_ns()
         self._seq = 0
         self._jsonl = None
         self.jsonl_path = None
+        self._anchor_interval_ns = max(int(anchor_interval_s * 1e9), 1)
+        self._last_anchor_ns = 0  # forces an anchor on the first record
         if jsonl_path is not None:
             from pathlib import Path
             p = Path(jsonl_path)
@@ -188,12 +206,31 @@ class Tracer:
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._t0) / 1000.0
 
+    def _anchor_locked(self, perf_ns: int) -> None:
+        """Caller holds self._lock.  Append one clock_sync pair."""
+        self._last_anchor_ns = perf_ns
+        ev = {"ph": "M", "name": "clock_sync",
+              "ts": (perf_ns - self._t0) / 1000.0,
+              "pid": self.pid, "tid": 0, "seq": self._seq,
+              "args": {"wall_ns": time.time_ns()}}
+        self._seq += 1
+        self.events.append(ev)
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(ev) + "\n")
+
     # ---- recording ----
     def _record(self, ev: dict) -> None:
         with self._lock:
+            perf_ns = time.perf_counter_ns()
+            if perf_ns - self._last_anchor_ns >= self._anchor_interval_ns:
+                self._anchor_locked(perf_ns)
             ev["seq"] = self._seq
             self._seq += 1
             self.events.append(ev)
+            if self._max_events and len(self.events) > self._max_events:
+                # drop the oldest tenth in one slice: amortized O(1)
+                # per record, and the stream on disk keeps everything
+                del self.events[:max(self._max_events // 10, 1)]
             if self._jsonl is not None:
                 self._jsonl.write(json.dumps(ev) + "\n")
                 self._jsonl.flush()
@@ -207,8 +244,12 @@ class Tracer:
                       "tid": threading.get_ident(), "s": "t",
                       "args": dict(attrs) if attrs else {}})
 
-    def complete(self, name, start_us, attrs=None, cat="hetu") -> None:
-        end = self._now_us()
+    def complete(self, name, start_us, attrs=None, cat="hetu", *,
+                 end_us: Optional[float] = None) -> None:
+        """Record a span retroactively; ``end_us`` pins the end for a
+        phase whose finish was stamped before this call (a request that
+        resolved in another thread), else the span ends NOW."""
+        end = self._now_us() if end_us is None else float(end_us)
         self._record({"ph": "X", "name": name, "cat": cat,
                       "ts": float(start_us),
                       "dur": max(end - float(start_us), 0.0),
@@ -233,11 +274,106 @@ class Tracer:
         p.write_text(json.dumps(self.chrome_trace()))
         return str(p)
 
+    def metric_dump(self, dump: dict, *, name: str = "hetu_metrics") -> None:
+        """Record a full registry dump (:meth:`MetricsRegistry.dump`) as a
+        metadata event — the stream doubles as a metrics black box, so a
+        SIGKILLed process's last-written counters survive on disk next to
+        its last spans."""
+        self._record({"ph": "M", "name": name, "ts": self._now_us(),
+                      "pid": self.pid, "tid": 0,
+                      "args": {"metrics": dump}})
+
+    def flush(self) -> None:
+        """Push every buffered line to the OS.  ``_record`` already
+        flushes per event, so this only matters for the SIGTERM/atexit
+        hardening path — a no-op on a closed or memory-only tracer."""
+        with self._lock:
+            if self._jsonl is not None:
+                try:
+                    self._jsonl.flush()
+                except ValueError:
+                    pass  # closed underneath us (atexit ordering)
+
+    def flush_from_signal(self) -> None:
+        """Signal-handler-safe flush: NEVER blocks on the tracer lock.
+        A handler runs on the main thread, and blocking-acquire while
+        that same thread sits inside ``_record`` (which holds the lock
+        across every write) would deadlock the process instead of
+        letting it die.  Skipping under contention is sound — the
+        holder's own per-record flush runs the moment it releases —
+        and reentrant-io RuntimeErrors (flush interrupting the
+        buffered writer mid-write) are swallowed for the same reason."""
+        if not self._lock.acquire(blocking=False):
+            return
+        try:
+            if self._jsonl is not None:
+                self._jsonl.flush()
+        except (ValueError, RuntimeError):
+            pass
+        finally:
+            self._lock.release()
+
     def close(self) -> None:
         with self._lock:
             if self._jsonl is not None:
                 self._jsonl.close()
                 self._jsonl = None
+
+
+def open_process_stream(stream_dir, name: str, *,
+                        anchor_interval_s: float = 30.0
+                        ) -> Optional["Tracer"]:
+    """The flight-recorder entry point every spawned process calls at
+    startup: install the process tracer with an append-only JSONL stream
+    at ``<stream_dir>/<name>.trace.jsonl``.
+
+    The stream is crash-durable by construction — every event is one
+    flushed line, so a SIGKILL loses at most the torn final line (which
+    :func:`load_jsonl` skips, never half-parses) — and this helper adds
+    the cooperative-death hardening on top: the stream is flushed on
+    atexit and on SIGTERM (chaining any previously installed handler,
+    e.g. the training supervisor's preemption checkpoint; when SIGTERM
+    was at its default disposition the default is re-raised so the
+    process still dies).
+
+    Disabled (returns None) when ``HETU_OBS_STREAM`` is "0"/"false" —
+    the switch the telemetry-off arm of ``bench.py obs`` ships to its
+    member processes."""
+    if os.environ.get("HETU_OBS_STREAM", "1").lower() in ("0", "false"):
+        return None
+    from pathlib import Path
+    path = Path(stream_dir) / f"{name}.trace.jsonl"
+    # bounded in-memory retention: the stream on disk is the record; a
+    # member up for days must not hold every span dict in RAM
+    t = Tracer(jsonl_path=path, process_name=name,
+               anchor_interval_s=anchor_interval_s, max_events=100_000)
+    enable(tracer=t)
+    import atexit
+    atexit.register(t.flush)
+    try:
+        import signal as _signal
+        prev = _signal.getsignal(_signal.SIGTERM)
+
+        def _flush_and_chain(signum, frame):
+            try:
+                t.flush_from_signal()
+            except Exception:
+                pass
+            if callable(prev) and prev not in (_signal.SIG_DFL,
+                                               _signal.SIG_IGN):
+                prev(signum, frame)
+            elif prev != _signal.SIG_IGN:
+                # SIG_DFL — or None (a handler installed by non-Python
+                # code, unrepresentable here): restore the default and
+                # re-raise so SIGTERM still KILLS the process; only an
+                # explicit SIG_IGN disposition is preserved as-is
+                _signal.signal(signum, _signal.SIG_DFL)
+                _signal.raise_signal(signum)
+
+        _signal.signal(_signal.SIGTERM, _flush_and_chain)
+    except (ValueError, OSError):
+        pass  # not the main thread: atexit + per-line flush still hold
+    return t
 
 
 def load_jsonl(path) -> list:
@@ -250,7 +386,9 @@ def load_jsonl(path) -> list:
             if not line:
                 continue
             try:
-                out.append(json.loads(line))
+                ev = json.loads(line)
             except json.JSONDecodeError:
                 continue  # torn final line from a crashed writer
-    return out
+            if isinstance(ev, dict):  # a torn line that still parses
+                out.append(ev)        # (e.g. a truncated number) is not
+    return out                        # an event — dropped, never mangled

@@ -271,12 +271,21 @@ def test_cross_process_pool_serves_token_exact(tmp_path):
 
 @needs_lib
 @pytest.mark.slow
+@pytest.mark.obs
 def test_cross_process_drain_migrates_live_slots(tmp_path):
     """Planned drain between PROCESSES: live KV slots cross the chunked
     CRC wire, the peer continues mid-decode with zero re-prefill, every
     request is token-exact, and the drained member exits cleanly (never
-    grieved by the lease)."""
+    grieved by the lease).
+
+    ISSUE 14 extension: with every process streaming spans to the
+    workdir, the preemption fault injected CONTROLLER-side must pair —
+    on the clock-aligned MERGED timeline — with the ``serve.migrate``
+    export span recorded inside the drained MEMBER process."""
+    from hetu_tpu.telemetry import fleet, timeline, trace
+
     ref = _engine_reference()
+    trace.open_process_stream(tmp_path, "controller")
     pool = CrossProcessServingPool(2, workdir=tmp_path, model=TINY,
                                    lease_s=0.5, suspect_grace_s=0.5)
     prompts = [[i + 1, i + 2, (i % 5) + 1] for i in range(10)]
@@ -286,18 +295,34 @@ def test_cross_process_drain_migrates_live_slots(tmp_path):
         def drain():
             src = max(range(2), key=lambda s: pool._inflight.get(s, 0))
             victim["slot"] = src
+            victim["pid"] = pool.procs[src].pid
+            trace.instant("fault.serve_preempt",
+                          {"kind": "serve_preempt", "step": 0,
+                           "member": src}, cat="fault")
             n = pool.drain_member(src, close=True)
             victim["n"] = n
 
-        results = _serve_all(pool, prompts, max_tokens=30, mid=drain)
-        for i, resp in results.items():
-            assert resp["status"] == "ok", (i, resp)
-            assert resp["tokens"] == ref(prompts[i], 30), i
+        # the drain races the generations it is trying to catch: on a
+        # warm machine a wave can complete before the two-phase drain's
+        # export lands, which returns n=0 — a benign outcome (nothing
+        # left to migrate) that is NOT the behavior under test.  Retry
+        # with a fresh wave (reviving the cleanly-exited source) until
+        # a drain catches LIVE work; the contract asserts it does
+        # within the attempt budget.
+        for attempt in range(1, 4):
+            results = _serve_all(pool, prompts, max_tokens=40,
+                                 mid=drain, mid_after_s=0.1)
+            for i, resp in results.items():
+                assert resp["status"] == "ok", (i, resp)
+                assert resp["tokens"] == ref(prompts[i], 40), i
+            if victim["n"] > 0:
+                break
+            pool.revive_member(victim["slot"])
         assert victim["n"] > 0
         # live mid-decode K/V actually crossed the wire (zero re-prefill
         # continuations, not queue re-homing)
         assert pool.last_drain["slots"] > 0
-        assert pool.metrics.count("pool_migrations") == 1
+        assert pool.metrics.count("pool_migrations") == attempt
         # the drained process exited; its departure was a planned leave,
         # not a failover
         assert pool.procs[victim["slot"]].poll() is not None
@@ -308,6 +333,19 @@ def test_cross_process_drain_migrates_live_slots(tmp_path):
         assert resp["tokens"] == ref([5, 6], 4)
     finally:
         pool.close()
+        trace.disable()
+    # ---- fleet-wide pairing: controller fault ↔ member recovery ----
+    merged, procs = fleet.merge_streams(tmp_path)
+    assert len(procs) >= 3  # controller + both member streams
+    pairs = [p for p in timeline.correlate(merged)
+             if p.kind == "serve_preempt"]
+    assert pairs and all(p.paired for p in pairs), pairs
+    # the LAST attempt's fault (the one whose drain caught live work):
+    # its claimed recovery span was recorded in the DRAINED MEMBER's
+    # own stream, not by the controller — the cross-process stitch
+    assert pairs[-1].recovery_name == "serve.migrate"
+    assert pairs[-1].recovery_pid == victim["pid"], \
+        (pairs[-1].recovery_pid, victim)
 
 
 @needs_lib

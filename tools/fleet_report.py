@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Fleet trace reporter: merge N per-process span streams into ONE trace.
+
+Takes a run workdir (every ``*.trace.jsonl`` inside — the crash-durable
+streams controller/member/worker/stage processes write via
+``telemetry.trace.open_process_stream``) or an explicit list of streams,
+and produces:
+
+  * ``--out merged.json`` — ONE Perfetto-loadable Chrome trace: one track
+    per process, clock-offset-corrected via each stream's ``clock_sync``
+    anchors, with flow events (``ph`` s/t/f, id = rid) linking each
+    request's causal chain submit → route → member queue/prefill/decode →
+    resolve across process tracks.  Open at https://ui.perfetto.dev;
+  * a per-rid latency decomposition table: queue wait / prefill / decode
+    (measured inside the owning member) and wire (what only the merged
+    clock sees), plus tenant and failover hop count;
+  * the fleet-wide fault → recovery table: pairing runs over the MERGED
+    stream, so a fault injected in the controller process pairs with a
+    recovery span recorded in a member process;
+  * per-process span counts and any ``hetu_metrics`` black-box records.
+
+Usage:  python tools/fleet_report.py RUNDIR [--out merged.json] [--json]
+        python tools/fleet_report.py a.trace.jsonl b.trace.jsonl ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from hetu_tpu.telemetry import fleet, timeline  # noqa: E402
+from tools.trace_report import _fmt_s  # noqa: E402
+
+
+def _sources(args_paths) -> list:
+    if len(args_paths) == 1 and Path(args_paths[0]).is_dir():
+        srcs = fleet.discover_streams(args_paths[0])
+        if not srcs:
+            raise SystemExit(f"no *{fleet.STREAM_SUFFIX} streams under "
+                             f"{args_paths[0]}")
+        return srcs
+    return [Path(p) for p in args_paths]
+
+
+def build_report(sources) -> tuple:
+    """Returns ``(report_dict, events, processes)`` — the merged events
+    come back so the ``--out`` export reuses them instead of re-merging
+    every stream from disk."""
+    events, processes = fleet.merge_streams(sources)
+    flows = fleet.stitch_flows(events)
+    per_proc: dict = {}
+    for e in events:
+        if e.get("ph") == "X":
+            d = per_proc.setdefault(e.get("pid"), [0, 0.0])
+            d[0] += 1
+            d[1] += float(e.get("dur", 0.0)) / 1e6
+    # black-box registry dumps: the LAST hetu_metrics record each
+    # process wrote to its stream — merging them reconstructs a fleet
+    # metric view PURELY from disk (the killed member's pre-kill
+    # counters included), no live controller needed
+    last_dump_by_pid = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "hetu_metrics":
+            last_dump_by_pid[e.get("pid")] = \
+                (e.get("args") or {}).get("metrics", {})
+    rep = {
+        "processes": {
+            str(pid): {"name": processes.get(pid, f"pid{pid}"),
+                       "spans": per_proc.get(pid, [0, 0.0])[0],
+                       "span_s": round(per_proc.get(pid, [0, 0.0])[1], 6)}
+            for pid in sorted(processes)},
+        "events": len(events),
+        "flow_events": len(flows),
+        "cross_process_rids": sorted(
+            fleet.cross_process_flow_rids(events)),
+        "requests": fleet.latency_breakdown(events),
+        "faults": timeline.report(events),
+        "stream_metrics": {
+            "processes_reporting": len(last_dump_by_pid),
+            "fleet": fleet.merge_registry_dumps(
+                last_dump_by_pid.values()).snapshot(),
+        } if last_dump_by_pid else None,
+    }
+    return rep, events, processes
+
+
+def render(rep: dict) -> str:
+    lines = [f"fleet trace: {len(rep['processes'])} process stream(s), "
+             f"{rep['events']} events, {rep['flow_events']} flow events, "
+             f"{len(rep['cross_process_rids'])} cross-process request "
+             f"chain(s)"]
+    lines.append("")
+    lines.append("== processes ==")
+    for pid, d in rep["processes"].items():
+        lines.append(f"  pid {pid:>8}  {d['name']:<28} {d['spans']:>6} "
+                     f"spans  {_fmt_s(d['span_s']):>10} total")
+    lines.append("")
+    lines.append("== per-request latency decomposition ==")
+    reqs = rep["requests"]
+    if reqs:
+        lines.append(f"{'rid':>6} {'tenant':>10} {'status':>8} "
+                     f"{'queue':>9} {'prefill':>9} {'decode':>9} "
+                     f"{'wire':>9} {'total':>9} {'hops':>4}")
+        for rid, r in sorted(reqs.items()):
+            lines.append(
+                f"{rid:>6} {str(r.get('tenant') or '-'):>10} "
+                f"{str(r.get('status') or '-'):>8} "
+                f"{_fmt_s(r.get('queue_s')):>9} "
+                f"{_fmt_s(r.get('prefill_s')):>9} "
+                f"{_fmt_s(r.get('decode_s')):>9} "
+                f"{_fmt_s(r.get('wire_s')):>9} "
+                f"{_fmt_s(r.get('total_s')):>9} {r['hops']:>4}")
+    else:
+        lines.append("(no stitched request chains)")
+    sm = rep.get("stream_metrics")
+    if sm:
+        lines.append("")
+        lines.append(f"== fleet metrics from stream black boxes "
+                     f"({sm['processes_reporting']} process(es)) ==")
+        for name, v in sorted(sm["fleet"].items()):
+            if isinstance(v, dict):
+                v = f"count={v.get('count')} sum={v.get('sum'):.4g}"
+            lines.append(f"  {name} = {v}")
+    lines.append("")
+    lines.append("== fleet fault -> recovery ==")
+    if rep["faults"]:
+        for kind, row in rep["faults"].items():
+            rec = row.get("recover_s") or {}
+            line = (f"  {kind:<18} injected={row['injected']} "
+                    f"paired={row['paired']}")
+            if rec:
+                line += f" recover_p50={_fmt_s(rec.get('p50'))}"
+            lines.append(line)
+    else:
+        lines.append("(no injected faults on the merged timeline)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="run workdir (merges every *.trace.jsonl "
+                         "inside) or explicit stream paths")
+    ap.add_argument("--out", default=None,
+                    help="write the merged Perfetto trace JSON here")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of text")
+    args = ap.parse_args(argv)
+    sources = _sources(args.paths)
+    rep, events, processes = build_report(sources)
+    if args.out:
+        out = fleet.chrome_trace_from(events, processes)
+        Path(args.out).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.out).write_text(json.dumps(out))
+        print(f"merged trace -> {args.out} "
+              f"({len(out['traceEvents'])} events)", file=sys.stderr)
+    if args.json:
+        print(json.dumps(rep, default=float, indent=1))
+    else:
+        print(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
