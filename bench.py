@@ -2379,6 +2379,149 @@ def bench_ctrlchaos():
     })
 
 
+def bench_vanchaos():
+    """Durable-tier failover: what a primary-van SIGKILL costs.
+
+    The durable tier runs REPLICATED — primary + backup van as
+    separate processes, the serving pool's blackboard/ledger
+    dual-writing synchronously — and a seeded ``van_kill`` SIGKILLs
+    the primary mid-traffic.  The backup is promoted via the
+    epoch-row CAS (``van.promote``), every table/channel re-resolves,
+    and the pool rebinds + re-sends.  Reported from the paired
+    timeline: detect p50 (kill → promotion-dance start) and promote
+    p50 (kill → backup adopted), with accepted-requests-lost asserted
+    ZERO — the number that makes the LAST single point of failure's
+    removal real.
+    """
+    import os
+    import tempfile
+    import threading
+
+    from hetu_tpu.ps import membership as mb
+    from hetu_tpu.resilience.faults import FaultInjector, FaultSchedule
+    from hetu_tpu.resilience.shardproc import free_port, spawn_shard_server
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+    from hetu_tpu.telemetry import timeline, trace
+
+    smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
+    ROUNDS = 1 if smoke else 2
+    N_REQ, GEN = (8, 10) if smoke else (12, 24)
+    model = {"vocab_size": 89, "hidden_size": 48, "num_layers": 2,
+             "num_heads": 4, "ffn_size": 96, "max_position": 96,
+             "num_slots": max(N_REQ, 4), "max_len": 88,
+             "min_bucket": 8, "seed": 1}
+    PROMOTE_AFTER_S, RCV_TIMEOUT_S = 0.3, 1.5
+
+    detect, promote_s, lost_total, accepted_total = [], [], 0, 0
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    try:
+        for rnd in range(ROUNDS):
+            with tempfile.TemporaryDirectory(
+                    prefix="bench_vanchaos_") as wd:
+                p1, p2 = free_port(), free_port()
+                v1 = spawn_shard_server(wd, p1, tag=f"prim{rnd}")
+                v2 = spawn_shard_server(wd, p2, tag=f"back{rnd}")
+                pool = None
+                try:
+                    van_spec = {
+                        "endpoints": [["127.0.0.1", p1],
+                                      ["127.0.0.1", p2]],
+                        "epoch_table": mb.fresh_table_id(),
+                        "promote_after_s": PROMOTE_AFTER_S,
+                        "rcv_timeout_s": RCV_TIMEOUT_S}
+                    pool = CrossProcessServingPool(
+                        2, workdir=wd, model=model, own_van=False,
+                        port=p1, van_spec=van_spec, lease_s=0.8,
+                        suspect_grace_s=0.8,
+                        member_env={"JAX_PLATFORMS": "cpu"})
+                    prompts = [[int(t) for t in
+                                np.random.default_rng((rnd, i)).integers(
+                                    1, 80, size=3 + i % 4)]
+                               for i in range(N_REQ)]
+                    schedule = FaultSchedule.generate(
+                        steps=N_REQ, seed=rnd + 1, van_kills=1,
+                        n_vans=1)
+                    inj = FaultInjector(schedule, van_procs=[v1])
+                    results = {}
+
+                    def worker(i, prompts=prompts, pool=pool,
+                               results=results):
+                        while True:
+                            try:
+                                req = pool.submit(prompts[i],
+                                                  max_tokens=GEN,
+                                                  timeout_s=90.0)
+                                break
+                            except Exception:
+                                time.sleep(0.1)  # refused accept: the
+                                # client retries (never counted
+                                # accepted)
+                        req.done.wait(timeout=120.0)
+                        # an UNRESOLVED request is a lost one — status
+                        # None must never read as "ok"
+                        results[i] = (req.status or "ok") \
+                            if req.done.is_set() else "lost"
+
+                    threads = []
+                    for i in range(N_REQ):
+                        th = threading.Thread(target=worker, args=(i,))
+                        th.start()
+                        threads.append(th)
+                        inj.on_step(i + 1)
+                        time.sleep(0.2)
+                    for th in threads:
+                        th.join(180)
+                    assert inj.counters["van_procs_killed"] == 1
+                    accepted_total += len(results)
+                    lost_total += sum(1 for s in results.values()
+                                      if s != "ok")
+                finally:
+                    if pool is not None:
+                        pool.close()
+                    for p in (v1, v2):
+                        if p.poll() is None:
+                            p.kill()
+                            p.wait()
+                    import subprocess as _sp
+                    try:
+                        _sp.run(["pkill", "-9", "-f", wd],
+                                capture_output=True, timeout=10)
+                    except Exception:
+                        pass
+    finally:
+        trace.disable()
+
+    assert lost_total == 0, f"{lost_total} accepted requests lost"
+    pairs = [p for p in timeline.correlate(tracer.events)
+             if p.kind == "van_kill"]
+    assert len(pairs) == ROUNDS and all(p.paired for p in pairs), pairs
+    detect = sorted(p.detect_s for p in pairs)
+    promote_s = sorted(p.recover_s for p in pairs)
+    p50 = lambda xs: xs[len(xs) // 2]  # noqa: E731
+    print(f"# van_kill detect p50 {p50(detect) * 1e3:8.1f} ms  "
+          f"promote p50 {p50(promote_s) * 1e3:8.1f} ms  "
+          f"(accepted {accepted_total}, lost {lost_total})",
+          file=sys.stderr)
+    _emit({
+        "metric": "vanchaos_promote_p50_s",
+        "value": round(p50(promote_s), 3),
+        "unit": "s_van_kill_to_backup_adopted_p50",
+        "extra": {
+            "detect_s_p50": round(p50(detect), 3),
+            "detect_s": [round(t, 3) for t in detect],
+            "promote_s": [round(t, 3) for t in promote_s],
+            "rounds": ROUNDS, "accepted": accepted_total,
+            "requests_lost": lost_total,
+            "promote_after_s": PROMOTE_AFTER_S,
+            "rcv_timeout_s": RCV_TIMEOUT_S,
+            "topology": "primary + backup van as separate processes; "
+                        "sync dual-write blackboard/ledger; CAS-fenced "
+                        "promotion",
+        },
+    })
+
+
 def bench_obs():
     """Fleet observability overhead: what the always-on flight recorder
     costs on the serving path.
@@ -2535,6 +2678,7 @@ _METRIC_BY_CMD = {
     "netchaos": "netchaos_shed_vs_noshed_p99_x",
     "mpmd": "mpmd_gpipe_over_1f1b_bubble_x",
     "ctrlchaos": "ctrlchaos_takeover_p50_s",
+    "vanchaos": "vanchaos_promote_p50_s",
     "obs": "obs_stream_scrape_overhead_pct",
 }
 
@@ -2580,6 +2724,7 @@ def main():
      "netchaos": bench_netchaos,
      "mpmd": bench_mpmd,
      "ctrlchaos": bench_ctrlchaos,
+     "vanchaos": bench_vanchaos,
      "obs": bench_obs,
      "telemetry": bench_telemetry}.get(cmd, bench_gpt)()
 

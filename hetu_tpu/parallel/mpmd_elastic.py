@@ -58,7 +58,7 @@ import signal as _signal
 import time
 import traceback
 from collections import defaultdict
-from dataclasses import asdict, dataclass
+from dataclasses import asdict, dataclass, field
 from pathlib import Path
 from typing import Optional
 
@@ -112,6 +112,10 @@ class StageSpec:
     # incarnation — the member half of fenced control-plane takeover
     ctrl_lease_s: float = 0.0
     log_path: str = ""
+    # replicated durable tier: a ReplicaSpec dict — non-empty means the
+    # stage's blackboard + weights tables dual-write over the
+    # primary+backup van pair and re-resolve on primary death
+    van: dict = field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -170,11 +174,12 @@ class PipelineStageProcess(ControlPlaneMember):
                              f"{spec.n_microbatches} microbatches")
         self.mb_size = spec.batch // spec.n_microbatches
         self._cap = self.mb_size * D
+        from hetu_tpu.ps.replica import open_table
         self.member = _mb.MembershipClient(
             "127.0.0.1", spec.port, table_id=spec.membership_table,
-            slot=s, n_slots=spec.n_stages)
-        self.table = van.RemotePSTable(
-            "127.0.0.1", spec.port, stage_table_rows(D), D,
+            slot=s, n_slots=spec.n_stages, replica=spec.van or None)
+        self.table = open_table(
+            spec.van, "127.0.0.1", spec.port, stage_table_rows(D), D,
             table_id=spec.table_base + s, create=False)
         self._init_control_plane(van=van, netem_local=f"stage{s}",
                                  my_slot=s)
@@ -478,6 +483,7 @@ class MPMDPipelineSupervisor:
                  straggler_factor: float = 4.0,
                  straggler_slow_ms: int = 120, port: int = 0,
                  own_van: bool = True,
+                 van_spec: Optional[dict] = None,
                  _takeover_spec: Optional[StageSpec] = None):
         from hetu_tpu.ps import van
         if n_stages < 2:
@@ -487,6 +493,25 @@ class MPMDPipelineSupervisor:
                              f"{n_microbatches} microbatches")
         self._van = van
         self._own_van = bool(own_van)
+        if not van_spec and _takeover_spec is not None:
+            van_spec = getattr(_takeover_spec, "van", None) or None
+        # replicated durable tier: stage weights + blackboard dual-write
+        # over a primary+backup van pair (see ps/replica.py); the model
+        # then survives the van process itself
+        self._replica = None
+        self._van_spec = dict(van_spec) if van_spec else {}
+        if self._van_spec:
+            if own_van:
+                raise ValueError(
+                    "a replicated durable tier is external by "
+                    "definition: pass own_van=False with van_spec")
+            from hetu_tpu.ps.replica import VanReplica
+            self._replica = VanReplica.from_spec(
+                self._van_spec, bootstrap=_takeover_spec is None)
+            if _takeover_spec is not None:
+                self._replica.refresh()  # unconditional: a stale
+                # cached view must not adopt the dead primary
+            port = self._replica.primary[1]
         if own_van:
             self.port = van.serve(port)
         else:
@@ -529,14 +554,16 @@ class MPMDPipelineSupervisor:
             # failure after some tables connected must close them, not
             # leak van connections for the process's life
             try:
+                from hetu_tpu.ps.replica import open_table
                 for s in range(self.n_stages):
-                    self.tables.append(van.RemotePSTable(
-                        "127.0.0.1", self.port, stage_table_rows(D), D,
+                    self.tables.append(open_table(
+                        self._replica, "127.0.0.1", self.port,
+                        stage_table_rows(D), D,
                         table_id=self.spec.table_base + s, create=False))
                 self._bb = _mb.attach_blackboard(
                     "127.0.0.1", self.port,
                     table_id=self.spec.membership_table,
-                    n_slots=self.n_stages)
+                    n_slots=self.n_stages, replica=self._replica)
                 self.svc = _mb.MembershipService(
                     self._bb, self.n_stages, lease_s=lease_s,
                     suspect_grace_s=suspect_grace_s,
@@ -574,15 +601,17 @@ class MPMDPipelineSupervisor:
             mail_base=mail_base, barrier_base=barrier_base,
             compute_sleep_s=float(compute_sleep_s),
             step_sleep_s=float(step_sleep_s),
-            ctrl_lease_s=float(ctrl_lease_s))
+            ctrl_lease_s=float(ctrl_lease_s), van=self._van_spec)
         # everything after van.serve is guarded: a table/blackboard/
         # spawn failure must stop the in-process van server (and close
         # what was created) instead of leaking it for the process's life
         try:
+            from hetu_tpu.ps.replica import open_table
             # per-stage weight tables, seeded — the model lives HERE
             for s in range(self.n_stages):
-                t = van.RemotePSTable(
-                    "127.0.0.1", self.port, stage_table_rows(D), D,
+                t = open_table(
+                    self._replica, "127.0.0.1", self.port,
+                    stage_table_rows(D), D,
                     table_id=table_base + s, create=True, init="zeros",
                     optimizer="sgd", lr=0.0)
                 self.tables.append(t)
@@ -594,7 +623,7 @@ class MPMDPipelineSupervisor:
                                              ver]))
             self._bb = _mb.create_blackboard(
                 "127.0.0.1", self.port, table_id=membership_table,
-                n_slots=self.n_stages)
+                n_slots=self.n_stages, replica=self._replica)
             self.svc = _mb.MembershipService(
                 self._bb, self.n_stages, lease_s=lease_s,
                 suspect_grace_s=suspect_grace_s, deaf_ack_s=deaf_ack_s)

@@ -190,11 +190,36 @@ def control_rpc(fn: Callable, *, attempts: int = 4, base_s: float = 0.05,
         f"membership rpc {op or fn!r} made no attempts") from last
 
 
+def _replica_of(replica):
+    """Accept a :class:`~hetu_tpu.ps.replica.VanReplica`, a
+    ``ReplicaSpec``, or a spec dict; returns the per-process replica
+    coordinator (or None).  Resolution rides ``from_spec`` so a
+    process spawned AFTER a failover adopts the promoted endpoint
+    before any handle binds the dead original primary."""
+    if not replica:
+        return None
+    from hetu_tpu.ps.replica import VanReplica
+    return VanReplica.from_spec(replica)
+
+
 def create_blackboard(host: str, port: int, *, table_id: int,
-                      n_slots: int, connect_timeout_s: float = 10.0):
+                      n_slots: int, connect_timeout_s: float = 10.0,
+                      replica=None):
     """Controller side: create the membership table.  ``n_slots`` member
     rows + 1 control row + 1 controller row, zero-initialized; plain SGD
-    so ``sparse_set`` writes rows verbatim."""
+    so ``sparse_set`` writes rows verbatim.
+
+    ``replica`` (a ``VanReplica``/``ReplicaSpec``/spec dict) builds the
+    blackboard over the REPLICATED durable tier instead: membership
+    rows are load-bearing, so every write dual-writes synchronously and
+    a primary-van death surfaces as a retryable
+    :class:`~hetu_tpu.ps.replica.VanFailover` under ``control_rpc``."""
+    rep = _replica_of(replica)
+    if rep is not None:
+        return rep.table(n_slots + 2, MEMBER_DIM, table_id=table_id,
+                         create=True, sync=True, init="zeros",
+                         optimizer="sgd", lr=0.0,
+                         connect_timeout_s=connect_timeout_s)
     from hetu_tpu.ps.van import RemotePSTable
     return RemotePSTable(host, port, n_slots + 2, MEMBER_DIM,
                          table_id=table_id, create=True, init="zeros",
@@ -203,10 +228,18 @@ def create_blackboard(host: str, port: int, *, table_id: int,
 
 
 def attach_blackboard(host: str, port: int, *, table_id: int,
-                      n_slots: int, connect_timeout_s: float = 10.0):
+                      n_slots: int, connect_timeout_s: float = 10.0,
+                      replica=None):
     """Member (or takeover-controller) side: attach to an EXISTING
     table (no create — a member racing the controller must fail loudly,
-    not fork the id; a takeover must adopt the rows, not zero them)."""
+    not fork the id; a takeover must adopt the rows, not zero them).
+    ``replica`` attaches over the replicated tier (see
+    :func:`create_blackboard`)."""
+    rep = _replica_of(replica)
+    if rep is not None:
+        return rep.table(n_slots + 2, MEMBER_DIM, table_id=table_id,
+                         create=False, sync=True,
+                         connect_timeout_s=connect_timeout_s)
     from hetu_tpu.ps.van import RemotePSTable
     return RemotePSTable(host, port, n_slots + 2, MEMBER_DIM,
                          table_id=table_id, create=False,
@@ -221,7 +254,7 @@ class MembershipClient:
     def __init__(self, host: str = "", port: int = 0, *, table_id: int = 0,
                  slot: int, n_slots: int, incarnation: Optional[int] = None,
                  connect_timeout_s: float = 10.0,
-                 rpc_deadline_s: float = 5.0, table=None):
+                 rpc_deadline_s: float = 5.0, table=None, replica=None):
         if not 0 <= int(slot) < int(n_slots):
             raise ValueError(f"slot {slot} outside [0, {n_slots})")
         self.slot = int(slot)
@@ -236,10 +269,11 @@ class MembershipClient:
         self.link = f"member{self.slot}->van"
         self.rpc_deadline_s = float(rpc_deadline_s)
         # `table` injects a pre-built table surface (tests); the normal
-        # path attaches over the van
+        # path attaches over the van (replicated when `replica` names
+        # the durable-tier pair — failover is then a retried transient)
         self._table = table if table is not None else attach_blackboard(
             host, port, table_id=table_id, n_slots=n_slots,
-            connect_timeout_s=connect_timeout_s)
+            connect_timeout_s=connect_timeout_s, replica=replica)
         self._rng = random.Random(self.incarnation * 1000003 + self.slot)
         # last-written workload fields: a later write that doesn't name a
         # field must NOT zero it (leave() clobbering `committed` would
@@ -254,6 +288,14 @@ class MembershipClient:
         self.ctrl_beat = -1
         self._ctrl_advance: Optional[float] = None
         self.stale_control_reads = 0
+        # the registry twin of the attribute: rejected zombie control
+        # rows are durable-tier health evidence, so they must ride the
+        # member's registry dump into fleet_metrics()/Prometheus
+        from hetu_tpu.telemetry import default_registry as _reg
+        self._m_stale = _reg.counter(
+            "membership.stale_control_reads",
+            help="control rows rejected for carrying a superseded "
+                 "controller incarnation (zombie fence hits)")
         self._accepted_control = (0, 0, 0, 0, 0, -1, 0)
 
     def _bump_beat(self) -> None:
@@ -327,6 +369,7 @@ class MembershipClient:
         ci = int(row[C_CTRL_INC])
         if ci and ci < self.ctrl_inc:
             self.stale_control_reads += 1
+            self._m_stale.inc()
             return self._accepted_control
         out = (int(row[C_EPOCH]), int(row[C_WIDTH]),
                int(row[C_MASK]), int(row[C_RESUME]),
@@ -1027,6 +1070,345 @@ class ControllerLedger:
                 last = e
                 time.sleep(0.02)
         raise RuntimeError(f"ledger snapshot would not decode: {last!r}")
+
+    def close(self) -> None:
+        close = getattr(self._table, "close", None)
+        if close is not None:
+            close()
+
+
+# ---------------------------------------------------------------------------
+# delta ledger: append-only accept/resolve records + periodic compaction
+# ---------------------------------------------------------------------------
+
+# header magic for the delta layout, < 2**24 so it is exact in f32 (and
+# distinct from LEDGER_MAGIC, so a reader can tell the layouts apart)
+DELTA_MAGIC = 0xD017A5
+# header row fields
+D_MAGIC, D_CINC, D_SEQ, D_BASE_NBYTES = 0, 1, 2, 3
+D_HEAD, D_NREC, D_COMPACTIONS = 4, 5, 6
+
+
+class LedgerCompactionNeeded(RuntimeError):
+    """The delta region is full: the caller must :meth:`DeltaLedger.
+    compact` a fresh base snapshot (one amortized O(state) write) and
+    re-append.  Raised INSTEAD of refusing the accept — the old
+    snapshot ledger's hard capacity cliff becomes a compaction
+    trigger."""
+
+
+class DeltaLedger:
+    """Append-only controller ledger: O(delta) bytes per state change.
+
+    :class:`ControllerLedger` journals ONE full JSON snapshot per
+    accept — O(inflight) bytes serialized behind one lock, with a hard
+    refuse-accepts cliff at the table's capacity.  This layout splits
+    the same PS table into three regions instead::
+
+        row 0                       header [magic, ctrl_inc, seq,
+                                    base_nbytes, head, n_records,
+                                    compactions]
+        rows [1, 1+base_rows)       the BASE snapshot (u16-packed JSON,
+                                    rewritten only at compaction)
+        rows [1+base_rows, rows)    append-only DELTA records, each
+                                    [nbytes, u16 payload...] packed into
+                                    whole rows
+
+    Every :meth:`append` writes header + the new record rows in ONE
+    ``sparse_set`` frame (atomic on the van server — the same
+    atomicity argument as the snapshot ledger), so an accept costs
+    bytes proportional to the RECORD, not to everything in flight.
+    When the delta region fills, the caller compacts: the current full
+    state becomes the new base and ``head`` resets, again one atomic
+    frame — a reader at ANY instant sees either (old base + old
+    deltas) or (new base, zero deltas), never a torn mix, so a
+    takeover mid-compaction restores the exact request set.
+
+    Readers use a two-pull protocol: probe the header, pull rows
+    ``[0, head)`` in one atomic op, and retry only if the header
+    inside the big pull says the writer appended past the probed head
+    meanwhile.  Fencing matches :class:`ControllerLedger`: the header
+    carries the owning incarnation, writes refuse when a higher one
+    was ever observed (cache-bounded re-read), and the member-side
+    incarnation comparison stays the authoritative fence.
+
+    Dual use with the replicated durable tier: the whole ledger is
+    verbatim ``sparse_set`` traffic, so a synchronously replicated
+    table keeps byte-identical ledgers on both vans.
+    """
+
+    def __init__(self, host: str = "", port: int = 0, *, table_id: int = 0,
+                 rows: int = 1024, dim: int = 32,
+                 base_rows: Optional[int] = None, create: bool = True,
+                 connect_timeout_s: float = 10.0,
+                 rpc_deadline_s: float = 5.0, table=None, replica=None):
+        self.rows, self.dim = int(rows), int(dim)
+        self.base_rows = int(base_rows) if base_rows is not None \
+            else max((self.rows - 1) // 2, 8)
+        self.delta_start = 1 + self.base_rows
+        if self.delta_start + 8 > self.rows:
+            raise ValueError(
+                f"ledger too small: {self.rows} rows leaves no delta "
+                f"region past base_rows={self.base_rows}")
+        if table is not None:
+            self._table = table
+        else:
+            rep = _replica_of(replica)
+            if rep is not None:
+                self._table = rep.table(
+                    self.rows, self.dim, table_id=int(table_id),
+                    create=create, sync=True, init="zeros",
+                    optimizer="sgd", lr=0.0,
+                    connect_timeout_s=connect_timeout_s)
+            else:
+                from hetu_tpu.ps.van import RemotePSTable
+                self._table = RemotePSTable(
+                    host, port, self.rows, self.dim,
+                    table_id=int(table_id), create=create, init="zeros",
+                    optimizer="sgd", lr=0.0,
+                    connect_timeout_s=connect_timeout_s)
+        self.rpc_deadline_s = float(rpc_deadline_s)
+        self._rng = random.Random(0x44454C54)
+        self.seq = 0
+        self.head = self.delta_start
+        self.n_records = 0
+        self.compactions = 0
+        self._base_nbytes = 0
+        self.fence_cache_s = 0.25
+        self._fence_read_at: Optional[float] = None
+        self._fenced_by = 0
+        from hetu_tpu.telemetry import default_registry as _reg
+        self._m_appends = _reg.counter(
+            "ledger.delta_appends", help="delta records appended")
+        self._m_append_bytes = _reg.counter(
+            "ledger.delta_bytes",
+            help="wire bytes of appended delta frames (header row "
+                 "included) — O(record), not O(inflight)")
+        self._m_compactions = _reg.counter(
+            "ledger.compactions", help="base-snapshot compactions")
+        self._m_compaction_bytes = _reg.counter(
+            "ledger.compaction_bytes",
+            help="wire bytes of compaction frames (the amortized "
+                 "O(state) cost)")
+        if create and table is None:
+            self._init_header()
+        else:
+            self.sync()
+
+    def _rpc(self, fn, op: str):
+        return control_rpc(fn, rng=self._rng, op=op, link="ledger->van",
+                           deadline_s=self.rpc_deadline_s)
+
+    # ---- geometry ----
+    def base_capacity_bytes(self) -> int:
+        return self.base_rows * self.dim * 2
+
+    def delta_capacity_rows(self) -> int:
+        return self.rows - self.delta_start
+
+    def _record_rows(self, nbytes: int) -> int:
+        n_u16 = (int(nbytes) + 1) // 2
+        return max(-(-(1 + n_u16) // self.dim), 1)
+
+    def _header_row(self, *, ctrl_inc: int) -> np.ndarray:
+        h = np.zeros(self.dim, np.float32)
+        h[D_MAGIC] = DELTA_MAGIC
+        h[D_CINC] = int(ctrl_inc)
+        h[D_SEQ] = self.seq
+        h[D_BASE_NBYTES] = self._base_nbytes
+        h[D_HEAD] = self.head
+        h[D_NREC] = self.n_records
+        h[D_COMPACTIONS] = self.compactions
+        return h
+
+    def _init_header(self) -> None:
+        self.seq = 1
+        frame = self._header_row(ctrl_inc=0).reshape(1, -1)
+        self._rpc(lambda: self._table.sparse_set([0], frame),
+                  "ledger_init")
+
+    def _load_header(self, row) -> bool:
+        if int(row[D_MAGIC]) != DELTA_MAGIC:
+            return False
+        self.seq = int(row[D_SEQ])
+        self._base_nbytes = int(row[D_BASE_NBYTES])
+        self.head = int(row[D_HEAD])
+        self.n_records = int(row[D_NREC])
+        self.compactions = int(row[D_COMPACTIONS])
+        self._fenced_by = max(self._fenced_by, int(row[D_CINC]))
+        return True
+
+    def sync(self) -> bool:
+        """Adopt the table's current header (attach / takeover path).
+        Returns False when the table was never initialized."""
+        row = self._rpc(lambda: self._table.sparse_pull([0]),
+                        "ledger_sync")[0]
+        return self._load_header(row)
+
+    # ---- fencing (the ControllerLedger contract, verbatim) ----
+    def _check_fence(self, ctrl_inc: int) -> None:
+        now = time.monotonic()
+        if self._fenced_by > int(ctrl_inc):
+            raise ControllerFenced(
+                f"ledger owned by incarnation {self._fenced_by} > "
+                f"{int(ctrl_inc)}: a takeover happened — stop writing")
+        if self._fence_read_at is None or \
+                now - self._fence_read_at >= self.fence_cache_s:
+            head = self._rpc(lambda: self._table.sparse_pull([0]),
+                             "ledger_fence_read")
+            self._fence_read_at = now
+            if int(head[0, D_MAGIC]) == DELTA_MAGIC:
+                self._fenced_by = max(self._fenced_by,
+                                      int(head[0, D_CINC]))
+                if int(head[0, D_SEQ]) > self.seq:
+                    # a successor (or a pre-fence write of ours that
+                    # raced) advanced the ledger: adopt its geometry
+                    # rather than append over it
+                    self._load_header(head[0])
+            if self._fenced_by > int(ctrl_inc):
+                raise ControllerFenced(
+                    f"ledger owned by incarnation {self._fenced_by} > "
+                    f"{int(ctrl_inc)}: a takeover happened — stop "
+                    f"writing")
+
+    # ---- codec ----
+    @staticmethod
+    def _pack_u16(data: bytes) -> np.ndarray:
+        pad = data + b"\x00" * (len(data) % 2)
+        return np.frombuffer(pad, np.uint16).astype(np.float32)
+
+    def _encode_record(self, rec: dict) -> np.ndarray:
+        data = json.dumps(rec, separators=(",", ":")).encode()
+        u16 = self._pack_u16(data)
+        nrows = self._record_rows(len(data))
+        flat = np.zeros(nrows * self.dim, np.float32)
+        flat[0] = len(data)
+        flat[1:1 + u16.size] = u16
+        return flat.reshape(nrows, self.dim)
+
+    @staticmethod
+    def _decode_bytes(flat: np.ndarray, nbytes: int) -> bytes:
+        n_u16 = (int(nbytes) + 1) // 2
+        return flat[:n_u16].astype(np.uint16).tobytes()[:int(nbytes)]
+
+    # ---- writes ----
+    def append(self, records, *, ctrl_inc: int) -> int:
+        """Append one or more delta records in ONE atomic frame;
+        returns the new seq.  Raises :class:`LedgerCompactionNeeded`
+        when they do not fit the remaining delta region."""
+        if isinstance(records, dict):
+            records = [records]
+        if not records:
+            return self.seq
+        self._check_fence(ctrl_inc)
+        encoded = [self._encode_record(r) for r in records]
+        k = sum(e.shape[0] for e in encoded)
+        if self.head + k > self.rows:
+            raise LedgerCompactionNeeded(
+                f"delta region full ({self.head - self.delta_start}/"
+                f"{self.delta_capacity_rows()} rows used, {k} more "
+                f"needed): compact")
+        self.seq += 1
+        self.head += k
+        self.n_records += len(records)
+        frame = np.concatenate(
+            [self._header_row(ctrl_inc=ctrl_inc).reshape(1, -1)]
+            + encoded, axis=0)
+        idx = np.concatenate(
+            [[0], np.arange(self.head - k, self.head)])
+        try:
+            self._rpc(lambda: self._table.sparse_set(idx, frame),
+                      "ledger_append")
+        except Exception:
+            # nothing (or everything) landed — re-sync before the next
+            # append so local geometry cannot drift from the table
+            self.seq -= 1
+            self.head -= k
+            self.n_records -= len(records)
+            self._fence_read_at = None
+            raise
+        self._fenced_by = max(self._fenced_by, int(ctrl_inc))
+        self._m_appends.inc(len(records))
+        self._m_append_bytes.inc(int(frame.nbytes))
+        return self.seq
+
+    def compact(self, state: dict, *, ctrl_inc: int) -> int:
+        """Write ``state`` as the new base and reset the delta region —
+        one atomic frame, amortized O(state).  Returns the new seq."""
+        self._check_fence(ctrl_inc)
+        data = json.dumps(state, separators=(",", ":")).encode()
+        if len(data) > self.base_capacity_bytes():
+            raise ValueError(
+                f"ledger base snapshot {len(data)}B exceeds base "
+                f"capacity {self.base_capacity_bytes()}B — size the "
+                f"ledger up")
+        u16 = self._pack_u16(data)
+        nrows = -(-u16.size // self.dim) if u16.size else 0
+        base = np.zeros((nrows, self.dim), np.float32)
+        if nrows:
+            base.reshape(-1)[:u16.size] = u16
+        self.seq += 1
+        self._base_nbytes = len(data)
+        self.head = self.delta_start
+        self.n_records = 0
+        self.compactions += 1
+        frame = np.concatenate(
+            [self._header_row(ctrl_inc=ctrl_inc).reshape(1, -1), base],
+            axis=0)
+        idx = np.arange(1 + nrows)
+        self._rpc(lambda: self._table.sparse_set(idx, frame),
+                  "ledger_compact")
+        self._fenced_by = max(self._fenced_by, int(ctrl_inc))
+        self._m_compactions.inc()
+        self._m_compaction_bytes.inc(int(frame.nbytes))
+        return self.seq
+
+    def needs_compaction(self, margin_rows: int = 16) -> bool:
+        return self.head + int(margin_rows) > self.rows
+
+    # ---- reads ----
+    def read(self) -> Optional[dict]:
+        """``{"state", "deltas", "seq", "ctrl_inc", "compactions"}`` —
+        the base snapshot plus every delta appended since, in order —
+        or None when nothing was ever journaled.  The caller replays
+        the deltas over the state."""
+        probe = self._rpc(lambda: self._table.sparse_pull([0]),
+                          "ledger_read_header")[0]
+        if int(probe[D_MAGIC]) != DELTA_MAGIC:
+            return None
+        want_head = int(probe[D_HEAD])
+        for _ in range(8):
+            rows = self._rpc(
+                lambda: self._table.sparse_pull(np.arange(want_head)),
+                "ledger_read")
+            hdr = rows[0]
+            if int(hdr[D_MAGIC]) != DELTA_MAGIC:
+                return None
+            head = int(hdr[D_HEAD])
+            if head > want_head:
+                want_head = head  # the writer appended mid-read: grow
+                continue
+            self._load_header(hdr)
+            nbytes = int(hdr[D_BASE_NBYTES])
+            state = {}
+            if nbytes:
+                base_flat = rows[1:1 + self.base_rows].reshape(-1)
+                state = json.loads(self._decode_bytes(base_flat, nbytes))
+            deltas = []
+            r = self.delta_start
+            while r < head:
+                rec_nbytes = int(rows[r][0])
+                nrows = self._record_rows(rec_nbytes)
+                flat = rows[r:r + nrows].reshape(-1)[1:]
+                deltas.append(json.loads(
+                    self._decode_bytes(flat, rec_nbytes)))
+                r += nrows
+            return {"state": state, "deltas": deltas, "seq": self.seq,
+                    "ctrl_inc": int(hdr[D_CINC]),
+                    "compactions": int(hdr[D_COMPACTIONS])}
+        raise RuntimeError(
+            "ledger read could not catch a quiescent header in 8 "
+            "attempts (writer appending continuously)")
 
     def close(self) -> None:
         close = getattr(self._table, "close", None)

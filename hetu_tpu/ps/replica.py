@@ -1,0 +1,907 @@
+"""Replicated durable tier: a backup van shadowing the primary.
+
+PR 12 made every CONTROLLER killable; the van process the controllers
+journal into became the last single point of failure — one SIGKILL of
+the durable tier took membership blackboard, controller ledger, and
+PS-resident model state down unrecoverably.  This module closes that
+hole at the CLIENT wire layer (the van server stays untouched C++):
+
+* :class:`ReplicatedPSTable` — the ``RemotePSTable`` surface over a
+  primary + backup van pair.  Mutating ops (``sparse_set`` /
+  ``slots_set`` / ``sparse_push`` / ``dense_push`` / ``row_cas`` /
+  ``clear``) dual-write: SYNCHRONOUSLY for load-bearing tables
+  (membership rows, the controller ledger, versioned weights — the
+  write returns only once BOTH vans acked), or ASYNC lag-bounded for
+  everything else (a bounded queue drains to the backup on a streamer
+  thread; a full queue blocks the writer, so replication lag is capped
+  at ``max_lag`` ops).  Reads always go to the primary.
+
+* :class:`VanReplica` — the per-process failover brain.  A 1-row EPOCH
+  table on every van carries ``[incarnation, primary_idx, pid]``;
+  promotion is a van-side ``OP_ROW_CAS`` on the incarnation field of
+  the SURVIVOR's epoch row, so of N clients (or standbys) racing to
+  promote, exactly one swap lands — the losers adopt the winner's
+  incarnation from the CAS response.  A claimant may only promote
+  after the primary stayed unreachable past ``promote_after_s``
+  (re-pinged with a short receive timeout, so a SIGSTOPped van —
+  whose TCP stack still accepts — fails the ping instead of hanging
+  the fleet).  After promotion the new epoch row is fence-written
+  into the OLD primary (retried in the background until it lands), so
+  a SIGSTOP'd-then-resumed primary advertises its own supersession:
+  any client still bound to it discovers the fence on its next
+  revalidation window and gets :class:`VanFailover` instead of
+  landing a stale write.
+
+* :class:`VanFailover` — a ``ConnectionError`` subclass raised AFTER
+  the client re-targeted to the promoted endpoint.  Every existing
+  retry layer (``control_rpc``, supervisor transient retry, blob
+  same-seq resend) already treats ``ConnectionError`` as transient,
+  so a van failover replays in-flight ops exactly like a netem drop.
+
+Determinism note: synchronous dual-write keeps the two vans BITWISE
+identical for verbatim writes (``sparse_set``/``slots_set``/
+``row_cas`` — the blackboard, ledger, and double-buffered stage
+weights are all written this way) and for optimizer-applying pushes
+issued by a single writer in order (the ``ordered_grads`` elastic
+path).  Concurrent unordered pushes from several processes may apply
+in different interleavings on the two vans — exactly the same
+nondeterminism those pushes already have on ONE van.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+import traceback
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from hetu_tpu.telemetry import trace as _trace
+
+# epoch-row fields (dim 8, exact in f32 like every blackboard value)
+E_INC, E_PRIMARY, E_PID = 0, 1, 2
+EPOCH_DIM = 8
+# default epoch-table id band marker ('VEPO'); deployments normally draw
+# a fresh id (the native registry outlives van.stop())
+VAN_EPOCH_TABLE = 0x5645504F
+
+
+class VanFailover(ConnectionError):
+    """The primary van died and this client re-targeted to the promoted
+    backup.  Raised INSTEAD of the op result so the caller's retry
+    layer replays the op against the new primary — failover is a
+    transient, exactly like a dropped frame."""
+
+
+class VanFenced(VanFailover):
+    """A write was refused because this handle's van incarnation has
+    been superseded (the old primary it targeted is no longer
+    authoritative).  Subclasses :class:`VanFailover`: by the time it
+    raises, the handle already re-targeted — retry and the op lands on
+    the promoted van."""
+
+
+def _is_wire_error(e: BaseException) -> bool:
+    if isinstance(e, (ConnectionError, TimeoutError)):
+        return True
+    return isinstance(e, RuntimeError) and "hetu_ps" in str(e)
+
+
+def set_rcv_timeout(fd: int, timeout_s: float) -> None:
+    """Arm ``SO_RCVTIMEO`` on a raw van connection fd.  The native
+    client's ``recv`` loop otherwise blocks forever against a
+    SIGSTOPped server (the kernel keeps the socket open while the
+    process is stopped) — with the timeout armed the op fails with the
+    transport rc instead, which is what lets ``van_suspend`` chaos
+    surface as a detectable, promotable outage rather than a fleet-wide
+    hang.  Options are kernel-socket state, so setting them through a
+    dup'd fileno affects the original fd."""
+    if fd < 0:
+        return
+    s = socket.socket(fileno=os.dup(fd))
+    try:
+        tv = struct.pack("ll", int(timeout_s),
+                         int((timeout_s % 1.0) * 1e6))
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+    finally:
+        s.close()
+
+
+@dataclass
+class ReplicaSpec:
+    """Everything a process needs to find (and fail over between) the
+    replicated durable tier — JSON-serialized into spawn configs like
+    every other control-plane id."""
+
+    endpoints: list = field(default_factory=list)  # [[host, port], ...]
+    epoch_table: int = VAN_EPOCH_TABLE
+    promote_after_s: float = 0.5
+    max_lag: int = 64              # async stream bound, in ops
+    rcv_timeout_s: float = 5.0     # SO_RCVTIMEO on replica connections
+    revalidate_s: float = 0.25     # stale-primary fence check cadence
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "ReplicaSpec":
+        return cls(**json.loads(s))
+
+    @classmethod
+    def from_dict(cls, d) -> Optional["ReplicaSpec"]:
+        if not d:
+            return None
+        return cls(**dict(d))
+
+
+def _reg():
+    from hetu_tpu.telemetry import default_registry
+    return default_registry
+
+
+class VanReplica:
+    """Per-process failover coordinator over a primary/backup van pair.
+
+    One instance per (endpoints, epoch_table) per process — use
+    :meth:`get` so every table/channel in the process shares one view
+    of which endpoint is authoritative.  Thread-safe."""
+
+    _instances: dict = {}
+    _instances_lock = threading.Lock()
+
+    def __init__(self, spec: ReplicaSpec):
+        if len(spec.endpoints) < 2:
+            raise ValueError("a van replica needs >= 2 endpoints "
+                             "(primary + backup)")
+        self.spec = spec
+        self.endpoints = [(str(h), int(p)) for h, p in spec.endpoints]
+        self.lock = threading.RLock()
+        self.incarnation = 0
+        self.primary_idx = 0
+        self._epoch: list = [None] * len(self.endpoints)
+        self._callbacks: list = []
+        self._first_fail: Optional[float] = None
+        self._fail_t0_us: Optional[float] = None
+        self._revalidated_at = 0.0
+        reg = _reg()
+        self._m_promotions = reg.counter(
+            "van.replica.promotions",
+            help="van promotions this process WON (CAS swap landed)")
+        self._m_adopted = reg.counter(
+            "van.replica.promotions_adopted",
+            help="van promotions won elsewhere and adopted here")
+        self._m_failovers = reg.counter(
+            "van.replica.failovers",
+            help="ops re-targeted to the promoted endpoint")
+        self._m_inc = reg.gauge(
+            "van.replica.incarnation",
+            help="highest van incarnation observed by this process")
+        self._m_lag = reg.gauge(
+            "van.replica.lag_ops",
+            help="async replication ops queued, all streamed tables")
+        self._lag_sources: list = []
+
+    # ---- construction ----
+    @classmethod
+    def get(cls, spec: ReplicaSpec) -> "VanReplica":
+        key = (tuple(tuple(e) for e in spec.endpoints),
+               int(spec.epoch_table))
+        with cls._instances_lock:
+            inst = cls._instances.get(key)
+            if inst is None:
+                inst = cls(spec)
+                cls._instances[key] = inst
+            return inst
+
+    @classmethod
+    def from_spec(cls, spec, *, bootstrap: bool = False) -> "VanReplica":
+        """The ONE construction path every plane shares: accept a
+        VanReplica / ReplicaSpec / spec dict, resolve the per-process
+        instance, and make sure it knows the CURRENT incarnation —
+        a process spawned AFTER a failover must not bind the dead
+        original primary.  ``bootstrap=True`` additionally creates the
+        epoch tables and claims incarnation 1 (the deployment-creation
+        path; attach/takeover paths refresh only)."""
+        if isinstance(spec, cls):
+            rep = spec
+        elif isinstance(spec, ReplicaSpec):
+            rep = cls.get(spec)
+        else:
+            rep = cls.get(ReplicaSpec.from_dict(spec))
+        if bootstrap:
+            rep.bootstrap()
+        elif rep.incarnation == 0:
+            # never resolved in this process: adopt whatever the pair
+            # currently says before any handle binds an endpoint
+            rep.refresh()
+        return rep
+
+    def bootstrap(self) -> int:
+        """Deployment side: create the epoch table on EVERY van and
+        claim incarnation 1 via CAS (idempotent — a second bootstrap
+        adopts the existing row).  Returns the current incarnation."""
+        for i in range(len(self.endpoints)):
+            h = self._epoch_handle(i, create=True)
+            if i == 0 and h is not None:
+                desired = np.zeros(EPOCH_DIM, np.float32)
+                desired[E_INC] = 1.0
+                desired[E_PID] = os.getpid() % (1 << 24)
+                try:
+                    swapped, actual = h.row_cas(0, E_INC, 0.0, desired)
+                    inc = 1 if swapped else int(actual[E_INC])
+                    pidx = 0 if swapped else int(actual[E_PRIMARY])
+                except NotImplementedError:
+                    row = h.sparse_pull([0])[0]
+                    if int(row[E_INC]) == 0:
+                        h.sparse_set([0], desired.reshape(1, -1))
+                        inc, pidx = 1, 0
+                    else:
+                        inc, pidx = int(row[E_INC]), int(row[E_PRIMARY])
+                with self.lock:
+                    self.incarnation = max(self.incarnation, inc)
+                    self.primary_idx = pidx
+                    self._m_inc.set(self.incarnation)
+        # mirror the claimed row onto the backups (verbatim — the fence
+        # every later promotion CASes against)
+        self._mirror_epoch_row()
+        return self.incarnation
+
+    def refresh(self) -> int:
+        """Adopt the highest incarnation any endpoint's epoch row
+        carries (attach/takeover path: the pair may have failed over
+        before this process existed).  Returns the incarnation."""
+        best = None
+        for i in range(len(self.endpoints)):
+            info = self._read_epoch(i)
+            if info is not None and \
+                    (best is None or info[0] > best[0]):
+                best = info
+        if best is not None:
+            with self.lock:
+                if best[0] > self.incarnation:
+                    self.incarnation, self.primary_idx = best
+                    self._m_inc.set(self.incarnation)
+        return self.incarnation
+
+    def _mirror_epoch_row(self) -> None:
+        with self.lock:
+            inc, pidx = self.incarnation, self.primary_idx
+        row = np.zeros((1, EPOCH_DIM), np.float32)
+        row[0, E_INC] = inc
+        row[0, E_PRIMARY] = pidx
+        row[0, E_PID] = os.getpid() % (1 << 24)
+        for i in range(len(self.endpoints)):
+            if i == pidx:
+                continue
+            h = self._epoch_handle(i, create=True)
+            if h is None:
+                continue
+            try:
+                h.sparse_set([0], row)
+            except Exception:
+                pass  # an unreachable backup mirrors later (promotion
+                # falls back to CAS-from-0 there)
+
+    def _epoch_handle(self, idx: int, *, create: bool = False):
+        from hetu_tpu.ps.van import RemotePSTable
+        h = self._epoch[idx]
+        if h is not None and h.fd >= 0:
+            return h
+        host, port = self.endpoints[idx]
+        for do_create in ((True, False) if create else (False, True)):
+            try:
+                h = RemotePSTable(
+                    host, port, 1, EPOCH_DIM,
+                    table_id=self.spec.epoch_table, create=do_create,
+                    init="zeros", optimizer="sgd", lr=0.0,
+                    connect_timeout_s=1.0,
+                    rcv_timeout_s=self.spec.rcv_timeout_s)
+                self._epoch[idx] = h
+                return h
+            except Exception:
+                continue
+        return None
+
+    # ---- views ----
+    @property
+    def primary(self) -> tuple:
+        with self.lock:
+            return self.endpoints[self.primary_idx]
+
+    @property
+    def backup_idx(self) -> Optional[int]:
+        with self.lock:
+            for i in range(len(self.endpoints)):
+                if i != self.primary_idx:
+                    return i
+        return None
+
+    def register(self, cb) -> None:
+        """``cb(replica)`` runs after every adopted/won promotion —
+        tables re-target themselves; the serving pool rebinds its blob
+        channels."""
+        with self.lock:
+            self._callbacks.append(cb)
+
+    def unregister(self, cb) -> None:
+        with self.lock:
+            if cb in self._callbacks:
+                self._callbacks.remove(cb)
+
+    def register_lag_source(self, fn) -> None:
+        with self.lock:
+            self._lag_sources.append(fn)
+
+    def export_lag(self) -> int:
+        with self.lock:
+            srcs = list(self._lag_sources)
+        lag = 0
+        for fn in srcs:
+            try:
+                lag += int(fn())
+            except Exception:
+                pass
+        self._m_lag.set(lag)
+        return lag
+
+    # ---- the failover dance ----
+    def note_ok(self) -> None:
+        if self._first_fail is not None:
+            with self.lock:
+                self._first_fail = None
+                self._fail_t0_us = None
+
+    def revalidate(self, *, force: bool = False) -> bool:
+        """Cheap stale-primary fence check, at most once per
+        ``revalidate_s``: read the CURRENT primary's epoch row — a
+        fence write landed by a promotion elsewhere shows a higher
+        incarnation, and this process adopts it (returns True).  The
+        check that rejects a resumed old primary's would-be writes."""
+        now = time.monotonic()
+        with self.lock:
+            if not force and \
+                    now - self._revalidated_at < self.spec.revalidate_s:
+                return False
+            self._revalidated_at = now
+            pidx = self.primary_idx
+        info = self._read_epoch(pidx)
+        if info is None:
+            return False
+        inc, new_pidx = info
+        with self.lock:
+            if inc > self.incarnation:
+                self._adopt_locked(inc, new_pidx, won=False)
+                return True
+        return False
+
+    def _read_epoch(self, idx: int) -> Optional[tuple]:
+        h = self._epoch_handle(idx)
+        if h is None:
+            return None
+        try:
+            row = h.sparse_pull([0])[0]
+        except Exception:
+            try:
+                h.close()
+            finally:
+                self._epoch[idx] = None
+            return None
+        return int(row[E_INC]), int(row[E_PRIMARY])
+
+    def _ping(self, idx: int) -> bool:
+        """Fresh short-deadline connect + ping: a SIGKILLed van refuses
+        fast; a SIGSTOPped one accepts but the ping recv times out."""
+        from hetu_tpu.ps.binding import lib
+        host, port = self.endpoints[idx]
+        fd = lib.ps_van_connect(host.encode(), port)
+        if fd < 0:
+            return False
+        try:
+            set_rcv_timeout(fd, min(self.spec.promote_after_s, 1.0))
+            return lib.ps_van_ping(fd) == 0
+        finally:
+            lib.ps_van_close(fd)
+
+    def failover(self, err: Optional[BaseException] = None) -> bool:
+        """Called when a primary op failed transport-wise.  Returns True
+        when the primary CHANGED (the caller must re-target and raise
+        :class:`VanFailover`); False when the failure should surface
+        as the ordinary transient it is."""
+        now = time.monotonic()
+        with self.lock:
+            if self._first_fail is None:
+                self._first_fail = now
+                self._fail_t0_us = _trace.now_us()
+            first_fail = self._first_fail
+            pidx = self.primary_idx
+            bidx = self.backup_idx
+        if bidx is None:
+            return False
+        # did someone already promote?  The survivor's epoch row is the
+        # cheapest truth — adopt before pinging anything
+        info = self._read_epoch(bidx)
+        if info is not None and info[0] > self.incarnation:
+            with self.lock:
+                self._adopt_locked(info[0], info[1], won=False)
+            return True
+        if self._ping(pidx):
+            self.note_ok()
+            return False
+        if now - first_fail < self.spec.promote_after_s:
+            return False  # not yet: a netem wobble must not promote
+        return self.promote()
+
+    def promote(self) -> bool:
+        """Claim the promotion via CAS on the survivor's epoch row.
+        Exactly one claimant's swap lands per incarnation; the losers
+        adopt the winner's row from the same round trip.  Returns True
+        when the primary changed (won or adopted)."""
+        with self.lock:
+            pidx = self.primary_idx
+            bidx = self.backup_idx
+            observed = self.incarnation
+        if bidx is None:
+            return False
+        h = self._epoch_handle(bidx, create=True)
+        if h is None:
+            return False
+        desired = np.zeros(EPOCH_DIM, np.float32)
+        desired[E_INC] = observed + 1
+        desired[E_PRIMARY] = bidx
+        desired[E_PID] = os.getpid() % (1 << 24)
+        try:
+            swapped, actual = h.row_cas(0, E_INC, float(observed),
+                                        desired)
+        except NotImplementedError:
+            # old van: read-then-write (the verified pre-CAS fallback)
+            row = h.sparse_pull([0])[0]
+            if int(row[E_INC]) > observed:
+                swapped, actual = False, row
+            else:
+                h.sparse_set([0], desired.reshape(1, -1))
+                swapped, actual = True, desired
+        except Exception:
+            return False
+        with self.lock:
+            if swapped:
+                self._adopt_locked(observed + 1, bidx, won=True)
+            else:
+                inc, np_idx = int(actual[E_INC]), int(actual[E_PRIMARY])
+                if inc <= self.incarnation or np_idx == pidx:
+                    # CAS lost against a row that still names the dead
+                    # primary (e.g. a never-mirrored epoch row): adopt
+                    # nothing — the next attempt re-reads and converges
+                    return False
+                self._adopt_locked(inc, np_idx, won=False)
+        return True
+
+    def _adopt_locked(self, inc: int, pidx: int, *, won: bool) -> None:
+        """Caller holds ``self.lock``."""
+        old_pidx = self.primary_idx
+        self.incarnation = int(inc)
+        self.primary_idx = int(pidx)
+        self._m_inc.set(self.incarnation)
+        t0 = self._fail_t0_us
+        self._first_fail = None
+        self._fail_t0_us = None
+        cbs = list(self._callbacks)
+        if won:
+            self._m_promotions.inc()
+        else:
+            self._m_adopted.inc()
+        self._m_failovers.inc()
+        # the retroactive recovery span the timeline pairs with
+        # fault.van_kill / fault.van_suspend: detection start -> adopted
+        _trace.complete(
+            "van.promote", t0 if t0 is not None else _trace.now_us(),
+            {"incarnation": self.incarnation, "primary": int(pidx),
+             "won": bool(won)}, cat="van")
+        # fence the OLD primary in the background: when it resumes
+        # (SIGSTOP case) its epoch row must already say "superseded",
+        # so clients still bound to it refuse their next write
+        threading.Thread(target=self._fence_old_primary,
+                         args=(old_pidx, self.incarnation,
+                               self.primary_idx),
+                         daemon=True).start()
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:
+                traceback.print_exc()
+
+    def _fence_old_primary(self, old_idx: int, inc: int,
+                           pidx: int) -> None:
+        row = np.zeros((1, EPOCH_DIM), np.float32)
+        row[0, E_INC] = inc
+        row[0, E_PRIMARY] = pidx
+        row[0, E_PID] = os.getpid() % (1 << 24)
+        deadline = time.monotonic() + 600.0
+        while time.monotonic() < deadline:
+            with self.lock:
+                if self.incarnation > inc:
+                    return  # a later promotion owns the fencing now
+            h = self._epoch_handle(old_idx, create=True)
+            if h is not None:
+                try:
+                    cur = h.sparse_pull([0])[0]
+                    if int(cur[E_INC]) >= inc:
+                        return  # already fenced (by us or a peer)
+                    h.sparse_set([0], row)
+                    return
+                except Exception:
+                    try:
+                        h.close()
+                    finally:
+                        self._epoch[old_idx] = None
+            time.sleep(1.0)
+
+    # ---- factories ----
+    def table(self, rows: int, dim: int, **kw) -> "ReplicatedPSTable":
+        return ReplicatedPSTable(self, rows, dim, **kw)
+
+    def channel(self, channel_id: int, *,
+                connect_timeout_s: float = 2.0):
+        """A ``BlobChannel`` at the CURRENT primary.  Channels are
+        transient transport, not durable state — they are not
+        replicated; callers rebind (``BlobChannel`` at the new
+        endpoint, seq reset) when the incarnation bumps, exactly like
+        a controller-incarnation rebind.  The connect budget is SHORT
+        (its in-op reconnects inherit it): a channel op against a dead
+        primary must fail fast so the failover dance runs, not park
+        the caller for the default 20s."""
+        from hetu_tpu.ps.van import BlobChannel
+        host, port = self.primary
+        return BlobChannel(host, port, channel_id,
+                           connect_timeout_s=connect_timeout_s,
+                           rcv_timeout_s=self.spec.rcv_timeout_s)
+
+
+def open_table(van_spec, host: str, port: int, rows: int, dim: int, *,
+               table_id: int, create: bool, sync: bool = True, **kw):
+    """Table factory shared by every plane's spawn path: a plain
+    ``RemotePSTable`` at (host, port) — or, when ``van_spec`` (a
+    ReplicaSpec dict / ReplicaSpec / VanReplica) names a durable-tier
+    pair, a :class:`ReplicatedPSTable` over it.  The one-line switch
+    that lets a worker/stage spawn config opt its weights tables into
+    replication."""
+    if van_spec:
+        rep = VanReplica.from_spec(van_spec)
+        return rep.table(rows, dim, table_id=table_id, create=create,
+                         sync=sync, **kw)
+    from hetu_tpu.ps.van import RemotePSTable
+    return RemotePSTable(host, port, rows, dim, table_id=table_id,
+                         create=create, **kw)
+
+
+class _ReplicaStreamer:
+    """Async (lag-bounded) replication: a bounded queue of mutating ops
+    drained to the backup on one daemon thread.  The queue bound IS the
+    lag bound — a full queue blocks the writer, so the backup is never
+    more than ``max_lag`` ops behind.  Ops that fail against the backup
+    are retried a few times, then dropped with a counter (a dead backup
+    must not wedge the primary's write path)."""
+
+    def __init__(self, owner: "ReplicatedPSTable", max_lag: int):
+        self.owner = owner
+        self.q: queue.Queue = queue.Queue(maxsize=max(int(max_lag), 1))
+        self._stop = threading.Event()
+        self._m_dropped = _reg().counter(
+            "van.replica.async_dropped",
+            help="async replication ops dropped (backup unreachable)")
+        self._m_streamed = _reg().counter(
+            "van.replica.async_streamed",
+            help="async replication ops applied to the backup")
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def put(self, name: str, args, kw) -> None:
+        self.q.put((name, args, kw))
+
+    def lag(self) -> int:
+        return self.q.qsize()
+
+    def flush(self, timeout_s: float = 1.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while self.q.qsize() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        return not self.q.qsize()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self.q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            name, args, kw = item
+            ok = False
+            for _ in range(3):
+                h = self.owner._backup_handle()
+                if h is None:
+                    break
+                try:
+                    getattr(h, name)(*args, **kw)
+                    ok = True
+                    break
+                except Exception as e:
+                    if not _is_wire_error(e):
+                        break
+                    self.owner._drop_backup_handle()
+                    time.sleep(0.05)
+            if ok:
+                self._m_streamed.inc()
+            else:
+                self._m_dropped.inc()
+
+
+class ReplicatedPSTable:
+    """``RemotePSTable`` surface over a :class:`VanReplica` pair.
+
+    ``sync=True`` (the default) dual-writes every mutating op — the
+    op returns only once both vans acked, so a failover loses nothing
+    ever written through this handle.  ``sync=False`` streams mutations
+    to the backup through a lag-bounded queue instead (see
+    :class:`_ReplicaStreamer`).  On a primary failure the handle runs
+    the replica's failover dance and, when the primary changed, raises
+    :class:`VanFailover` so the caller's retry layer replays the op
+    against the promoted endpoint."""
+
+    def __init__(self, replica: VanReplica, rows: int, dim: int, *,
+                 table_id: int, create: bool = True, sync: bool = True,
+                 replicate: bool = True, **table_kw):
+        self.replica = replica
+        self.rows, self.dim = int(rows), int(dim)
+        self.id = int(table_id)
+        self.sync = bool(sync)
+        self.replicate = bool(replicate)
+        self._create = bool(create)
+        self._table_kw = dict(table_kw)
+        self._hlock = threading.Lock()
+        self._handles: dict = {}
+        self._bound_inc = replica.incarnation
+        self._m_sync = _reg().counter(
+            "van.replica.sync_writes",
+            help="dual-written mutating ops (both vans acked)")
+        self._m_unrepl = _reg().counter(
+            "van.replica.unreplicated_writes",
+            help="mutating ops that reached only one van (backup "
+                 "down, or post-failover single-van operation)")
+        self._streamer: Optional[_ReplicaStreamer] = None
+        # build the primary handle eagerly (construction errors must
+        # surface like RemotePSTable's)
+        h = self._build_handle(replica.primary_idx)
+        if h is None:
+            host, port = replica.primary
+            raise ConnectionError(
+                f"cannot reach primary van {host}:{port}")
+        if self.replicate and self.sync and create:
+            # sync+create: bring the BACKUP copy up NOW — the creator
+            # (a supervisor) may never mutate the table itself, and a
+            # worker attaching later must find the backup table already
+            # there (its attach handle does not create)
+            self._backup_handle()
+        if self.replicate and not self.sync:
+            self._streamer = _ReplicaStreamer(self,
+                                              replica.spec.max_lag)
+            replica.register_lag_source(self._streamer.lag)
+        self.dtype = self._table_kw.get("dtype", "f32")
+
+    # ---- handles ----
+    def _build_handle(self, idx: int,
+                      connect_timeout_s: Optional[float] = None):
+        """Try the preferred create mode first, then the other: create
+        fails when the table already exists on that van (a rebuilt
+        handle attaches), attach fails when it does not yet (the first
+        handle on a fresh backup creates)."""
+        from hetu_tpu.ps.van import RemotePSTable
+        host, port = self.replica.endpoints[idx]
+        kw = dict(self._table_kw)
+        if connect_timeout_s is not None:
+            kw["connect_timeout_s"] = connect_timeout_s
+        kw.setdefault("rcv_timeout_s", self.replica.spec.rcv_timeout_s)
+        for do_create in (self._create, not self._create):
+            try:
+                h = RemotePSTable(host, port, self.rows, self.dim,
+                                  table_id=self.id, create=do_create,
+                                  **kw)
+                with self._hlock:
+                    self._handles[idx] = h
+                return h
+            except Exception:
+                continue
+        return None
+
+    def _handle(self, idx: int):
+        with self._hlock:
+            h = self._handles.get(idx)
+        if h is not None and h.fd >= 0:
+            return h
+        # lazy rebuilds keep a SHORT connect budget: they run on op
+        # paths (often against a dead endpoint) where the caller's
+        # retry layer owns the patience
+        return self._build_handle(idx, connect_timeout_s=1.0)
+
+    def _primary_handle(self):
+        return self._handle(self.replica.primary_idx)
+
+    def _backup_handle(self):
+        bidx = self.replica.backup_idx
+        if bidx is None:
+            return None
+        return self._handle(bidx)
+
+    def _drop_backup_handle(self) -> None:
+        bidx = self.replica.backup_idx
+        with self._hlock:
+            h = self._handles.pop(bidx, None)
+        if h is not None:
+            try:
+                h.close()
+            except Exception:
+                pass
+
+    def _drop_handle(self, idx: int) -> None:
+        with self._hlock:
+            h = self._handles.pop(idx, None)
+        if h is not None:
+            try:
+                h.close()
+            except Exception:
+                pass
+
+    # ---- the fence / failover core ----
+    def _pre_write_check(self) -> None:
+        """The stale-primary fence: before a mutating op, a cheap
+        (cadence-capped) revalidation of the current primary's epoch
+        row.  A promotion that happened elsewhere (this process idle
+        throughout) surfaces here as :class:`VanFenced` BEFORE the
+        write lands on the superseded van."""
+        if self.replica.revalidate():
+            raise VanFenced(
+                "van primary superseded (fence observed on epoch "
+                "row); re-targeted to the promoted endpoint — retry")
+        if self.replica.incarnation != self._bound_inc:
+            self._bound_inc = self.replica.incarnation
+
+    def _primary_op(self, name: str, args, kw=None, *, write: bool):
+        kw = kw or {}
+        if write:
+            self._pre_write_check()
+        pidx = self.replica.primary_idx
+        h = self._handle(pidx)
+        if h is None:
+            if self.replica.failover():
+                self._bound_inc = self.replica.incarnation
+                raise VanFailover(
+                    "van primary unreachable; promoted "
+                    f"incarnation {self.replica.incarnation} — retry")
+            host, port = self.replica.endpoints[pidx]
+            raise ConnectionError(f"cannot reach van {host}:{port}")
+        try:
+            out = getattr(h, name)(*args, **kw)
+        except Exception as e:
+            if not _is_wire_error(e):
+                raise
+            self._drop_handle(pidx)
+            if self.replica.failover(e):
+                self._bound_inc = self.replica.incarnation
+                raise VanFailover(
+                    "van primary failed over to incarnation "
+                    f"{self.replica.incarnation} — retry") from e
+            raise
+        self.replica.note_ok()
+        if write and self.replicate:
+            self._replicate(name, args, kw)
+        return out
+
+    def _replicate(self, name: str, args, kw) -> None:
+        if self._streamer is not None:
+            self._streamer.put(name, args, kw)
+            return
+        h = self._backup_handle()
+        if h is None:
+            self._m_unrepl.inc()
+            return
+        try:
+            getattr(h, name)(*args, **kw)
+            self._m_sync.inc()
+        except Exception as e:
+            if not _is_wire_error(e):
+                raise
+            # one rebuild-and-retry: a backup that bounced (or a stale
+            # fd) must not instantly degrade the table to unreplicated
+            self._drop_backup_handle()
+            h = self._backup_handle()
+            if h is not None:
+                try:
+                    getattr(h, name)(*args, **kw)
+                    self._m_sync.inc()
+                    return
+                except Exception:
+                    self._drop_backup_handle()
+            self._m_unrepl.inc()
+
+    # ---- RemotePSTable surface ----
+    def ping(self) -> bool:
+        try:
+            return bool(self._primary_op("ping", (), write=False))
+        except Exception:
+            return False
+
+    def sparse_pull(self, indices):
+        return self._primary_op("sparse_pull", (indices,), write=False)
+
+    def dense_pull(self):
+        return self._primary_op("dense_pull", (), write=False)
+
+    def slots_get(self, indices):
+        return self._primary_op("slots_get", (indices,), write=False)
+
+    def sparse_push(self, indices, grads) -> None:
+        self._primary_op("sparse_push", (indices, grads), write=True)
+
+    def dense_push(self, grad) -> None:
+        self._primary_op("dense_push", (grad,), write=True)
+
+    def sparse_set(self, indices, values) -> None:
+        # materialize: async replication must not race the caller's
+        # buffer reuse (the queue holds a reference, not a copy)
+        idx = np.ascontiguousarray(np.asarray(indices).reshape(-1))
+        v = np.ascontiguousarray(values)
+        self._primary_op("sparse_set", (idx, v), write=True)
+
+    def slots_set(self, indices, s1, s2, step) -> None:
+        self._primary_op("slots_set", (indices, s1, s2, step),
+                         write=True)
+
+    def row_cas(self, row: int, fld: int, expected: float, desired):
+        """Dual-written CAS: the primary decides (its swap result is
+        THE result); the decided row is mirrored to the backup as a
+        verbatim ``sparse_set`` of the actual post-op row — so the
+        backup converges to the primary's decision whichever claimant
+        won."""
+        self._pre_write_check()
+        swapped, actual = self._primary_op(
+            "row_cas", (row, fld, expected, desired), write=False)
+        if self.replicate:
+            self._replicate("sparse_set",
+                            ([int(row)], actual.reshape(1, -1)), {})
+        return swapped, actual
+
+    def clear(self) -> None:
+        self._primary_op("clear", (), write=True)
+
+    def flush_replication(self, timeout_s: float = 2.0) -> bool:
+        if self._streamer is not None:
+            return self._streamer.flush(timeout_s)
+        return True
+
+    def replication_lag(self) -> int:
+        return self._streamer.lag() if self._streamer is not None else 0
+
+    def close(self) -> None:
+        if self._streamer is not None:
+            self._streamer.flush(0.5)
+            self._streamer.stop()
+        with self._hlock:
+            handles, self._handles = dict(self._handles), {}
+        for h in handles.values():
+            try:
+                h.close()
+            except Exception:
+                pass
+
+    @property
+    def fd(self) -> int:
+        """The primary connection's fd (diagnostics only)."""
+        h = self._handles.get(self.replica.primary_idx)
+        return h.fd if h is not None else -1

@@ -191,9 +191,17 @@ def _maybe_netem(op: str, nbytes: int) -> None:
         hook(op, nbytes)
 
 
-def _connect_with_deadline(host: str, port: int, timeout_s: float) -> int:
+def _connect_with_deadline(host: str, port: int, timeout_s: float,
+                           rcv_timeout_s: Optional[float] = None) -> int:
     """Poll ``ps_van_connect`` until it succeeds or the deadline expires;
-    shared by every van client constructor."""
+    shared by every van client constructor.
+
+    ``rcv_timeout_s`` arms ``SO_RCVTIMEO`` on the fresh connection
+    BEFORE any op runs over it: the native recv loop otherwise blocks
+    forever against a SIGSTOPped server (whose kernel still accepts
+    connections and buffers sends) — the replicated durable tier
+    (:mod:`hetu_tpu.ps.replica`) needs that hang to surface as the
+    transport rc so a suspended primary is promotable, not fatal."""
     deadline = time.monotonic() + timeout_s
     fd = lib.ps_van_connect(host.encode(), port)
     while fd < 0:
@@ -201,6 +209,9 @@ def _connect_with_deadline(host: str, port: int, timeout_s: float) -> int:
             raise ConnectionError(f"cannot reach PS van {host}:{port}")
         time.sleep(0.05)
         fd = lib.ps_van_connect(host.encode(), port)
+    if rcv_timeout_s is not None and rcv_timeout_s > 0:
+        from hetu_tpu.ps.replica import set_rcv_timeout
+        set_rcv_timeout(fd, rcv_timeout_s)
     return fd
 
 
@@ -308,7 +319,8 @@ class RemotePSTable:
                  beta1: float = 0.9, beta2: float = 0.999,
                  dtype: str = "f32", wire: Optional[str] = None,
                  error_feedback: bool = True,
-                 connect_timeout_s: float = 10.0):
+                 connect_timeout_s: float = 10.0,
+                 rcv_timeout_s: Optional[float] = None):
         from hetu_tpu.ps.client import (
             TABLE_DTYPES, WIRE_DTYPES, _INIT_KINDS, _OPT_KINDS,
             ErrorFeedback,
@@ -323,7 +335,8 @@ class RemotePSTable:
         self._wdt = WIRE_DTYPES[wire] if self.wire else 0
         self._ef = ErrorFeedback(dim) if (
             self.wire == "int8" and error_feedback) else None
-        self.fd = _connect_with_deadline(host, port, connect_timeout_s)
+        self.fd = _connect_with_deadline(host, port, connect_timeout_s,
+                                         rcv_timeout_s)
         self.id = table_id if table_id is not None else _fresh_remote_id()
         if create:
             try:
@@ -908,16 +921,19 @@ class BlobChannel:
     """
 
     def __init__(self, host: str, port: int, channel_id: int, *,
-                 connect_timeout_s: float = 20.0):
+                 connect_timeout_s: float = 20.0,
+                 rcv_timeout_s: Optional[float] = None):
         self.host, self.port = host, port
         self.id = int(channel_id)
         self._timeout_s = connect_timeout_s
+        self._rcv_timeout_s = rcv_timeout_s
         # receive buffer persists across get() calls: messages are usually
         # the same size per channel, so after one grow every later get is
         # a single round trip (a fresh 1 MB buffer each call would
         # re-transfer every >1 MB message just to learn its size)
         self._rbuf = ctypes.create_string_buffer(1 << 20)
-        self.fd = _connect_with_deadline(host, port, connect_timeout_s)
+        self.fd = _connect_with_deadline(host, port, connect_timeout_s,
+                                         rcv_timeout_s)
 
     def _reconnect(self) -> None:
         from hetu_tpu.telemetry import default_registry as _reg
@@ -927,7 +943,8 @@ class BlobChannel:
         if self.fd >= 0:
             lib.ps_van_close(self.fd)
         self.fd = _connect_with_deadline(self.host, self.port,
-                                         self._timeout_s)
+                                         self._timeout_s,
+                                         self._rcv_timeout_s)
 
     def reconnect(self) -> None:
         """Drop the connection and establish a fresh one.

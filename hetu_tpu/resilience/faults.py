@@ -74,6 +74,16 @@ Fault kinds
                    seconds, then SIGCONT — the ZOMBIE case: a takeover
                    during the pause must fence the resumed controller
                    (its writes rejected, fleet state unchanged)
+``van_kill``       SIGKILL the primary VAN process ``arg`` — the
+                   durable tier itself is the fault domain: clients'
+                   ops fail transiently, the backup van is promoted
+                   via the epoch-row CAS (``van.promote``), and every
+                   table/channel re-resolves (ps/replica.py)
+``van_suspend``    SIGSTOP van process ``arg`` for ``arg2`` seconds,
+                   then SIGCONT — the durable-tier zombie: clients'
+                   receive timeouts surface the hang, the backup
+                   promotes, and the RESUMED old primary is fenced
+                   (its epoch row names its successor)
 
 The van hooks ride :func:`hetu_tpu.ps.van.set_fault_hook` (one-shot
 faults) and :func:`hetu_tpu.ps.van.set_netem_hook` (link policies);
@@ -116,7 +126,8 @@ KINDS = ("van_error", "van_delay", "data_error", "nan_grad",
          "member_kill", "member_suspend", "worker_proc_kill",
          "netem_partition", "netem_degrade", "straggler",
          "stage_kill", "stage_slow",
-         "controller_kill", "controller_suspend")
+         "controller_kill", "controller_suspend",
+         "van_kill", "van_suspend")
 
 
 @dataclass(frozen=True, order=True)
@@ -176,7 +187,10 @@ class FaultSchedule:
                  controller_kills: int = 0,
                  controller_suspends: int = 0,
                  controller_suspend_s: float = 1.0,
-                 n_controllers: int = 1) -> "FaultSchedule":
+                 n_controllers: int = 1,
+                 van_kills: int = 0, van_suspends: int = 0,
+                 van_suspend_s: float = 1.5,
+                 n_vans: int = 1) -> "FaultSchedule":
         """Draw a schedule over training steps ``[1, steps)`` from ``seed``.
 
         Counts are clipped to the available steps.  Shard-targeted faults
@@ -229,6 +243,12 @@ class FaultSchedule:
         ``controller_suspend_s`` seconds (the zombie-fencing path) —
         victims uniform from ``n_controllers``, drawn after EVERY kind
         above (FIFTH extension of the frozen-bytes contract).
+
+        Durable-tier faults (the van itself): ``van_kills`` SIGKILL a
+        primary van process, ``van_suspends`` SIGSTOP one for
+        ``van_suspend_s`` seconds (the fenced-resume path) — victims
+        uniform from ``n_vans``, drawn after EVERY kind above (SIXTH
+        extension of the frozen-bytes contract).
         """
         rng = np.random.default_rng(seed)
         hi = max(int(steps), 2)
@@ -344,6 +364,17 @@ class FaultSchedule:
                                      float(rng.integers(
                                          max(n_controllers, 1))),
                                      float(controller_suspend_s)))
+        # durable-tier kinds: drawn after everything above — the same
+        # frozen-bytes guarantee every earlier extension honored
+        for s in pick(van_kills):
+            events.append(FaultEvent(s, "van_kill",
+                                     float(rng.integers(max(n_vans,
+                                                            1)))))
+        for s in pick(van_suspends):
+            events.append(FaultEvent(s, "van_suspend",
+                                     float(rng.integers(max(n_vans,
+                                                            1))),
+                                     float(van_suspend_s)))
         return cls(events)
 
     def at(self, step: int) -> list[FaultEvent]:
@@ -386,7 +417,8 @@ class FaultInjector:
 
     def __init__(self, schedule: FaultSchedule, *, shard_procs=(),
                  member_procs=None, worker_procs=None, stage_procs=None,
-                 ctrl_procs=None, pid: int | None = None):
+                 ctrl_procs=None, van_procs=None,
+                 pid: int | None = None):
         self.schedule = schedule
         self.shard_procs = list(shard_procs)  # subprocess.Popen-likes
         # LIVE references (not copies): the cross-process pool /
@@ -396,6 +428,7 @@ class FaultInjector:
         self.worker_procs = worker_procs if worker_procs is not None else []
         self.stage_procs = stage_procs if stage_procs is not None else []
         self.ctrl_procs = ctrl_procs if ctrl_procs is not None else []
+        self.van_procs = van_procs if van_procs is not None else []
         self.pid = int(pid) if pid is not None else os.getpid()
         self.counters = defaultdict(int)
         self._armed_van = deque()   # one-shot ("error"|"delay", arg)
@@ -510,6 +543,13 @@ class FaultInjector:
                 self._proc_suspend(self.ctrl_procs, int(ev.arg),
                                    ev.arg2 or 1.0,
                                    "controller_procs_suspended")
+            elif k == "van_kill":
+                self._proc_kill(self.van_procs, int(ev.arg),
+                                "van_procs_killed")
+            elif k == "van_suspend":
+                self._proc_suspend(self.van_procs, int(ev.arg),
+                                   ev.arg2 or 1.5,
+                                   "van_procs_suspended")
             elif k == "stage_slow":
                 self.counters["stage_slows_injected"] += 1
                 with self._lock:

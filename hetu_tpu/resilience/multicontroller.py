@@ -126,6 +126,10 @@ class WorkerSpec:
     ordered_grads: bool = False
     staging_table: int = 0
     log_path: str = ""
+    # replicated durable tier: a ReplicaSpec dict — non-empty means the
+    # worker's blackboard + weights/staging tables dual-write over the
+    # primary+backup van pair and re-resolve on primary death
+    van: dict = field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -178,17 +182,20 @@ class WorkerProcess(ControlPlaneMember):
         from hetu_tpu.ps import van
         self.spec = spec
         self.schedule = make_schedule(spec)
+        from hetu_tpu.ps.replica import open_table
         self.member = _mb.MembershipClient(
             "127.0.0.1", spec.port, table_id=spec.membership_table,
-            slot=spec.slot, n_slots=spec.n_slots)
-        self.table = van.RemotePSTable(
-            "127.0.0.1", spec.port, spec.features, spec.out_dim,
-            table_id=spec.weights_table, create=False)
+            slot=spec.slot, n_slots=spec.n_slots,
+            replica=spec.van or None)
+        self.table = open_table(
+            spec.van, "127.0.0.1", spec.port, spec.features,
+            spec.out_dim, table_id=spec.weights_table, create=False)
         self._staging = None
         if spec.ordered_grads and spec.staging_table:
-            self._staging = van.RemotePSTable(
-                "127.0.0.1", spec.port, spec.n_slots * spec.features,
-                spec.out_dim, table_id=spec.staging_table, create=False)
+            self._staging = open_table(
+                spec.van, "127.0.0.1", spec.port,
+                spec.n_slots * spec.features, spec.out_dim,
+                table_id=spec.staging_table, create=False)
         self._sbar = None  # (epoch, stage barrier) — ordered_grads only
         self._init_control_plane(van=van, netem_local=f"w{spec.slot}",
                                  my_slot=spec.slot)
@@ -503,6 +510,7 @@ class MultiControllerElasticSupervisor:
                  straggler_slow_ms: int = 120,
                  straggler_readmit_after: int = 3,
                  ordered_grads: bool = False,
+                 van_spec: Optional[dict] = None,
                  _takeover_spec: Optional[WorkerSpec] = None):
         from hetu_tpu.ps import van
         if n_workers < 1:
@@ -514,6 +522,28 @@ class MultiControllerElasticSupervisor:
                     f"reachable width (fails at {w})")
         self._van = van
         self._own_van = bool(own_van)
+        if not van_spec and _takeover_spec is not None:
+            # the durable-tier pair is recorded in the spawn configs on
+            # disk, like every other control-plane id
+            van_spec = getattr(_takeover_spec, "van", None) or None
+        # replicated durable tier: weights/staging/blackboard tables
+        # dual-write over a primary+backup van pair; a primary SIGKILL
+        # is a retried transient at every op site (VanFailover), so the
+        # PS-resident model survives the van process itself
+        self._replica = None
+        self._van_spec = dict(van_spec) if van_spec else {}
+        if self._van_spec:
+            if own_van:
+                raise ValueError(
+                    "a replicated durable tier is external by "
+                    "definition: pass own_van=False with van_spec")
+            from hetu_tpu.ps.replica import VanReplica
+            self._replica = VanReplica.from_spec(
+                self._van_spec, bootstrap=_takeover_spec is None)
+            if _takeover_spec is not None:
+                self._replica.refresh()  # unconditional: a stale
+                # cached view must not adopt the dead primary
+            port = self._replica.primary[1]
         if own_van:
             self.port = van.serve(port)
         else:
@@ -579,13 +609,15 @@ class MultiControllerElasticSupervisor:
             # failure after the weights table connected must close it,
             # not leak the van connection for the process's life
             try:
-                self.table = van.RemotePSTable(
-                    "127.0.0.1", self.port, int(features), int(out_dim),
+                from hetu_tpu.ps.replica import open_table
+                self.table = open_table(
+                    self._replica, "127.0.0.1", self.port,
+                    int(features), int(out_dim),
                     table_id=self.spec.weights_table, create=False)
                 self._bb = _mb.attach_blackboard(
                     "127.0.0.1", self.port,
                     table_id=self.spec.membership_table,
-                    n_slots=n_workers)
+                    n_slots=n_workers, replica=self._replica)
                 self.svc = _mb.MembershipService(
                     self._bb, n_workers, lease_s=lease_s,
                     suspect_grace_s=suspect_grace_s,
@@ -626,27 +658,30 @@ class MultiControllerElasticSupervisor:
             step_sleep_s=float(step_sleep_s),
             ctrl_lease_s=float(ctrl_lease_s),
             ordered_grads=bool(ordered_grads),
-            staging_table=staging_table)
+            staging_table=staging_table, van=self._van_spec)
         # everything after van.serve is guarded: a table/blackboard/
         # spawn failure must stop the in-process van server (and close
         # what was created) instead of leaking it for the process's life
         try:
-            self.table = van.RemotePSTable(
-                "127.0.0.1", self.port, int(features), int(out_dim),
-                table_id=weights_table, create=True, init="zeros",
-                optimizer="sgd", lr=float(lr))
+            from hetu_tpu.ps.replica import open_table
+            self.table = open_table(
+                self._replica, "127.0.0.1", self.port, int(features),
+                int(out_dim), table_id=weights_table, create=True,
+                init="zeros", optimizer="sgd", lr=float(lr))
             if ordered_grads:
                 # gradient staging area: one block of `features` rows
                 # per rank, lr=0 SGD so sparse_set writes verbatim (the
                 # blackboard convention) — workers stage here and rank 0
                 # applies to the weights table in rank order
-                self._staging = van.RemotePSTable(
-                    "127.0.0.1", self.port, n_workers * int(features),
-                    int(out_dim), table_id=staging_table, create=True,
+                self._staging = open_table(
+                    self._replica, "127.0.0.1", self.port,
+                    n_workers * int(features), int(out_dim),
+                    table_id=staging_table, create=True,
                     init="zeros", optimizer="sgd", lr=0.0)
             self._bb = _mb.create_blackboard(
                 "127.0.0.1", self.port,
-                table_id=membership_table, n_slots=n_workers)
+                table_id=membership_table, n_slots=n_workers,
+                replica=self._replica)
             self.svc = _mb.MembershipService(
                 self._bb, n_workers, lease_s=lease_s,
                 suspect_grace_s=suspect_grace_s, deaf_ack_s=deaf_ack_s)

@@ -25,10 +25,18 @@ from pathlib import Path
 _REPO = Path(__file__).resolve().parents[2]
 
 _SERVER_SRC = """\
-import sys, time
+import os, sys, time
 sys.path.insert(0, {repo!r})
+# the durable tier's own flight recorder (PR 14 instrumented members/
+# workers/stages; the van was the gap): a SIGKILLed primary's final
+# spans — and the serve instant below — survive on disk for
+# tools/fleet_report.py.  HETU_OBS_STREAM=0 disables like everywhere.
+from hetu_tpu.telemetry import trace
+trace.open_process_stream({trace_dir!r}, "van_p%d" % os.getpid())
 from hetu_tpu.ps import van
 port = van.serve({port})
+trace.instant("van.serve", {{"port": port, "pid": os.getpid()}},
+              cat="van")
 print("READY", port, flush=True)
 time.sleep({lifetime})
 """
@@ -36,12 +44,16 @@ time.sleep({lifetime})
 # a van server that REGISTERS with a scheduler (the postoffice server
 # role) — the rejoin-at-a-new-address path the heartbeat tests exercise
 _REGISTERED_SERVER_SRC = """\
-import sys, time
+import os, sys, time
 sys.path.insert(0, {repo!r})
+from hetu_tpu.telemetry import trace
+trace.open_process_stream({trace_dir!r}, "van_p%d" % os.getpid())
 from hetu_tpu.ps import van
 port, rank = van.serve_and_register("127.0.0.1", {sched_port},
                                     port={port}, rank_hint={rank_hint},
                                     beat_ms={beat_ms})
+trace.instant("van.serve", {{"port": port, "rank": rank,
+                             "pid": os.getpid()}}, cat="van")
 print("READY", port, rank, flush=True)
 time.sleep({lifetime})
 """
@@ -115,7 +127,8 @@ def spawn_shard_server(workdir, port: int, tag: str = "s", *,
     """Start a van server subprocess on ``port``; blocks until READY
     (the server is accepting connections)."""
     return spawn_ready(workdir, f"shard_server_{tag}", _SERVER_SRC,
-                       port=int(port), lifetime=int(lifetime_s))
+                       port=int(port), lifetime=int(lifetime_s),
+                       trace_dir=str(workdir))
 
 
 def spawn_registered_server(workdir, sched_port: int, tag: str = "r", *,
@@ -128,4 +141,5 @@ def spawn_registered_server(workdir, sched_port: int, tag: str = "r", *,
     return spawn_ready(workdir, f"reg_server_{tag}",
                        _REGISTERED_SERVER_SRC, sched_port=int(sched_port),
                        port=int(port), rank_hint=int(rank_hint),
-                       beat_ms=int(beat_ms), lifetime=int(lifetime_s))
+                       beat_ms=int(beat_ms), lifetime=int(lifetime_s),
+                       trace_dir=str(workdir))

@@ -148,8 +148,13 @@ class MemberSpec:
     netem: dict = field(default_factory=dict)
     # the controller-ledger table id, recorded here so a TAKEOVER can
     # find every durable control-plane id from any member's spawn
-    # config on disk; members themselves never read the ledger
+    # config on disk; members themselves never read the ledger.  The
+    # ROW COUNT is geometry, not just capacity: DeltaLedger derives
+    # base/delta region boundaries from it, so a takeover reading with
+    # a different rows value would misparse the delta region — it must
+    # ride the spawn config like the id does
     ledger_table: int = 0
+    ledger_rows: int = 2048
     # fleet observability: non-empty = the member opens a crash-durable
     # span/metric stream (<trace_dir>/member_sN_pPID.trace.jsonl) at
     # startup — the flight recorder a SIGKILL cannot erase.  scrape_s
@@ -157,6 +162,13 @@ class MemberSpec:
     # cadence, not the constructor default
     trace_dir: str = ""
     scrape_s: float = 1.0
+    # replicated durable tier: a ReplicaSpec dict ({"endpoints":
+    # [[h,p],[h,p]], "epoch_table": id, ...}) — non-empty means the
+    # member's blackboard/channel wire runs over the primary+backup van
+    # pair and re-resolves to the promoted endpoint on primary death.
+    # Recorded in the spawn config like every other durable id, so a
+    # controller takeover finds the SAME pair.
+    van: dict = field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -218,6 +230,14 @@ class MemberHarness:
         from hetu_tpu.serve.server import InferenceServer
         self.spec = spec
         self._van = van
+        # the replicated durable tier, when the spawn config names one:
+        # every table/channel this member builds re-resolves to the
+        # promoted endpoint on primary-van death (a VanFailover is a
+        # retried transient at every call site)
+        self.replica = None
+        if spec.van:
+            from hetu_tpu.ps.replica import VanReplica
+            self.replica = VanReplica.from_spec(spec.van)
         # the flight recorder FIRST: every span this process ever
         # records (engine prefill/decode, per-request lifecycle, drain
         # legs) streams to disk line-by-line, so a SIGKILL loses at most
@@ -246,10 +266,15 @@ class MemberHarness:
             failover_grace_s=spec.failover_grace_s)
         self.member = _mb.MembershipClient(
             "127.0.0.1", spec.port, table_id=spec.membership_table,
-            slot=spec.slot, n_slots=spec.n_slots)
+            slot=spec.slot, n_slots=spec.n_slots, replica=self.replica)
         self._stop = threading.Event()
         self._events: queue.Queue = queue.Queue()
         self._migrated: set = set()   # rids handed to a peer (no event)
+        # rid dedup: after a van failover the controller RE-SENDS every
+        # unresolved submit (it cannot know which landed before the
+        # primary died); a rid this member already owns must not be
+        # served twice.  Bounded like _done_log.
+        self._seen_rids: OrderedDict = OrderedDict()
         self._pending_drain = None    # (xfer_id, pairs) awaiting commit
         # completion RECORDS, kept after emission: when a controller
         # dies, whatever sat unread in the old event channel's single
@@ -274,13 +299,14 @@ class MemberHarness:
                     "no controller incarnation on the control row")
             time.sleep(0.02)
         self._ctrl_gen = self.member.ctrl_inc
-        self._in_gen = self._out_gen = self._ctrl_gen
-        self._in = van.BlobChannel(
-            "127.0.0.1", spec.port,
-            _fenced_chan(spec.submit_ch, self._ctrl_gen))
-        self._out = van.BlobChannel(
-            "127.0.0.1", spec.port,
-            _fenced_chan(spec.event_ch, self._ctrl_gen))
+        self._van_gen = self.replica.incarnation if self.replica else 0
+        # generations are (controller incarnation, van incarnation)
+        # pairs: EITHER bump rebinds the command/event channels — a new
+        # controller allocates fresh incarnation-keyed ids, a promoted
+        # van has fresh (empty) channel state at the same ids
+        self._in_gen = self._out_gen = (self._ctrl_gen, self._van_gen)
+        self._in = self._chan(spec.submit_ch, self._ctrl_gen)
+        self._out = self._chan(spec.event_ch, self._ctrl_gen)
         self.member.join(epoch_ack=float(self._epoch_ack))
         self._threads = [
             threading.Thread(target=self._beat_loop, daemon=True),
@@ -294,40 +320,64 @@ class MemberHarness:
     def _emit(self, ev: dict) -> None:
         self._events.put(ev)
 
+    def _chan(self, base: int, ctrl_inc: int):
+        """A control/event blob channel at the CURRENT durable-tier
+        endpoint, keyed by controller incarnation as always."""
+        cid = _fenced_chan(base, ctrl_inc)
+        if self.replica is not None:
+            return self.replica.channel(cid)
+        return self._van.BlobChannel("127.0.0.1", self.spec.port, cid)
+
+    def _mig_chan(self, ch_id: int):
+        if self.replica is not None:
+            return self.replica.channel(ch_id)
+        return self._van.BlobChannel("127.0.0.1", self.spec.port, ch_id)
+
+    def _gen(self) -> tuple:
+        return (self._ctrl_gen, self._van_gen)
+
     def _ctrl_watch_loop(self) -> None:
         """Track the controller lease: the read updates the client's
         fence (``ctrl_inc``) and silence clock; an incarnation bump is
         the rebind signal for the command/event loops, and the observed
         control EPOCH is acked through the heartbeat so deaf-member
-        detection works on the serving plane too."""
+        detection works on the serving plane too.  With a replicated
+        durable tier the same read drives VAN failover: a failed pull
+        runs the replica's promotion dance inside its retry loop, and
+        the observed van incarnation joins the rebind generation."""
         period = max(self.spec.hb_ms, 10) / 1000.0
         while not self._stop.wait(period):
             try:
                 e = self.member.read_control()[0]
             except Exception:
-                continue  # unreadable control row: nothing to react to
-            self._epoch_ack = max(self._epoch_ack, e)
-            if self.member.ctrl_inc > self._ctrl_gen:
-                self._ctrl_gen = self.member.ctrl_inc
+                e = None  # unreadable control row: nothing to react to
+            if e is not None:
+                self._epoch_ack = max(self._epoch_ack, e)
+                if self.member.ctrl_inc > self._ctrl_gen:
+                    self._ctrl_gen = self.member.ctrl_inc
+            if self.replica is not None and \
+                    self.replica.incarnation != self._van_gen:
+                self._van_gen = self.replica.incarnation
 
     def _event_loop(self) -> None:
         seq = 1
         backlog: list = []
         while not self._stop.is_set():
-            if self._out_gen != self._ctrl_gen:
-                # a new controller incarnation owns the fleet: bind its
-                # event channel and RE-ANNOUNCE every completion record
-                # — the dead controller may have resolved none/some of
-                # them (the new one dedups by rid), and whatever sat
-                # unread in the old channel's single slot is gone
-                gen = self._ctrl_gen
+            if self._out_gen != self._gen():
+                # a new controller incarnation owns the fleet (or the
+                # durable tier failed over to the promoted van): bind
+                # the event channel there and RE-ANNOUNCE every
+                # completion record — the dead controller may have
+                # resolved none/some of them (the new one dedups by
+                # rid), whatever sat unread in the old channel's single
+                # slot is gone, and a promoted van starts with EMPTY
+                # channel state at the same ids
+                gen = self._gen()
                 try:
                     self._out.close()
                 except Exception:
                     pass
-                self._out = self._van.BlobChannel(
-                    "127.0.0.1", self.spec.port,
-                    _fenced_chan(self.spec.event_ch, gen))
+                self._out = self._chan(self.spec.event_ch, gen[0])
                 self._out_gen = gen
                 seq = 1
                 backlog = list(self._done_log)
@@ -342,7 +392,7 @@ class MemberHarness:
             payload = json.dumps(ev).encode()
             sent = False
             while not self._stop.is_set() and \
-                    self._out_gen == self._ctrl_gen:
+                    self._out_gen == self._gen():
                 try:
                     # idempotent same-seq resend: a timeout retries the
                     # SAME slot until the controller drains it.
@@ -445,7 +495,7 @@ class MemberHarness:
     def run(self) -> None:
         seq = 1
         while not self._stop.is_set():
-            if self._in_gen != self._ctrl_gen:
+            if self._in_gen != self._gen():
                 # DRAIN the dying incarnation's channel before
                 # switching: the slot is one-deep, and the command
                 # possibly still sitting in it (e.g. the submit the
@@ -456,9 +506,14 @@ class MemberHarness:
                 # fence (they were written by the then-legitimate
                 # controller; a zombie can only reach this window by
                 # racing the one bounded drain, after which the old
-                # channel is never read again).
+                # channel is never read again).  When the VAN
+                # incarnation changed, the old channel lives on a dead
+                # (or fenced) van — nothing to drain, and the
+                # controller re-sends every unresolved submit after its
+                # own rebind, so skip straight to the new endpoint.
+                van_changed = self._in_gen[1] != self._van_gen
                 drain_deadline = time.monotonic() + 5.0
-                while not self._stop.is_set():
+                while not van_changed and not self._stop.is_set():
                     try:
                         # a generous get timeout: 0.2s would conflate
                         # "slot empty" with "slow wire" and drop a
@@ -485,14 +540,12 @@ class MemberHarness:
                             return
                     except Exception:
                         traceback.print_exc()
-                gen = self._ctrl_gen
+                gen = self._gen()
                 try:
                     self._in.close()
                 except Exception:
                     pass
-                self._in = self._van.BlobChannel(
-                    "127.0.0.1", self.spec.port,
-                    _fenced_chan(self.spec.submit_ch, gen))
+                self._in = self._chan(self.spec.submit_ch, gen[0])
                 self._in_gen = gen
                 seq = 1
             try:
@@ -501,6 +554,15 @@ class MemberHarness:
                 continue  # idle poll / netem-partitioned ingress: the
                 # command loop outlives a transiently unreachable wire
             except RuntimeError:
+                if self.replica is not None:
+                    # a dead PRIMARY van surfaces here as rc=-101 at
+                    # the get deadline — with a replicated durable
+                    # tier that is a survivable outage (the watch
+                    # loop promotes/adopts and bumps the van
+                    # generation, and this loop rebinds), NOT a
+                    # shutdown signal
+                    time.sleep(0.05)
+                    continue
                 break  # van gone under us
             seq += 1
             try:
@@ -524,12 +586,23 @@ class MemberHarness:
             return True
         cmd = msg.get("cmd")
         if cmd == "submit":
+            rid = int(msg["rid"])
+            if rid in self._seen_rids:
+                # duplicate delivery (a controller re-send after a van
+                # failover, or an orphan re-route that picked this
+                # member again): already owned — serving it twice would
+                # waste slots, and the original's completion record
+                # answers the controller either way
+                return True
+            self._seen_rids[rid] = True
+            while len(self._seen_rids) > 4096:
+                self._seen_rids.popitem(last=False)
             req = Request(prompt=[int(t) for t in msg["prompt"]],
                           max_tokens=int(msg.get("max_tokens", 16)),
                           eos_id=msg.get("eos_id"),
                           timeout_s=float(msg.get(
                               "timeout_s", self.spec.request_timeout_s)))
-            req.rid = int(msg["rid"])  # controller-global id: completion
+            req.rid = rid  # controller-global id: completion
             # events and cross-process drains correlate on it
             req.tenant = msg.get("tenant")  # rides the migration record
             # too, so an adopter keeps the attribution
@@ -550,19 +623,40 @@ class MemberHarness:
             self._drain_abort(int(msg["xfer"]))
         elif cmd == "netem":
             self._apply_netem(msg)
+        elif cmd == "replay":
+            # the controller lost track of these rids (an event that
+            # died in a dead van's single-slot channel, a listener
+            # rebind race): re-emit any COMPLETED record it names —
+            # in-progress rids simply have no record yet, and the
+            # controller's first-wins dedup absorbs duplicates
+            rids = {int(r) for r in msg.get("rids", ())}
+            for ev in list(self._done_log):
+                if int(ev.get("rid", -1)) in rids:
+                    self._emit(ev)
         elif cmd == "metrics":
             self._emit_metrics()
         elif cmd == "shutdown":
             return False
         return True
 
+    _DURABLE_TIER_METRICS = ("membership.", "van.replica.", "ledger.",
+                             "standby.")
+
     def _emit_metrics(self) -> None:
         """Answer a fleet scrape: ship the FULL registry state (raw
         histogram buckets, not percentiles — the controller's merge is
         bucket-wise) over the event channel, and mirror it into the span
         stream as a black-box record so a later SIGKILL cannot erase
-        the last scraped numbers."""
-        dump = self.scheduler.metrics.registry.dump()
+        the last scraped numbers.  Durable-tier health counters
+        (stale control reads, replication lag/promotions) live in the
+        process-default registry — folded into the same dump so
+        ``fleet_metrics()`` and the Prometheus export cover them."""
+        from hetu_tpu.telemetry import default_registry
+        if self.replica is not None:
+            self.replica.export_lag()  # refresh the lag gauge
+        dump = {k: v for k, v in default_registry.dump().items()
+                if k.startswith(self._DURABLE_TIER_METRICS)}
+        dump.update(self.scheduler.metrics.registry.dump())
         t = trace.get_tracer()
         if t is not None:
             t.metric_dump(dump)
@@ -599,8 +693,7 @@ class MemberHarness:
             try:
                 payload, pairs = _migrate.export_payload(self.scheduler,
                                                          codec=codec)
-                tx = self._van.BlobChannel("127.0.0.1", self.spec.port,
-                                           ch_id)
+                tx = self._mig_chan(ch_id)
                 try:
                     _migrate.send_payload(tx, payload, timeout_s=timeout_s)
                 finally:
@@ -657,8 +750,7 @@ class MemberHarness:
                         {"xfer": int(xfer), "member": int(self.spec.slot),
                          "ci": int(self._ctrl_gen)}, cat="serve") as sp:
             try:
-                rx = self._van.BlobChannel("127.0.0.1", self.spec.port,
-                                           ch_id)
+                rx = self._mig_chan(ch_id)
                 try:
                     got = _migrate.recv_payload(rx, timeout_s=timeout_s)
                 finally:
@@ -672,6 +764,8 @@ class MemberHarness:
                 return
             sp.set("requests", len(reqs))
         for req in reqs:
+            self._seen_rids[req.rid] = True  # adopted = owned: a later
+            # duplicate submit for the rid must not double-serve it
             self._watch(req, tenant=getattr(req, "tenant", None))
         self._emit({"type": "adopted", "xfer": xfer, "n": len(reqs),
                     "slots": len(slot_map)})
@@ -726,13 +820,15 @@ class PoolRequest:
     pool's ``generate``."""
 
     __slots__ = ("rid", "msg", "member", "retries", "tokens", "status",
-                 "ttft_s", "done", "sent")
+                 "ttft_s", "done", "sent", "routed_at")
 
     def __init__(self, rid: int, msg: dict):
         self.rid = rid
         self.msg = msg
         self.member: Optional[int] = None
         self.retries = 0
+        self.routed_at: Optional[float] = None  # monotonic; the
+        # replay-nudge ages unresolved requests from here
         self.tokens: list = []
         self.status: Optional[str] = None
         self.ttft_s = None
@@ -768,7 +864,10 @@ class CrossProcessServingPool:
                  migrate_codec: str = "none",
                  membership_table: Optional[int] = None,
                  ledger_table: Optional[int] = None,
-                 ledger_rows: int = 1024,
+                 # DeltaLedger geometry: half the rows hold the base
+                 # snapshot (state capacity ~= the old snapshot
+                 # ledger's), half the append-only delta region
+                 ledger_rows: int = 2048,
                  deaf_ack_s: Optional[float] = None,
                  metrics: Optional[ServeMetrics] = None,
                  member_env: Optional[dict] = None,
@@ -778,6 +877,7 @@ class CrossProcessServingPool:
                  start_poll: bool = True,
                  telemetry_streams: bool = True,
                  scrape_s: float = 1.0,
+                 van_spec: Optional[dict] = None,
                  _takeover: bool = False):
         from hetu_tpu.ps import van
         if n_members < 1:
@@ -785,6 +885,29 @@ class CrossProcessServingPool:
         migrate_codec = _migrate.check_codec(migrate_codec)
         self._van = van
         self._own_van = own_van
+        # replicated durable tier: `van_spec` (a ReplicaSpec dict)
+        # names a primary+backup van pair — the blackboard and ledger
+        # dual-write synchronously, channels re-resolve to the promoted
+        # endpoint, and a primary-van SIGKILL costs a rebind, not the
+        # fleet
+        self._replica = None
+        self._van_spec = dict(van_spec) if van_spec else {}
+        self._van_gen = 0
+        self._van_rebind_pending = False
+        if self._van_spec:
+            if own_van:
+                raise ValueError(
+                    "a replicated durable tier is external by "
+                    "definition: pass own_van=False with van_spec")
+            from hetu_tpu.ps.replica import VanReplica
+            self._replica = VanReplica.from_spec(
+                self._van_spec, bootstrap=not _takeover)
+            if _takeover:
+                self._replica.refresh()  # unconditional: a stale
+                # cached view must not adopt the dead primary
+            port = self._replica.primary[1]
+            self._van_gen = self._replica.incarnation
+            self._replica.register(self._on_van_failover)
         if own_van:
             self.port = van.serve(port)
         else:
@@ -814,6 +937,11 @@ class CrossProcessServingPool:
         self._poll_lock = threading.Lock()
         self._journal_lock = threading.Lock()
         self._journal_dirty = False
+        self._pending_deltas: list = []  # coalesced route/resolve
+        # records, flushed by the poll loop in ONE append frame
+        self._unrouted: dict = {}  # rid -> routing deadline (parked
+        # while no member is routable — e.g. mid van-failover blind
+        # window; journaled, so they must resolve, not error out)
         self._rid_seq = 0               # journaled: rid space survives
         self._ctrl_seq = 0              # a takeover (no reuse)
         self._requests: dict = {}       # rid -> PoolRequest
@@ -860,6 +988,14 @@ class CrossProcessServingPool:
         self._retired_metrics: dict = {}
         self._last_scrape = 0.0
         self._scrape_busy = threading.Event()
+        # completion-replay nudge: a done event can die in a dead van's
+        # single-slot channel (or a listener rebind race) — the member
+        # keeps the record in its _done_log, so the controller
+        # periodically asks owners to re-emit records for rids it still
+        # sees unresolved.  First-wins dedup makes duplicates free.
+        self._nudge_after_s = 3.0
+        self._last_nudge = 0.0
+        self._nudge_busy = threading.Event()
         self.procs: list = [None] * self.n_members
         self.adopted: dict = {}         # takeover: rid -> PoolRequest
         self.takeover_report: dict = {}
@@ -871,27 +1007,29 @@ class CrossProcessServingPool:
                 self._bb = _mb.attach_blackboard(
                     "127.0.0.1", self.port,
                     table_id=self._membership_table,
-                    n_slots=self.n_members)
+                    n_slots=self.n_members, replica=self._replica)
                 self.svc = _mb.MembershipService(
                     self._bb, self.n_members, lease_s=lease_s,
                     suspect_grace_s=suspect_grace_s,
                     deaf_ack_s=deaf_ack_s)
-                self._ledger = _mb.ControllerLedger(
+                self._ledger = _mb.DeltaLedger(
                     "127.0.0.1", self.port, table_id=self._ledger_table,
-                    rows=self._ledger_rows, create=False)
+                    rows=self._ledger_rows, create=False,
+                    replica=self._replica)
                 self._adopt()
             else:
                 self._bb = _mb.create_blackboard(
                     "127.0.0.1", self.port,
                     table_id=self._membership_table,
-                    n_slots=self.n_members)
+                    n_slots=self.n_members, replica=self._replica)
                 self.svc = _mb.MembershipService(
                     self._bb, self.n_members, lease_s=lease_s,
                     suspect_grace_s=suspect_grace_s,
                     deaf_ack_s=deaf_ack_s)
-                self._ledger = _mb.ControllerLedger(
+                self._ledger = _mb.DeltaLedger(
                     "127.0.0.1", self.port, table_id=self._ledger_table,
-                    rows=self._ledger_rows, create=True)
+                    rows=self._ledger_rows, create=True,
+                    replica=self._replica)
                 # publish the control row BEFORE spawning: members key
                 # their command channels on the incarnation it carries
                 self.svc.publish_control(epoch=1, width=self.n_members,
@@ -945,16 +1083,17 @@ class CrossProcessServingPool:
                    max_retries=max_retries,
                    membership_table=spec.membership_table,
                    ledger_table=spec.ledger_table,
+                   ledger_rows=spec.ledger_rows,
                    deaf_ack_s=deaf_ack_s, metrics=metrics,
                    spawn_timeout_s=spawn_timeout_s,
                    shed=spec.shed, shed_headroom=spec.shed_headroom,
                    telemetry_streams=bool(spec.trace_dir),
-                   scrape_s=spec.scrape_s,
+                   scrape_s=spec.scrape_s, van_spec=spec.van or None,
                    start_poll=start_poll, _takeover=True)
 
     def _adopt(self) -> None:
         got = self._ledger.read()
-        state = (got or {}).get("state") or {}
+        state = self._replay_ledger(got) if got else {}
         with trace.span("ctrl.takeover", cat="ctrl") as sp:
             sp.set("plane", "serving")
             sp.set("incarnation", self.svc.ctrl_incarnation)
@@ -978,6 +1117,10 @@ class CrossProcessServingPool:
                     req = PoolRequest(int(rid_s), dict(rec["msg"]))
                     req.member = rec.get("member")
                     req.sent = req.member is not None
+                    if req.sent:  # nudge-eligible: the member's
+                        # re-announce usually beats the nudge, but a
+                        # lost event must not strand the adoption
+                        req.routed_at = time.monotonic()
                     req.retries = int(rec.get("retries", 0))
                     self._requests[req.rid] = req
                     self.adopted[req.rid] = req
@@ -989,8 +1132,7 @@ class CrossProcessServingPool:
             # wire up every recorded member under the new incarnation
             inc = self.svc.ctrl_incarnation
             for slot, (sub, evb) in sorted(self._ch_bases.items()):
-                ch = self._van.BlobChannel(
-                    "127.0.0.1", self.port, _fenced_chan(sub, inc))
+                ch = self._ctrl_chan(_fenced_chan(sub, inc))
                 with self._lock:
                     old = self._out.get(slot)
                     self._out[slot] = (ch, threading.Lock(), [1])
@@ -1086,7 +1228,11 @@ class CrossProcessServingPool:
             sp.set("drains_orphaned", orphaned)
             sp.set("orphans_rerouted", len(orphans))
         self.metrics.inc("controller_takeovers")
-        self._journal()
+        # the new incarnation opens on a FRESH base: one compaction
+        # subsumes the predecessor's base + deltas (and proves the
+        # mid-compaction takeover safe — a reader only ever sees one
+        # atomic frame or the other)
+        self._compact_ledger()
 
     def wait_adopted(self, timeout_s: float = 120.0) -> dict:
         """Block until every request adopted at takeover resolves;
@@ -1128,8 +1274,9 @@ class CrossProcessServingPool:
             request_timeout_s=self.request_timeout_s, model=self.model,
             shed=self._shed, shed_headroom=self._shed_headroom,
             ledger_table=self._ledger_table,
+            ledger_rows=self._ledger_rows,
             trace_dir=str(self.workdir) if self._telemetry_streams
-            else "", scrape_s=self._scrape_s)
+            else "", scrape_s=self._scrape_s, van=self._van_spec)
         from pathlib import Path
         cfg = Path(self.workdir) / f"member_{slot}_{cid}.json"
         cfg.write_text(spec.to_json())
@@ -1138,8 +1285,7 @@ class CrossProcessServingPool:
                             extra_env=self._member_env,
                             timeout_s=self._spawn_timeout_s)
         self.procs[slot] = proc
-        ch = self._van.BlobChannel(
-            "127.0.0.1", self.port,
+        ch = self._ctrl_chan(
             _fenced_chan(spec.submit_ch, self.svc.ctrl_incarnation))
         with self._lock:
             old = self._out.get(slot)
@@ -1161,9 +1307,87 @@ class CrossProcessServingPool:
         # bases, not the dead slot's old ones (a takeover would
         # otherwise wire this member to channels nobody serves)
         try:
-            self._journal()
+            self._append_ledger([
+                {"c": [slot, spec.submit_ch, spec.event_ch]},
+                {"q": [self._rid_seq, self._ctrl_seq]}])
         except Exception:
             traceback.print_exc()
+
+    def _ctrl_chan(self, channel_id: int):
+        """A control/event blob channel at the CURRENT durable-tier
+        endpoint (the replica's primary when replicated)."""
+        if self._replica is not None:
+            return self._replica.channel(channel_id)
+        return self._van.BlobChannel("127.0.0.1", self.port, channel_id)
+
+    def _on_van_failover(self, replica) -> None:
+        """Replica callback (runs on whichever thread hit the
+        failover): flag only — the poll loop owns the rebind, so
+        channel surgery never runs concurrently with itself."""
+        self._van_rebind_pending = True
+
+    def _van_rebind(self) -> None:
+        """The durable tier failed over: rebind every member control/
+        event channel to the promoted endpoint (same incarnation-keyed
+        ids — the new van has fresh channel state, both sides reset to
+        seq 1) and RE-SEND every unresolved submit (whatever sat in the
+        dead van's single-slot channels died with it; members dedup by
+        rid, so a duplicate is absorbed and a lost one re-delivered).
+        The blackboard and ledger need no rebinding — their tables
+        re-target inside :class:`~hetu_tpu.ps.replica.
+        ReplicatedPSTable`."""
+        if self._replica is None:
+            return
+        self._van_rebind_pending = False
+        self._van_gen = self._replica.incarnation
+        self.port = self._replica.primary[1]
+        with trace.span("ctrl.van_rebind",
+                        {"incarnation": int(self._van_gen)},
+                        cat="ctrl"):
+            inc = self.svc.ctrl_incarnation
+            with self._lock:
+                bases = dict(self._ch_bases)
+            for slot, (sub, evb) in sorted(bases.items()):
+                try:
+                    ch = self._ctrl_chan(_fenced_chan(sub, inc))
+                except Exception:
+                    traceback.print_exc()
+                    continue
+                with self._lock:
+                    old = self._out.get(slot)
+                    self._out[slot] = (ch, threading.Lock(), [1])
+                if old is not None:
+                    try:
+                        old[0].close()
+                    except Exception:
+                        pass
+                self._start_listener(slot, evb)
+            with self._lock:
+                pending = [r for r in self._requests.values()
+                           if not r.done.is_set()]
+            for r in pending:
+                if r.member is not None and r.sent:
+                    try:
+                        self._send(r.member, {"cmd": "submit",
+                                              "rid": r.rid, **r.msg})
+                        r.routed_at = time.monotonic()
+                    except Exception:
+                        # the member did not hear the re-send (its own
+                        # rebind may be lagging): PARK the rid so the
+                        # unrouted sweep re-routes it — a sent+owned
+                        # request is otherwise in nobody's recovery
+                        # scope (the lease never expires for a beating
+                        # member, and the replay nudge only re-emits
+                        # COMPLETED records)
+                        with self._lock:
+                            r.sent = False
+                            self._unrouted.setdefault(
+                                r.rid, time.monotonic() + float(
+                                    r.msg.get("timeout_s",
+                                              self.request_timeout_s)))
+                else:
+                    self._route(r)
+        self.metrics.inc("van_rebinds")
 
     def _start_listener(self, slot: int, event_ch: int) -> None:
         old = self._listeners.get(slot)
@@ -1178,30 +1402,16 @@ class CrossProcessServingPool:
         self._listeners[slot] = (t, stop)
         t.start()
 
-    # ---- the controller ledger (durable RAM) ----
-    def _journal(self) -> None:
-        """Write the controller's recoverable state to the van ledger:
-        one small full snapshot per state change (accept / route /
-        resolve / drain transition).  Everything a takeover cannot
-        re-derive from lease rows or member-side records rides here —
-        rid→member ownership, retry budgets, original request messages,
-        half-open drains, per-slot channel bases, id high-waters.
-
-        ``_journal_lock`` orders snapshot-taking WITH the wire write:
-        without it, two concurrent journals could land out of order and
-        an older snapshot (taken before an accept) could overwrite the
-        newer one that recorded it — exactly the lost-accepted-request
-        hole the accept-before-route journaling exists to close."""
-        with self._journal_lock:
-            self._journal_locked()
-
-    def _journal_locked(self) -> None:
-        # clear the coalesce flag BEFORE the snapshot: a resolve landing
-        # after the snapshot re-marks it and the next sweep flushes —
-        # clearing after the write would swallow that re-mark
-        self._journal_dirty = False
+    # ---- the controller ledger (durable RAM, O(delta) per change) ----
+    def _snapshot(self) -> dict:
+        """The full recoverable state — everything a takeover cannot
+        re-derive from lease rows or member-side records: rid→member
+        ownership, retry budgets, original request messages, half-open
+        drains, per-slot channel bases, id high-waters.  Written only
+        at COMPACTION (amortized); the per-change path appends O(delta)
+        records instead."""
         with self._lock:
-            snap = {
+            return {
                 "rid": self._rid_seq, "cid": self._ctrl_seq,
                 "channels": {str(s): list(b)
                              for s, b in self._ch_bases.items()},
@@ -1220,15 +1430,127 @@ class CrossProcessServingPool:
                 "drains": {str(k): dict(v)
                            for k, v in self._drain_journal.items()},
             }
+
+    def _append_ledger(self, records) -> None:
+        """Synchronously journal delta records (accept / drain / spawn
+        transitions — the load-bearing writes).  A full delta region
+        triggers compaction: the CURRENT state (which already contains
+        everything the records describe — state mutates before it
+        journals) becomes the new base in one atomic frame, and the
+        records are therefore covered without re-append.  The old
+        snapshot ledger's refuse-accepts cliff is gone: sustained
+        accepts cost O(record) bytes each, plus an amortized O(state)
+        compaction."""
+        with self._journal_lock:
+            self._append_records_locked(list(records))
+
+    def _append_records_locked(self, records) -> None:
+        ci = self.svc.ctrl_incarnation
         try:
-            self._ledger.write(snap,
-                               ctrl_inc=self.svc.ctrl_incarnation)
+            try:
+                self._ledger.append(records, ctrl_inc=ci)
+            except _mb.LedgerCompactionNeeded:
+                self._ledger.compact(self._snapshot(), ctrl_inc=ci)
         except _mb.ControllerFenced:
             self._fenced = True
             raise
-        except Exception:
-            self._journal_dirty = True  # nothing landed: stay dirty
-            raise
+
+    def _queue_delta(self, rec: dict) -> None:
+        """Coalesced (route/resolve) records: flushed by the poll loop
+        in one append frame.  Losing them with the controller is safe
+        by the replay's own invariants — an unjournaled owner re-routes
+        and the rid dedup absorbs the duplicate; a lost resolution is
+        recovered from re-announced ``_done_log`` records — only the
+        ACCEPT record is load-bearing for zero loss and stays
+        synchronous."""
+        with self._lock:
+            self._pending_deltas.append(rec)
+            self._journal_dirty = True
+
+    def _journal(self) -> None:
+        """Flush the coalesced delta queue (poll loop / close).  On
+        failure the batch is re-queued AT THE FRONT so per-rid record
+        order survives the retry."""
+        with self._journal_lock:
+            with self._lock:
+                batch = self._pending_deltas
+                self._pending_deltas = []
+                self._journal_dirty = False
+            if not batch:
+                return
+            try:
+                self._append_records_locked(batch)
+            except Exception:
+                with self._lock:
+                    self._pending_deltas = batch + self._pending_deltas
+                    self._journal_dirty = True
+                raise
+
+    def _compact_ledger(self) -> None:
+        """One amortized full-state write: at takeover (a fresh base
+        under the new incarnation) and proactively from the poll loop
+        before the delta region forces it mid-accept."""
+        with self._journal_lock:
+            with self._lock:
+                batch = self._pending_deltas
+                self._pending_deltas = []
+                self._journal_dirty = False
+            # the snapshot subsumes any queued deltas (state mutates
+            # before journaling), so the batch just drops
+            del batch
+            try:
+                self._ledger.compact(self._snapshot(),
+                                     ctrl_inc=self.svc.ctrl_incarnation)
+            except _mb.ControllerFenced:
+                self._fenced = True
+                raise
+
+    @staticmethod
+    def _replay_ledger(got: dict) -> dict:
+        """Base snapshot + delta records → the snapshot-shaped state a
+        takeover adopts.  Every record application is an idempotent
+        upsert, so replay converges whatever the interleaving of
+        coalesced flushes and compactions was."""
+        state = got.get("state") or {}
+        requests = dict(state.get("requests") or {})
+        resolved = OrderedDict(state.get("resolved") or {})
+        drains = dict(state.get("drains") or {})
+        channels = dict(state.get("channels") or {})
+        rid_seq = int(state.get("rid", 0))
+        cid_seq = int(state.get("cid", 0))
+        for d in got.get("deltas") or ():
+            if "a" in d:
+                rid, msg = d["a"]
+                rid_seq = max(rid_seq, int(rid))
+                requests[str(int(rid))] = {"msg": msg, "member": None,
+                                           "retries": 0}
+            elif "o" in d:
+                rid, member, retries = d["o"]
+                rec = requests.get(str(int(rid)))
+                if rec is not None:
+                    rec["member"] = member
+                    rec["retries"] = int(retries)
+            elif "r" in d:
+                rid, status = d["r"]
+                requests.pop(str(int(rid)), None)
+                resolved[str(int(rid))] = status
+            elif "d" in d:
+                xid, rec = d["d"]
+                if rec is None:
+                    drains.pop(str(xid), None)
+                else:
+                    drains[str(xid)] = dict(rec)
+            elif "c" in d:
+                slot, sub, evb = d["c"]
+                channels[str(int(slot))] = [int(sub), int(evb)]
+            elif "q" in d:
+                rid_seq = max(rid_seq, int(d["q"][0]))
+                cid_seq = max(cid_seq, int(d["q"][1]))
+        while len(resolved) > 1024:
+            resolved.popitem(last=False)
+        return {"rid": rid_seq, "cid": cid_seq, "channels": channels,
+                "requests": requests, "resolved": resolved,
+                "drains": drains}
 
     def _wait_joined(self, slots, timeout_s: Optional[float] = None) -> None:
         deadline = time.monotonic() + (timeout_s if timeout_s is not None
@@ -1330,7 +1652,11 @@ class CrossProcessServingPool:
 
     def _event_loop(self, slot: int, event_ch: int,
                     stop: threading.Event) -> None:
-        ch = self._van.BlobChannel("127.0.0.1", self.port, event_ch)
+        try:
+            ch = self._ctrl_chan(event_ch)
+        except Exception:
+            traceback.print_exc()
+            return  # a van-failover rebind restarts this listener
         seq = 1
         try:
             while not (stop.is_set() or self._stop.is_set()):
@@ -1442,6 +1768,40 @@ class CrossProcessServingPool:
         finally:
             self._scrape_busy.clear()
 
+    def _nudge_stale_guarded(self) -> None:
+        """One replay-nudge round (one-shot side thread, like the
+        scrape: a wedged member's channel must never stall the lease
+        sweep).  For every member owning requests unresolved past
+        ``_nudge_after_s``, ask it to re-emit their completion records
+        — a no-op for rids still decoding, a recovery for any done
+        event lost in transit."""
+        try:
+            now = time.monotonic()
+            busy = self._drain_busy_slots()
+            by_slot: dict = {}
+            with self._lock:
+                for r in self._requests.values():
+                    if r.done.is_set() or r.member is None or \
+                            not r.sent or r.routed_at is None or \
+                            now - r.routed_at < self._nudge_after_s:
+                        continue
+                    by_slot.setdefault(r.member, []).append(r.rid)
+            for slot, rids in by_slot.items():
+                if slot in busy or \
+                        self.svc.state_of(slot).state != "alive":
+                    continue
+                try:
+                    self._send(slot, {"cmd": "replay", "rids": rids},
+                               timeout_s=0.5, attempts=1,
+                               observe_rtt=False)
+                    self.metrics.inc("completion_replays_asked")
+                except Exception:
+                    pass  # the lease machinery owns unreachable members
+        except Exception:
+            traceback.print_exc()
+        finally:
+            self._nudge_busy.clear()
+
     def scrape(self, timeout_s: float = 3.0) -> dict:
         """One SYNCHRONOUS scrape: keep asking (under the same
         pending-window discipline as the cadence — a cadence ask
@@ -1511,6 +1871,16 @@ class CrossProcessServingPool:
                        if v.get("type") != "gauge"})
             reg.merge(gauges, prefix=f"m{slot}.")
         reg.merge(self.metrics.registry.dump(), prefix="ctrl.")
+        # the controller's own durable-tier health (ledger append/
+        # compaction bytes, replication lag, promotions observed) lives
+        # in the process-default registry — exported under ctrl. like
+        # the rest of its metrics
+        from hetu_tpu.telemetry import default_registry
+        if self._replica is not None:
+            self._replica.export_lag()
+        reg.merge({k: v for k, v in default_registry.dump().items()
+                   if k.startswith(MemberHarness._DURABLE_TIER_METRICS)},
+                  prefix="ctrl.")
         reg.gauge("fleet.members_reporting",
                   help="member slots with a scraped registry dump"
                   ).set(len(dumps))
@@ -1571,15 +1941,12 @@ class CrossProcessServingPool:
         trace.complete("serve.resolve",
                        t0, {"rid": req.rid, "status": status},
                        cat="serve")
-        # resolution journaling is COALESCED (flushed by the poll loop,
-        # or by the next synchronous accept/route/drain journal): this
-        # write only narrows the duplicate-replay window — a resolution
-        # lost with the controller is recovered from the members'
+        # resolution journaling is COALESCED (flushed by the poll loop
+        # as one multi-record append): losing it with the controller is
+        # safe — a resolution is recovered from the members'
         # re-announced ``_done_log`` records, token-identically — while
-        # the accept/route journals (the zero-loss contract) stay
-        # synchronous.  Journaling every resolve would put a
-        # full-snapshot van RPC on the serving hot path.
-        self._journal_dirty = True
+        # the accept record (the zero-loss contract) stays synchronous.
+        self._queue_delta({"r": [req.rid, status]})
 
     # ---- routing ----
     def _routable(self, exclude=()) -> list:
@@ -1615,16 +1982,20 @@ class CrossProcessServingPool:
                 self._send(slot, {"cmd": "submit", "rid": req.rid,
                                   **req.msg})
                 req.sent = True
+                req.routed_at = time.monotonic()
                 trace.instant("serve.route",
                               {"rid": req.rid, "member": int(slot)},
                               cat="serve")
                 # ownership journaling is coalesced like resolutions:
-                # by the snapshot's own invariant, losing it is safe —
+                # by the replay's own invariant, losing it is safe —
                 # an unjournaled owner reads member=None, the takeover
                 # re-routes, and the duplicate submit is absorbed by
                 # the rid dedup token-identically.  Only the ACCEPT
-                # journal is load-bearing for zero loss.
-                self._journal_dirty = True
+                # record is load-bearing for zero loss.
+                self._queue_delta({"o": [req.rid, int(slot),
+                                         req.retries]})
+                with self._lock:
+                    self._unrouted.pop(req.rid, None)
                 return
             except Exception:
                 with self._lock:
@@ -1632,8 +2003,17 @@ class CrossProcessServingPool:
                         self._inflight.get(slot, 1) - 1, 0)
                     req.member = None
                 exclude.add(slot)
-        self._resolve(req, "error")
-        self.metrics.inc("requests_rejected_no_member")
+        # no routable member RIGHT NOW (every member suspect during a
+        # durable-tier failover's blind window, a mid-rebind wire, the
+        # whole fleet draining): the request is JOURNALED, so it must
+        # resolve, not error out — park it and let the poll loop
+        # re-route once somebody is routable again.  Only outliving
+        # its own deadline turns the outage into an error.
+        with self._lock:
+            if req.rid not in self._unrouted:
+                self._unrouted[req.rid] = time.monotonic() + float(
+                    req.msg.get("timeout_s", self.request_timeout_s))
+        self.metrics.inc("requests_routing_deferred")
 
     def submit(self, prompt, *, max_tokens: int = 16, eos_id=None,
                timeout_s: Optional[float] = None,
@@ -1657,13 +2037,15 @@ class CrossProcessServingPool:
         with trace.span("serve.submit", attrs, cat="serve"):
             with self._lock:
                 self._requests[rid] = req
-            # accepted ⇒ durable, BEFORE routing: once this journal
-            # write lands, a controller death at ANY later point still
+            # accepted ⇒ durable, BEFORE routing: once this ONE delta
+            # record lands, a controller death at ANY later point still
             # resolves the request (the zero-lost-accepted-requests
-            # contract).  A journal failure therefore REFUSES the
-            # accept.
+            # contract).  O(record) bytes — not a full snapshot — so
+            # sustained accepts never hit a capacity cliff (a full
+            # delta region compacts and continues).  A journal failure
+            # still REFUSES the accept.
             try:
-                self._journal()
+                self._append_ledger([{"a": [rid, msg]}])
             except Exception:
                 with self._lock:
                     self._requests.pop(rid, None)
@@ -1686,12 +2068,51 @@ class CrossProcessServingPool:
                 "tokens": list(req.tokens), "ttft_s": req.ttft_s}
 
     # ---- membership / failover ----
+    def _sweep_unrouted(self) -> None:
+        """Re-route parked requests once somebody is routable again;
+        only a request that outlived its own deadline errors out."""
+        with self._lock:
+            items = list(self._unrouted.items())
+        if not items:
+            return
+        now = time.monotonic()
+        for rid, deadline in items:
+            with self._lock:
+                req = self._requests.get(rid)
+            if req is None or req.done.is_set():
+                with self._lock:
+                    self._unrouted.pop(rid, None)
+                continue
+            if now > deadline:
+                with self._lock:
+                    self._unrouted.pop(rid, None)
+                self._resolve(req, "error")
+                self.metrics.inc("requests_rejected_no_member")
+            elif self._routable():
+                # the entry is NOT popped first: a failed route re-park
+                # (setdefault) must keep the ORIGINAL deadline, or a
+                # request could outlive its own budget forever while
+                # members are alive but unreachable
+                self._route(req)
+
     def _poll_loop(self, poll_s: float) -> None:
         while not self._stop.wait(poll_s):
             try:
                 self.poll()
             except Exception:
                 traceback.print_exc()  # the poll must survive anything
+            # the durable tier failed over: rebind channels + re-send
+            # unresolved submits (the poll loop owns channel surgery)
+            if self._van_rebind_pending and not self._fenced:
+                try:
+                    self._van_rebind()
+                except Exception:
+                    traceback.print_exc()
+                    self._van_rebind_pending = True  # retry next sweep
+            try:
+                self._sweep_unrouted()
+            except Exception:
+                traceback.print_exc()
             # fleet scrape on its cadence: triggered here (the poll loop
             # is the controller's one clock) but RUN in a one-shot side
             # thread — a member whose control channel is wedged must
@@ -1703,12 +2124,29 @@ class CrossProcessServingPool:
                 self._scrape_busy.set()
                 threading.Thread(target=self._scrape_guarded,
                                  daemon=True).start()
+            if not self._fenced and \
+                    time.monotonic() - self._last_nudge >= \
+                    self._nudge_after_s and \
+                    not self._nudge_busy.is_set():
+                self._last_nudge = time.monotonic()
+                self._nudge_busy.set()
+                threading.Thread(target=self._nudge_stale_guarded,
+                                 daemon=True).start()
             if self._journal_dirty and not self._fenced:
                 try:
                     self._journal()
                 except Exception:
                     traceback.print_exc()  # stays dirty; retried next
                     # sweep
+            # proactive compaction: one amortized O(state) frame on the
+            # poll thread beats paying it inside an accept
+            if not self._fenced and self._ledger.needs_compaction(
+                    margin_rows=max(
+                        self._ledger.delta_capacity_rows() // 4, 16)):
+                try:
+                    self._compact_ledger()
+                except Exception:
+                    traceback.print_exc()
 
     def poll(self) -> int:
         """One membership sweep; returns how many members failed over.
@@ -1865,12 +2303,11 @@ class CrossProcessServingPool:
         ch = _fenced_chan(CROSSHOST_MIGRATE_BASE + next(_MIG_SEQ),
                           self.svc.ctrl_incarnation)
         try:
+            rec = {"source": int(slot), "target": int(target), "ch": ch,
+                   "codec": codec, "state": "begin", "close": bool(close)}
             with self._lock:
-                self._drain_journal[str(xid)] = {
-                    "source": int(slot), "target": int(target), "ch": ch,
-                    "codec": codec, "state": "begin",
-                    "close": bool(close)}
-            self._journal()
+                self._drain_journal[str(xid)] = rec
+            self._append_ledger([{"d": [xid, rec]}])
             self._send(target, {"cmd": "recv_migration", "ch": ch,
                                 "xfer": xid, "timeout_s": timeout_s})
             self._await_xfer(xfer, ("mig_ready",), timeout_s)
@@ -1879,7 +2316,14 @@ class CrossProcessServingPool:
         except Exception:
             self._xfers.pop(xid, None)
             with self._lock:
-                self._drain_journal.pop(str(xid), None)
+                dropped = self._drain_journal.pop(str(xid),
+                                                  None) is not None
+            if dropped:
+                try:  # journal the rollback too (best effort — a
+                    # takeover aborting a long-dropped record is benign)
+                    self._append_ledger([{"d": [xid, None]}])
+                except Exception:
+                    traceback.print_exc()
             raise
         return xid, xfer
 
@@ -1954,7 +2398,7 @@ class CrossProcessServingPool:
                                   "exit": bool(close)})
                 with self._lock:
                     self._drain_journal.pop(str(xid), None)
-                self._journal()
+                self._append_ledger([{"d": [xid, None]}])
                 sp.set("requests", n)
         except Exception:
             with self._lock:
@@ -1962,7 +2406,8 @@ class CrossProcessServingPool:
                 if xid is not None:
                     self._drain_journal.pop(str(xid), None)
             try:
-                self._journal()
+                if xid is not None:
+                    self._append_ledger([{"d": [xid, None]}])
             except Exception:
                 traceback.print_exc()
             raise
@@ -2053,6 +2498,8 @@ class CrossProcessServingPool:
     # ---- lifecycle ----
     def close(self, timeout_s: float = 10.0) -> None:
         self._stop.set()
+        if self._replica is not None:
+            self._replica.unregister(self._on_van_failover)
         t = getattr(self, "_poll_thread", None)
         if t is not None:
             t.join(timeout_s)
@@ -2158,6 +2605,7 @@ def controller_main(config_path: str) -> int:
         suspect_grace_s=float(cfg.get("suspect_grace_s", 0.5)),
         request_timeout_s=float(cfg.get("request_timeout_s", 120.0)),
         deaf_ack_s=cfg.get("deaf_ack_s"),
+        van_spec=cfg.get("van"),
         member_env={"JAX_PLATFORMS": "cpu"})
     print("READY", flush=True)
     try:

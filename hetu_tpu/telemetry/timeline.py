@@ -108,6 +108,14 @@ RECOVERY_FOR = {
     # aborts, re-routes / exact resume) is complete
     "controller_kill": ("ctrl.takeover",),
     "controller_suspend": ("ctrl.takeover",),
+    # durable tier (ps/replica.py): a killed primary van is answered by
+    # the backup's promotion (epoch-row CAS; the span runs from the
+    # first failed-op detection to adoption).  A suspended van is
+    # answered the same way — and when the suspension is shorter than
+    # the promote grace, no promotion happens and the fault is
+    # legitimately unpaired (the ops just retried through it).
+    "van_kill": ("van.promote",),
+    "van_suspend": ("van.promote",),
 }
 
 # kinds whose RECOVERY_FOR tuple is a strict preference order: the first

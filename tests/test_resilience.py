@@ -193,6 +193,57 @@ def test_schedule_controller_fault_kinds_draw_after_everything():
         grown.to_json()
 
 
+def test_schedule_van_fault_kinds_draw_after_everything():
+    """SIXTH extension of the frozen-bytes contract (ISSUE 15): the
+    durable-tier kinds (van_kill/van_suspend) must draw from the rng
+    AFTER every pre-existing kind — including the control-plane kinds
+    PR 12 added — so every recorded chaos seed still replays
+    byte-for-byte."""
+    old = dict(steps=50, seed=7, van_errors=2, kill_shards=1, n_shards=2,
+               serve_preempts=1, n_members=2, member_kills=1,
+               member_suspends=1, worker_proc_kills=1, n_workers=3,
+               netem_partitions=1, netem_degrades=1, stragglers=1,
+               stage_kills=1, stage_slows=1, n_stages=3,
+               controller_kills=1, controller_suspends=1,
+               n_controllers=1)
+    base = FaultSchedule.generate(**old)
+    van_kinds = ("van_kill", "van_suspend")
+    grown = FaultSchedule.generate(**old, van_kills=1, van_suspends=1,
+                                   van_suspend_s=2.5, n_vans=2)
+    old_events = [e for e in grown.events if e.kind not in van_kinds]
+    assert old_events == base.events
+    new = {e.kind: e for e in grown.events if e.kind in van_kinds}
+    assert sorted(new) == sorted(van_kinds)
+    assert new["van_suspend"].arg2 == 2.5
+    assert 0 <= new["van_kill"].arg < 2
+    assert FaultSchedule.from_json(grown.to_json()).to_json() == \
+        grown.to_json()
+
+
+def test_van_fault_timeline_pairing_and_report_coverage():
+    """RECOVERY_FOR satellite: van_kill/van_suspend pair with the
+    backup's van.promote span, and report() covers them."""
+    from hetu_tpu.telemetry import timeline
+    evs = [
+        {"ph": "i", "name": "fault.van_kill", "ts": 100.0, "seq": 0,
+         "args": {"kind": "van_kill", "step": 2}},
+        {"ph": "i", "name": "fault.van_suspend", "ts": 500.0, "seq": 1,
+         "args": {"kind": "van_suspend", "step": 5}},
+        {"ph": "X", "name": "van.promote", "ts": 180.0, "dur": 60.0,
+         "seq": 2, "args": {"incarnation": 2, "won": True}},
+        {"ph": "X", "name": "van.promote", "ts": 620.0, "dur": 40.0,
+         "seq": 3, "args": {"incarnation": 3, "won": True}},
+    ]
+    pairs = timeline.correlate(evs)
+    by = {p.kind: p for p in pairs}
+    assert by["van_kill"].paired
+    assert by["van_kill"].recovery_name == "van.promote"
+    assert by["van_suspend"].paired
+    rep = timeline.report(pairs)
+    for kind in ("van_kill", "van_suspend"):
+        assert rep[kind]["injected"] == 1 and rep[kind]["paired"] == 1
+
+
 def test_schedule_at_and_validation():
     s = FaultSchedule([FaultEvent(3, "nan_grad"), FaultEvent(3, "van_error"),
                        FaultEvent(5, "preempt")])

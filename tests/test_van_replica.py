@@ -1,0 +1,217 @@
+"""Replicated durable tier: dual-write, CAS promotion, fencing
+(ISSUE 15).
+
+Fast lane (one IN-PROCESS van as the survivor; the dead primary is an
+unused port): the promotion CAS race — N concurrent claimants, exactly
+one winner per round, x50 — and the standby-controller claim race on
+the blackboard's controller row.  Process-spawning coverage (real
+primary SIGKILL/SIGSTOP mid-traffic, dual-write parity across real
+vans) lives in tests/test_vanchaos.py.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import available
+from hetu_tpu.ps import membership as mb
+from hetu_tpu.resilience.standby import StandbyController
+
+pytestmark = pytest.mark.vanchaos
+
+needs_lib = pytest.mark.skipif(not available(),
+                               reason="native hetu_ps lib not built")
+
+
+@pytest.fixture(scope="module")
+def inproc_van():
+    from hetu_tpu.ps import van
+    if not available():
+        yield None
+        return
+    port = van.serve(0)
+    yield port
+    van.stop()
+
+
+def _replica_pair(port, *, dead_port=1):
+    """A replica whose PRIMARY endpoint is dead (an unused port) and
+    whose backup is the live in-process van — the post-mortem moment a
+    promotion race starts from."""
+    from hetu_tpu.ps.replica import ReplicaSpec, VanReplica
+    spec = ReplicaSpec(
+        endpoints=[["127.0.0.1", int(dead_port)], ["127.0.0.1", port]],
+        epoch_table=mb.fresh_table_id(), promote_after_s=0.05,
+        rcv_timeout_s=1.0)
+    return spec
+
+
+def _seed_epoch(port, spec, inc=1, primary=0):
+    from hetu_tpu.ps.replica import E_INC, E_PRIMARY, EPOCH_DIM
+    from hetu_tpu.ps.van import RemotePSTable
+    t = RemotePSTable("127.0.0.1", port, 1, EPOCH_DIM,
+                      table_id=spec.epoch_table, create=True,
+                      init="zeros", optimizer="sgd", lr=0.0)
+    row = np.zeros((1, EPOCH_DIM), np.float32)
+    row[0, E_INC] = inc
+    row[0, E_PRIMARY] = primary
+    t.sparse_set([0], row)
+    t.close()
+
+
+@needs_lib
+def test_promotion_race_exactly_one_winner_x50(inproc_van):
+    """Two claimants race the promotion CAS x50: exactly one swap lands
+    per round, the loser ADOPTS the winner's incarnation from the same
+    round trip, and both end on the same (incarnation, primary)."""
+    from hetu_tpu.ps.replica import VanReplica
+    port = inproc_van
+    for rnd in range(50):
+        spec = _replica_pair(port)
+        _seed_epoch(port, spec, inc=1, primary=0)
+        reps = []
+        for _ in range(2):
+            r = VanReplica(spec)  # direct construction: each claimant
+            # gets its OWN view (the .get() cache would share state)
+            r.incarnation, r.primary_idx = 1, 0
+            reps.append(r)
+        wins = []
+        barrier = threading.Barrier(2)
+
+        def claim(r):
+            barrier.wait()
+            changed = r.promote()
+            wins.append((changed, r.incarnation, r.primary_idx))
+
+        ts = [threading.Thread(target=claim, args=(r,)) for r in reps]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert len(wins) == 2, rnd
+        # both converged to the same promoted state (the exactly-one-
+        # swap property is asserted row-side in the 4-claimant test:
+        # the incarnation advances exactly ONE step per race)
+        assert all(w[1] == 2 and w[2] == 1 for w in wins), (rnd, wins)
+
+
+@needs_lib
+def test_promotion_race_single_winner_counted(inproc_van):
+    """The countable version of the race: N=4 claimants, one round,
+    exactly one CAS swap lands (asserted via the van-side row — the
+    incarnation moved exactly one step despite 4 claims)."""
+    from hetu_tpu.ps.replica import E_INC, EPOCH_DIM, VanReplica
+    from hetu_tpu.ps.van import RemotePSTable
+    port = inproc_van
+    spec = _replica_pair(port)
+    _seed_epoch(port, spec, inc=7, primary=0)
+    reps = []
+    for _ in range(4):
+        r = VanReplica(spec)
+        r.incarnation, r.primary_idx = 7, 0
+        reps.append(r)
+    barrier = threading.Barrier(4)
+    outcomes = []
+
+    def claim(r):
+        barrier.wait()
+        outcomes.append(r.promote())
+
+    ts = [threading.Thread(target=claim, args=(r,)) for r in reps]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    # every claimant's view converged; the row advanced EXACTLY one
+    # step (7 -> 8): a lost CAS never re-claims higher
+    t = RemotePSTable("127.0.0.1", port, 1, EPOCH_DIM,
+                      table_id=spec.epoch_table, create=False)
+    assert int(t.sparse_pull([0])[0][E_INC]) == 8
+    t.close()
+    assert all(r.incarnation == 8 and r.primary_idx == 1 for r in reps)
+
+
+# ---------------------------------------------------------------------------
+# standby-controller claim (the controller-row CAS, single-shot)
+# ---------------------------------------------------------------------------
+
+def _blackboard(port, n_slots=2):
+    tid = mb.fresh_table_id()
+    return mb.create_blackboard("127.0.0.1", port, table_id=tid,
+                                n_slots=n_slots), tid
+
+
+@needs_lib
+def test_two_standbys_exactly_one_promotes_x50(inproc_van):
+    """The acceptance race: two standbys watching one silent controller
+    row claim concurrently, x50 — exactly one wins each round, the
+    loser reads the winner's incarnation and stands down FENCED."""
+    port = inproc_van
+    for rnd in range(50):
+        bb, tid = _blackboard(port)
+        svc = mb.MembershipService(bb, 2, lease_s=10.0,
+                                   suspect_grace_s=10.0)
+        base_inc = svc.ctrl_incarnation
+        sbs = [StandbyController(plane="serving", n_slots=2,
+                                 lease_bound_s=0.0, table=bb,
+                                 name=f"sb{i}") for i in range(2)]
+        for sb in sbs:
+            sb.observe()
+            assert sb.ctrl_inc == base_inc
+        results = []
+        barrier = threading.Barrier(2)
+
+        def claim(sb):
+            barrier.wait()
+            results.append(sb.try_claim())
+
+        ts = [threading.Thread(target=claim, args=(sb,)) for sb in sbs]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert sorted(results) == [False, True], (rnd, results)
+        # the loser adopted the winner's incarnation (fenced view),
+        # and the row advanced exactly one step
+        assert all(sb.ctrl_inc == base_inc + 1 for sb in sbs), rnd
+        bb.close()
+
+
+@needs_lib
+def test_standby_watches_silence_then_claims(inproc_van):
+    """End-to-end watch loop against a real blackboard: a beating
+    controller holds the standby off; silence past the bound promotes
+    exactly once (claim-only — plane takeover is exercised in
+    test_vanchaos.py with real processes)."""
+    port = inproc_van
+    bb, tid = _blackboard(port)
+    svc = mb.MembershipService(bb, 2, lease_s=10.0,
+                               suspect_grace_s=10.0)
+    sb = StandbyController(plane="serving", n_slots=2,
+                           lease_bound_s=0.3, poll_s=0.02, table=bb)
+    # controller beating: no claim
+    import time
+    deadline = time.monotonic() + 0.6
+    while time.monotonic() < deadline:
+        svc.poll()  # beats the controller row
+        assert sb.run_once() is None
+        time.sleep(0.02)
+    inc_before = sb.ctrl_inc
+    # silence: the standby must claim (monkeypatch the takeover away —
+    # this is the claim-only lane)
+    sb._invoke_takeover = lambda: "adopted-sentinel"
+    out = sb.watch(timeout_s=10.0)
+    assert out == "promoted"
+    assert sb.ctrl_inc == inc_before + 1
+    assert sb.adopted == "adopted-sentinel"
+    # the claim is visible van-side: a zombie service poll now fences
+    with pytest.raises(mb.ControllerFenced):
+        svc.poll()
+        svc.publish_control(epoch=2, width=2, alive_mask=3)
+    bb.close()
+
+
+def test_standby_rejects_unknown_plane():
+    with pytest.raises(ValueError, match="plane"):
+        StandbyController(plane="nope", n_slots=1, table=object())
