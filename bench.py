@@ -2660,6 +2660,168 @@ def bench_obs():
     })
 
 
+def bench_autoscale():
+    """Traffic plane headline: a seeded 10x diurnal spike (two tenants,
+    the low-SLO one also bursting) replayed OPEN-LOOP against a real
+    cross-process pool of paged members, with measured-load autoscaling
+    on vs off.
+
+    Off arm: a fixed fleet at ``min_members`` rides out the spike on
+    admission shedding alone.  On arm: the same trace, same starting
+    fleet, but an :class:`~hetu_tpu.traffic.autoscale.Autoscaler` reads
+    queue depth / shed rate / per-tenant windowed TTFT p99 from
+    ``fleet_metrics()`` and revives parked slots into the spike, then
+    drains them back (zero-re-prefill ``drain_member``) as the diurnal
+    curve comes down.  Headline: sustained ok-QPS ratio (on / off);
+    the extras carry per-tenant p99 TTFT and shed rates for both arms.
+
+    Contracts asserted, not just reported: the on arm scales up AND
+    back down (>=1 spawn, >=1 drain); EVERY accepted request resolves
+    terminally with no 'error' (zero loss across every scale-down
+    drain); the high-SLO tenant's p99 TTFT stays inside its budget on
+    the on arm while the bursting low-SLO tenant absorbs the shed."""
+    import os
+    import tempfile
+
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+    from hetu_tpu.traffic import (AutoscalePolicy, Autoscaler, TenantSpec,
+                                  TraceSpec, llm_submitter, replay,
+                                  synthesize)
+
+    smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
+    if smoke:
+        MINM, MAXM, DUR, QPS, GEN = 1, 2, 6.0, 3.0, 6
+    else:
+        MINM, MAXM, DUR, QPS, GEN = 2, 4, 16.0, 6.0, 8
+    GOLD_SLO = 2.5   # TTFT p99 budget (s) for the high-SLO tenant
+    model_spec = {"vocab_size": 97, "hidden_size": 64, "num_layers": 2,
+                  "num_heads": 4, "ffn_size": 128, "max_position": 64,
+                  "num_slots": 4, "max_len": 48, "min_bucket": 8,
+                  "seed": 0, "engine": "paged", "page_size": 8}
+    slo_classes = {
+        "gold": {"priority": 2, "weight": 4.0, "ttft_slo_s": GOLD_SLO},
+        "bronze": {"priority": 0, "weight": 1.0, "ttft_slo_s": None},
+    }
+    spec = TraceSpec(
+        seed=0, duration_s=DUR, base_qps=QPS, diurnal_peak_x=10.0,
+        vocab=89, max_prompt_len=6,
+        tenants=[
+            TenantSpec(name="gold", share=0.3, slo="gold",
+                       deadline_lo_s=8.0, deadline_hi_s=12.0,
+                       max_tokens=GEN),
+            TenantSpec(name="bronze", share=0.7, slo="bronze",
+                       deadline_lo_s=1.0, deadline_hi_s=2.5,
+                       burst_x=3.0, burst_on_s=1.5, burst_off_s=2.0,
+                       max_tokens=GEN),
+        ])
+    trace_j = synthesize(spec)
+
+    def run_arm(wd, *, autoscaling):
+        xpool = CrossProcessServingPool(
+            MAXM, workdir=wd, model=model_spec, request_timeout_s=300.0,
+            shed=True, slo_classes=slo_classes, scrape_s=0.25,
+            member_env={"JAX_PLATFORMS": "cpu"})
+        scaler = None
+        try:
+            # both arms START at min_members; the parked slots are the
+            # capacity only the autoscaler can reach
+            for s in range(MINM, MAXM):
+                xpool.drain_member(s, close=True)
+            if autoscaling:
+                scaler = Autoscaler(
+                    xpool,
+                    AutoscalePolicy(
+                        min_members=MINM, max_members=MAXM,
+                        interval_s=0.3, queue_high=2.0, queue_low=0.5,
+                        shed_high=0.02, shed_low=0.005,
+                        up_ticks=2, down_ticks=4,
+                        up_cooldown_s=1.0, down_cooldown_s=2.0),
+                    ttft_slos={"gold": GOLD_SLO},
+                    active=set(range(MINM)))
+                scaler.start()
+            t0 = time.perf_counter()
+            issued = replay(trace_j, llm_submitter(xpool))
+            handles = [(ev, h) for ev, h in issued
+                       if not isinstance(h, Exception)]
+            for _, h in handles:
+                h.done.wait(120.0)
+            wall = time.perf_counter() - t0
+            if scaler is not None:
+                # calm tail: give the loop the consecutive idle ticks +
+                # cooldown a scale-down needs (load is over; this is
+                # where the fleet should shrink back)
+                deadline = time.monotonic() + (20.0 if smoke else 40.0)
+                while scaler.scale_downs < 1 and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.2)
+                scaler.stop()
+            stats = {"wall_s": wall, "issued": len(issued),
+                     "submit_errors": len(issued) - len(handles),
+                     "unresolved": sum(1 for _, h in handles
+                                       if not h.done.is_set())}
+            per_tenant = {}
+            for ev, h in handles:
+                t = per_tenant.setdefault(
+                    ev["tenant"], {"ok": 0, "shed": 0, "timeout": 0,
+                                   "error": 0, "other": 0, "ttft": []})
+                st = h.status or "other"
+                t[st if st in t else "other"] += 1
+                if st == "ok" and h.ttft_s is not None:
+                    t["ttft"].append(float(h.ttft_s))
+            for t in per_tenant.values():
+                tt = sorted(t.pop("ttft"))
+                t["ttft_p99_s"] = round(
+                    tt[min(int(0.99 * len(tt)), len(tt) - 1)], 4) \
+                    if tt else None
+                n = t["ok"] + t["shed"] + t["timeout"] + t["error"] \
+                    + t["other"]
+                t["shed_rate"] = round(t["shed"] / n, 4) if n else 0.0
+            stats["tenants"] = per_tenant
+            stats["ok"] = sum(t["ok"] for t in per_tenant.values())
+            stats["qps"] = round(stats["ok"] / wall, 3)
+            if scaler is not None:
+                stats["scale_ups"] = scaler.scale_ups
+                stats["scale_downs"] = scaler.scale_downs
+                stats["decisions"] = len(scaler.decisions)
+            return stats
+        finally:
+            if scaler is not None:
+                scaler.stop()
+            xpool.close()
+
+    with tempfile.TemporaryDirectory(prefix="bench_autoscale_off_") as wd:
+        off = run_arm(wd, autoscaling=False)
+    with tempfile.TemporaryDirectory(prefix="bench_autoscale_on_") as wd:
+        on = run_arm(wd, autoscaling=True)
+
+    # the contracts the traffic plane exists to hold
+    for arm, name in ((off, "off"), (on, "on")):
+        assert arm["unresolved"] == 0, (name, arm)  # zero lost accepts
+        errs = sum(t["error"] + t["other"]
+                   for t in arm["tenants"].values())
+        assert errs == 0, (name, arm)
+    assert on["scale_ups"] >= 1 and on["scale_downs"] >= 1, on
+    gold_p99 = on["tenants"].get("gold", {}).get("ttft_p99_s")
+    assert gold_p99 is not None and gold_p99 <= GOLD_SLO, on
+    gold_shed = on["tenants"].get("gold", {}).get("shed_rate", 0.0)
+    bronze_shed = on["tenants"].get("bronze", {}).get("shed_rate", 0.0)
+    assert gold_shed <= bronze_shed, on  # the burster absorbs the shed
+
+    _emit({
+        "metric": "autoscale_qps_gain_x",
+        "value": round(on["qps"] / max(off["qps"], 1e-9), 3),
+        "unit": "x_sustained_ok_qps_vs_fixed_min_fleet",
+        "extra": {
+            "spike": {"peak_x": 10.0, "duration_s": DUR,
+                      "base_qps": QPS, "seed": 0},
+            "fleet": {"min_members": MINM, "max_members": MAXM,
+                      "engine": "paged"},
+            "on": on, "off": off,
+            "gold_ttft_slo_s": GOLD_SLO,
+        },
+    })
+
+
 _METRIC_BY_CMD = {
     "gpt": "gpt2s_bf16_train_mfu_1chip",
     "gpt_sweep": "gpt_config_sweep_best_mfu_1chip",
@@ -2680,6 +2842,7 @@ _METRIC_BY_CMD = {
     "ctrlchaos": "ctrlchaos_takeover_p50_s",
     "vanchaos": "vanchaos_promote_p50_s",
     "obs": "obs_stream_scrape_overhead_pct",
+    "autoscale": "autoscale_qps_gain_x",
 }
 
 
@@ -2726,6 +2889,7 @@ def main():
      "ctrlchaos": bench_ctrlchaos,
      "vanchaos": bench_vanchaos,
      "obs": bench_obs,
+     "autoscale": bench_autoscale,
      "telemetry": bench_telemetry}.get(cmd, bench_gpt)()
 
 

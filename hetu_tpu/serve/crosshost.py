@@ -142,6 +142,10 @@ class MemberSpec:
     # queueing into collapse
     shed: bool = False
     shed_headroom: float = 1.0
+    # per-tenant SLO classes forwarded into the member scheduler
+    # (serve/scheduler.py): {name: {"priority": int, "weight": float,
+    # "ttft_slo_s": float|None}} — empty keeps pure FIFO admission
+    slo_classes: dict = field(default_factory=dict)
     # netem link emulation applied at process start: {"seed": int,
     # "links": [[direction, policy_dict], ...]} — the static half; the
     # dynamic half arrives over the wire as a "netem" command
@@ -188,11 +192,17 @@ DEFAULT_MODEL = {
 def build_engine(model_spec: dict):
     """Deterministic engine construction shared by member processes and
     in-test reference engines: same spec → same weights everywhere, the
-    property that makes cross-process failover token-exact."""
+    property that makes cross-process failover token-exact.
+
+    ``{"engine": "paged"}`` in the spec builds a
+    :class:`~hetu_tpu.serve.engine.PagedServeEngine` (page size via
+    ``"page_size"``, pool size via ``"num_pages"``) instead of the slot
+    engine — same weights, same wire; migration between the two is the
+    cross-allocator path serve/migrate.py already supports."""
     import jax
 
     from hetu_tpu.models.gpt import GPTConfig, GPTModel
-    from hetu_tpu.serve.engine import ServeEngine
+    from hetu_tpu.serve.engine import PagedServeEngine, ServeEngine
     spec = {**DEFAULT_MODEL, **(model_spec or {})}
     cfg = GPTConfig(
         vocab_size=int(spec["vocab_size"]),
@@ -203,6 +213,14 @@ def build_engine(model_spec: dict):
         max_position=int(spec["max_position"]), dropout_rate=0.0)
     model = GPTModel(cfg)
     variables = model.init(jax.random.PRNGKey(int(spec["seed"])))
+    if spec.get("engine") == "paged":
+        num_pages = spec.get("num_pages")
+        return model, variables, PagedServeEngine(
+            model, variables, num_slots=int(spec["num_slots"]),
+            max_len=int(spec["max_len"]),
+            page_size=int(spec.get("page_size", 8)),
+            num_pages=None if num_pages is None else int(num_pages),
+            min_bucket=int(spec["min_bucket"]))
     return model, variables, ServeEngine(
         model, variables, num_slots=int(spec["num_slots"]),
         max_len=int(spec["max_len"]), min_bucket=int(spec["min_bucket"]))
@@ -247,7 +265,8 @@ class MemberHarness:
                 spec.trace_dir, f"member_s{spec.slot}_p{os.getpid()}")
         _, _, engine = build_engine(spec.model)
         self.scheduler = ContinuousBatchingScheduler(
-            engine, shed=spec.shed, shed_headroom=spec.shed_headroom)
+            engine, shed=spec.shed, shed_headroom=spec.shed_headroom,
+            slo_classes=spec.slo_classes)
         # the member's half of the gray-failure plane: one emulator per
         # process, installed up front (policies arrive via spec.netem
         # and/or "netem" commands; an empty emulator is a transparent
@@ -606,6 +625,8 @@ class MemberHarness:
             # events and cross-process drains correlate on it
             req.tenant = msg.get("tenant")  # rides the migration record
             # too, so an adopter keeps the attribution
+            req.slo = msg.get("slo")  # SLO class name — the scheduler
+            # maps it to (priority, weight) via its slo_classes
             self._watch(req, tenant=req.tenant)
             self.scheduler.submit(req)
         elif cmd == "recv_migration":
@@ -873,6 +894,7 @@ class CrossProcessServingPool:
                  member_env: Optional[dict] = None,
                  spawn_timeout_s: float = 120.0,
                  shed: bool = False, shed_headroom: float = 1.0,
+                 slo_classes: Optional[dict] = None,
                  rtt_degraded_x: float = 5.0,
                  start_poll: bool = True,
                  telemetry_streams: bool = True,
@@ -963,6 +985,10 @@ class CrossProcessServingPool:
         # degraded link BEFORE its lease ever wobbles
         self._shed = bool(shed)
         self._shed_headroom = float(shed_headroom)
+        # per-tenant SLO classes, forwarded verbatim into every member's
+        # spawn config (and so into each member scheduler) — the pool
+        # itself only needs them to stamp submits with a class name
+        self._slo_classes = dict(slo_classes) if slo_classes else {}
         self._rtt_degraded_x = float(rtt_degraded_x)
         self._rtt: dict = {}            # slot -> EWMA send seconds
         self._degraded_t0: dict = {}    # slot -> trace ts of degrade
@@ -1273,6 +1299,7 @@ class CrossProcessServingPool:
             membership_table=self._membership_table, hb_ms=self.hb_ms,
             request_timeout_s=self.request_timeout_s, model=self.model,
             shed=self._shed, shed_headroom=self._shed_headroom,
+            slo_classes=self._slo_classes,
             ledger_table=self._ledger_table,
             ledger_rows=self._ledger_rows,
             trace_dir=str(self.workdir) if self._telemetry_streams
@@ -2017,7 +2044,8 @@ class CrossProcessServingPool:
 
     def submit(self, prompt, *, max_tokens: int = 16, eos_id=None,
                timeout_s: Optional[float] = None,
-               tenant: Optional[str] = None) -> PoolRequest:
+               tenant: Optional[str] = None,
+               slo: Optional[str] = None) -> PoolRequest:
         rid = self._next_rid()
         msg = {"prompt": [int(t) for t in prompt],
                "max_tokens": int(max_tokens), "eos_id": eos_id,
@@ -2027,6 +2055,11 @@ class CrossProcessServingPool:
             # the tenant tag rides the wire into the member (span args)
             # and the journal (a takeover keeps the attribution)
             msg["tenant"] = str(tenant)
+        if slo is not None:
+            # the SLO class name rides the same way — the member
+            # scheduler maps it to (priority, weight) from its spawn
+            # config's slo_classes; an unknown name is best-effort
+            msg["slo"] = str(slo)
         req = PoolRequest(rid, msg)
         # the controller-side head of the rid's causal chain: the fleet
         # stitcher links this span to the member-side serve.request and
@@ -2057,9 +2090,10 @@ class CrossProcessServingPool:
 
     def generate(self, prompt, *, max_tokens: int = 16, eos_id=None,
                  timeout_s: Optional[float] = None,
-                 tenant: Optional[str] = None) -> dict:
+                 tenant: Optional[str] = None,
+                 slo: Optional[str] = None) -> dict:
         req = self.submit(prompt, max_tokens=max_tokens, eos_id=eos_id,
-                          timeout_s=timeout_s, tenant=tenant)
+                          timeout_s=timeout_s, tenant=tenant, slo=slo)
         # generous backstop over the serving deadline: a failover or a
         # suspended-then-resumed member must not strand the waiter
         if not req.done.wait(timeout=req.msg["timeout_s"] + 30.0):

@@ -784,6 +784,27 @@ class PagedServeEngine:
         self.metrics.inc("slots_adopted", len(slot_map))
         return slot_map
 
+    def reindex_prefix(self, slot: int, tokens) -> None:
+        """Re-dedup an ADOPTED slot into this engine's prefix index:
+        register the page-boundary hashes of ``tokens`` (the slot's
+        cached token stream — the scheduler knows it; the cache only
+        holds K/V rows) against the freshly imported pages.  Without
+        this, post-drain traffic sharing the migrated requests' prompts
+        re-prefills the prefix from scratch until the imported pages
+        age out — the receiver keeps the source's hit rate only if the
+        hashes move with the pages.  Page-aligned entries only: the
+        tail page is mid-decode (``register_prefix(aligned_only)``)."""
+        n = int(self.cache.lengths[slot])
+        toks = list(tokens)[:n]
+        if len(toks) < n or n < self.cache.page_size:
+            return  # stream shorter than the cached rows (defensive),
+            # or no complete page to index
+        before = self.cache.prefix_entries
+        self.cache.register_prefix(slot, toks, aligned_only=True)
+        added = self.cache.prefix_entries - before
+        if added > 0:
+            self.metrics.inc("prefix_reindexed", added)
+
     # ---- slot lifecycle ----
     def alloc_slot(self) -> int:
         slot = self.cache.alloc()

@@ -270,6 +270,18 @@ def pow2_ceil(n: int, cap: int) -> int:
     return max(min(b, cap), 1)
 
 
+class PagePoolExhausted(RuntimeError):
+    """The page pool has no free page and nothing reclaimable.
+
+    Raised from :meth:`PagedKVCache._alloc_page` — reachable at DECODE
+    time only by a slot decoding past its reservation, i.e. an adopted
+    (migrated-in) slot, whose import allocates its live pages but
+    reserves nothing for the decode ahead.  A distinct type so the
+    scheduler can catch exactly this and preempt-and-requeue a victim
+    (vLLM recompute-mode preemption) instead of killing the engine
+    loop."""
+
+
 @dataclass
 class _PrefixEntry:
     """One cached token-prefix: ``pages`` hold the K/V of the first
@@ -448,9 +460,10 @@ class PagedKVCache:
         under pressure), charging its reservation."""
         while not self._free_pages:
             if not self._evict_one_entry():
-                raise RuntimeError(
+                raise PagePoolExhausted(
                     "KV page pool exhausted: no free pages and nothing "
-                    "reclaimable — the scheduler's page budget "
+                    "reclaimable — an unreserved (adopted) slot decoded "
+                    "past the pool, or the scheduler's page budget "
                     "under-reserved")
         page = self._free_pages.pop()
         self.ref_table[page] = 1
@@ -596,17 +609,25 @@ class PagedKVCache:
         self.lengths[slot] = int(n_shared)
         self.prefix_hit_tokens += int(n_shared)
 
-    def register_prefix(self, slot: int, tokens) -> None:
+    def register_prefix(self, slot: int, tokens, *,
+                        aligned_only: bool = False) -> None:
         """Index ``slot``'s freshly prefilled prompt so later arrivals
         can share it: one entry per page-aligned prefix plus the partial
         tail.  Registered pages become IMMUTABLE (index refs make them
         COW-on-write) — including for ``slot`` itself, whose first
         decode into a registered partial page copies it, leaving the
-        indexed prompt K/V pristine."""
+        indexed prompt K/V pristine.
+
+        ``aligned_only``: skip the partial-tail entry — the re-index
+        path for ADOPTED (migrated-in) slots, whose tail page is still
+        being decoded into; indexing it would force a useless COW on
+        the very next token and leave a stale never-matching entry."""
         if not self.max_prefix_entries:
             return
         table = self.tables[slot]
         for n_tok, digest in self._digests(tokens, self.page_size).items():
+            if aligned_only and n_tok % self.page_size:
+                continue
             if digest in self._prefix:
                 self._prefix.move_to_end(digest)
                 continue

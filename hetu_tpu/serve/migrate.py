@@ -45,10 +45,13 @@ same contiguous truncated-rows snapshot (page ids are process-local
 and meaningless on the wire — the adopter rebuilds page tables as it
 imports), so payload size scales with live tokens either way, every
 codec applies, and slot↔paged CROSS-ALLOCATOR drains work — the
-rolling-upgrade path from a slot-engine fleet to a paged one.
-Residual: an adopter does not re-dedup imported slots into its prefix
-index; shared-prefix requests that migrate together re-materialize
-their prefix per slot until their pages age out.
+rolling-upgrade path from a slot-engine fleet to a paged one.  A paged
+adopter also RE-DEDUPS each imported slot back into its prefix index
+(scheduler ``adopt_inflight`` → engine ``reindex_prefix``: page-boundary
+hashes of the request's token stream registered against the imported
+pages), so post-drain traffic sharing the migrated requests' prompts
+keeps its prefix hit rate instead of re-prefilling until the pages age
+out.
 """
 
 from __future__ import annotations
@@ -121,6 +124,9 @@ def request_record(req, *, now: float | None = None) -> dict:
         # per-tenant attribution must survive the hand-off: the
         # adopter's serve.request span and accounting carry it forward
         "tenant": getattr(req, "tenant", None),
+        # SLO class rides too — a migrated high-priority request must
+        # keep its admission tier on the adopter's scheduler
+        "slo": getattr(req, "slo", None),
     }
 
 
@@ -135,6 +141,7 @@ def request_from_record(rec: dict, *, now: float | None = None):
         eos_id=rec.get("eos_id"), timeout_s=rec.get("timeout_s"))
     req.rid = int(rec["rid"])
     req.tenant = rec.get("tenant")
+    req.slo = rec.get("slo")
     req.tokens = list(rec["tokens"])
     req.folded = int(rec.get("folded", 0))
     req.requeues = int(rec.get("requeues", 0))

@@ -23,6 +23,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
+from hetu_tpu.serve.kv_cache import PagePoolExhausted
 from hetu_tpu.telemetry import trace
 
 _ids = itertools.count(1)
@@ -106,6 +107,8 @@ class Request:
     eos_id: Optional[int] = None
     timeout_s: Optional[float] = None   # deadline from submit()
     rid: int = field(default_factory=lambda: next(_ids))
+    tenant: Optional[str] = None  # multi-tenant accounting key
+    slo: Optional[str] = None     # SLO class name (scheduler slo_classes)
 
     # filled in by the scheduler
     tokens: list = field(default_factory=list)
@@ -138,7 +141,8 @@ class ContinuousBatchingScheduler:
     def __init__(self, engine, *, token_budget: Optional[int] = None,
                  metrics=None, max_requeues: int = 3,
                  shed: bool = False, shed_headroom: float = 1.0,
-                 prefill_chunks_per_step: int = 1):
+                 prefill_chunks_per_step: int = 1,
+                 slo_classes: Optional[dict] = None):
         self.engine = engine
         self.metrics = metrics or engine.metrics
         # engine-failover requeue budget per request: a request whose
@@ -170,6 +174,20 @@ class ContinuousBatchingScheduler:
         # contract should queue, not reject.
         self.shed = bool(shed)
         self.shed_headroom = float(shed_headroom)
+        # per-tenant SLO classes: {name: {"priority": int, "weight":
+        # float, "ttft_slo_s": float|None}}.  Higher priority admits
+        # first under pressure (strict tiering — a page-budget stall at
+        # a high-priority head deliberately blocks lower tiers: pages
+        # freed by completions go to the tier that matters); WITHIN a
+        # tier, weighted fair queueing over (slo, tenant) flows via
+        # virtual finish tags, so one tenant's burst cannot starve its
+        # classmates.  Empty (the default) keeps pure FIFO — the pick
+        # below returns index 0 and no behavior changes.  Requests
+        # naming no/unknown class get priority 0, weight 1.0.
+        self.slo_classes = {str(k): dict(v)
+                            for k, v in (slo_classes or {}).items()}
+        self._vtime = 0.0     # WFQ virtual clock
+        self._vfinish = {}    # flow (slo, tenant) -> virtual finish tag
         self._ewma_service_s: Optional[float] = None
         self._lock = threading.Lock()
         self._queue = deque()
@@ -195,6 +213,75 @@ class ContinuousBatchingScheduler:
         # only numbers already on hand
         return (ahead / slots + 1.0) * ewma
 
+    # ---- SLO classes (priority admission + WFQ) ----
+    def _class_of(self, req) -> tuple:
+        """``(priority, weight)`` for the request's SLO class —
+        ``(0, 1.0)`` when classes are unconfigured or the name is
+        unknown (an unknown class must degrade to best-effort, not
+        raise on the submit path)."""
+        if not self.slo_classes:
+            return 0, 1.0
+        cls = self.slo_classes.get(getattr(req, "slo", None))
+        if cls is None:
+            return 0, 1.0
+        return int(cls.get("priority", 0)), \
+            float(cls.get("weight", 1.0)) or 1.0
+
+    def _pick_index_locked(self) -> int:
+        """Index of the next request to admit (caller holds the lock).
+
+        Pure — charges nothing; :meth:`_charge_wfq_locked` runs only
+        when the pick actually dequeues for admission, so a page-budget
+        stall re-picking the same head every step does not inflate its
+        flow's finish tag.  Strict priority across classes, then the
+        smallest WFQ virtual-finish tag within the winning tier, then
+        FIFO.  O(queue) per admission — fine at serving depths, and the
+        unconfigured fast path is O(1)."""
+        if not self.slo_classes or len(self._queue) < 2:
+            return 0
+        best_key, best_idx = None, 0
+        for idx, req in enumerate(self._queue):
+            prio, weight = self._class_of(req)
+            flow = (getattr(req, "slo", None), getattr(req, "tenant", None))
+            tag = max(self._vtime, self._vfinish.get(flow, 0.0)) \
+                + 1.0 / weight
+            key = (-prio, tag, idx)
+            if best_key is None or key < best_key:
+                best_key, best_idx = key, idx
+        return best_idx
+
+    def _charge_wfq_locked(self, req) -> None:
+        """Advance the picked flow's virtual finish tag — called at the
+        moment a request is dequeued FOR ADMISSION (not at pick time,
+        and not for timeout/overflow dequeues: those consumed no
+        service)."""
+        if not self.slo_classes:
+            return
+        _, weight = self._class_of(req)
+        flow = (getattr(req, "slo", None), getattr(req, "tenant", None))
+        start = max(self._vtime, self._vfinish.get(flow, 0.0))
+        self._vfinish[flow] = start + 1.0 / weight
+        self._vtime = start
+
+    def _projected_wait_locked(self, priority: int) -> float:
+        """:meth:`projected_wait_s`, but the queued backlog counts only
+        requests at >= ``priority`` (caller holds the lock): admission
+        serves strictly by priority, so a low-tier burst queued behind
+        a high-tier submit is simply not ahead of it — without this,
+        one bursting low-SLO tenant's backlog would shed every tenant's
+        traffic instead of absorbing its own."""
+        ewma = self._ewma_service_s
+        if ewma is None:
+            return 0.0
+        slots = max(self.engine.cache.num_slots, 1)
+        if self.slo_classes:
+            ahead_q = sum(1 for r in self._queue
+                          if self._class_of(r)[0] >= priority)
+        else:
+            ahead_q = len(self._queue)
+        ahead = ahead_q + len(self._running) + len(self._prefilling)
+        return (ahead / slots + 1.0) * ewma
+
     def submit(self, request: Request, *,
                resolve_on_reject: bool = True) -> Request:
         request.submitted_at = time.monotonic()
@@ -208,7 +295,9 @@ class ContinuousBatchingScheduler:
                 # draining member's queue is about to be handed away
                 # and says nothing about whether the deadline is
                 # feasible elsewhere
-                projected = self.projected_wait_s() * self.shed_headroom
+                prio, _ = self._class_of(request)
+                projected = self._projected_wait_locked(prio) \
+                    * self.shed_headroom
                 shed = projected > request.timeout_s
             if not shed and not self._accepting:
                 # a drain/stop_intake closed the front door — complete
@@ -545,6 +634,29 @@ class ContinuousBatchingScheduler:
                         if req in self._queue:
                             self._queue.remove(req)
                 raise
+            if snapshots and hasattr(self.engine, "reindex_prefix"):
+                # re-dedup the imported pages into THIS engine's prefix
+                # index: the scheduler is the one party that knows each
+                # adopted slot's token stream (prompt + emitted tokens;
+                # the cache holds only K/V rows).  The stream's last
+                # emitted token has no K/V row yet (it is the pending
+                # decode input) — reindex_prefix truncates to the
+                # cache's recorded length, so passing the full stream
+                # is correct.  Folded tokens are already inside prompt;
+                # tokens[folded:] are the live emissions.  Best-effort:
+                # re-dedup is an optimization and must never fail an
+                # adoption that already attached.
+                for req, _ in pairs:
+                    if req.slot is None or req.done.is_set() or \
+                            self._running.get(req.slot) is not req:
+                        continue
+                    try:
+                        self.engine.reindex_prefix(
+                            req.slot,
+                            list(req.prompt)
+                            + list(req.tokens[req.folded:]))
+                    except Exception:
+                        pass
             self.metrics.inc("requests_adopted", n)
             self.metrics.set_gauge("queue_depth", len(self._queue))
         if return_count:
@@ -640,8 +752,28 @@ class ContinuousBatchingScheduler:
             pf_progressed, pf_exc = self._advance_prefills(completed)
             progressed = progressed or pf_progressed
             admit_exc = admit_exc or pf_exc
-            if self._running:
-                toks = self.engine.decode()
+            toks = None
+            while self._running:
+                try:
+                    toks = self.engine.decode()
+                except PagePoolExhausted:
+                    # vLLM recompute-mode preemption: an UNRESERVED slot
+                    # (adopted via migration — its import allocated live
+                    # pages but reserved nothing for the decode ahead)
+                    # outran the page pool.  Preempt a victim — release
+                    # its slot (freeing its unshared pages), fold its
+                    # tokens into its prompt, requeue at the HEAD — and
+                    # retry the decode.  Retry is safe: prepare_write is
+                    # idempotent (pages already appended are found in
+                    # the table; a COW'd page has ref 1) and lengths
+                    # only advance after the jitted step, so no token is
+                    # lost or double-written.  No victim left => the
+                    # exhaustion really is fatal; re-raise.
+                    if not self._preempt_victim_locked(completed):
+                        raise
+                    continue
+                break
+            if toks is not None:
                 progressed = True
                 now = time.monotonic()
                 for slot, req in list(self._running.items()):
@@ -673,6 +805,15 @@ class ContinuousBatchingScheduler:
         admit_exc = None
         now = time.monotonic()
         while self._queue and self.engine.cache.num_free:
+            # SLO pick: rotate the chosen request to the head, then the
+            # rest of the loop (and its popleft/appendleft failure
+            # handling) runs unchanged against index 0.  FIFO when
+            # classes are unconfigured (pick returns 0, no rotation).
+            idx = self._pick_index_locked()
+            if idx:
+                chosen = self._queue[idx]
+                del self._queue[idx]
+                self._queue.appendleft(chosen)
             req = self._queue[0]
             if req.timeout_s is not None and \
                     now - req.submitted_at > req.timeout_s:
@@ -692,12 +833,17 @@ class ContinuousBatchingScheduler:
                 completed.append(req)
                 continue
             paged = hasattr(self.engine, "begin_prefill")
+            # a requeued/preempted request's emitted tokens were FOLDED
+            # into its prompt — its worst case is the remaining budget,
+            # not max_tokens, or a fold near the page-pool ceiling
+            # inflates the reservation past what the pool can EVER grant
+            # and wedges the queue head forever
+            remaining = max(int(req.max_tokens) - len(req.tokens), 1)
             if paged:
                 # page-budget backpressure: the engine's ledger knows
                 # what the request's worst case costs AFTER prefix
                 # sharing and what outstanding reservations still claim
-                if not self.engine.admission_ok(req.prompt,
-                                                req.max_tokens):
+                if not self.engine.admission_ok(req.prompt, remaining):
                     break
             elif self.engine.cache.active_tokens + n + 1 > \
                     self.token_budget:
@@ -706,6 +852,7 @@ class ContinuousBatchingScheduler:
                 # finish and free it)
                 break
             self._queue.popleft()
+            self._charge_wfq_locked(req)
             try:
                 slot = self.engine.alloc_slot()
             except Exception as e:
@@ -730,7 +877,7 @@ class ContinuousBatchingScheduler:
                 # (_advance_prefills), interleaved with decode rounds
                 try:
                     self.engine.begin_prefill(slot, req.prompt,
-                                              max_tokens=req.max_tokens)
+                                              max_tokens=remaining)
                 except Exception as e:
                     admit_exc = e
                     if not self._requeue_locked(req, self.max_requeues,
@@ -773,7 +920,8 @@ class ContinuousBatchingScheduler:
                 # re-prefill must not double-count the histogram or
                 # overwrite the client-visible ttft_s
                 req.first_token_at = now_t
-                self.metrics.observe_ttft(req.ttft_s)
+                self.metrics.observe_ttft(req.ttft_s,
+                                          tenant=req.tenant)
             self._running[slot] = req
             if self._should_evict(req, now_t):
                 del self._running[slot]
@@ -836,7 +984,8 @@ class ContinuousBatchingScheduler:
             now_t = time.monotonic()
             if req.first_token_at is None:
                 req.first_token_at = now_t
-                self.metrics.observe_ttft(req.ttft_s)
+                self.metrics.observe_ttft(req.ttft_s,
+                                          tenant=req.tenant)
             self._running[slot] = req
             if self._should_evict(req, now_t):
                 del self._running[slot]
@@ -844,6 +993,32 @@ class ContinuousBatchingScheduler:
                 self._finish(req, req.status or "ok")
                 completed.append(req)
         return progressed, exc
+
+    def _preempt_victim_locked(self, completed: list) -> bool:
+        """Evict one running request to free pages for the rest (caller
+        holds the lock): lowest SLO priority first, newest submission
+        within a tier (the newest request has the least sunk decode work
+        to re-prefill).  The victim's emitted tokens fold into its
+        prompt and it requeues at the HEAD (:meth:`_requeue_locked`) —
+        its next admission re-prefills through the normal page-budget
+        gate, so greedy decode continues token-for-token; past its
+        requeue cap it finishes 'error' (appended to ``completed``).
+        Returns False when nothing is running (no victim exists)."""
+        if not self._running:
+            return False
+        slot, req = min(
+            self._running.items(),
+            key=lambda kv: (self._class_of(kv[1])[0],
+                            -(kv[1].submitted_at or 0.0), -kv[1].rid))
+        del self._running[slot]
+        self._release_slot_locked(slot)
+        self.metrics.inc("requests_preempted")
+        trace.instant("serve.preempt",
+                      {"rid": int(req.rid), "slot": int(slot),
+                       "tokens": len(req.tokens)})
+        if not self._requeue_locked(req, self.max_requeues):
+            completed.append(req)
+        return True
 
     def _should_evict(self, req: Request, now: float) -> bool:
         if req.eos_id is not None and req.tokens[-1] == req.eos_id:
@@ -861,8 +1036,17 @@ class ContinuousBatchingScheduler:
         return False
 
     def _finish(self, req: Request, status: str) -> None:
-        if finish_request(req, status, self.metrics) and \
-                req.first_token_at is not None and \
+        if not finish_request(req, status, self.metrics):
+            return
+        if req.tenant is not None and hasattr(self.metrics, "note_tenant"):
+            # per-tenant terminal + token accounting (rides the fleet
+            # scrape: members' tenant.* counters sum in fleet_metrics,
+            # so per-tenant shed/throughput is readable fleet-wide)
+            self.metrics.note_tenant(req.tenant, status)
+            if req.tokens:
+                self.metrics.note_tenant(req.tenant, "tokens",
+                                         len(req.tokens))
+        if req.first_token_at is not None and \
                 req.finished_at is not None:
             # learn per-request SERVICE time (first token -> finish:
             # queue wait excluded, or load would inflate the model and

@@ -1,0 +1,307 @@
+"""Measured-load autoscaler over a cross-process serving pool.
+
+The pool already has every primitive elasticity needs — a spawn harness
+that brings a member up on a parked slot (``revive_member``), a
+zero-re-prefill drain that hands a member's live KV to a peer before
+the process exits (``drain_member(close=True)``), and a fleet-wide
+metrics merge (``fleet_metrics``).  What it lacks is the loop that
+connects them to MEASURED load.  :class:`Autoscaler` is that loop: each
+tick it scrapes the fleet registry and reads three signals —
+
+* **queue depth** — mean of the per-member ``m<slot>.queue_depth``
+  gauges over the active set (level, not rate: the backlog that exists
+  right now);
+* **shed rate** — windowed ``requests_shed`` / ``requests_submitted``
+  counter deltas between this tick and the last (cumulative fleet
+  counters diff cleanly because dead incarnations stay folded into the
+  merge — the PR 14 retired-accumulator property this loop leans on);
+* **SLO breach** — windowed per-tenant TTFT p99 from
+  ``tenant.<slug>.ttft_s`` histogram bucket DELTAS vs each tenant's
+  declared budget (``ttft_slos``), so one tenant blowing its p99 in
+  the last window triggers scale-up even while fleet averages look
+  calm —
+
+and votes scale-up / scale-down / hold.  Votes become actions only
+through hysteresis (``up_ticks``/``down_ticks`` consecutive agreeing
+ticks) and per-direction cooldowns, with hard ``min_members``/
+``max_members`` bounds: a control loop over a noisy sensor must be
+deliberately harder to move than the load it measures, or it oscillates
+and every oscillation is a drain.
+
+Every decision (including holds that broke a streak) lands in
+``decisions`` and actions emit a ``traffic.scale`` span with the
+signals that justified them — the fleet trace shows WHY the fleet
+resized, not just that it did.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from hetu_tpu.serve.metrics import ServeMetrics
+from hetu_tpu.telemetry import trace
+
+_tenant_slug = ServeMetrics._tenant_slug  # same sanitization both ways:
+# the slug this loop reads MUST be the slug the scheduler wrote
+
+
+@dataclass
+class AutoscalePolicy:
+    """Knobs for :class:`Autoscaler` — thresholds are in signal units
+    (queue depth in requests/member, shed rate as a fraction of the
+    window's submits)."""
+
+    min_members: int = 1
+    max_members: int = 4
+    interval_s: float = 1.0
+    # scale-up triggers (ANY of them, up_ticks consecutive ticks)
+    queue_high: float = 4.0
+    shed_high: float = 0.02
+    # scale-down requires ALL low-watermarks, down_ticks consecutive
+    # ticks (down is deliberately slower than up: adding capacity late
+    # costs latency, removing it early costs a drain AND latency)
+    queue_low: float = 0.5
+    shed_low: float = 0.001
+    up_ticks: int = 2
+    down_ticks: int = 5
+    up_cooldown_s: float = 3.0
+    down_cooldown_s: float = 6.0
+
+
+@dataclass
+class _Signals:
+    queue_depth: float = 0.0
+    shed_rate: float = 0.0
+    submitted_delta: int = 0
+    shed_delta: int = 0
+    slo_breaches: dict = field(default_factory=dict)  # tenant -> p99
+
+
+def _p99_from_counts(buckets, counts, q: float = 0.99) -> Optional[float]:
+    """Conservative quantile from raw bucket counts (upper bound of the
+    winning bucket): enough resolution for a threshold comparison, and
+    self-contained — no fabricated Histogram internals."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            return float(buckets[i]) if i < len(buckets) \
+                else float(buckets[-1])
+    return float(buckets[-1])
+
+
+class Autoscaler:
+    """Scale ``pool`` between ``policy.min_members`` and
+    ``policy.max_members`` from measured load.
+
+    ``pool`` needs the :class:`~hetu_tpu.serve.crosshost.
+    CrossProcessServingPool` surface this loop touches:
+    ``fleet_metrics(scrape=...)`` → registry with ``.dump()``,
+    ``revive_member(slot)``, ``drain_member(slot, close=True)``,
+    ``n_members`` — a fake with those four is a fine unit-test double.
+
+    ``ttft_slos`` maps tenant name → TTFT p99 budget in seconds; a
+    tenant's windowed p99 over budget votes scale-up.  ``clock`` is
+    injectable for deterministic tests.
+    """
+
+    def __init__(self, pool, policy: AutoscalePolicy, *,
+                 ttft_slos: Optional[dict] = None,
+                 active: Optional[set] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if policy.min_members < 1:
+            raise ValueError("min_members must be >= 1")
+        if policy.max_members < policy.min_members:
+            raise ValueError("max_members must be >= min_members")
+        if policy.max_members > int(pool.n_members):
+            raise ValueError(
+                f"max_members {policy.max_members} exceeds the pool's "
+                f"slot count {pool.n_members} — the pool is constructed "
+                f"at max geometry and scaling parks/revives slots")
+        self.pool = pool
+        self.policy = policy
+        self.ttft_slos = dict(ttft_slos or {})
+        self.clock = clock
+        # the slots this loop believes are serving; everything else is
+        # parked (drained-and-closed, or never started).  Own
+        # bookkeeping, not a lease read: a drain's lease takes time to
+        # lapse and the loop must not double-drain in that window.
+        self.active = set(range(int(pool.n_members))) \
+            if active is None else {int(s) for s in active}
+        self.decisions: list = []     # every tick's verdict, in order
+        self._last_counters: dict = {}
+        self._last_tenant_hists: dict = {}
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_up = -float("inf")
+        self._last_down = -float("inf")
+        self._thread = None
+        self._stop = threading.Event()
+
+    # ---- sensing ----
+    def _counter_delta(self, dump: dict, name: str) -> int:
+        cur = int(dump.get(name, {}).get("value", 0))
+        prev = self._last_counters.get(name, 0)
+        self._last_counters[name] = cur
+        return max(cur - prev, 0)
+
+    def read_signals(self, dump: dict) -> _Signals:
+        """One tick's view of the fleet from a ``fleet_metrics`` dump —
+        split out so tests can feed canned dumps."""
+        sig = _Signals()
+        depths = []
+        for slot in self.active:
+            rec = dump.get(f"m{slot}.queue_depth")
+            if rec is not None:
+                depths.append(float(rec.get("value", 0.0)))
+        sig.queue_depth = sum(depths) / max(len(self.active), 1)
+        sig.submitted_delta = self._counter_delta(
+            dump, "requests_submitted")
+        sig.shed_delta = self._counter_delta(dump, "requests_shed")
+        if sig.submitted_delta > 0:
+            sig.shed_rate = sig.shed_delta / sig.submitted_delta
+        for tenant, budget in self.ttft_slos.items():
+            name = f"tenant.{_tenant_slug(tenant)}.ttft_s"
+            rec = dump.get(name)
+            if rec is None or rec.get("type") != "histogram":
+                continue
+            counts = list(rec["counts"])
+            prev = self._last_tenant_hists.get(name)
+            self._last_tenant_hists[name] = counts
+            if prev is not None and len(prev) == len(counts):
+                delta = [max(c - p, 0) for c, p in zip(counts, prev)]
+            else:
+                delta = counts
+            p99 = _p99_from_counts(rec["buckets"], delta)
+            if p99 is not None and p99 > float(budget):
+                sig.slo_breaches[tenant] = p99
+        return sig
+
+    # ---- deciding / actuating ----
+    def _parked(self) -> list:
+        return sorted(set(range(int(self.pool.n_members))) - self.active)
+
+    def _pick_victim(self, dump: dict) -> int:
+        """Scale-down victim: the active slot with the shallowest queue
+        (cheapest drain), highest slot id on ties (revive order then
+        tends to repopulate low slots first — stable, boring)."""
+        return max(self.active,
+                   key=lambda s: (-float(
+                       dump.get(f"m{s}.queue_depth", {}).get("value", 0.0)),
+                       s))
+
+    def tick(self) -> dict:
+        """One sense → decide → (maybe) actuate round.  Returns the
+        decision record (also appended to ``decisions``)."""
+        pol = self.policy
+        dump = self.pool.fleet_metrics(scrape=True).dump()
+        sig = self.read_signals(dump)
+        now = self.clock()
+        overloaded = (sig.queue_depth >= pol.queue_high
+                      or sig.shed_rate >= pol.shed_high
+                      or bool(sig.slo_breaches))
+        underloaded = (sig.queue_depth <= pol.queue_low
+                       and sig.shed_rate <= pol.shed_low
+                       and not sig.slo_breaches)
+        self._up_streak = self._up_streak + 1 if overloaded else 0
+        self._down_streak = self._down_streak + 1 if underloaded else 0
+        rec = {"t": now, "action": "hold",
+               "active": sorted(self.active),
+               "queue_depth": round(sig.queue_depth, 3),
+               "shed_rate": round(sig.shed_rate, 4),
+               "slo_breaches": dict(sig.slo_breaches)}
+        if overloaded and self._up_streak >= pol.up_ticks \
+                and len(self.active) < pol.max_members \
+                and now - self._last_up >= pol.up_cooldown_s \
+                and self._parked():
+            slot = self._parked()[0]
+            rec.update(action="up", slot=slot,
+                       reason=self._reason(sig, pol))
+            with trace.span("traffic.scale", {
+                    "action": "up", "slot": slot,
+                    "queue_depth": rec["queue_depth"],
+                    "shed_rate": rec["shed_rate"],
+                    "reason": rec["reason"]}, cat="traffic"):
+                try:
+                    self.pool.revive_member(slot)
+                    self.active.add(slot)
+                    self._last_up = now
+                    self._up_streak = 0
+                    self._bump("autoscale_up")
+                except Exception as e:
+                    rec.update(action="up_failed", error=repr(e))
+        elif underloaded and self._down_streak >= pol.down_ticks \
+                and len(self.active) > pol.min_members \
+                and now - self._last_down >= pol.down_cooldown_s \
+                and now - self._last_up >= pol.down_cooldown_s:
+            slot = self._pick_victim(dump)
+            rec.update(action="down", slot=slot, reason="idle")
+            with trace.span("traffic.scale", {
+                    "action": "down", "slot": slot,
+                    "queue_depth": rec["queue_depth"],
+                    "shed_rate": rec["shed_rate"]}, cat="traffic"):
+                try:
+                    # zero-re-prefill: live KV migrates to a peer, the
+                    # victim exits, no accepted request is lost
+                    self.pool.drain_member(slot, close=True)
+                    self.active.discard(slot)
+                    self._last_down = now
+                    self._down_streak = 0
+                    self._bump("autoscale_down")
+                except Exception as e:
+                    rec.update(action="down_failed", error=repr(e))
+        self.decisions.append(rec)
+        return rec
+
+    @staticmethod
+    def _reason(sig: _Signals, pol: AutoscalePolicy) -> str:
+        if sig.slo_breaches:
+            return "slo_breach:" + ",".join(sorted(sig.slo_breaches))
+        if sig.shed_rate >= pol.shed_high:
+            return "shed_rate"
+        return "queue_depth"
+
+    def _bump(self, name: str) -> None:
+        m = getattr(self.pool, "metrics", None)
+        if m is not None and hasattr(m, "inc"):
+            m.inc(name)
+
+    # ---- loop lifecycle ----
+    def start(self) -> "Autoscaler":
+        if self._thread is not None:
+            raise RuntimeError("autoscaler already running")
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.wait(self.policy.interval_s):
+                try:
+                    self.tick()
+                except Exception:
+                    import traceback
+                    traceback.print_exc()  # a failed tick must not
+                    # kill the loop — the next scrape may succeed
+
+        self._thread = threading.Thread(target=_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+
+    @property
+    def scale_ups(self) -> int:
+        return sum(1 for d in self.decisions if d["action"] == "up")
+
+    @property
+    def scale_downs(self) -> int:
+        return sum(1 for d in self.decisions if d["action"] == "down")

@@ -64,8 +64,36 @@ def test_registry_merge_incompatible_buckets_raise():
     a, b = MetricsRegistry(), MetricsRegistry()
     a.histogram("lat", (0.1, 1.0)).observe(0.5)
     b.histogram("lat", (0.2, 2.0)).observe(0.5)
-    with pytest.raises(ValueError):
+    with pytest.raises(ValueError, match="incompatible buckets"):
         a.merge(b)
+    # the failed merge must not have half-applied: a's histogram intact
+    assert a.metrics()["lat"].count == 1
+
+
+def test_registry_merge_histogram_schema_mismatch_paths():
+    """A wire dump is attacker-shaped JSON as far as merge() is
+    concerned: a counts vector that disagrees with the bucket schema,
+    or an unknown metric type, must be a loud ValueError — bucket-wise
+    addition against the wrong schema would silently corrupt every
+    fleet percentile."""
+    a = MetricsRegistry()
+    a.histogram("lat", (0.1, 1.0)).observe(0.5)
+    good = a.dump()["lat"]
+    # counts length disagrees with the (matching) bucket schema — e.g.
+    # a dump truncated in flight
+    b = MetricsRegistry()
+    b.histogram("lat", (0.1, 1.0))
+    with pytest.raises(ValueError, match="counts for"):
+        b.merge({"lat": {**good, "counts": good["counts"][:-1]}})
+    # unknown metric type from a newer/corrupt sender
+    with pytest.raises(ValueError, match="unknown metric type"):
+        MetricsRegistry().merge({"x": {"type": "summary", "value": 1}})
+    # same data, same schema: merges clean (the guards aren't trigger-
+    # happy) — and twice doubles, proving the counts really add
+    c = MetricsRegistry()
+    c.merge({"lat": good})
+    c.merge({"lat": good})
+    assert c.metrics()["lat"].count == 2
 
 
 def test_registry_dump_survives_json_and_prefix_namespacing():
@@ -531,3 +559,63 @@ def test_obs_acceptance_member_sigkill(tmp_path):
     pairs = [p for p in timeline.correlate(events)
              if p.kind == "member_kill"]
     assert pairs and pairs[0].paired
+
+
+# ---------------------------------------------------------------------------
+# slow: per-tenant histograms survive a member revive (retired fold)
+# ---------------------------------------------------------------------------
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.crosshost
+@pytest.mark.traffic
+def test_tenant_ttft_histogram_survives_member_revive(tmp_path):
+    """revive_member replaces a member process; the dead incarnation's
+    last-scraped registry folds into the retired accumulator.  The
+    fleet view of ``tenant.<t>.ttft_s`` must keep EVERY pre-revive
+    observation — the autoscaler's windowed per-tenant p99 reads this
+    exact histogram, and a revive that zeroed it would read as a
+    miraculous latency recovery mid-scale-up."""
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+
+    pool = CrossProcessServingPool(
+        2, workdir=tmp_path, model=TINY, scrape_s=0.2)
+    try:
+        def gold_count():
+            fl = pool.fleet_metrics(timeout_s=8.0)
+            h = fl.metrics().get("tenant.gold.ttft_s")
+            return 0 if h is None else int(h.count)
+
+        def wait_count(want):
+            deadline = time.monotonic() + 30
+            got = gold_count()
+            while got < want and time.monotonic() < deadline:
+                time.sleep(0.2)
+                got = gold_count()
+            return got
+
+        n1 = 3
+        for i in range(n1):
+            r = pool.generate([i + 1, i + 2, 5], max_tokens=6,
+                              timeout_s=120.0, tenant="gold")
+            assert r["status"] == "ok"
+        # a scrape must capture the observations BEFORE the kill — the
+        # retired fold can only keep what was ever on the wire
+        assert wait_count(n1) == n1
+        pool.revive_member(0)
+        assert wait_count(n1) == n1  # nothing lost to the new incarnation
+        n2 = 2
+        for i in range(n2):
+            r = pool.generate([i + 7, 3, 9], max_tokens=6,
+                              timeout_s=120.0, tenant="gold")
+            assert r["status"] == "ok"
+        # dead incarnation's fold + live members sum, never double-count
+        assert wait_count(n1 + n2) == n1 + n2
+        # the global histogram kept them too, and the controller-side
+        # tenant counters (ctrl. namespace) agree with what was served
+        fl = pool.fleet_metrics(timeout_s=8.0)
+        assert int(fl.metrics()["ttft_s"].count) == n1 + n2
+        assert fl.counter("ctrl.tenant.gold.requests").value == n1 + n2
+        assert fl.counter("ctrl.members_revived").value == 1
+    finally:
+        pool.close()

@@ -39,7 +39,7 @@ LOG = REPO / ".bench_watch.log"
 PIDFILE = REPO / ".bench_watch.pid"
 CMDS = ["gpt", "resnet", "ctr", "moe", "elastic", "telemetry", "migrate",
         "netchaos", "mpmd", "ctrlchaos", "vanchaos", "paged", "obs",
-        "quant", "ctr_serve", "crosshost", "gpt_sweep"]
+        "quant", "ctr_serve", "crosshost", "autoscale", "gpt_sweep"]
 # gpt_sweep last: the headline matrix captures first; the sweep then maps
 # the MFU residual (attention head-dim, CE head, remat cost) in the same
 # tunnel window
