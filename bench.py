@@ -2685,8 +2685,8 @@ def bench_autoscale():
 
     from hetu_tpu.serve.crosshost import CrossProcessServingPool
     from hetu_tpu.traffic import (AutoscalePolicy, Autoscaler, TenantSpec,
-                                  TraceSpec, llm_submitter, replay,
-                                  synthesize)
+                                  TraceSpec, ctr_submitter, llm_submitter,
+                                  replay, synthesize)
 
     smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
     if smoke:
@@ -2694,6 +2694,7 @@ def bench_autoscale():
     else:
         MINM, MAXM, DUR, QPS, GEN = 2, 4, 16.0, 6.0, 8
     GOLD_SLO = 2.5   # TTFT p99 budget (s) for the high-SLO tenant
+    CTR_SHARE = 0.2  # the recsys side-channel tenant's share
     model_spec = {"vocab_size": 97, "hidden_size": 64, "num_layers": 2,
                   "num_heads": 4, "ffn_size": 128, "max_position": 64,
                   "num_slots": 4, "max_len": 48, "min_bucket": 8,
@@ -2702,25 +2703,60 @@ def bench_autoscale():
         "gold": {"priority": 2, "weight": 4.0, "ttft_slo_s": GOLD_SLO},
         "bronze": {"priority": 0, "weight": 1.0, "ttft_slo_s": None},
     }
+    # the CTR tenant rides the SAME diurnal trace (kind="ctr": dense +
+    # sparse payloads instead of prompts) and is dispatched to an
+    # in-process RecsysPool by the kind-splitting submitter below; the
+    # LLM tenants keep their original ABSOLUTE rates (base_qps scales
+    # up by the ctr share so gold stays at 0.3*QPS, bronze at 0.7*QPS)
+    llm_scale = 1.0 - CTR_SHARE
     spec = TraceSpec(
-        seed=0, duration_s=DUR, base_qps=QPS, diurnal_peak_x=10.0,
-        vocab=89, max_prompt_len=6,
+        seed=0, duration_s=DUR, base_qps=QPS / llm_scale,
+        diurnal_peak_x=10.0, vocab=89, max_prompt_len=6,
         tenants=[
-            TenantSpec(name="gold", share=0.3, slo="gold",
+            TenantSpec(name="gold", share=0.3 * llm_scale, slo="gold",
                        deadline_lo_s=8.0, deadline_hi_s=12.0,
                        max_tokens=GEN),
-            TenantSpec(name="bronze", share=0.7, slo="bronze",
+            TenantSpec(name="bronze", share=0.7 * llm_scale,
+                       slo="bronze",
                        deadline_lo_s=1.0, deadline_hi_s=2.5,
                        burst_x=3.0, burst_on_s=1.5, burst_off_s=2.0,
                        max_tokens=GEN),
+            TenantSpec(name="ctr", share=CTR_SHARE, kind="ctr",
+                       slo="bronze", deadline_lo_s=5.0,
+                       deadline_hi_s=8.0),
         ])
     trace_j = synthesize(spec)
+
+    def ctr_pool(port):
+        import jax
+
+        from hetu_tpu.models.wdl import WideDeep
+        from hetu_tpu.ps.client import PSTable
+        from hetu_tpu.serve.recsys import (RecsysEngine, RecsysPool,
+                                           ServingEmbeddingCache)
+        from hetu_tpu.telemetry.registry import MetricsRegistry
+        model = WideDeep(4, 8, 8, hidden=(16,))
+        variables = model.init(jax.random.PRNGKey(0))
+        table = PSTable(64, 8, init="normal", seed=1,
+                        optimizer="sgd", lr=1.0)
+
+        def factory():
+            return RecsysEngine(
+                model, variables,
+                ServingEmbeddingCache(table, capacity=64, pull_bound=1,
+                                      registry=MetricsRegistry()),
+                max_batch=16, min_bucket=4)
+        # ride the crosshost pool's in-process van (one per process):
+        # a second van.serve() would refuse to start
+        return RecsysPool({"r0": factory, "r1": factory},
+                          own_van=False, port=port)
 
     def run_arm(wd, *, autoscaling):
         xpool = CrossProcessServingPool(
             MAXM, workdir=wd, model=model_spec, request_timeout_s=300.0,
             shed=True, slo_classes=slo_classes, scrape_s=0.25,
             member_env={"JAX_PLATFORMS": "cpu"})
+        rpool = ctr_pool(xpool.port)
         scaler = None
         try:
             # both arms START at min_members; the parked slots are the
@@ -2740,7 +2776,13 @@ def bench_autoscale():
                     active=set(range(MINM)))
                 scaler.start()
             t0 = time.perf_counter()
-            issued = replay(trace_j, llm_submitter(xpool))
+            sub_llm = llm_submitter(xpool)
+            sub_ctr = ctr_submitter(rpool)
+
+            def submit(ev):
+                return sub_ctr(ev) if ev.get("kind") == "ctr" \
+                    else sub_llm(ev)
+            issued = replay(trace_j, submit)
             handles = [(ev, h) for ev, h in issued
                        if not isinstance(h, Exception)]
             for _, h in handles:
@@ -2766,8 +2808,13 @@ def bench_autoscale():
                                    "error": 0, "other": 0, "ttft": []})
                 st = h.status or "other"
                 t[st if st in t else "other"] += 1
-                if st == "ok" and h.ttft_s is not None:
-                    t["ttft"].append(float(h.ttft_s))
+                # RecsysRequest measures time-to-first-RESPONSE, not
+                # TTFT — fold whichever the handle carries
+                ttft = getattr(h, "ttft_s", None)
+                if ttft is None:
+                    ttft = getattr(h, "ttfr_s", None)
+                if st == "ok" and ttft is not None:
+                    t["ttft"].append(float(ttft))
             for t in per_tenant.values():
                 tt = sorted(t.pop("ttft"))
                 t["ttft_p99_s"] = round(
@@ -2787,6 +2834,10 @@ def bench_autoscale():
         finally:
             if scaler is not None:
                 scaler.stop()
+            try:
+                rpool.close()
+            except Exception:
+                pass
             xpool.close()
 
     with tempfile.TemporaryDirectory(prefix="bench_autoscale_off_") as wd:
@@ -2822,6 +2873,188 @@ def bench_autoscale():
     })
 
 
+def bench_soak():
+    """Second-fault survivability: sequential van kills against ONE
+    long-lived serving pool.
+
+    ``vanchaos`` measures the FIRST fault — a fresh pair per round.
+    The soak keeps one pool alive and feeds it a seeded
+    ``SequentialFaultCampaign``: each round SIGKILLs the CURRENT
+    primary (which, from round two on, is a van that itself arrived by
+    promotion or re-silvering), waits for the pair to be REDUNDANT
+    again (promotion landed, fresh backup attached, resilver copied,
+    degraded cleared), and only then draws the next fault.  Zero lost
+    accepted requests across the whole campaign is asserted; the
+    headline is the re-silver p50 — the time from promotion to
+    redundancy restored, i.e. how long the pair is one fault away from
+    data loss.
+    """
+    import os
+    import tempfile
+    import threading
+
+    from hetu_tpu.ps import membership as mb
+    from hetu_tpu.resilience.faults import SequentialFaultCampaign
+    from hetu_tpu.resilience.shardproc import free_port, \
+        spawn_shard_server
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+    from hetu_tpu.telemetry import timeline, trace
+
+    smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
+    ROUNDS = 2 if smoke else 3
+    N_REQ, GEN = (4, 10) if smoke else (6, 24)
+    model = {"vocab_size": 89, "hidden_size": 48, "num_layers": 2,
+             "num_heads": 4, "ffn_size": 96, "max_position": 96,
+             "num_slots": max(N_REQ, 4), "max_len": 88,
+             "min_bucket": 8, "seed": 1}
+    PROMOTE_AFTER_S, RCV_TIMEOUT_S = 0.3, 1.5
+
+    lost_total = accepted_total = 0
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    camp = SequentialFaultCampaign(seed=23, rounds=ROUNDS,
+                                   kinds=("van_kill",))
+    pool = None
+    procs: list = []
+    by_port: dict = {}
+    with tempfile.TemporaryDirectory(prefix="bench_soak_") as wd:
+        try:
+            p1, p2 = free_port(), free_port()
+            v1 = spawn_shard_server(wd, p1, tag="prim")
+            v2 = spawn_shard_server(wd, p2, tag="back")
+            procs += [v1, v2]
+            by_port.update({p1: v1, p2: v2})
+
+            def fresh_backup(_rep):
+                port = free_port()
+                proc = spawn_shard_server(wd, port, tag=f"rsv{port}")
+                procs.append(proc)
+                by_port[port] = proc
+                return ("127.0.0.1", port)
+
+            van_spec = {
+                "endpoints": [["127.0.0.1", p1], ["127.0.0.1", p2]],
+                "epoch_table": mb.fresh_table_id(),
+                "promote_after_s": PROMOTE_AFTER_S,
+                "rcv_timeout_s": RCV_TIMEOUT_S,
+                "revalidate_s": 0.05, "resilver_settle_s": 0.2}
+            pool = CrossProcessServingPool(
+                2, workdir=wd, model=model, own_van=False, port=p1,
+                van_spec=van_spec, lease_s=0.8, suspect_grace_s=0.8,
+                van_backup_factory=fresh_backup,
+                member_env={"JAX_PLATFORMS": "cpu"})
+            rep = pool._replica
+            rng = np.random.default_rng(23)
+
+            for rnd in range(ROUNDS):
+                kind, _victim = camp.draw()
+                assert kind == "van_kill"
+                victim_port = rep.primary[1]
+                victim = by_port[victim_port]
+                prompts = [list(map(int, rng.integers(
+                    1, 80, rng.integers(2, 5)))) for _ in range(N_REQ)]
+                results: dict = {}
+
+                def worker(i, prompts=prompts, results=results):
+                    while True:
+                        try:
+                            req = pool.submit(prompts[i],
+                                              max_tokens=GEN,
+                                              timeout_s=90.0)
+                            break
+                        except Exception:
+                            time.sleep(0.1)  # refused accept: retried,
+                            # never counted accepted
+                    req.done.wait(timeout=120.0)
+                    results[i] = (req.status or "ok") \
+                        if req.done.is_set() else "lost"
+
+                threads = [threading.Thread(target=worker, args=(i,))
+                           for i in range(N_REQ)]
+                for th in threads:
+                    th.start()
+                time.sleep(0.3)
+                t_kill = time.monotonic()
+                victim.kill()
+                victim.wait()
+                for th in threads:
+                    th.join(180)
+                accepted_total += len(results)
+                lost_total += sum(1 for s in results.values()
+                                  if s != "ok")
+                # recovery-aware pacing: the NEXT fault only fires once
+                # this one's full recovery landed (promotion + fresh
+                # backup + resilver; pair redundant again)
+                deadline = time.monotonic() + 120.0
+                while time.monotonic() < deadline and \
+                        (rep.incarnation < rnd + 2 or rep.degraded):
+                    time.sleep(0.25)
+                redundant = rep.incarnation >= rnd + 2 \
+                    and not rep.degraded
+                camp.complete(
+                    ok=redundant
+                    and all(s == "ok" for s in results.values()),
+                    recovery_s=time.monotonic() - t_kill,
+                    detail={"accepted": len(results)})
+                if not redundant:
+                    break
+        finally:
+            if pool is not None:
+                try:
+                    pool.close()
+                except Exception:
+                    pass
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            import subprocess as _sp
+            try:
+                _sp.run(["pkill", "-9", "-f", wd],
+                        capture_output=True, timeout=10)
+            except Exception:
+                pass
+            trace.disable()
+
+    report = camp.report()
+    assert report["rounds_survived"] == ROUNDS, report
+    assert lost_total == 0, f"{lost_total} accepted requests lost"
+    pairs = [p for p in timeline.correlate(tracer.events)
+             if p.kind == "van_kill"]
+    assert len(pairs) == ROUNDS and all(p.paired for p in pairs), pairs
+    resilver_s = sorted(
+        ev["dur"] / 1e6 for ev in tracer.events
+        if ev.get("name") == "van.resilver" and ev.get("ph") == "X"
+        and ev.get("args", {}).get("ok"))
+    assert resilver_s, "no successful van.resilver span recorded"
+    recovery_s = sorted(r["recovery_s"] for r in camp.results)
+    p50 = lambda xs: xs[len(xs) // 2]  # noqa: E731
+    print(f"# soak {ROUNDS} sequential van kills: resilver p50 "
+          f"{p50(resilver_s) * 1e3:8.1f} ms  recovery p50 "
+          f"{p50(recovery_s) * 1e3:8.1f} ms  (accepted "
+          f"{accepted_total}, lost {lost_total})", file=sys.stderr)
+    _emit({
+        "metric": "soak_resilver_p50_s",
+        "value": round(p50(resilver_s), 3),
+        "unit": "s_promotion_to_redundancy_restored_p50",
+        "extra": {
+            "rounds": ROUNDS,
+            "campaign": camp.to_json(),
+            "campaign_id": camp.campaign_id,
+            "recovery_s": [round(t, 3) for t in recovery_s],
+            "resilver_s": [round(t, 3) for t in resilver_s],
+            "accepted": accepted_total,
+            "requests_lost": lost_total,
+            "promote_after_s": PROMOTE_AFTER_S,
+            "rcv_timeout_s": RCV_TIMEOUT_S,
+            "topology": "one pool across all rounds; each kill lands "
+                        "on a primary that arrived by promotion or "
+                        "re-silvering; next fault gated on redundancy "
+                        "restored",
+        },
+    })
+
+
 _METRIC_BY_CMD = {
     "gpt": "gpt2s_bf16_train_mfu_1chip",
     "gpt_sweep": "gpt_config_sweep_best_mfu_1chip",
@@ -2843,6 +3076,7 @@ _METRIC_BY_CMD = {
     "vanchaos": "vanchaos_promote_p50_s",
     "obs": "obs_stream_scrape_overhead_pct",
     "autoscale": "autoscale_qps_gain_x",
+    "soak": "soak_resilver_p50_s",
 }
 
 
@@ -2890,6 +3124,7 @@ def main():
      "vanchaos": bench_vanchaos,
      "obs": bench_obs,
      "autoscale": bench_autoscale,
+     "soak": bench_soak,
      "telemetry": bench_telemetry}.get(cmd, bench_gpt)()
 
 

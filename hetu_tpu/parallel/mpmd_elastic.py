@@ -195,7 +195,8 @@ class PipelineStageProcess(ControlPlaneMember):
 
     # ---- epoch-scoped mailboxes ----
     def _chan(self, edge: int, backward: bool) -> VanMailbox:
-        if self._mail_epoch != self.epoch:
+        gen_key = (self._van_gen(), self.epoch)
+        if self._mail_epoch != gen_key:
             for mbx in self._mail.values():
                 self._wire_totals["logical"] += mbx.bytes_logical
                 self._wire_totals["wire"] += mbx.bytes_wire
@@ -205,16 +206,21 @@ class PipelineStageProcess(ControlPlaneMember):
                     pass
             self._mail.clear()
             self._seq.clear()
-            self._mail_epoch = self.epoch
+            self._mail_epoch = gen_key
         key = (edge, backward)
         if key not in self._mail:
             # channel ids are EPOCH-scoped: a membership change abandons
             # every in-flight message (at-least-once activations) and
-            # both endpoints restart seq-aligned on fresh channels
+            # both endpoints restart seq-aligned on fresh channels.  A
+            # van promotion does the same — the promoted van has no
+            # channel state, so both endpoints of every edge discard
+            # their mailboxes (the (van_gen, epoch) key above) and
+            # restart seq-aligned against the new primary.
             cid = (self.spec.mail_base + (self.epoch << 8) + edge * 2 +
                    (1 if backward else 0))
+            host, port = self._van_endpoint()
             self._mail[key] = VanMailbox(
-                "127.0.0.1", self.spec.port, cid, self._cap,
+                host, port, cid, self._cap,
                 wire=self.spec.wire,
                 metric_path=f"mpmd.edge{edge}."
                             f"{'bwd' if backward else 'fwd'}")
@@ -225,23 +231,31 @@ class PipelineStageProcess(ControlPlaneMember):
         ch = self._chan(edge, backward)
         self._seq[(edge, backward)] += 1
         seq = self._seq[(edge, backward)]
+        faults = 0
         while True:
             try:
                 ch.put(arr, seq, timeout_s=self.spec.barrier_wait_s)
                 return
             except TimeoutError:
                 self._check_epoch()  # blob put is same-seq idempotent
+            except (ConnectionError, RuntimeError) as e:
+                faults += 1
+                self._wire_fault(e, faults=faults)
 
     def _mail_get(self, edge: int, backward: bool, shape) -> np.ndarray:
         ch = self._chan(edge, backward)
         self._seq[(edge, backward)] += 1
         seq = self._seq[(edge, backward)]
+        faults = 0
         while True:
             try:
                 return ch.get(shape, seq,
                               timeout_s=self.spec.barrier_wait_s)
             except TimeoutError:
                 self._check_epoch()
+            except (ConnectionError, RuntimeError) as e:
+                faults += 1
+                self._wire_fault(e, faults=faults)
 
     # ---- PS-resident stage state (version-gated double buffer) ----
     def _pull_state(self, step: int):
@@ -374,6 +388,12 @@ class PipelineStageProcess(ControlPlaneMember):
                 if self._stop.wait(0.02):
                     break
                 continue
+            if self._hold_for_republish(e, phase):
+                # a van promotion voided the in-flight step: wait for
+                # the controller's re-freeze before re-running it
+                if self._stop.wait(0.02):
+                    break
+                continue
             if e != self.epoch:
                 self.epoch = e
                 self.acked = max(self.acked, e)
@@ -395,6 +415,19 @@ class PipelineStageProcess(ControlPlaneMember):
                 t3 = time.perf_counter()
             except _EpochChanged:
                 continue  # step void; re-runs after the new epoch
+            except Exception as e:
+                # a table op mid-step hit the durable-tier failover
+                # (VanFailover after the dance, or a raw wire error the
+                # dance can absorb): void the step exactly like an
+                # epoch change.  The re-run replays from the version-
+                # gated double buffer — a half-applied step recomputes
+                # bitwise identical and re-writes idempotently, so van
+                # chaos preserves this plane's byte-identity contract.
+                try:
+                    self._wire_fault(e)
+                except _EpochChanged:
+                    pass
+                continue
             self._work_ms = (rep["pull_s"] + rep["busy_s"] +
                              rep["write_s"]) * 1e3
             self.committed = step
@@ -512,6 +545,14 @@ class MPMDPipelineSupervisor:
                 self._replica.refresh()  # unconditional: a stale
                 # cached view must not adopt the dead primary
             port = self._replica.primary[1]
+            # a van promotion re-freezes from poll(): stages converge on
+            # the re-keyed barriers/mailboxes themselves, but the fresh
+            # epoch gives any still-parked stage a control-row edge and
+            # records the event
+            self._van_failover_pending = False
+            self._replica.register(
+                lambda _rep: setattr(self, "_van_failover_pending",
+                                     True))
         if own_van:
             self.port = van.serve(port)
         else:
@@ -763,6 +804,12 @@ class MPMDPipelineSupervisor:
         from hetu_tpu.resilience.shardproc import spawn_module
         self._incarnations += 1
         tag = f"stage_{stage}_{self._incarnations}"
+        if self._replica is not None:
+            # spawn configs carry the CURRENT pair membership: after a
+            # failover + re-silver the original endpoints may both be
+            # dead, and a fresh process has no other rendezvous
+            self.spec = StageSpec(**{**asdict(self.spec),
+                                     "van": self._replica.current_spec()})
         spec = StageSpec(**{**asdict(self.spec), "stage": int(stage),
                             "log_path": str(self.workdir /
                                             f"{tag}.jsonl")})
@@ -906,6 +953,15 @@ class MPMDPipelineSupervisor:
         # serialized with every other control-row write (the shared
         # SupervisorStragglerPlane's heal-in-poll rule)
         self._stragglers.maybe_heal()
+        if self._replica is not None and self._van_failover_pending:
+            self._van_failover_pending = False
+            self.counters["van_failover"] += 1
+            with trace.span("pipeline.van_failover") as sp:
+                sp.set("van_incarnation", self._replica.incarnation)
+                if self.svc.present_slots() and \
+                        self._committed_hw < self.steps - 1:
+                    self._refreeze()
+                sp.set("epoch", self.epoch)
         events = self.svc.poll()
         self._committed_hw = max(
             self._committed_hw,

@@ -413,6 +413,9 @@ class MemberState:
     # time the MEMBER had to ack, so a fresh joiner is never deaf-
     # suspected for an epoch published before it existed
     joined_at: float = 0.0
+    # when the slot was declared lost — the van-failover forgiveness
+    # check needs to know whether a loss straddled a promotion
+    lost_at: Optional[float] = None
     row: np.ndarray = field(default_factory=lambda: np.zeros(
         MEMBER_DIM, np.float32))
 
@@ -476,6 +479,10 @@ class MembershipService:
         self.deaf_ack_s = None if deaf_ack_s is None else float(deaf_ack_s)
         self._published_epoch = 0
         self._published_epoch_at: Optional[float] = None
+        # monotonic ts of the last durable-tier failover the caller
+        # reported via note_van_failover(); None = never (default
+        # semantics unchanged for planes without a replicated tier)
+        self._van_failover_at: Optional[float] = None
         self.members = [MemberState(slot=i) for i in range(self.n_slots)]
         self._rng = random.Random(0x4C454153)
         self.link = "controller->van"
@@ -779,6 +786,24 @@ class MembershipService:
                 # it: its old lease is void — only a NEW incarnation (a
                 # restarted process) re-admits the slot.  Keeps a
                 # zombie's stale beats from flapping the fleet.
+                #
+                # One exception: a loss declared on the heels of a
+                # durable-tier failover.  The member was beating into a
+                # van that died and spent the silence running its own
+                # promotion dance; once its beats ADVANCE again they can
+                # only be landing on the CURRENT primary (the dead van
+                # is fenced), so the process is demonstrably live and
+                # connected — re-admit without demanding a restart.
+                if (m.state == "lost" and beat != m.beat and
+                        self._van_failover_forgives(m)):
+                    m.beat = beat
+                    m.last_advance = now
+                    m.joined_at = now
+                    m.suspect_since = None
+                    m.suspect_reason = None
+                    m.lost_at = None
+                    m.state = "alive"
+                    events.append(("rejoin", m.slot))
                 continue
             if beat != m.beat:
                 m.beat = beat
@@ -833,8 +858,40 @@ class MembershipService:
                 # suspicion (our link, not theirs) and deaf suspicion
                 # (their ingress, beats still flowing) hold at suspect
                 m.state = "lost"
+                m.lost_at = now
                 events.append(("lost", m.slot))
         return events
+
+    def note_van_failover(self) -> None:
+        """The durable tier just failed over: members could not land
+        beats while the van pair promoted, so silence accrued during
+        the window is the tier's fault, not theirs.  Grant every
+        alive/suspect member a fresh lease clock, and remember the
+        moment — a ``lost`` declared shortly after (the member's own
+        failover dance outlasting the grace) is forgiven in the sweep
+        when its beats resume advancing.  Callers serialize this with
+        ``poll()``."""
+        now = time.monotonic()
+        self._van_failover_at = now
+        for m in self.members:
+            if m.state in ("alive", "suspect"):
+                m.last_advance = now
+                if m.suspect_since is not None:
+                    m.suspect_since = now
+
+    def _van_failover_forgives(self, m: "MemberState") -> bool:
+        """Was this slot's loss plausibly induced by the last durable-
+        tier failover?  A failover-induced loss lands one silence
+        budget after the fresh clock note_van_failover() grants — but
+        probe_failed blind windows (the controller itself mid-failover)
+        freeze the silence clocks while wall time runs, so the
+        declaration can drift well past that.  Four budgets of wall
+        time bounds the drift; the advancing-beat requirement at the
+        call site keeps the re-admission evidence-based regardless."""
+        if self._van_failover_at is None or m.lost_at is None:
+            return False
+        budget = self.lease_s + self.suspect_grace_s
+        return 0.0 <= m.lost_at - self._van_failover_at <= 4.0 * budget
 
     def _probe_failed(self) -> list:
         """The controller could not read the blackboard: freeze the

@@ -58,6 +58,7 @@ import struct
 import threading
 import time
 import traceback
+import weakref
 from dataclasses import asdict, dataclass, field
 from typing import Optional
 
@@ -65,12 +66,28 @@ import numpy as np
 
 from hetu_tpu.telemetry import trace as _trace
 
-# epoch-row fields (dim 8, exact in f32 like every blackboard value)
-E_INC, E_PRIMARY, E_PID = 0, 1, 2
+# epoch-row fields (dim 8, exact in f32 like every blackboard value).
+# E_BPORT names the CURRENT backup endpoint's port (0 = the spec's
+# original): a re-silver swaps the backup van under a LIVE incarnation,
+# and every process discovers the new endpoint from the primary's epoch
+# row on its normal revalidation cadence — no side channel.
+E_INC, E_PRIMARY, E_PID, E_BPORT = 0, 1, 2, 3
 EPOCH_DIM = 8
 # default epoch-table id band marker ('VEPO'); deployments normally draw
 # a fresh id (the native registry outlives van.stop())
 VAN_EPOCH_TABLE = 0x5645504F
+
+
+_DBG = os.environ.get("HETU_DEBUG_REPLICA") == "1"
+
+
+def _dbg(msg: str) -> None:
+    if _DBG:
+        import sys
+        sys.stderr.write(
+            f"[replica pid={os.getpid()} t={time.monotonic():.3f}] "
+            f"{msg}\n")
+        sys.stderr.flush()
 
 
 class VanFailover(ConnectionError):
@@ -92,6 +109,58 @@ def _is_wire_error(e: BaseException) -> bool:
     if isinstance(e, (ConnectionError, TimeoutError)):
         return True
     return isinstance(e, RuntimeError) and "hetu_ps" in str(e)
+
+
+# ---------------------------------------------------------------------------
+# deferred handle close (the fd-reassignment race)
+# ---------------------------------------------------------------------------
+# Failover paths drop handles that OTHER threads may still be using: an
+# op thread takes its handle reference lock-free, then runs the native
+# wire op outside any lock — if the dropping thread close()s that fd
+# mid-op, the kernel reassigns the number to whatever connects next
+# (a fresh channel, a spawn pipe) and the in-flight op reads/writes a
+# STRANGER's stream.  Observed as garbage bytes on a spawner's stdout
+# pipe and EBADF out of set_rcv_timeout during the chaos soak's second
+# fault.  Handles retired here are closed by a reaper only after a
+# grace period longer than any bounded wire op (connect deadline +
+# SO_RCVTIMEO), so a stale reference finishes (failing harmlessly on
+# its own connection) before the fd number can be recycled.
+
+_RETIRE_GRACE_S = 10.0
+_retired: list = []            # (deadline, closeable)
+_retired_lock = threading.Lock()
+_reaper_started = False
+
+
+def _reaper_loop() -> None:
+    while True:
+        time.sleep(_RETIRE_GRACE_S / 4)
+        now = time.monotonic()
+        due = []
+        with _retired_lock:
+            keep = []
+            for item in _retired:
+                (due if item[0] <= now else keep).append(item)
+            _retired[:] = keep
+        for _, h in due:
+            try:
+                h.close()
+            except Exception:
+                pass
+
+
+def retire_handle(h, *, grace_s: float = _RETIRE_GRACE_S) -> None:
+    """Schedule ``h.close()`` after ``grace_s`` instead of closing now.
+    Use on any van handle/channel another thread might still be inside."""
+    global _reaper_started
+    if h is None:
+        return
+    with _retired_lock:
+        _retired.append((time.monotonic() + float(grace_s), h))
+        if not _reaper_started:
+            _reaper_started = True
+            threading.Thread(target=_reaper_loop, daemon=True,
+                             name="van-handle-reaper").start()
 
 
 def set_rcv_timeout(fd: int, timeout_s: float) -> None:
@@ -126,6 +195,16 @@ class ReplicaSpec:
     max_lag: int = 64              # async stream bound, in ops
     rcv_timeout_s: float = 5.0     # SO_RCVTIMEO on replica connections
     revalidate_s: float = 0.25     # stale-primary fence check cadence
+    resilver_settle_s: float = 0.5  # wait for peers to adopt the new
+    # backup endpoint (>= their revalidate cadence) before snapshotting
+    resilver_repair_passes: int = 8  # verify/repair rounds per table
+    # owner-maintained pair-membership snapshot on SHARED storage (the
+    # fleet workdir): the epoch-row discovery protocol needs at least
+    # one reachable van, so a process whose entire cached endpoint view
+    # died (it missed a re-silver's bport publication, then the second
+    # fault took the promoted primary too) re-reads the pair from here
+    # instead of livelocking against two dead ports
+    rendezvous: Optional[str] = None
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -163,6 +242,11 @@ class VanReplica:
         self.spec = spec
         self.endpoints = [(str(h), int(p)) for h, p in spec.endpoints]
         self.lock = threading.RLock()
+        # serializes WIRE ops on the (shared) epoch handles: revalidate
+        # runs on op threads while promote/_publish_bport/_mirror run on
+        # failover + resilver threads — interleaved frames on one fd
+        # desync the stream for every later request on it
+        self._elock = threading.Lock()
         self.incarnation = 0
         self.primary_idx = 0
         self._epoch: list = [None] * len(self.endpoints)
@@ -170,6 +254,7 @@ class VanReplica:
         self._first_fail: Optional[float] = None
         self._fail_t0_us: Optional[float] = None
         self._revalidated_at = 0.0
+        self._rdv_read_at = 0.0  # rendezvous re-read rate limit
         reg = _reg()
         self._m_promotions = reg.counter(
             "van.replica.promotions",
@@ -186,7 +271,44 @@ class VanReplica:
         self._m_lag = reg.gauge(
             "van.replica.lag_ops",
             help="async replication ops queued, all streamed tables")
+        self._m_degraded = reg.gauge(
+            "van.replica.degraded",
+            help="1 while this process's writes reach only one van "
+                 "(post-promotion, before re-silvering completes)")
         self._lag_sources: list = []
+        # ---- re-silvering state ----
+        # every ReplicatedPSTable over this pair registers itself so a
+        # resilver can snapshot-copy EVERY open table (weak: a closed
+        # table must not be pinned alive by the registry)
+        self._tables: "weakref.WeakSet" = weakref.WeakSet()
+        self.degraded = False          # promoted, redundancy not yet
+        self._unrepl_debt = 0          # restored; writes since then that
+        #                                reached only the surviving van
+        self.spawn_backup = None       # owner-provided () -> (host, port)
+        # of a FRESH backup van; when set, a promotion auto-resilvers
+        self._resilvering = False
+        # owner-side: a promotion scheduled a resilver that has not
+        # COMPLETED yet.  While set, dual-writes landing on the
+        # half-attached backup must not clear the degraded window —
+        # "both vans acked" is not "the snapshot copy finished"
+        self._resilver_due = False
+        self._resilver_lock = threading.Lock()
+        self._m_resilvers = reg.counter(
+            "van.resilver.runs",
+            help="re-silver attempts started by this process")
+        self._m_resilver_rows = reg.counter(
+            "van.resilver.rows_copied",
+            help="table rows snapshot-copied onto a fresh backup")
+        self._m_resilver_catchup = reg.counter(
+            "van.resilver.catchup_ops",
+            help="journaled writes replayed onto the fresh backup at "
+                 "cutover (landed mid-copy)")
+        self._m_resilver_repaired = reg.counter(
+            "van.resilver.repaired_rows",
+            help="rows re-copied by the post-copy verify/repair loop")
+        self._m_resilver_active = reg.gauge(
+            "van.resilver.active",
+            help="1 while a re-silver is streaming in this process")
 
     # ---- construction ----
     @classmethod
@@ -233,21 +355,26 @@ class VanReplica:
                 desired = np.zeros(EPOCH_DIM, np.float32)
                 desired[E_INC] = 1.0
                 desired[E_PID] = os.getpid() % (1 << 24)
+                desired[E_BPORT] = self.endpoints[1][1]
                 try:
                     swapped, actual = h.row_cas(0, E_INC, 0.0, desired)
                     inc = 1 if swapped else int(actual[E_INC])
                     pidx = 0 if swapped else int(actual[E_PRIMARY])
+                    bport = 0 if swapped else int(actual[E_BPORT])
                 except NotImplementedError:
                     row = h.sparse_pull([0])[0]
                     if int(row[E_INC]) == 0:
                         h.sparse_set([0], desired.reshape(1, -1))
-                        inc, pidx = 1, 0
+                        inc, pidx, bport = 1, 0, 0
                     else:
-                        inc, pidx = int(row[E_INC]), int(row[E_PRIMARY])
+                        inc, pidx, bport = (int(row[E_INC]),
+                                            int(row[E_PRIMARY]),
+                                            int(row[E_BPORT]))
                 with self.lock:
                     self.incarnation = max(self.incarnation, inc)
                     self.primary_idx = pidx
                     self._m_inc.set(self.incarnation)
+                    self._adopt_bport_locked(inc, pidx, bport)
         # mirror the claimed row onto the backups (verbatim — the fence
         # every later promotion CASes against)
         self._mirror_epoch_row()
@@ -266,17 +393,21 @@ class VanReplica:
         if best is not None:
             with self.lock:
                 if best[0] > self.incarnation:
-                    self.incarnation, self.primary_idx = best
+                    self.incarnation, self.primary_idx = best[:2]
                     self._m_inc.set(self.incarnation)
+                self._adopt_bport_locked(*best)
         return self.incarnation
 
     def _mirror_epoch_row(self) -> None:
         with self.lock:
             inc, pidx = self.incarnation, self.primary_idx
+            bidx = self.backup_idx
+            bport = self.endpoints[bidx][1] if bidx is not None else 0
         row = np.zeros((1, EPOCH_DIM), np.float32)
         row[0, E_INC] = inc
         row[0, E_PRIMARY] = pidx
         row[0, E_PID] = os.getpid() % (1 << 24)
+        row[0, E_BPORT] = bport
         for i in range(len(self.endpoints)):
             if i == pidx:
                 continue
@@ -284,7 +415,8 @@ class VanReplica:
             if h is None:
                 continue
             try:
-                h.sparse_set([0], row)
+                with self._elock:
+                    h.sparse_set([0], row)
             except Exception:
                 pass  # an unreachable backup mirrors later (promotion
                 # falls back to CAS-from-0 there)
@@ -310,6 +442,98 @@ class VanReplica:
         return None
 
     # ---- views ----
+    def current_spec(self) -> dict:
+        """ReplicaSpec dict with the CURRENT pair membership — what a
+        spawn config written after failovers/re-silvers must carry: the
+        original spec's endpoints may BOTH be dead by then, and a fresh
+        process has no rendezvous to discover a re-silvered van from a
+        fully-stale endpoint list."""
+        with self.lock:
+            d = asdict(self.spec)
+            d["endpoints"] = [list(e) for e in self.endpoints]
+        return d
+
+    def write_rendezvous(self) -> None:
+        """Owner-side: atomically snapshot the CURRENT pair membership
+        to ``spec.rendezvous`` (shared fleet storage).  Peers read it
+        only as a last resort — when their whole cached endpoint view
+        is unreachable — so staleness costs nothing and freshness
+        rescues a process that slept through a re-silver."""
+        path = self.spec.rendezvous
+        if not path:
+            return
+        try:
+            with self.lock:
+                snap = {"incarnation": int(self.incarnation),
+                        "primary_idx": int(self.primary_idx),
+                        "endpoints": [list(e) for e in self.endpoints]}
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, path)
+        except Exception:
+            pass  # best-effort: the epoch rows remain the truth
+
+    def _refresh_from_rendezvous(self) -> bool:
+        """Reload pair membership from the owner's rendezvous snapshot.
+        Called when the failover dance dead-ends (primary AND backup
+        unreachable): a process that missed a re-silver's bport
+        publication — and then lost the promoted primary to the next
+        fault — holds a fully-dead endpoint view with no van left to
+        discover the survivors from.  Returns True when the snapshot
+        moved an endpoint; the caller re-runs discovery against the
+        refreshed pair (the epoch rows there carry the authoritative
+        incarnation — the file never adopts one directly)."""
+        path = self.spec.rendezvous
+        if not path:
+            return False
+        now = time.monotonic()
+        with self.lock:
+            if now - self._rdv_read_at < 1.0:
+                return False
+            self._rdv_read_at = now
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+            inc = int(snap["incarnation"])
+            eps = [(str(h), int(p)) for h, p in snap["endpoints"]]
+        except Exception:
+            return False
+        with self.lock:
+            if inc < self.incarnation or len(eps) != len(self.endpoints):
+                return False
+            cand = []
+            for i, ep in enumerate(eps):
+                if ep == self.endpoints[i]:
+                    continue
+                if inc == self.incarnation and i == self.primary_idx:
+                    # under an UNCHANGED incarnation only the backup
+                    # slot legitimately moves (re-silver); the primary
+                    # moves only with an incarnation advance
+                    continue
+                cand.append(i)
+        changed = False
+        for i in cand:
+            # the file can be STALER than this process's view (a
+            # half-attached backup's epoch read fails even though its
+            # van answers): never replace an endpoint that still
+            # accepts — regressing a live slot to a dead snapshot
+            # would wedge the very failover this fallback unsticks
+            if self._ping(i):
+                continue
+            with self.lock:
+                if eps[i] == self.endpoints[i]:
+                    continue
+                _dbg(f"rendezvous: slot {i} {self.endpoints[i]} -> "
+                     f"{eps[i]} (file inc={inc}, ours={self.incarnation})")
+                self.endpoints[i] = eps[i]
+                h, self._epoch[i] = self._epoch[i], None
+                retire_handle(h)
+                for t in list(self._tables):
+                    t._drop_handle(i)
+                changed = True
+        return changed
+
     @property
     def primary(self) -> tuple:
         with self.lock:
@@ -340,9 +564,16 @@ class VanReplica:
             self._lag_sources.append(fn)
 
     def export_lag(self) -> int:
+        """Refresh the replication-lag gauge.  While the pair is
+        DEGRADED (promoted, backup not yet re-silvered) the unreplicated
+        write debt counts as lag: the async streamer drains (dropping)
+        against the dead ex-backup, so raw queue depth reads 0 exactly
+        when the pair is least healthy — the satellite bug this method
+        used to have."""
         with self.lock:
             srcs = list(self._lag_sources)
-        lag = 0
+            debt = self._unrepl_debt if self.degraded else 0
+        lag = debt
         for fn in srcs:
             try:
                 lag += int(fn())
@@ -350,6 +581,55 @@ class VanReplica:
                 pass
         self._m_lag.set(lag)
         return lag
+
+    def _note_unreplicated(self) -> None:
+        """A mutating op reached only the surviving van — the debt the
+        degraded-window lag gauge must keep visible."""
+        with self.lock:
+            self._unrepl_debt += 1
+            if self.degraded:
+                self._m_lag.set(self._unrepl_debt)
+
+    def _note_replicated(self) -> None:
+        """A write landed on BOTH vans again.  Outside a resilver that
+        means the backup endpoint is live (either it bounced back or
+        this process adopted a re-silvered endpoint) — clear the
+        degraded flag.  During a resilver the owner keeps it set until
+        the snapshot copy + catchup drain actually finish."""
+        if not self.degraded or self._resilvering or self._resilver_due:
+            return
+        with self.lock:
+            if self.degraded and not self._resilvering \
+                    and not self._resilver_due:
+                self.degraded = False
+                self._unrepl_debt = 0
+                self._m_degraded.set(0)
+
+    def _adopt_bport_locked(self, inc: int, pidx: int,
+                            bport: int) -> bool:
+        """Caller holds ``self.lock``.  Adopt a re-silvered backup
+        endpoint advertised in an epoch row: the backup slot's PORT
+        moves (host stays — re-silvering is same-host in this tier),
+        and every handle bound to the replaced endpoint is dropped so
+        it rebuilds against the new van."""
+        if bport <= 0 or inc < self.incarnation:
+            return False
+        try:
+            bidx = next(i for i in range(len(self.endpoints))
+                        if i != pidx)
+        except StopIteration:
+            return False
+        host, cur = self.endpoints[bidx]
+        if int(bport) == cur:
+            return False
+        _dbg(f"adopt bport inc={inc} pidx={pidx} "
+             f"bport {cur}->{int(bport)}")
+        self.endpoints[bidx] = (host, int(bport))
+        h, self._epoch[bidx] = self._epoch[bidx], None
+        retire_handle(h)  # the failover dance may be inside it
+        for t in list(self._tables):
+            t._drop_handle(bidx)
+        return True
 
     # ---- the failover dance ----
     def note_ok(self) -> None:
@@ -374,8 +654,12 @@ class VanReplica:
         info = self._read_epoch(pidx)
         if info is None:
             return False
-        inc, new_pidx = info
+        inc, new_pidx, bport = info
         with self.lock:
+            # a re-silvered backup endpoint rides the SAME incarnation
+            # (the primary did not change): adopt it silently — the
+            # write proceeds, now dual-writing to the fresh backup
+            self._adopt_bport_locked(inc, new_pidx, bport)
             if inc > self.incarnation:
                 self._adopt_locked(inc, new_pidx, won=False)
                 return True
@@ -386,14 +670,13 @@ class VanReplica:
         if h is None:
             return None
         try:
-            row = h.sparse_pull([0])[0]
+            with self._elock:
+                row = h.sparse_pull([0])[0]
         except Exception:
-            try:
-                h.close()
-            finally:
-                self._epoch[idx] = None
+            self._epoch[idx] = None
+            retire_handle(h)  # epoch handles are shared across threads
             return None
-        return int(row[E_INC]), int(row[E_PRIMARY])
+        return int(row[E_INC]), int(row[E_PRIMARY]), int(row[E_BPORT])
 
     def _ping(self, idx: int) -> bool:
         """Fresh short-deadline connect + ping: a SIGKILLed van refuses
@@ -429,8 +712,27 @@ class VanReplica:
         info = self._read_epoch(bidx)
         if info is not None and info[0] > self.incarnation:
             with self.lock:
+                self._adopt_bport_locked(*info)
                 self._adopt_locked(info[0], info[1], won=False)
             return True
+        if info is None and self._refresh_from_rendezvous():
+            # the whole cached pair view was dead: the owner's
+            # snapshot replaced it — re-run discovery against the
+            # refreshed endpoints (either slot's epoch row carries the
+            # authoritative incarnation; the fresh backup's is mirrored
+            # at resilver cutover)
+            with self.lock:
+                pidx = self.primary_idx
+                bidx = self.backup_idx
+            for idx in (bidx, pidx):
+                if idx is None:
+                    continue
+                info = self._read_epoch(idx)
+                if info is not None and info[0] > self.incarnation:
+                    with self.lock:
+                        self._adopt_bport_locked(*info)
+                        self._adopt_locked(info[0], info[1], won=False)
+                    return True
         if self._ping(pidx):
             self.note_ok()
             return False
@@ -456,17 +758,30 @@ class VanReplica:
         desired[E_INC] = observed + 1
         desired[E_PRIMARY] = bidx
         desired[E_PID] = os.getpid() % (1 << 24)
+        # after the swap the ex-primary slot IS the backup: carry its
+        # current port so late-joining processes reconstruct the pair's
+        # true membership even after earlier re-silvers moved it
+        desired[E_BPORT] = self.endpoints[pidx][1]
         try:
-            swapped, actual = h.row_cas(0, E_INC, float(observed),
-                                        desired)
+            with self._elock:
+                swapped, actual = h.row_cas(0, E_INC, float(observed),
+                                            desired)
+                if not swapped and not np.asarray(actual).any():
+                    # never-mirrored epoch row: a half-attached backup
+                    # whose resilver died before cutover answers with
+                    # the zeroed row create-on-connect planted.  Claim
+                    # from zero — the CAS still arbitrates racing
+                    # claimants, exactly one swap lands
+                    swapped, actual = h.row_cas(0, E_INC, 0.0, desired)
         except NotImplementedError:
             # old van: read-then-write (the verified pre-CAS fallback)
-            row = h.sparse_pull([0])[0]
-            if int(row[E_INC]) > observed:
-                swapped, actual = False, row
-            else:
-                h.sparse_set([0], desired.reshape(1, -1))
-                swapped, actual = True, desired
+            with self._elock:
+                row = h.sparse_pull([0])[0]
+                if int(row[E_INC]) > observed:
+                    swapped, actual = False, row
+                else:
+                    h.sparse_set([0], desired.reshape(1, -1))
+                    swapped, actual = True, desired
         except Exception:
             return False
         with self.lock:
@@ -479,11 +794,15 @@ class VanReplica:
                     # primary (e.g. a never-mirrored epoch row): adopt
                     # nothing — the next attempt re-reads and converges
                     return False
+                self._adopt_bport_locked(inc, np_idx,
+                                         int(actual[E_BPORT]))
                 self._adopt_locked(inc, np_idx, won=False)
         return True
 
     def _adopt_locked(self, inc: int, pidx: int, *, won: bool) -> None:
         """Caller holds ``self.lock``."""
+        _dbg(f"adopt inc={inc} pidx={pidx} won={won} "
+             f"endpoints={self.endpoints}")
         old_pidx = self.primary_idx
         self.incarnation = int(inc)
         self.primary_idx = int(pidx)
@@ -497,6 +816,26 @@ class VanReplica:
         else:
             self._m_adopted.inc()
         self._m_failovers.inc()
+        # the promoted pair runs on ONE van until a resilver lands:
+        # mark the degraded window and re-export the lag gauge under
+        # the new incarnation NOW — the streamer is about to drain
+        # (dropping) against the dead ex-backup and would read 0
+        self.degraded = True
+        self._unrepl_debt = 0
+        self._m_degraded.set(1)
+        self._m_lag = _reg().gauge(
+            "van.replica.lag_ops",
+            help="async replication ops queued, all streamed tables")
+        self._m_lag.set(0)
+        if self.spawn_backup is not None:
+            # the resilver owner keeps the shared rendezvous snapshot
+            # current so peers stranded on dead endpoints can re-find
+            # the pair (the resilver completion re-writes it with the
+            # fresh backup)
+            self.write_rendezvous()
+            self._resilver_due = True
+            threading.Thread(target=self._auto_resilver,
+                             daemon=True).start()
         # the retroactive recovery span the timeline pairs with
         # fault.van_kill / fault.van_suspend: detection start -> adopted
         _trace.complete(
@@ -518,36 +857,236 @@ class VanReplica:
 
     def _fence_old_primary(self, old_idx: int, inc: int,
                            pidx: int) -> None:
+        with self.lock:
+            old_ep = self.endpoints[old_idx]
         row = np.zeros((1, EPOCH_DIM), np.float32)
         row[0, E_INC] = inc
         row[0, E_PRIMARY] = pidx
         row[0, E_PID] = os.getpid() % (1 << 24)
+        row[0, E_BPORT] = old_ep[1]
         deadline = time.monotonic() + 600.0
         while time.monotonic() < deadline:
             with self.lock:
                 if self.incarnation > inc:
                     return  # a later promotion owns the fencing now
+                if self.endpoints[old_idx] != old_ep:
+                    # a re-silver replaced this slot's endpoint: the
+                    # SIGKILLed van this fence was aimed at is never
+                    # coming back, and dialing the slot now reaches the
+                    # FRESH backup — where a create-on-connect would
+                    # plant a zeroed epoch row and this fence row (its
+                    # E_BPORT names the dead port) could clobber the
+                    # mirrored one.  The fence is moot; stop.
+                    return
             h = self._epoch_handle(old_idx, create=True)
             if h is not None:
                 try:
-                    cur = h.sparse_pull([0])[0]
-                    if int(cur[E_INC]) >= inc:
-                        return  # already fenced (by us or a peer)
-                    h.sparse_set([0], row)
+                    with self._elock:
+                        cur = h.sparse_pull([0])[0]
+                        if int(cur[E_INC]) >= inc:
+                            return  # already fenced (by us or a peer)
+                        h.sparse_set([0], row)
                     return
                 except Exception:
+                    self._epoch[old_idx] = None
+                    retire_handle(h)
+            time.sleep(1.0)
+
+    # ---- re-silvering: restore redundancy after a promotion ----
+    def register_table(self, table) -> None:
+        with self.lock:
+            self._tables.add(table)
+
+    def _auto_resilver(self) -> None:
+        """Promotion hook (``spawn_backup`` installed): attach a fresh
+        backup without an operator.  Retried against a deadline — the
+        first attempt may race the tail of the failover it reacts to,
+        and on a loaded host the snapshot copy itself can time out
+        repeatedly before the fresh van warms up."""
+        with self.lock:
+            inc0 = self.incarnation
+        ep = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            with self.lock:
+                if self.incarnation != inc0:
+                    return  # superseded: the next promotion re-runs it
+            try:
+                if self.resilver(ep):
+                    return
+            except Exception:
+                traceback.print_exc()
+            # a failed attempt whose van still answers retries onto
+            # the SAME van: a fresh spawn per attempt leaks the orphan
+            # and re-pays process warmup — the main reason attempts
+            # time out back-to-back under load
+            ep = None
+            with self.lock:
+                bidx = self.backup_idx
+            if bidx is not None and self._ping(bidx):
+                with self.lock:
+                    ep = tuple(self.endpoints[bidx])
+            time.sleep(max(self.spec.promote_after_s, 0.5))
+
+    def _publish_bport(self, inc: int, pidx: int, bport: int) -> bool:
+        """CAS the re-silvered backup port into the PRIMARY's epoch row
+        under the UNCHANGED incarnation — a racing promotion moves the
+        incarnation and the CAS loses, aborting the resilver (the new
+        primary's owner re-runs it)."""
+        h = self._epoch_handle(pidx, create=True)
+        if h is None:
+            return False
+        desired = np.zeros(EPOCH_DIM, np.float32)
+        desired[E_INC] = inc
+        desired[E_PRIMARY] = pidx
+        desired[E_PID] = os.getpid() % (1 << 24)
+        desired[E_BPORT] = bport
+        try:
+            with self._elock:
+                swapped, _ = h.row_cas(0, E_INC, float(inc), desired)
+            return bool(swapped)
+        except NotImplementedError:
+            with self._elock:
+                row = h.sparse_pull([0])[0]
+                if int(row[E_INC]) != inc:
+                    return False
+                h.sparse_set([0], desired.reshape(1, -1))
+            return True
+        except Exception:
+            return False
+
+    def resilver(self, endpoint=None, *,
+                 settle_s: Optional[float] = None) -> bool:
+        """Restore redundancy after a promotion: attach a FRESH backup
+        van and stream a consistent snapshot of every open table onto
+        it over the durable-slot snapshot/repair wire (rows via
+        ``dense_pull``/``sparse_set``, optimizer slots via
+        ``slots_get``/``slots_set``), while dual-write journaling
+        catches up writes that land mid-copy.
+
+        Sequence (one resilver at a time per process):
+
+        1. resolve the new endpoint — the ``endpoint`` argument, else
+           the owner-installed ``spawn_backup`` hook;
+        2. adopt it locally and CAS-publish it (``E_BPORT``) on the
+           primary's epoch row under the UNCHANGED incarnation; every
+           peer process adopts it on its next revalidation window and
+           resumes dual-writing, so peer writes during the copy land
+           on the new backup too;
+        3. settle for >= the peers' revalidate cadence, then journal
+           this process's own replication stream per table and
+           snapshot-copy rows + slots primary -> backup;
+        4. cut over: drain the journal onto the backup, resume direct
+           dual-write;
+        5. verify/repair: re-compare rows + slots on both sides and
+           re-copy divergent rows (peer writes that raced the copy)
+           until bitwise identical or the pass budget runs out —
+           still-hot rows converge through the restored dual-write;
+        6. re-assert the epoch row on the primary (incarnation still
+           unchanged), mirror it verbatim onto the new backup, clear
+           the degraded window.
+
+        Returns True when the pair is redundant again."""
+        if not self._resilver_lock.acquire(blocking=False):
+            return False
+        t0 = _trace.now_us()
+        ok = False
+        tables: list = []
+        rows_copied = catchup_ops = repaired = 0
+        port = 0
+        try:
+            with self.lock:
+                inc0 = self.incarnation
+                pidx = self.primary_idx
+                bidx = self.backup_idx
+            if bidx is None:
+                return False
+            if endpoint is None:
+                if self.spawn_backup is None:
+                    return False
+                endpoint = self.spawn_backup(self)
+            host, port = str(endpoint[0]), int(endpoint[1])
+            self._m_resilvers.inc()
+            self._resilvering = True
+            self._m_resilver_active.set(1)
+            with self.lock:
+                self.endpoints[bidx] = (host, port)
+                h, self._epoch[bidx] = self._epoch[bidx], None
+                if h is not None:
                     try:
                         h.close()
-                    finally:
-                        self._epoch[old_idx] = None
-            time.sleep(1.0)
+                    except Exception:
+                        pass
+                tables = [t for t in self._tables if t.replicate]
+                for t in tables:
+                    t._drop_handle(bidx)
+            if not self._publish_bport(inc0, pidx, port):
+                return False
+            # the pair's MEMBERSHIP changed the moment the bport
+            # published — peers dual-write to the fresh van from their
+            # next revalidation on, whether or not this copy attempt
+            # finishes.  Mirror the epoch row and rewrite the
+            # rendezvous snapshot NOW: a failed copy must not leave an
+            # adopted backup that is unpromotable (zeroed epoch row)
+            # and undiscoverable (stale snapshot) through the next
+            # fault.
+            self._mirror_epoch_row()
+            self.write_rendezvous()
+            time.sleep(self.spec.resilver_settle_s
+                       if settle_s is None else float(settle_s))
+            for t in tables:
+                t._begin_catchup()
+            for t in tables:
+                rows_copied += t._resilver_copy(bidx)
+            for t in tables:
+                catchup_ops += t._drain_catchup(bidx)
+            for t in tables:
+                repaired += t._resilver_verify(
+                    bidx, self.spec.resilver_repair_passes)
+            # the incarnation must not have moved during the copy
+            if not self._publish_bport(inc0, pidx, port):
+                return False
+            with self.lock:
+                if self.incarnation != inc0:
+                    return False
+            self._mirror_epoch_row()
+            with self.lock:
+                self.degraded = False
+                self._resilver_due = False
+                self._unrepl_debt = 0
+                self._m_degraded.set(0)
+            self.export_lag()
+            # peers discover the fresh backup from the epoch row on
+            # their revalidate cadence; the rendezvous snapshot covers
+            # the ones that miss the window entirely
+            self.write_rendezvous()
+            ok = True
+            return True
+        finally:
+            self._resilvering = False
+            self._m_resilver_active.set(0)
+            for t in tables:
+                t._abort_catchup()  # no-op after a clean cutover
+            self._m_resilver_rows.inc(rows_copied)
+            self._m_resilver_catchup.inc(catchup_ops)
+            self._m_resilver_repaired.inc(repaired)
+            _trace.complete(
+                "van.resilver", t0,
+                {"ok": ok, "tables": len(tables),
+                 "rows_copied": rows_copied,
+                 "catchup_ops": catchup_ops,
+                 "repaired_rows": repaired,
+                 "backup_port": port,
+                 "incarnation": self.incarnation}, cat="van")
+            self._resilver_lock.release()
 
     # ---- factories ----
     def table(self, rows: int, dim: int, **kw) -> "ReplicatedPSTable":
         return ReplicatedPSTable(self, rows, dim, **kw)
 
     def channel(self, channel_id: int, *,
-                connect_timeout_s: float = 2.0):
+                connect_timeout_s: float = 2.0,
+                failover_wait_s: Optional[float] = None):
         """A ``BlobChannel`` at the CURRENT primary.  Channels are
         transient transport, not durable state — they are not
         replicated; callers rebind (``BlobChannel`` at the new
@@ -555,12 +1094,99 @@ class VanReplica:
         a controller-incarnation rebind.  The connect budget is SHORT
         (its in-op reconnects inherit it): a channel op against a dead
         primary must fail fast so the failover dance runs, not park
-        the caller for the default 20s."""
+        the caller for the default 20s.
+
+        A refused connect DRIVES the failover dance here, exactly like
+        a failed table op in :class:`ReplicatedPSTable`: binding a
+        channel is often the FIRST van contact after a rebind signal,
+        and on a second/third fault the rebind itself may be what
+        discovers the fresh corpse — the bind must promote and
+        re-target, not surface a crash to the watch/rebind loop that
+        called it.  ``failover_wait_s`` bounds the retry window
+        (default: promote_after_s plus connect slack).
+
+        The SAME applies mid-op: an ESTABLISHED channel whose van dies
+        reconnects inside put/get/ack, and a reconnect that dialed the
+        snapshot endpoint would ring a corpse for the whole op timeout
+        — with the caller often holding a per-member send lock, so one
+        wedged scrape serializes every later submit behind it.  The
+        returned channel therefore re-resolves the CURRENT primary and
+        drives the failover dance on every in-op reconnect too."""
+        cls = _replica_channel_cls()
+        if failover_wait_s is None:
+            failover_wait_s = self.spec.promote_after_s + 3.0
+        deadline = time.monotonic() + failover_wait_s
+        while True:
+            host, port = self.primary
+            try:
+                ch = cls(host, port, channel_id,
+                         connect_timeout_s=connect_timeout_s,
+                         rcv_timeout_s=self.spec.rcv_timeout_s)
+                ch._bind_replica(self, failover_wait_s)
+                return ch
+            except ConnectionError as e:
+                if self.failover(e):
+                    continue  # promoted/adopted: bind at the new primary
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+
+
+_REPLICA_CHANNEL_CLS = None
+
+
+def _replica_channel_cls():
+    """Lazily defined (van.py imports stay function-local here): a
+    ``BlobChannel`` whose mid-op reconnects chase the replica's CURRENT
+    primary instead of the endpoint snapshotted at bind time."""
+    global _REPLICA_CHANNEL_CLS
+    if _REPLICA_CHANNEL_CLS is None:
         from hetu_tpu.ps.van import BlobChannel
-        host, port = self.primary
-        return BlobChannel(host, port, channel_id,
-                           connect_timeout_s=connect_timeout_s,
-                           rcv_timeout_s=self.spec.rcv_timeout_s)
+
+        class _ReplicaBlobChannel(BlobChannel):
+            _replica: Optional[VanReplica] = None
+            _failover_wait_s = 3.0
+            _bound_inc = 0
+
+            def _bind_replica(self, replica, failover_wait_s) -> None:
+                self._replica = replica
+                self._failover_wait_s = float(failover_wait_s)
+                self._bound_inc = replica.incarnation
+
+            def _reconnect(self) -> None:
+                rep = self._replica
+                if rep is None:
+                    return super()._reconnect()
+                deadline = time.monotonic() + self._failover_wait_s
+                while True:
+                    if rep.incarnation != self._bound_inc:
+                        # the van this channel's STATE lived on is
+                        # gone: a reconnect that silently resumed this
+                        # seq on the promoted van would desync against
+                        # the peer's rebound seq-1 stream.  The caller
+                        # must REBIND (fresh channel, seq reset) — the
+                        # in-op reconnect's job is only to drive the
+                        # promotion so that rebind has a live target.
+                        raise VanFailover(
+                            "van channel bound to superseded "
+                            f"incarnation {self._bound_inc}; rebind "
+                            f"at incarnation {rep.incarnation}")
+                    try:
+                        return super()._reconnect()
+                    except ConnectionError as e:
+                        # the failed reconnect already closed the old
+                        # fd: forget the number, or the next attempt
+                        # would close it AGAIN after the kernel may
+                        # have reassigned it to another thread
+                        self.fd = -1
+                        rep.failover(e)  # drive the dance; the loop
+                        # head turns a landed promotion into rebind
+                        if time.monotonic() >= deadline:
+                            raise
+                        time.sleep(0.05)
+
+        _REPLICA_CHANNEL_CLS = _ReplicaBlobChannel
+    return _REPLICA_CHANNEL_CLS
 
 
 def open_table(van_spec, host: str, port: int, rows: int, dim: int, *,
@@ -639,8 +1265,12 @@ class _ReplicaStreamer:
                     time.sleep(0.05)
             if ok:
                 self._m_streamed.inc()
+                self.owner.replica._note_replicated()
             else:
                 self._m_dropped.inc()
+                # a dropped op is exactly the debt the degraded-window
+                # lag gauge must keep visible (the queue itself drains)
+                self.owner.replica._note_unreplicated()
 
 
 class ReplicatedPSTable:
@@ -676,6 +1306,19 @@ class ReplicatedPSTable:
             help="mutating ops that reached only one van (backup "
                  "down, or post-failover single-van operation)")
         self._streamer: Optional[_ReplicaStreamer] = None
+        # resilver catch-up journal: while a resilver snapshot-copies
+        # this table, replication writes queue here instead of racing
+        # the copy; the cutover drains them onto the new backup
+        self._cu_lock = threading.Lock()
+        self._catchup: Optional[list] = None
+        # negative cache for a DEAD backup endpoint: in the degraded
+        # window (promoted, resilver not yet landed) the backup slot
+        # names the fresh corpse, and a sync-replicated write must not
+        # pay the full connect deadline re-probing it — that stall sat
+        # on the controller's poll loop and turned the SECOND fault's
+        # promotion from sub-second into tens of seconds.  One probe
+        # per window; an endpoint change (resilver adoption) resets it
+        self._backup_down_until = 0.0
         # build the primary handle eagerly (construction errors must
         # surface like RemotePSTable's)
         h = self._build_handle(replica.primary_idx)
@@ -693,6 +1336,8 @@ class ReplicatedPSTable:
             self._streamer = _ReplicaStreamer(self,
                                               replica.spec.max_lag)
             replica.register_lag_source(self._streamer.lag)
+        if self.replicate:
+            replica.register_table(self)
         self.dtype = self._table_kw.get("dtype", "f32")
 
     # ---- handles ----
@@ -743,20 +1388,17 @@ class ReplicatedPSTable:
         bidx = self.replica.backup_idx
         with self._hlock:
             h = self._handles.pop(bidx, None)
-        if h is not None:
-            try:
-                h.close()
-            except Exception:
-                pass
+        retire_handle(h)
 
     def _drop_handle(self, idx: int) -> None:
         with self._hlock:
             h = self._handles.pop(idx, None)
-        if h is not None:
-            try:
-                h.close()
-            except Exception:
-                pass
+        # topology moved under this slot (promotion re-labeled it, or a
+        # resilver replaced the endpoint): the backup negative cache is
+        # stale — allow an immediate re-probe
+        self._backup_down_until = 0.0
+        # deferred close: an op thread may still be inside this handle
+        retire_handle(h)
 
     # ---- the fence / failover core ----
     def _pre_write_check(self) -> None:
@@ -804,16 +1446,30 @@ class ReplicatedPSTable:
         return out
 
     def _replicate(self, name: str, args, kw) -> None:
+        with self._cu_lock:
+            if self._catchup is not None:
+                # a resilver is snapshot-copying this table: journal
+                # the write; the cutover drains it onto the backup in
+                # order, after the copy
+                self._catchup.append((name, args, kw))
+                return
         if self._streamer is not None:
             self._streamer.put(name, args, kw)
             return
+        if time.monotonic() < self._backup_down_until:
+            self._m_unrepl.inc()
+            self.replica._note_unreplicated()
+            return
         h = self._backup_handle()
         if h is None:
+            self._backup_down_until = time.monotonic() + 1.0
             self._m_unrepl.inc()
+            self.replica._note_unreplicated()
             return
         try:
             getattr(h, name)(*args, **kw)
             self._m_sync.inc()
+            self.replica._note_replicated()
         except Exception as e:
             if not _is_wire_error(e):
                 raise
@@ -825,10 +1481,136 @@ class ReplicatedPSTable:
                 try:
                     getattr(h, name)(*args, **kw)
                     self._m_sync.inc()
+                    self.replica._note_replicated()
                     return
                 except Exception:
                     self._drop_backup_handle()
+            self._backup_down_until = time.monotonic() + 1.0
             self._m_unrepl.inc()
+            self.replica._note_unreplicated()
+
+    # ---- resilver plumbing (driven by VanReplica.resilver) ----
+    def _begin_catchup(self) -> None:
+        with self._cu_lock:
+            self._catchup = []
+
+    def _drain_catchup(self, bidx: int) -> int:
+        """Cutover: apply the journaled writes to the new backup in
+        order, then resume direct dual-write.  Holds the journal lock
+        throughout — concurrent writers block for the (short) drain
+        instead of interleaving out of order."""
+        n = 0
+        with self._cu_lock:
+            ops, self._catchup = (self._catchup or []), None
+            for name, args, kw in ops:
+                h = self._handle(bidx)
+                if h is None:
+                    self.replica._note_unreplicated()
+                    continue
+                try:
+                    getattr(h, name)(*args, **kw)
+                    n += 1
+                except Exception as e:
+                    if not _is_wire_error(e):
+                        raise
+                    self._drop_handle(bidx)
+                    self.replica._note_unreplicated()
+        return n
+
+    def _abort_catchup(self) -> None:
+        """A resilver died mid-copy: the journaled writes never reached
+        the backup — count them as unreplicated debt and resume the
+        normal (degraded) write path."""
+        with self._cu_lock:
+            ops, self._catchup = (self._catchup or []), None
+        for _ in ops:
+            self.replica._note_unreplicated()
+
+    def _resilver_conn(self, idx: int):
+        """Dedicated connection for bulk resilver traffic on slot
+        ``idx``, never entered into the handle cache.  The cached
+        op-path handles are shared by op threads with no per-fd lock;
+        a full-table snapshot interleaving frames with a concurrent op
+        desyncs the stream for BOTH users, and every later request on
+        that fd returns a transport error.  Bulk copy and verify run
+        on private fds instead, closed when the pass finishes."""
+        from hetu_tpu.ps.van import RemotePSTable
+        host, port = self.replica.endpoints[idx]
+        kw = dict(self._table_kw)
+        kw["connect_timeout_s"] = 2.0
+        # full-table pulls are much larger than op-path frames
+        kw["rcv_timeout_s"] = max(
+            float(self.replica.spec.rcv_timeout_s), 5.0)
+        for do_create in (self._create, not self._create):
+            try:
+                return RemotePSTable(host, port, self.rows, self.dim,
+                                     table_id=self.id, create=do_create,
+                                     **kw)
+            except Exception:
+                continue
+        return None
+
+    def _resilver_copy(self, bidx: int) -> int:
+        """Snapshot rows + optimizer slots primary -> fresh backup over
+        the durable-slot repair wire.  The backup-side handle CREATES
+        the table (same table_kw) when it does not exist yet."""
+        hp = self._resilver_conn(self.replica.primary_idx)
+        hb = self._resilver_conn(bidx)
+        try:
+            if hp is None or hb is None:
+                raise ConnectionError(
+                    f"resilver: van pair unreachable for table "
+                    f"{self.id:#x}")
+            idx = np.arange(self.rows, dtype=np.int64)
+            hb.sparse_set(idx, hp.dense_pull())
+            s1, s2, step = hp.slots_get(idx)
+            hb.slots_set(idx, s1, s2, step)
+            return self.rows
+        finally:
+            for h in (hp, hb):
+                if h is not None:
+                    try:
+                        h.close()
+                    except Exception:
+                        pass
+
+    def _resilver_verify(self, bidx: int, passes: int) -> int:
+        """Compare rows + slots on both vans, re-copying divergent rows
+        (peer writes that raced the snapshot), until bitwise identical
+        or the pass budget runs out.  Rows still being written diverge
+        transiently between the two (non-atomic) reads — the restored
+        dual-write converges them; quiesced tables come out exact."""
+        hp = self._resilver_conn(self.replica.primary_idx)
+        hb = self._resilver_conn(bidx)
+        try:
+            if hp is None or hb is None:
+                raise ConnectionError(
+                    f"resilver: van pair unreachable for table "
+                    f"{self.id:#x}")
+            idx = np.arange(self.rows, dtype=np.int64)
+            repaired = 0
+            for _ in range(max(int(passes), 1)):
+                wp, wb = hp.dense_pull(), hb.dense_pull()
+                s1p, s2p, stp = hp.slots_get(idx)
+                s1b, s2b, stb = hb.slots_get(idx)
+                bad = ~(np.all(wp == wb, axis=1)
+                        & np.all(s1p == s1b, axis=1)
+                        & np.all(s2p == s2b, axis=1)
+                        & (stp == stb))
+                if not bad.any():
+                    break
+                rows = idx[bad]
+                hb.sparse_set(rows, wp[bad])
+                hb.slots_set(rows, s1p[bad], s2p[bad], stp[bad])
+                repaired += int(bad.sum())
+            return repaired
+        finally:
+            for h in (hp, hb):
+                if h is not None:
+                    try:
+                        h.close()
+                    except Exception:
+                        pass
 
     # ---- RemotePSTable surface ----
     def ping(self) -> bool:

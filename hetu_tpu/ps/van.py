@@ -211,7 +211,20 @@ def _connect_with_deadline(host: str, port: int, timeout_s: float,
         fd = lib.ps_van_connect(host.encode(), port)
     if rcv_timeout_s is not None and rcv_timeout_s > 0:
         from hetu_tpu.ps.replica import set_rcv_timeout
-        set_rcv_timeout(fd, rcv_timeout_s)
+        try:
+            set_rcv_timeout(fd, rcv_timeout_s)
+        except OSError as e:
+            # the connection died between connect and the setsockopt
+            # (kernel reset, or a raced peer close): surface it as the
+            # wire error it is — retry layers classify ConnectionError,
+            # not EBADF — and do not leak the fd
+            try:
+                lib.ps_van_close(fd)
+            except Exception:
+                pass
+            raise ConnectionError(
+                f"van connection to {host}:{port} died during "
+                f"setup") from e
     return fd
 
 

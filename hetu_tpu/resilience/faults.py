@@ -84,6 +84,22 @@ Fault kinds
                    receive timeouts surface the hang, the backup
                    promotes, and the RESUMED old primary is fenced
                    (its epoch row names its successor)
+``van_resilver_kill``  the SECOND-fault kind: once the previous van
+                   fault's promotion has RE-SILVERED (a fresh backup
+                   attached, pair bitwise-identical again), SIGKILL
+                   the promoted primary — survival proves redundancy
+                   was genuinely restored, not just reported.  Paced
+                   by the driver (recovery-aware: injected only after
+                   ``van.resilver`` closed), drained via
+                   :meth:`FaultInjector.pop_campaign_events`
+``controller_kill_mid_failover``  SIGKILL the controller WHILE a van
+                   failover/re-silver is in flight — the takeover must
+                   re-derive both the fleet AND the current van pair
+                   from what survives (paced by the driver)
+``member_kill_mid_resilver``  SIGKILL a serving-member process WHILE
+                   the pair is re-silvering — the copy/catch-up stream
+                   must stay consistent across a concurrent member
+                   failover (paced by the driver)
 
 The van hooks ride :func:`hetu_tpu.ps.van.set_fault_hook` (one-shot
 faults) and :func:`hetu_tpu.ps.van.set_netem_hook` (link policies);
@@ -127,7 +143,9 @@ KINDS = ("van_error", "van_delay", "data_error", "nan_grad",
          "netem_partition", "netem_degrade", "straggler",
          "stage_kill", "stage_slow",
          "controller_kill", "controller_suspend",
-         "van_kill", "van_suspend")
+         "van_kill", "van_suspend",
+         "van_resilver_kill", "controller_kill_mid_failover",
+         "member_kill_mid_resilver")
 
 
 @dataclass(frozen=True, order=True)
@@ -190,7 +208,10 @@ class FaultSchedule:
                  n_controllers: int = 1,
                  van_kills: int = 0, van_suspends: int = 0,
                  van_suspend_s: float = 1.5,
-                 n_vans: int = 1) -> "FaultSchedule":
+                 n_vans: int = 1,
+                 van_resilver_kills: int = 0,
+                 controller_mid_failover_kills: int = 0,
+                 member_mid_resilver_kills: int = 0) -> "FaultSchedule":
         """Draw a schedule over training steps ``[1, steps)`` from ``seed``.
 
         Counts are clipped to the available steps.  Shard-targeted faults
@@ -249,6 +270,18 @@ class FaultSchedule:
         ``van_suspend_s`` seconds (the fenced-resume path) — victims
         uniform from ``n_vans``, drawn after EVERY kind above (SIXTH
         extension of the frozen-bytes contract).
+
+        Sequential-campaign kinds (the SECOND-fault loop):
+        ``van_resilver_kills`` kill the promoted primary only after the
+        pair re-silvered, ``controller_mid_failover_kills`` kill the
+        controller while a van failover is in flight,
+        ``member_mid_resilver_kills`` kill a member mid-resilver —
+        victims uniform from ``n_vans`` / ``n_controllers`` /
+        ``n_members``, drawn after EVERY kind above (SEVENTH extension
+        of the frozen-bytes contract).  These kinds are PACED: the
+        injector records them (``pop_campaign_events``) and the driver
+        applies each only once its precondition (recovery of the
+        previous fault / an in-flight failover or resilver) holds.
         """
         rng = np.random.default_rng(seed)
         hi = max(int(steps), 2)
@@ -375,6 +408,20 @@ class FaultSchedule:
                                      float(rng.integers(max(n_vans,
                                                             1))),
                                      float(van_suspend_s)))
+        # sequential-campaign kinds: drawn after everything above — the
+        # same frozen-bytes guarantee every earlier extension honored
+        for s in pick(van_resilver_kills):
+            events.append(FaultEvent(s, "van_resilver_kill",
+                                     float(rng.integers(max(n_vans,
+                                                            1)))))
+        for s in pick(controller_mid_failover_kills):
+            events.append(FaultEvent(s, "controller_kill_mid_failover",
+                                     float(rng.integers(
+                                         max(n_controllers, 1)))))
+        for s in pick(member_mid_resilver_kills):
+            events.append(FaultEvent(s, "member_kill_mid_resilver",
+                                     float(rng.integers(max(n_members,
+                                                            1)))))
         return cls(events)
 
     def at(self, step: int) -> list[FaultEvent]:
@@ -447,6 +494,12 @@ class FaultInjector:
         # through its own control plane (the injector cannot reach into
         # another PROCESS's van hooks)
         self.net_events = deque()
+        # sequential-campaign events: (kind, victim_idx), drained via
+        # pop_campaign_events() — these kinds are RECOVERY-PACED (kill
+        # the promoted primary only after the resilver closed, kill the
+        # controller only mid-failover), and only the driver can see
+        # that state
+        self.campaign_events = deque()
         self._lock = threading.Lock()
         self._prev_hook = None
         self._installed = False
@@ -550,6 +603,11 @@ class FaultInjector:
                 self._proc_suspend(self.van_procs, int(ev.arg),
                                    ev.arg2 or 1.5,
                                    "van_procs_suspended")
+            elif k in ("van_resilver_kill", "controller_kill_mid_failover",
+                       "member_kill_mid_resilver"):
+                self.counters[k + "s_injected"] += 1
+                with self._lock:
+                    self.campaign_events.append((k, int(ev.arg)))
             elif k == "stage_slow":
                 self.counters["stage_slows_injected"] += 1
                 with self._lock:
@@ -594,6 +652,17 @@ class FaultInjector:
                 keep = [e for e in self.net_events if e[0] not in kinds]
                 self.net_events.clear()
                 self.net_events.extend(keep)
+        return out
+
+    def pop_campaign_events(self) -> list:
+        """Drain pending sequential-campaign events as
+        ``[("van_resilver_kill"|"controller_kill_mid_failover"|
+        "member_kill_mid_resilver", victim_idx)]`` — the driver applies
+        each once its recovery-aware precondition holds (see
+        :class:`SequentialFaultCampaign`)."""
+        with self._lock:
+            out = list(self.campaign_events)
+            self.campaign_events.clear()
         return out
 
     def pop_worker_events(self) -> list:
@@ -694,3 +763,106 @@ class FaultInjector:
                     f"injected dataloader fault at step {step}")
             return batch_fn(step)
         return wrapped
+
+
+class SequentialFaultCampaign:
+    """A seeded SEQUENCE of faults with recovery-aware pacing — the
+    second-fault chaos loop.
+
+    A :class:`FaultSchedule` answers "which faults, at which steps";
+    a campaign answers the question one fault at a time: every fault
+    after the first is injected into the system state the PREVIOUS
+    fault's recovery left behind (van_kill → wait for the promotion to
+    re-silver → kill the promoted primary; controller_kill while a van
+    failover is in flight; member_kill mid-resilver).  The campaign
+    owns the DRAW (seeded, replayable — ``to_json`` is the evidence);
+    the driver owns injection, the recovery wait, and the invariant
+    asserts, reporting each round back via :meth:`complete`.  Drawing
+    the next round before completing the current one is a driver bug
+    (the pacing contract IS the campaign), as is completing a round
+    never drawn.
+
+    The standing per-round invariants the soak driver asserts (see
+    tests/test_soak.py): zero lost accepted requests, token-exact
+    serving, byte-identical training, and REDUNDANCY RESTORED (pair
+    not degraded) before the next draw.
+    """
+
+    KINDS = ("van_kill", "van_resilver_kill",
+             "controller_kill_mid_failover", "member_kill_mid_resilver")
+
+    def __init__(self, *, seed: int, rounds: int, kinds=None,
+                 n_victims: int = 1):
+        self.seed = int(seed)
+        self.kinds = tuple(kinds if kinds is not None else self.KINDS)
+        bad = sorted(set(self.kinds) - set(KINDS))
+        if bad:
+            raise ValueError(f"unknown campaign kinds {bad}")
+        rng = np.random.default_rng(self.seed)
+        # one (kind, victim) pair per round, drawn up front: the draw
+        # order is the replay contract, so pacing (which happens at
+        # drive time) can never perturb WHAT is injected
+        self.draws = [(self.kinds[int(rng.integers(len(self.kinds)))],
+                       int(rng.integers(max(int(n_victims), 1))))
+                      for _ in range(int(rounds))]
+        self._next = 0
+        self._open = False
+        self.results: list = []
+
+    @property
+    def campaign_id(self) -> str:
+        return f"{zlib.crc32(self.to_json().encode()):08x}"
+
+    def to_json(self) -> str:
+        return json.dumps([[k, v] for k, v in self.draws],
+                          separators=(",", ":"))
+
+    def draw(self) -> tuple:
+        """The next round's ``(kind, victim)``.  Emits the fault
+        instant (``fault.<kind>``) so the timeline pairing sees the
+        campaign exactly like a scheduled fault."""
+        if self._open:
+            raise ValueError(
+                "previous round not completed — recovery-aware pacing "
+                "means one fault in flight at a time")
+        if self._next >= len(self.draws):
+            raise IndexError("campaign exhausted")
+        kind, victim = self.draws[self._next]
+        self._open = True
+        trace.instant("fault." + kind,
+                      {"kind": kind, "step": self._next, "arg": victim,
+                       "campaign": self.campaign_id})
+        return kind, victim
+
+    def complete(self, *, ok: bool, recovery_s: float = 0.0,
+                 detail: dict | None = None) -> None:
+        """Close the in-flight round: the driver verified recovery (or
+        gave up).  ``recovery_s`` is fault→redundancy-restored wall
+        time as the driver measured it."""
+        if not self._open:
+            raise ValueError("no round in flight")
+        kind, victim = self.draws[self._next]
+        self.results.append({"round": self._next, "kind": kind,
+                             "victim": victim, "ok": bool(ok),
+                             "recovery_s": float(recovery_s),
+                             **(detail or {})})
+        self._open = False
+        self._next += 1
+
+    @property
+    def exhausted(self) -> bool:
+        return self._next >= len(self.draws)
+
+    def report(self) -> dict:
+        """Rounds survived / drawn, plus per-kind recovery seconds —
+        the ``bench.py soak`` headline inputs."""
+        ok = [r for r in self.results if r["ok"]]
+        per_kind: dict = defaultdict(list)
+        for r in self.results:
+            per_kind[r["kind"]].append(r["recovery_s"])
+        return {"campaign_id": self.campaign_id,
+                "rounds_drawn": len(self.results),
+                "rounds_total": len(self.draws),
+                "rounds_survived": len(ok),
+                "recovery_s_by_kind": {k: sorted(v)
+                                       for k, v in per_kind.items()}}

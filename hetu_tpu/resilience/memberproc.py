@@ -42,7 +42,16 @@ class ControlPlaneMember:
         self.committed = -1
         self.epoch = 0
         self.acked = 0
-        self._bars = None      # (epoch, sync_barrier, commit_barrier)
+        self._bars = None      # (key, sync_barrier, commit_barrier)
+        # replicated durable tier: resolve the per-process VanReplica
+        # (same cached instance the tables/membership already share) so
+        # barriers can re-key and re-dial across a primary promotion
+        self._replica = None
+        self._van_voided = None   # epoch whose step a promotion voided
+        van_spec = getattr(self.spec, "van", None)
+        if van_spec:
+            from hetu_tpu.ps.replica import VanReplica
+            self._replica = VanReplica.from_spec(van_spec)
         # the scalar WORK time reported in the heartbeat's load field —
         # work time only, barrier/mailbox waits excluded: a fast member
         # parked on a slow peer must not itself read as slow
@@ -91,19 +100,43 @@ class ControlPlaneMember:
             self.netem.clear()
         self._slow_ms_active = want
 
+    def _van_endpoint(self):
+        """Where barriers dial: the replica pair's CURRENT primary, or
+        the spec's fixed port when the plane runs unreplicated."""
+        if self._replica is not None:
+            host, port = self._replica.primary
+            return host, port
+        return "127.0.0.1", self.spec.port
+
+    def _van_gen(self) -> int:
+        """The van-generation band of barrier ids: 0 before any
+        failover (incarnation 1 — ids are unchanged from the
+        unreplicated plane), +1 per promotion.  A promoted van has NONE
+        of the old van's arrival-generation state, so re-arriving at
+        the OLD ids would resume someone else's generation counter;
+        re-keying by ``(van_gen, epoch, phase)`` makes every member
+        arrive at FRESH ids on the new primary instead — idempotently,
+        because the voided step re-runs in full."""
+        if self._replica is not None:
+            return max(self._replica.incarnation - 1, 0)
+        return 0
+
     def _barrier(self, phase: int, width: int):
-        bid = self.spec.barrier_base + 2 * self.epoch + phase
-        return self._van.RemoteBarrier("127.0.0.1", self.spec.port, bid,
-                                       width)
+        bid = (self.spec.barrier_base + self._van_gen() * (1 << 21)
+               + 2 * self.epoch + phase)
+        host, port = self._van_endpoint()
+        return self._van.RemoteBarrier(host, port, bid, width)
 
     def _epoch_barriers(self, width: int):
-        """The (sync, commit) barrier pair for the CURRENT epoch,
-        cached — barrier ids and widths only change with the epoch, and
-        opening two fresh van connections per STEP would put hundreds
-        of connect/close cycles per second on the hot path."""
-        if self._bars is None or self._bars[0] != self.epoch:
+        """The (sync, commit) barrier pair for the CURRENT (van_gen,
+        epoch), cached — barrier ids and widths only change with the
+        epoch or a van promotion, and opening two fresh van connections
+        per STEP would put hundreds of connect/close cycles per second
+        on the hot path."""
+        key = (self._van_gen(), self.epoch)
+        if self._bars is None or self._bars[0] != key:
             self._close_barriers()
-            self._bars = (self.epoch, self._barrier(0, width),
+            self._bars = (key, self._barrier(0, width),
                           self._barrier(1, width))
         return self._bars[1], self._bars[2]
 
@@ -158,6 +191,20 @@ class ControlPlaneMember:
             self._stop.wait(0.02)
         return True
 
+    def _hold_for_republish(self, e: int, phase: int) -> bool:
+        """True while the member should idle at its loop top after a
+        promotion-driven step void: the controller (which learns of the
+        promotion through its own replica callback) republishes a fresh
+        epoch, and only THAT epoch's re-run is safe to log.  The hold
+        never blocks a PREPARE — the loop's phase branch runs first, so
+        the republish's ack path stays live."""
+        if self._van_voided is None:
+            return False
+        if phase != 0 or e != self._van_voided:
+            self._van_voided = None
+            return False
+        return True
+
     def _check_epoch(self) -> None:
         """Raise :class:`EpochChanged` when the controller moved the
         membership (new epoch OR a prepare freeze) — the in-flight step
@@ -170,13 +217,56 @@ class ControlPlaneMember:
         """Wait out one lockstep barrier, re-checking the control row
         between short waits.  The generation-counted van barrier
         withdraws timed-out arrivals, so lockstep cannot release
-        short-handed."""
+        short-handed.  Transport failures run the replica failover
+        dance: once the primary changes the in-flight step is void
+        (:class:`EpochChanged`) and the re-run arrives at the re-keyed
+        barrier ids on the promoted van."""
+        faults = 0
         while True:
             try:
                 bar.wait(timeout_s=self.spec.barrier_wait_s)
                 return
             except TimeoutError:
                 self._check_epoch()
+            except (ConnectionError, RuntimeError) as e:
+                faults += 1
+                self._wire_fault(e, faults=faults)
+
+    def _wire_fault(self, e: BaseException, *, faults: int = 1) -> None:
+        """A van op (barrier wait, mailbox, table) failed transport-
+        wise mid-step.  With a replicated durable tier, run the
+        failover dance; once the primary changed, drop the stale
+        barrier handles and void the step.  Without a replica (or for
+        a non-wire error) the failure propagates — the van is the
+        single point of failure it always was."""
+        from hetu_tpu.ps.replica import _is_wire_error
+        wire = _is_wire_error(e) or (isinstance(e, RuntimeError)
+                                     and "rc=" in str(e))
+        if self._replica is None or not wire:
+            raise e
+        if self._replica.failover(e):
+            self._close_barriers()
+            # hold the re-run until the controller republishes: a
+            # re-run at the OLD epoch would write a same-epoch
+            # duplicate of the voided step's consumed record (the dp
+            # plane's complete-cover evidence tolerates crash residue
+            # ACROSS epochs, not same-epoch duplicates)
+            self._van_voided = self.epoch
+            raise EpochChanged from e
+        if faults > 120:
+            raise e  # the van is alive and the op persistently fails:
+            # this is not a failover, surface the real error
+        # not promoted yet (detection grace window): give the dance a
+        # beat, then re-check the control row — a controller-driven
+        # move can land while the pair is still deciding.  An
+        # unreachable van parks the check too; the next wait retries.
+        time.sleep(0.05)
+        try:
+            self._check_epoch()
+        except EpochChanged:
+            raise
+        except Exception:
+            pass
 
     def _close_control_plane(self) -> None:
         self._close_barriers()

@@ -233,6 +233,12 @@ class WorkerProcess(ControlPlaneMember):
                 if self._stop.wait(0.02):
                     break
                 continue
+            if self._hold_for_republish(e, phase):
+                # a van promotion voided the in-flight step: wait for
+                # the controller's republish before re-running it
+                if self._stop.wait(0.02):
+                    break
+                continue
             if e != self.epoch:
                 # the resume is EXACT (computed from frozen acks), so
                 # adopting it never re-runs or skips a committed step
@@ -316,6 +322,20 @@ class WorkerProcess(ControlPlaneMember):
                     "bar_commit": (time.perf_counter() - t4) * 1e3}
             except _EpochChanged:
                 continue  # step discarded, re-run at the new width
+            except Exception as e:
+                # a table op mid-step hit the durable-tier failover
+                # (VanFailover after the dance, or a raw wire error the
+                # dance can absorb): void the step exactly like an
+                # epoch change — the re-run re-pulls and re-pushes on
+                # the promoted primary at re-keyed barrier ids.  The
+                # re-push is the plane's documented at-least-once
+                # (check_complete_cover tolerance); byte-identity under
+                # van chaos lives with the idempotent MPMD plane.
+                try:
+                    self._wire_fault(e)
+                except _EpochChanged:
+                    pass
+                continue
             # COMMITTED: every worker of this epoch passed the commit
             # barrier; the blackboard row is written BEFORE proceeding,
             # so a prepare freeze always reads current progress
@@ -333,16 +353,22 @@ class WorkerProcess(ControlPlaneMember):
         """The ordered-apply barrier for the current epoch, cached like
         ``_epoch_barriers`` — in a DISJOINT id band (the epoch pair
         occupies ``base + 2*epoch + phase``, so a third phase would
-        collide with the next epoch's sync barrier)."""
-        if self._sbar is None or self._sbar[0] != self.epoch:
+        collide with the next epoch's sync barrier).  Re-keyed by the
+        van generation and dialed at the current primary, exactly like
+        the epoch pair — a promoted van has no arrival state to
+        resume."""
+        key = (self._van_gen(), self.epoch)
+        if self._sbar is None or self._sbar[0] != key:
             if self._sbar is not None:
                 try:
                     self._sbar[1].close()
                 except Exception:
                     pass
-            bid = self.spec.barrier_base + (1 << 20) + self.epoch
-            self._sbar = (self.epoch, self._van.RemoteBarrier(
-                "127.0.0.1", self.spec.port, bid, width))
+            bid = (self.spec.barrier_base + self._van_gen() * (1 << 21)
+                   + (1 << 20) + self.epoch)
+            host, port = self._van_endpoint()
+            self._sbar = (key, self._van.RemoteBarrier(host, port, bid,
+                                                       width))
         return self._sbar[1]
 
     def _push_ordered(self, grad, rank: int, width: int) -> None:
@@ -544,6 +570,15 @@ class MultiControllerElasticSupervisor:
                 self._replica.refresh()  # unconditional: a stale
                 # cached view must not adopt the dead primary
             port = self._replica.primary[1]
+            # a van promotion republishes a fresh epoch from poll():
+            # members that detected the failover themselves converge on
+            # the re-keyed barriers anyway; the republish gives any
+            # still-parked member a control-row edge to re-read, and
+            # records the event as a reshard
+            self._van_failover_pending = False
+            self._replica.register(
+                lambda _rep: setattr(self, "_van_failover_pending",
+                                     True))
         if own_van:
             self.port = van.serve(port)
         else:
@@ -782,6 +817,12 @@ class MultiControllerElasticSupervisor:
         from hetu_tpu.resilience.shardproc import spawn_module
         self._incarnations += 1
         tag = f"worker_{slot}_{self._incarnations}"
+        if self._replica is not None:
+            # spawn configs carry the CURRENT pair membership: after a
+            # failover + re-silver the original endpoints may both be
+            # dead, and a fresh process has no other rendezvous
+            self.spec = WorkerSpec(**{**asdict(self.spec),
+                                      "van": self._replica.current_spec()})
         spec = WorkerSpec(**{**asdict(self.spec), "slot": int(slot),
                              "log_path": str(self.workdir /
                                              f"{tag}.jsonl")})
@@ -899,6 +940,17 @@ class MultiControllerElasticSupervisor:
         # the heal runs HERE, serialized with every other control-row
         # write (see SupervisorStragglerPlane)
         self._stragglers.maybe_heal()
+        if self._replica is not None and self._van_failover_pending:
+            self._van_failover_pending = False
+            t0 = time.perf_counter()
+            with trace.span("elastic.reshard") as sp:
+                sp.set("kind", "van_failover")
+                sp.set("van_incarnation", self._replica.incarnation)
+                if self._present():
+                    # a finished-and-departed fleet needs no republish
+                    # (and could not reform below min_width anyway)
+                    self._publish(kind="van_failover", t0=t0)
+                sp.set("width", len(self.svc.present_slots()))
         events = self.svc.poll()
         for kind, slot in events:
             if kind == "lost":

@@ -70,6 +70,7 @@ import itertools
 import json
 import os
 import queue
+import sys
 import signal as _signal
 import threading
 import time
@@ -396,7 +397,13 @@ class MemberHarness:
                     self._out.close()
                 except Exception:
                     pass
-                self._out = self._chan(self.spec.event_ch, gen[0])
+                try:
+                    self._out = self._chan(self.spec.event_ch, gen[0])
+                except ConnectionError:
+                    # mid-promotion (see run()): keep the thread alive,
+                    # retry once the replica adopts the new primary
+                    time.sleep(0.1)
+                    continue
                 self._out_gen = gen
                 seq = 1
                 backlog = list(self._done_log)
@@ -435,14 +442,21 @@ class MemberHarness:
                 self._events.put(ev)
 
     def _beat_loop(self) -> None:
+        from hetu_tpu.ps.replica import _dbg
         period = max(self.spec.hb_ms, 10) / 1000.0
+        last_err = 0.0
         while not self._stop.wait(period):
             try:
                 self.member.heartbeat(
                     load=float(self.scheduler.load),
                     healthy=self.server.healthy,
                     epoch_ack=float(self._epoch_ack))
-            except Exception:
+            except Exception as e:
+                now = time.monotonic()
+                if now - last_err > 1.0:
+                    last_err = now
+                    _dbg(f"slot={self.spec.slot} heartbeat failed: "
+                         f"{type(e).__name__}: {e}")
                 # a transiently unreachable van must not kill the beat
                 # thread — silence IS the loss signal, so keep trying
                 time.sleep(period)
@@ -564,7 +578,17 @@ class MemberHarness:
                     self._in.close()
                 except Exception:
                     pass
-                self._in = self._chan(self.spec.submit_ch, gen[0])
+                try:
+                    self._in = self._chan(self.spec.submit_ch, gen[0])
+                except ConnectionError:
+                    # the pair is mid-promotion (a SECOND fault can
+                    # land while this rebind is already in flight):
+                    # _in_gen stays stale, so the loop re-enters this
+                    # block and re-binds once the watch loop adopts
+                    # the promoted incarnation — a member must outlive
+                    # the window, not crash into a lease expiry
+                    time.sleep(0.1)
+                    continue
                 self._in_gen = gen
                 seq = 1
             try:
@@ -660,8 +684,8 @@ class MemberHarness:
             return False
         return True
 
-    _DURABLE_TIER_METRICS = ("membership.", "van.replica.", "ledger.",
-                             "standby.")
+    _DURABLE_TIER_METRICS = ("membership.", "van.replica.",
+                             "van.resilver.", "ledger.", "standby.")
 
     def _emit_metrics(self) -> None:
         """Answer a fleet scrape: ship the FULL registry state (raw
@@ -823,6 +847,9 @@ class MemberHarness:
 def member_main(config_path: str) -> int:
     """Entry point for a spawned member process: build the harness,
     announce READY (the spawner's handshake), serve until told to stop."""
+    import faulthandler
+    import signal as _signal
+    faulthandler.register(_signal.SIGUSR1)  # live-stack dump to stderr
     spec = MemberSpec.from_json(open(config_path).read())
     harness = MemberHarness(spec)
     print("READY", spec.slot, flush=True)
@@ -900,6 +927,7 @@ class CrossProcessServingPool:
                  telemetry_streams: bool = True,
                  scrape_s: float = 1.0,
                  van_spec: Optional[dict] = None,
+                 van_backup_factory=None,
                  _takeover: bool = False):
         from hetu_tpu.ps import van
         if n_members < 1:
@@ -915,6 +943,7 @@ class CrossProcessServingPool:
         self._replica = None
         self._van_spec = dict(van_spec) if van_spec else {}
         self._van_gen = 0
+        self._mb_van_seen = 0
         self._van_rebind_pending = False
         if self._van_spec:
             if own_van:
@@ -922,6 +951,12 @@ class CrossProcessServingPool:
                     "a replicated durable tier is external by "
                     "definition: pass own_van=False with van_spec")
             from hetu_tpu.ps.replica import VanReplica
+            # pair-membership rendezvous on the shared workdir: members
+            # whose cached endpoint view goes fully dead (both slots
+            # replaced while they were busy) re-read the pair from here
+            # instead of livelocking against two dead ports
+            self._van_spec.setdefault(
+                "rendezvous", os.path.join(workdir, "van_pair.json"))
             self._replica = VanReplica.from_spec(
                 self._van_spec, bootstrap=not _takeover)
             if _takeover:
@@ -929,7 +964,14 @@ class CrossProcessServingPool:
                 # cached view must not adopt the dead primary
             port = self._replica.primary[1]
             self._van_gen = self._replica.incarnation
+            self._mb_van_seen = self._replica.incarnation
             self._replica.register(self._on_van_failover)
+            if van_backup_factory is not None:
+                # continuous redundancy: a promotion auto-resilvers
+                # onto a fresh van from this factory (() -> (host,
+                # port)), restoring the pair without an operator
+                self._replica.spawn_backup = van_backup_factory
+                self._replica.write_rendezvous()  # seed the snapshot
         if own_van:
             self.port = van.serve(port)
         else:
@@ -1025,6 +1067,10 @@ class CrossProcessServingPool:
         self.procs: list = [None] * self.n_members
         self.adopted: dict = {}         # takeover: rid -> PoolRequest
         self.takeover_report: dict = {}
+        # warm autoscaler takeover: the control loop's streaks/cooldown
+        # deadlines/active set journal here (and into the ledger) so a
+        # takeover resumes the loop from measured history, not cold
+        self._autoscaler_state: Optional[dict] = None
         self._stop = threading.Event()
         try:
             if _takeover:
@@ -1155,6 +1201,9 @@ class CrossProcessServingPool:
                 self._drain_journal = {
                     str(k): dict(v)
                     for k, v in (state.get("drains") or {}).items()}
+                self._autoscaler_state = \
+                    dict(state["autoscaler"]) \
+                    if state.get("autoscaler") else None
             # wire up every recorded member under the new incarnation
             inc = self.svc.ctrl_incarnation
             for slot, (sub, evb) in sorted(self._ch_bases.items()):
@@ -1248,6 +1297,8 @@ class CrossProcessServingPool:
                 "drains_orphaned": orphaned,
                 "orphans_rerouted": len(orphans),
                 "members_present": sorted(self.svc.present_slots()),
+                "autoscaler_state": dict(self._autoscaler_state)
+                if self._autoscaler_state else None,
             }
             sp.set("adopted_requests", len(self.adopted))
             sp.set("drains_aborted", aborted)
@@ -1289,6 +1340,11 @@ class CrossProcessServingPool:
 
     def _spawn(self, slot: int) -> None:
         from hetu_tpu.resilience.shardproc import spawn_module
+        if self._replica is not None:
+            # spawn configs must carry the CURRENT pair membership: a
+            # member spawned after failovers + re-silvers would find
+            # the original endpoints both dead and have no rendezvous
+            self._van_spec = self._replica.current_spec()
         with self._lock:
             cid = self._ctrl_seq
             self._ctrl_seq += 1
@@ -1374,21 +1430,30 @@ class CrossProcessServingPool:
             inc = self.svc.ctrl_incarnation
             with self._lock:
                 bases = dict(self._ch_bases)
+            rebind_failed = False
             for slot, (sub, evb) in sorted(bases.items()):
                 try:
                     ch = self._ctrl_chan(_fenced_chan(sub, inc))
                 except Exception:
                     traceback.print_exc()
+                    # this slot is still bound to the dead van: the
+                    # pending flag was cleared at entry, so re-arm it
+                    # below or the slot never rebinds (a SECOND fault
+                    # mid-rebind would strand it forever)
+                    rebind_failed = True
                     continue
                 with self._lock:
                     old = self._out.get(slot)
                     self._out[slot] = (ch, threading.Lock(), [1])
                 if old is not None:
-                    try:
-                        old[0].close()
-                    except Exception:
-                        pass
+                    # deferred close: a _send may be inside the old
+                    # channel — closing now frees its fd for kernel
+                    # reassignment mid-op
+                    from hetu_tpu.ps.replica import retire_handle
+                    retire_handle(old[0])
                 self._start_listener(slot, evb)
+            if rebind_failed:
+                self._van_rebind_pending = True
             with self._lock:
                 pending = [r for r in self._requests.values()
                            if not r.done.is_set()]
@@ -1456,7 +1521,33 @@ class CrossProcessServingPool:
                              for k, v in self._resolved.items()},
                 "drains": {str(k): dict(v)
                            for k, v in self._drain_journal.items()},
+                "autoscaler": dict(self._autoscaler_state)
+                if self._autoscaler_state else None,
             }
+
+    # ---- warm autoscaler takeover (the control loop's durable RAM) ----
+    def journal_autoscaler(self, state: dict, *,
+                           sync: bool = True) -> None:
+        """Journal the autoscaler's exported state (streaks, cooldown
+        elapsed times, active set) into the ledger alongside accepts.
+        ``sync=True`` for ACTION ticks (a lost scale action must not be
+        repeated by a cold successor); hold ticks may coalesce — each
+        record is a full upsert, so losing one costs staleness, never
+        corruption."""
+        with self._lock:
+            self._autoscaler_state = dict(state)
+        rec = {"s": dict(state)}
+        if sync:
+            self._append_ledger([rec])
+        else:
+            self._queue_delta(rec)
+
+    def autoscaler_state(self) -> Optional[dict]:
+        """The journaled autoscaler state (after a takeover: replayed
+        from the ledger) — what a resumed control loop warms up from."""
+        with self._lock:
+            return dict(self._autoscaler_state) \
+                if self._autoscaler_state else None
 
     def _append_ledger(self, records) -> None:
         """Synchronously journal delta records (accept / drain / spawn
@@ -1543,6 +1634,7 @@ class CrossProcessServingPool:
         resolved = OrderedDict(state.get("resolved") or {})
         drains = dict(state.get("drains") or {})
         channels = dict(state.get("channels") or {})
+        autoscaler = state.get("autoscaler") or None
         rid_seq = int(state.get("rid", 0))
         cid_seq = int(state.get("cid", 0))
         for d in got.get("deltas") or ():
@@ -1573,11 +1665,15 @@ class CrossProcessServingPool:
             elif "q" in d:
                 rid_seq = max(rid_seq, int(d["q"][0]))
                 cid_seq = max(cid_seq, int(d["q"][1]))
+            elif "s" in d:
+                # autoscaler state: each record is a full upsert —
+                # the LAST one wins, whatever compaction interleaving
+                autoscaler = dict(d["s"])
         while len(resolved) > 1024:
             resolved.popitem(last=False)
         return {"rid": rid_seq, "cid": cid_seq, "channels": channels,
                 "requests": requests, "resolved": resolved,
-                "drains": drains}
+                "drains": drains, "autoscaler": autoscaler}
 
     def _wait_joined(self, slots, timeout_s: Optional[float] = None) -> None:
         deadline = time.monotonic() + (timeout_s if timeout_s is not None
@@ -1679,17 +1775,32 @@ class CrossProcessServingPool:
 
     def _event_loop(self, slot: int, event_ch: int,
                     stop: threading.Event) -> None:
-        try:
-            ch = self._ctrl_chan(event_ch)
-        except Exception:
-            traceback.print_exc()
-            return  # a van-failover rebind restarts this listener
+        ch = None
         seq = 1
         try:
             while not (stop.is_set() or self._stop.is_set()):
+                if ch is None:
+                    # bound in-loop, retried: this listener is usually
+                    # (re)started by a van-failover rebind, i.e. MID
+                    # promotion — a bind that raises once must not kill
+                    # the thread, or the member's completions strand in
+                    # its event channel until the NEXT failover (which
+                    # may never come) while its emitter spins on an
+                    # undrained single-slot mailbox
+                    try:
+                        ch = self._ctrl_chan(event_ch)
+                    except Exception:
+                        if stop.wait(0.2):
+                            break
+                        continue
                 try:
                     raw = ch.get(seq, timeout_s=0.25)
-                except (TimeoutError, ConnectionError):
+                except TimeoutError:
+                    continue
+                except ConnectionError:
+                    # a failover raises instantly (VanFailover) until
+                    # the rebind replaces this listener: pace the loop
+                    time.sleep(0.05)
                     continue
                 except RuntimeError:
                     if self._stop.is_set():
@@ -1706,7 +1817,8 @@ class CrossProcessServingPool:
                 except Exception:
                     traceback.print_exc()
         finally:
-            ch.close()
+            if ch is not None:
+                ch.close()
 
     def _dispatch_event(self, slot: int, ev: dict) -> None:
         kind = ev.get("type")
@@ -2024,12 +2136,21 @@ class CrossProcessServingPool:
                 with self._lock:
                     self._unrouted.pop(req.rid, None)
                 return
-            except Exception:
+            except Exception as e:
+                if os.environ.get("HETU_DEBUG_FLEET"):
+                    print(f"[fleet] {time.monotonic():.2f} send fail "
+                          f"rid={req.rid} slot={slot} {type(e).__name__}: "
+                          f"{e}", file=sys.stderr, flush=True)
                 with self._lock:
                     self._inflight[slot] = max(
                         self._inflight.get(slot, 1) - 1, 0)
                     req.member = None
                 exclude.add(slot)
+        if os.environ.get("HETU_DEBUG_FLEET"):
+            print(f"[fleet] {time.monotonic():.2f} park rid={req.rid} "
+                  f"exclude={exclude} states="
+                  f"{[(m.slot, m.state, m.suspect_reason) for m in self.svc.members]}",
+                  file=sys.stderr, flush=True)
         # no routable member RIGHT NOW (every member suspect during a
         # durable-tier failover's blind window, a mid-rebind wire, the
         # whole fleet draining): the request is JOURNALED, so it must
@@ -2191,6 +2312,14 @@ class CrossProcessServingPool:
             return self._poll_locked()
 
     def _poll_locked(self) -> int:
+        # a durable-tier failover stalls every member's beats while the
+        # pair promotes: grant the lease grace BEFORE this sweep so the
+        # window never reads as member silence (and a loss that still
+        # slips through is forgiven once the member's beats resume)
+        if self._replica is not None and \
+                self._replica.incarnation != self._mb_van_seen:
+            self._mb_van_seen = self._replica.incarnation
+            self.svc.note_van_failover()
         try:
             events = self.svc.poll()
         except _mb.ControllerFenced:
@@ -2202,6 +2331,10 @@ class CrossProcessServingPool:
             self.metrics.inc("controller_fenced")
             return 0
         n = 0
+        if events and os.environ.get("HETU_DEBUG_FLEET"):
+            print(f"[fleet] {time.monotonic():.2f} events={events} "
+                  f"states={[(m.slot, m.state) for m in self.svc.members]}",
+                  file=sys.stderr, flush=True)
         for kind, slot in events:
             if kind == "suspect":
                 self._suspect_t0[slot] = trace.now_us()
@@ -2643,6 +2776,24 @@ def controller_main(config_path: str) -> int:
         member_env={"JAX_PLATFORMS": "cpu"})
     print("READY", flush=True)
     try:
+        ac = cfg.get("autoscale")
+        if ac:
+            # the soak's controller-kill target: make >= 1 JOURNALED
+            # scale decision before the chaos harness SIGKILLs this
+            # process, so the takeover can prove the successor resumes
+            # the loop's RAM warm (no duplicate action)
+            from hetu_tpu.traffic.autoscale import (AutoscalePolicy,
+                                                    Autoscaler)
+            for s in ac.get("park", []):
+                pool.drain_member(int(s), close=True)
+            scaler = Autoscaler(
+                pool, AutoscalePolicy(**ac["policy"]),
+                active={int(s) for s in ac.get("active", [0])})
+            for _ in range(int(ac.get("ticks", 1))):
+                rec = scaler.tick()
+                print(f"SCALED {rec['action']} {rec.get('slot', -1)}",
+                      flush=True)
+                time.sleep(float(ac.get("tick_gap_s", 0.1)))
         prompts = seeded_prompts(
             int(cfg.get("n_requests", 8)),
             int(cfg.get("prompt_seed", 0)),

@@ -116,6 +116,21 @@ RECOVERY_FOR = {
     # legitimately unpaired (the ops just retried through it).
     "van_kill": ("van.promote",),
     "van_suspend": ("van.promote",),
+    # sequential campaign (second-fault chaos): killing the promoted
+    # primary AFTER a re-silver is directly answered by the NEXT
+    # promotion (the re-silvered backup takes over); the re-silver that
+    # restores redundancy afterwards is the fallback closer when the
+    # promote span is missing from a partial trace.  Preference-ordered:
+    # the promotion IS the recovery the kill invokes, the resilver only
+    # its consequence.
+    "van_resilver_kill": ("van.promote", "van.resilver"),
+    # a controller killed mid-van-failover is answered by the fenced
+    # takeover, same as any controller death — the van pair's own
+    # recovery runs concurrently and pairs with the VAN fault
+    "controller_kill_mid_failover": ("ctrl.takeover",),
+    # a member killed mid-resilver is answered by the pool's
+    # lease-expiry failover, same as member_kill
+    "member_kill_mid_resilver": ("serve.failover",),
 }
 
 # kinds whose RECOVERY_FOR tuple is a strict preference order: the first
@@ -125,7 +140,7 @@ RECOVERY_FOR = {
 # shard_repair/retry actually ran), so time decides, not the tuple.
 PREFERENCE_ORDERED = frozenset({"serve_preempt", "member_suspend",
                                 "netem_partition", "straggler",
-                                "stage_slow"})
+                                "stage_slow", "van_resilver_kill"})
 
 # fault kind -> args a candidate recovery event must carry.  A preempt
 # must claim the checkpoint the SIGTERM caused (reason="preempt"), not a

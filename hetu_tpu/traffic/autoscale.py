@@ -32,6 +32,15 @@ Every decision (including holds that broke a streak) lands in
 ``decisions`` and actions emit a ``traffic.scale`` span with the
 signals that justified them — the fleet trace shows WHY the fleet
 resized, not just that it did.
+
+The loop's RAM is journaled: after every tick the streaks, cooldown
+elapsed times (relative — monotonic clocks do not compare across
+processes), and active-set bookkeeping export into the pool's
+``DeltaLedger`` alongside accepts (synchronously on action ticks,
+coalesced on holds), and a controller takeover resumes the loop WARM —
+a successor constructed over a taken-over pool adopts the journaled
+state instead of re-deriving streaks from zero, so it neither repeats
+a just-landed scale action nor forgets a cooldown mid-window.
 """
 
 from __future__ import annotations
@@ -115,7 +124,9 @@ class Autoscaler:
     def __init__(self, pool, policy: AutoscalePolicy, *,
                  ttft_slos: Optional[dict] = None,
                  active: Optional[set] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 state: Optional[dict] = None,
+                 journal=None):
         if policy.min_members < 1:
             raise ValueError("min_members must be >= 1")
         if policy.max_members < policy.min_members:
@@ -142,8 +153,64 @@ class Autoscaler:
         self._down_streak = 0
         self._last_up = -float("inf")
         self._last_down = -float("inf")
+        self._actions_prior = 0  # predecessor incarnations' actions
         self._thread = None
         self._stop = threading.Event()
+        # ---- warm takeover wiring ----
+        # `journal` defaults to the pool's ledger hook; `state`
+        # defaults to the pool's journaled record — present exactly
+        # when the pool came from takeover() and the predecessor's
+        # loop journaled at least one tick, so a successor over a
+        # taken-over pool resumes WARM with no extra plumbing.
+        self.journal = journal if journal is not None \
+            else getattr(pool, "journal_autoscaler", None)
+        if state is None and active is None:
+            getter = getattr(pool, "autoscaler_state", None)
+            if callable(getter):
+                state = getter()
+        if state:
+            self.restore(state)
+
+    # ---- warm takeover (journaled streaks / cooldowns / active set) ----
+    def export_state(self) -> dict:
+        """This loop's RAM as a journalable record.  Cooldown anchors
+        export as ELAPSED seconds (monotonic clocks do not compare
+        across processes); absent keys mean 'never fired'."""
+        now = self.clock()
+        st = {"active": sorted(self.active),
+              "up_streak": int(self._up_streak),
+              "down_streak": int(self._down_streak),
+              "actions": self._actions_prior + self.scale_ups
+              + self.scale_downs}
+        if self._last_up != -float("inf"):
+            st["up_elapsed_s"] = round(min(now - self._last_up, 1e6), 3)
+        if self._last_down != -float("inf"):
+            st["down_elapsed_s"] = round(
+                min(now - self._last_down, 1e6), 3)
+        return st
+
+    def restore(self, state: dict) -> None:
+        """Adopt a predecessor's exported state: the successor's first
+        ticks honor the predecessor's cooldown windows and streaks —
+        no immediate duplicate scale action after a takeover."""
+        now = self.clock()
+        if state.get("active") is not None:
+            self.active = {int(s) for s in state["active"]}
+        self._up_streak = int(state.get("up_streak", 0))
+        self._down_streak = int(state.get("down_streak", 0))
+        up_e = state.get("up_elapsed_s")
+        down_e = state.get("down_elapsed_s")
+        self._last_up = now - float(up_e) if up_e is not None \
+            else -float("inf")
+        self._last_down = now - float(down_e) if down_e is not None \
+            else -float("inf")
+        self._actions_prior = int(state.get("actions", 0))
+
+    @property
+    def actions_total(self) -> int:
+        """Scale actions across ALL incarnations of this loop (journal
+        lineage included)."""
+        return self._actions_prior + self.scale_ups + self.scale_downs
 
     # ---- sensing ----
     def _counter_delta(self, dump: dict, name: str) -> int:
@@ -258,6 +325,14 @@ class Autoscaler:
                 except Exception as e:
                     rec.update(action="down_failed", error=repr(e))
         self.decisions.append(rec)
+        if self.journal is not None:
+            try:
+                self.journal(self.export_state(),
+                             sync=rec["action"] in ("up", "down"))
+            except Exception:
+                pass  # journaling is durability, not control: a
+                # wedged ledger (mid van-failover) must not stall the
+                # loop — the next tick re-exports the full state
         return rec
 
     @staticmethod

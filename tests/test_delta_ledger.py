@@ -244,3 +244,88 @@ def test_needs_compaction_margin():
         except mb.LedgerCompactionNeeded:
             break
     assert led.needs_compaction(margin_rows=1)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_compaction_racing_van_failover_restores_exact_request_set(
+        tmp_path):
+    """A compaction issued while the primary van is ALREADY DEAD rides
+    the replica's promotion dance (the append-path retry ladder drives
+    the CAS) and lands — atomically — on the promoted backup.  Every
+    takeover-style reader along the way replays the exact request set:
+    before the kill (old base + sync-replicated deltas on the backup),
+    after the raced compaction (new base, zero deltas), and after
+    post-compaction appends (new base + fresh deltas)."""
+    from hetu_tpu.ps import available
+    if not available():
+        pytest.skip("native hetu_ps lib not built")
+    from hetu_tpu.ps.replica import ReplicaSpec, VanReplica
+    from hetu_tpu.resilience.shardproc import (free_port,
+                                               spawn_shard_server)
+
+    p1, p2 = free_port(), free_port()
+    v1 = spawn_shard_server(tmp_path, p1, tag="prim")
+    v2 = spawn_shard_server(tmp_path, p2, tag="back")
+    rep = None
+    try:
+        spec = {"endpoints": [["127.0.0.1", p1], ["127.0.0.1", p2]],
+                "epoch_table": mb.fresh_table_id(),
+                "promote_after_s": 0.1, "rcv_timeout_s": 1.5,
+                "revalidate_s": 0.05}
+        rep = VanReplica.from_spec(spec, bootstrap=True)
+        tid = mb.fresh_table_id()
+        led = mb.DeltaLedger(replica=rep, table_id=tid, rows=64,
+                             dim=16)
+        inflight = {}
+        for i in range(1, 9):
+            inflight[str(i)] = {"msg": {"prompt": [i]}}
+            led.append({"a": [i, {"prompt": [i]}]}, ctrl_inc=1)
+        led.append({"r": [3, "ok"]}, ctrl_inc=1)
+        del inflight["3"]
+        want = set(inflight)
+
+        v1.kill()
+        v1.wait()
+
+        # the raced compaction: its fence read + one-frame write hit
+        # the corpse, the retry ladder promotes, the frame lands on
+        # the survivor
+        led.compact({"requests": dict(inflight)}, ctrl_inc=1)
+        assert rep.incarnation == 2 and rep.primary[1] == p2
+
+        def takeover_read():
+            # DIRECT construction: from_spec caches per-process, and a
+            # takeover must start from a fresh (pre-failover) view and
+            # discover the promotion itself
+            r2 = VanReplica(ReplicaSpec.from_dict(spec))
+            r2.refresh()
+            l2 = mb.DeltaLedger(replica=r2, table_id=tid, rows=64,
+                                dim=16, create=False)
+            try:
+                return l2.read()
+            finally:
+                l2.close()
+        got = takeover_read()
+        assert got["compactions"] == 1 and got["deltas"] == []
+        after, _ = _replay(got)
+        assert set(after) == want
+
+        # post-compaction deltas replay over the new base on the
+        # promoted van
+        led.append({"a": [9, {"prompt": [9]}]}, ctrl_inc=1)
+        led.append({"r": [5, "ok"]}, ctrl_inc=1)
+        want = (want - {"5"}) | {"9"}
+        final, resolved = _replay(takeover_read())
+        assert set(final) == want and "5" in resolved
+        led.close()
+    finally:
+        if rep is not None:
+            try:
+                rep.close()
+            except Exception:
+                pass
+        for v in (v1, v2):
+            if v.poll() is None:
+                v.kill()
+                v.wait()

@@ -244,6 +244,130 @@ def test_van_fault_timeline_pairing_and_report_coverage():
         assert rep[kind]["injected"] == 1 and rep[kind]["paired"] == 1
 
 
+def test_schedule_campaign_fault_kinds_draw_after_everything():
+    """SEVENTH extension of the frozen-bytes contract (ISSUE 18): the
+    sequential-campaign kinds (van_resilver_kill/
+    controller_kill_mid_failover/member_kill_mid_resilver) must draw
+    from the rng AFTER every pre-existing kind — including the
+    durable-tier kinds PR 15 added — so every recorded chaos seed
+    still replays byte-for-byte."""
+    old = dict(steps=50, seed=7, van_errors=2, kill_shards=1, n_shards=2,
+               serve_preempts=1, n_members=2, member_kills=1,
+               member_suspends=1, worker_proc_kills=1, n_workers=3,
+               netem_partitions=1, netem_degrades=1, stragglers=1,
+               stage_kills=1, stage_slows=1, n_stages=3,
+               controller_kills=1, controller_suspends=1,
+               n_controllers=1, van_kills=1, van_suspends=1, n_vans=2)
+    base = FaultSchedule.generate(**old)
+    camp_kinds = ("van_resilver_kill", "controller_kill_mid_failover",
+                  "member_kill_mid_resilver")
+    grown = FaultSchedule.generate(**old, van_resilver_kills=1,
+                                   controller_mid_failover_kills=1,
+                                   member_mid_resilver_kills=1)
+    old_events = [e for e in grown.events if e.kind not in camp_kinds]
+    assert old_events == base.events
+    new = {e.kind: e for e in grown.events if e.kind in camp_kinds}
+    assert sorted(new) == sorted(camp_kinds)
+    assert 0 <= new["van_resilver_kill"].arg < 2          # n_vans=2
+    assert new["controller_kill_mid_failover"].arg == 0.0  # 1 ctrl
+    assert 0 <= new["member_kill_mid_resilver"].arg < 2   # n_members=2
+    assert FaultSchedule.from_json(grown.to_json()).to_json() == \
+        grown.to_json()
+
+
+def test_injector_routes_campaign_events_to_driver():
+    """The campaign kinds are recovery-PACED: the injector records
+    them (counter + queue) and the driver drains them via
+    pop_campaign_events — it never kills anything itself."""
+    sched = FaultSchedule([
+        FaultEvent(1, "van_resilver_kill", 0.0),
+        FaultEvent(2, "controller_kill_mid_failover", 0.0),
+        FaultEvent(2, "member_kill_mid_resilver", 1.0)])
+    inj = FaultInjector(sched)
+    inj.on_step(1)
+    inj.on_step(2)
+    assert inj.pop_campaign_events() == [
+        ("van_resilver_kill", 0),
+        ("controller_kill_mid_failover", 0),
+        ("member_kill_mid_resilver", 1)]
+    assert inj.pop_campaign_events() == []  # drained
+    assert inj.counters["van_resilver_kills_injected"] == 1
+    assert inj.counters["controller_kill_mid_failovers_injected"] == 1
+    assert inj.counters["member_kill_mid_resilvers_injected"] == 1
+
+
+def test_campaign_fault_timeline_pairing_and_report_coverage():
+    """RECOVERY_FOR satellite (ISSUE 18): van_resilver_kill pairs
+    PREFERENCE-ORDERED with van.promote over an earlier-ending
+    van.resilver (the promotion IS the recovery the kill invokes, the
+    resilver only restores redundancy afterwards), and report() covers
+    every new campaign kind."""
+    from hetu_tpu.telemetry import timeline
+    assert "van_resilver_kill" in timeline.PREFERENCE_ORDERED
+    assert timeline.RECOVERY_FOR["van_resilver_kill"] == \
+        ("van.promote", "van.resilver")
+    evs = [
+        {"ph": "i", "name": "fault.van_resilver_kill", "ts": 100.0,
+         "seq": 0, "args": {"kind": "van_resilver_kill", "step": 0}},
+        # the resilver span ENDS FIRST — earliest-ending would grab it;
+        # the preference order must pick the promote anyway
+        {"ph": "X", "name": "van.resilver", "ts": 120.0, "dur": 30.0,
+         "seq": 1, "args": {"ok": True}},
+        {"ph": "X", "name": "van.promote", "ts": 160.0, "dur": 50.0,
+         "seq": 2, "args": {"incarnation": 3, "won": True}},
+        {"ph": "i", "name": "fault.controller_kill_mid_failover",
+         "ts": 300.0, "seq": 3,
+         "args": {"kind": "controller_kill_mid_failover", "step": 1}},
+        {"ph": "X", "name": "ctrl.takeover", "ts": 340.0, "dur": 40.0,
+         "seq": 4, "args": {"incarnation": 2}},
+        {"ph": "i", "name": "fault.member_kill_mid_resilver",
+         "ts": 600.0, "seq": 5,
+         "args": {"kind": "member_kill_mid_resilver", "step": 2}},
+        {"ph": "X", "name": "serve.failover", "ts": 650.0, "dur": 25.0,
+         "seq": 6, "args": {}},
+    ]
+    pairs = timeline.correlate(evs)
+    by = {p.kind: p for p in pairs}
+    assert by["van_resilver_kill"].recovery_name == "van.promote"
+    assert by["controller_kill_mid_failover"].recovery_name == \
+        "ctrl.takeover"
+    assert by["member_kill_mid_resilver"].recovery_name == \
+        "serve.failover"
+    rep = timeline.report(pairs)
+    for kind in ("van_resilver_kill", "controller_kill_mid_failover",
+                 "member_kill_mid_resilver"):
+        assert rep[kind]["injected"] == 1 and rep[kind]["paired"] == 1
+
+
+def test_sequential_campaign_draws_and_pacing_contract():
+    """The campaign owns the seeded draw (replayable) and enforces the
+    one-fault-in-flight pacing contract."""
+    from hetu_tpu.resilience.faults import SequentialFaultCampaign
+    a = SequentialFaultCampaign(seed=11, rounds=5, n_victims=2)
+    b = SequentialFaultCampaign(seed=11, rounds=5, n_victims=2)
+    assert a.to_json() == b.to_json()
+    assert a.campaign_id == b.campaign_id
+    assert SequentialFaultCampaign(seed=12, rounds=5).to_json() != \
+        a.to_json()
+    assert all(k in SequentialFaultCampaign.KINDS
+               for k, _ in a.draws)
+    kind, victim = a.draw()
+    assert (kind, victim) == a.draws[0]
+    with pytest.raises(ValueError):
+        a.draw()  # previous round still in flight
+    a.complete(ok=True, recovery_s=0.5)
+    with pytest.raises(ValueError):
+        a.complete(ok=True)  # nothing in flight
+    while not a.exhausted:
+        a.draw()
+        a.complete(ok=True, recovery_s=0.1)
+    with pytest.raises(IndexError):
+        a.draw()
+    rep = a.report()
+    assert rep["rounds_survived"] == rep["rounds_total"] == 5
+    assert sum(len(v) for v in rep["recovery_s_by_kind"].values()) == 5
+
+
 def test_schedule_at_and_validation():
     s = FaultSchedule([FaultEvent(3, "nan_grad"), FaultEvent(3, "van_error"),
                        FaultEvent(5, "preempt")])

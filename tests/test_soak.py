@@ -1,0 +1,496 @@
+"""Sequential-fault chaos soak (ISSUE 18).
+
+One fault is table stakes; fleets die on the SECOND one.  The soak
+draws a seeded SEQUENCE of faults with recovery-aware pacing — each
+round's fault is injected into the system state the previous round's
+recovery left behind — and asserts the standing invariants after
+every round: zero lost accepted requests, token-exact serving,
+byte-identical training, and redundancy restored before the next
+draw.
+
+Fast lane (tier-1, fake clocks, no processes): the campaign's seeded
+draw/pacing/report contract, and the autoscaler's journaled warm
+takeover (a successor restored from the journal holds where a cold
+successor would duplicate the scale action).
+
+Slow lane (``soak`` marker): THE acceptance —
+
+* >= 3 consecutive van SIGKILLs against one serving pool, each kill
+  aimed at the PREVIOUSLY-PROMOTED primary after auto re-silvering
+  restored redundancy; every accepted request resolves 'ok'
+  token-exact, every round;
+* a mid-step van SIGKILL under a training pipeline finishes the run
+  byte-identical to an un-killed same-seed run (barrier re-keying +
+  idempotent replay);
+* a controller SIGKILL after >= 1 journaled autoscale decision: the
+  takeover resumes the autoscaler WARM from the ledger — no duplicate
+  scale action.
+"""
+
+import json
+import signal
+import subprocess
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from hetu_tpu.ps import available
+from hetu_tpu.ps import membership as mb
+from hetu_tpu.resilience.faults import SequentialFaultCampaign
+from hetu_tpu.telemetry import timeline, trace
+from hetu_tpu.traffic.autoscale import AutoscalePolicy, Autoscaler
+
+pytestmark = pytest.mark.soak
+
+needs_lib = pytest.mark.skipif(not available(),
+                               reason="native hetu_ps lib not built")
+
+TINY = {"vocab_size": 89, "hidden_size": 48, "num_layers": 2,
+        "num_heads": 4, "ffn_size": 96, "max_position": 64,
+        "num_slots": 4, "max_len": 48, "min_bucket": 8, "seed": 1}
+
+
+# ---------------------------------------------------------------------------
+# fast lane: campaign + warm-takeover contracts on fake clocks
+# ---------------------------------------------------------------------------
+
+def test_campaign_rejects_unknown_kinds():
+    with pytest.raises(ValueError):
+        SequentialFaultCampaign(seed=1, rounds=2,
+                                kinds=("van_kill", "cosmic_ray"))
+
+
+def test_campaign_fake_clock_driver_round_trip():
+    """A driver loop on a fake clock: draw → recover → complete per
+    round, recovery seconds land per kind, and the report carries the
+    soak headline inputs."""
+    camp = SequentialFaultCampaign(seed=3, rounds=4, n_victims=3)
+    now = [0.0]
+    while not camp.exhausted:
+        kind, victim = camp.draw()
+        assert kind in SequentialFaultCampaign.KINDS
+        assert 0 <= victim < 3
+        t0 = now[0]
+        now[0] += 1.5  # the fake recovery
+        camp.complete(ok=True, recovery_s=now[0] - t0,
+                      detail={"victim": victim})
+    rep = camp.report()
+    assert rep["rounds_drawn"] == rep["rounds_total"] == 4
+    assert rep["rounds_survived"] == 4
+    assert sum(len(v) for v in rep["recovery_s_by_kind"].values()) == 4
+    for vals in rep["recovery_s_by_kind"].values():
+        assert all(v == 1.5 for v in vals)
+    # same seed, fresh instance: identical draws (the replay contract)
+    again = SequentialFaultCampaign(seed=3, rounds=4, n_victims=3)
+    assert again.draws == camp.draws
+
+
+def test_campaign_draw_emits_pairable_fault_instant():
+    """draw() emits the same ``fault.<kind>`` instant a scheduled
+    fault would — the timeline pairing treats campaign rounds exactly
+    like FaultInjector rounds."""
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    try:
+        camp = SequentialFaultCampaign(seed=5, rounds=1,
+                                       kinds=("van_kill",))
+        kind, _ = camp.draw()
+        assert kind == "van_kill"
+        with trace.span("van.promote") as sp:
+            sp.set("won", True)
+        camp.complete(ok=True, recovery_s=0.2)
+    finally:
+        trace.disable()
+    pairs = [p for p in timeline.correlate(tracer.events)
+             if p.kind == "van_kill"]
+    assert len(pairs) == 1 and pairs[0].paired
+    assert pairs[0].recovery_name == "van.promote"
+
+
+class _FakeScalePool:
+    """The four-method surface Autoscaler touches (see its docstring),
+    plus a journal capture standing in for the van ledger."""
+
+    def __init__(self, dump):
+        self.n_members = 3
+        self.dump = dump
+        self.revived: list = []
+        self.journaled: list = []
+
+    def fleet_metrics(self, scrape=False):
+        outer = self
+
+        class _Reg:
+            def dump(self):
+                return dict(outer.dump)
+        return _Reg()
+
+    def revive_member(self, slot):
+        self.revived.append(slot)
+
+    def drain_member(self, slot, close=False):
+        pass
+
+    def journal_autoscaler(self, state, *, sync=False):
+        self.journaled.append(dict(state))
+
+
+_OVERLOADED = {"m0.queue_depth": {"type": "gauge", "value": 9.0}}
+
+_POL = AutoscalePolicy(min_members=1, max_members=3, queue_high=4.0,
+                       queue_low=0.5, shed_high=0.5, shed_low=0.001,
+                       up_ticks=2, down_ticks=3,
+                       up_cooldown_s=600.0, down_cooldown_s=600.0)
+
+
+def test_autoscaler_warm_takeover_holds_where_cold_duplicates():
+    """The controller-kill invariant, deterministically: a successor
+    restored from the predecessor's journaled state honors the
+    cooldown (no duplicate scale-up); the SAME successor built cold
+    fires the action again."""
+    now = [0.0]
+    pool1 = _FakeScalePool(_OVERLOADED)
+    sc1 = Autoscaler(pool1, _POL, clock=lambda: now[0], active={0})
+    assert sc1.tick()["action"] == "hold"  # streak 1 < up_ticks
+    now[0] += 1.0
+    assert sc1.tick()["action"] == "up"    # the journaled decision
+    assert pool1.revived == [1]
+    assert pool1.journaled, "every tick must journal"
+    state = pool1.journaled[-1]
+    assert state["actions"] == 1 and state["active"] == [0, 1]
+
+    # the predecessor dies here; a successor adopts the journal
+    now[0] += 2.0
+    pool2 = _FakeScalePool(_OVERLOADED)
+    warm = Autoscaler(pool2, _POL, clock=lambda: now[0], state=state)
+    assert warm.active == {0, 1}
+    assert warm.actions_total == 1  # lineage, not just this process
+    for _ in range(3):
+        now[0] += 1.0
+        assert warm.tick()["action"] == "hold"  # cooldown journaled
+    assert pool2.revived == [] and warm.actions_total == 1
+
+    # counterfactual: same signals, NO journal — the cold successor
+    # re-fires the scale-up the predecessor already actuated
+    pool3 = _FakeScalePool(_OVERLOADED)
+    cold = Autoscaler(pool3, _POL, clock=lambda: now[0],
+                      active={0, 1})
+    cold.tick()
+    now[0] += 1.0
+    assert cold.tick()["action"] == "up"
+    assert pool3.revived == [2]
+
+
+# ---------------------------------------------------------------------------
+# slow lane: the acceptance, real processes
+# ---------------------------------------------------------------------------
+
+def _reap(procs, workdir):
+    for p in procs:
+        if p is not None and p.poll() is None:
+            try:
+                p.send_signal(signal.SIGCONT)
+            except Exception:
+                pass
+            p.kill()
+            p.wait()
+    subprocess.run(["pkill", "-9", "-f", str(workdir)],
+                   capture_output=True, timeout=10)
+
+
+def _engine_reference():
+    from hetu_tpu.serve import ContinuousBatchingScheduler, Request
+    from hetu_tpu.serve.crosshost import build_engine
+    _, _, engine = build_engine(TINY)
+    sched = ContinuousBatchingScheduler(engine)
+    memo = {}
+
+    def ref(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in memo:
+            r = Request(prompt=list(prompt), max_tokens=n,
+                        timeout_s=300.0)
+            sched.submit(r)
+            while not r.done.is_set():
+                sched.step()
+            assert r.status == "ok"
+            memo[key] = list(r.tokens)
+        return memo[key]
+    return ref
+
+
+def _serve_round(pool, prompts, *, max_tokens, mid):
+    """Submit every prompt from client threads, fire ``mid`` while the
+    batch is in flight, and resolve.  A refused accept (the journal
+    write raced the kill) was never accepted — the client retries; an
+    UNRESOLVED request is a lost one."""
+    results = {}
+
+    def worker(i):
+        while True:
+            try:
+                req = pool.submit(prompts[i], max_tokens=max_tokens,
+                                  timeout_s=90.0)
+                break
+            except Exception:
+                time.sleep(0.1)
+        req.done.wait(timeout=180.0)
+        results[i] = {"status": (req.status or "ok")
+                      if req.done.is_set() else "lost",
+                      "tokens": list(req.tokens)}
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(prompts))]
+    for th in threads:
+        th.start()
+    time.sleep(0.3)
+    mid()
+    for th in threads:
+        th.join(240)
+    assert len(results) == len(prompts)
+    return results
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_soak_three_sequential_van_kills_zero_loss_token_exact(
+        tmp_path):
+    """THE acceptance, durable tier: a seeded campaign SIGKILLs the
+    van primary three times in a row against ONE serving pool — each
+    kill lands on the van the PREVIOUS round promoted, after
+    auto re-silvering restored redundancy.  Every round: zero lost
+    accepted requests, token-exact responses, pair redundant again
+    before the next draw."""
+    from hetu_tpu.resilience.shardproc import (free_port,
+                                               spawn_shard_server)
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+
+    p1, p2 = free_port(), free_port()
+    v1 = spawn_shard_server(tmp_path, p1, tag="prim")
+    v2 = spawn_shard_server(tmp_path, p2, tag="back")
+    procs = [v1, v2]
+    by_port = {p1: v1, p2: v2}
+    van_spec = {"endpoints": [["127.0.0.1", p1], ["127.0.0.1", p2]],
+                "epoch_table": mb.fresh_table_id(),
+                "promote_after_s": 0.3, "rcv_timeout_s": 1.5,
+                "revalidate_s": 0.05, "resilver_settle_s": 0.2}
+
+    def fresh_backup(_rep):
+        port = free_port()
+        proc = spawn_shard_server(tmp_path, port, tag=f"rsv{port}")
+        procs.append(proc)
+        by_port[port] = proc
+        return ("127.0.0.1", port)
+
+    camp = SequentialFaultCampaign(seed=23, rounds=3,
+                                   kinds=("van_kill",))
+    tracer = trace.Tracer()
+    trace.enable(tracer=tracer)
+    pool = None
+    try:
+        pool = CrossProcessServingPool(
+            2, workdir=tmp_path, model=TINY, own_van=False, port=p1,
+            van_spec=van_spec, lease_s=0.8, suspect_grace_s=0.8,
+            van_backup_factory=fresh_backup,
+            member_env={"JAX_PLATFORMS": "cpu"})
+        rep = pool._replica
+        ref = _engine_reference()
+        rng = np.random.default_rng(23)
+        round_no = 0
+        while not camp.exhausted:
+            kind, _ = camp.draw()
+            assert kind == "van_kill"
+            round_no += 1
+            # the victim is the CURRENT primary — from round 2 on,
+            # that is the van the previous round promoted
+            victim_port = rep.primary[1]
+            victim = by_port[victim_port]
+            prompts = [list(map(int, rng.integers(
+                1, TINY["vocab_size"], rng.integers(2, 5))))
+                for _ in range(4)]
+            t0 = time.monotonic()
+
+            def kill():
+                victim.kill()
+                victim.wait()
+
+            results = _serve_round(pool, prompts, max_tokens=8,
+                                   mid=kill)
+            bad = {i: r for i, r in results.items()
+                   if r["status"] != "ok"}
+            assert not bad, (round_no, bad)   # zero lost accepts
+            for i, r in results.items():
+                assert r["tokens"] == ref(prompts[i], 8), \
+                    (round_no, i)             # token-exact
+            # recovery-aware pacing: redundancy restored (promotion
+            # AND re-silver done) before the next draw
+            deadline = time.monotonic() + 90.0
+            while time.monotonic() < deadline and \
+                    (rep.incarnation < round_no + 1 or rep.degraded):
+                time.sleep(0.1)
+            assert rep.incarnation == round_no + 1, \
+                (round_no, rep.incarnation)
+            assert not rep.degraded, round_no
+            assert rep.export_lag() == 0, round_no
+            assert rep.primary[1] != victim_port, round_no
+            camp.complete(ok=True,
+                          recovery_s=time.monotonic() - t0)
+        srep = camp.report()
+        assert srep["rounds_survived"] == 3, srep
+        # fresh traffic still serves after the third fault
+        resp = pool.generate([5, 6, 7], max_tokens=5, timeout_s=60.0)
+        assert resp["status"] == "ok"
+        assert resp["tokens"] == ref([5, 6, 7], 5)
+    finally:
+        trace.disable()
+        if pool is not None:
+            pool.close()
+        _reap(procs, tmp_path)
+    # every campaign round paired with a promotion on the timeline
+    pairs = [p for p in timeline.correlate(tracer.events)
+             if p.kind == "van_kill"]
+    assert len(pairs) == 3 and all(p.paired for p in pairs), pairs
+    assert all(p.recovery_name == "van.promote" for p in pairs)
+    # and the re-silver left its spans (redundancy restoration is
+    # observable, not just asserted)
+    resilvers = [e for e in tracer.events
+                 if e.get("name") == "van.resilver"]
+    assert len(resilvers) >= 3, len(resilvers)
+
+
+def _run_pipeline(wd, *, van_spec=None, port=0, kill_at_step=None,
+                  kill_proc=None):
+    from hetu_tpu.parallel.mpmd_elastic import MPMDPipelineSupervisor
+    wd.mkdir(parents=True, exist_ok=True)
+    sup = MPMDPipelineSupervisor(
+        3, workdir=wd, steps=8, n_microbatches=4, width=8, batch=8,
+        data_seed=7, lr=0.05, own_van=van_spec is None, port=port,
+        van_spec=van_spec, lease_s=1.0, suspect_grace_s=0.8,
+        step_sleep_s=0.05)
+    killed = False
+    deadline = time.monotonic() + 180
+    try:
+        while time.monotonic() < deadline:
+            sup.poll()
+            hw = max((sup.svc.state_of(s).committed
+                      for s in range(3)), default=-1)
+            if (kill_at_step is not None and not killed
+                    and hw >= kill_at_step):
+                kill_proc.kill()
+                kill_proc.wait()
+                killed = True
+            if hw >= 7 and all(
+                    sup.svc.state_of(s).committed >= 7 or
+                    sup.svc.state_of(s).state == "left"
+                    for s in range(3)):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("pipeline did not finish in time")
+        assert killed == (kill_at_step is not None)
+        return sup.final_params()
+    finally:
+        sup.close()
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_soak_midstep_van_kill_trains_byte_identical(tmp_path):
+    """THE acceptance, training plane: a van primary SIGKILL in the
+    middle of a pipeline step — stages re-key their barriers and
+    mailboxes under the promoted incarnation, replay the voided epoch
+    idempotently, and the run finishes BYTE-IDENTICAL to an un-killed
+    same-seed run."""
+    from hetu_tpu.resilience.shardproc import (free_port,
+                                               spawn_shard_server)
+
+    ref = _run_pipeline(tmp_path / "ref")
+    p1, p2 = free_port(), free_port()
+    wd = tmp_path / "chaos"
+    wd.mkdir(parents=True)
+    v1 = spawn_shard_server(wd, p1, tag="prim")
+    v2 = spawn_shard_server(wd, p2, tag="back")
+    try:
+        van_spec = {"endpoints": [["127.0.0.1", p1],
+                                  ["127.0.0.1", p2]],
+                    "epoch_table": mb.fresh_table_id(),
+                    "promote_after_s": 0.3, "rcv_timeout_s": 1.5,
+                    "revalidate_s": 0.1}
+        out = _run_pipeline(wd, van_spec=van_spec, kill_at_step=2,
+                            kill_proc=v1)
+        assert set(out) == set(ref)
+        for k in ref:
+            assert np.array_equal(ref[k], out[k]), \
+                f"stage {k} params differ across the van kill"
+    finally:
+        _reap([v1, v2], tmp_path)
+
+
+_SOAK_POLICY = {"min_members": 1, "max_members": 3, "queue_high": 0.0,
+                "queue_low": -1.0, "shed_high": 2.0, "shed_low": -1.0,
+                "up_ticks": 1, "down_ticks": 99,
+                "up_cooldown_s": 600.0, "down_cooldown_s": 600.0}
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_soak_controller_kill_after_autoscale_resumes_warm(tmp_path):
+    """THE acceptance, control loop: SIGKILL the controller AFTER it
+    journaled an autoscale decision; the takeover restores the loop's
+    RAM from the van ledger and the successor holds inside the
+    journaled cooldown — no duplicate scale action."""
+    from hetu_tpu.resilience.shardproc import (free_port, spawn_module,
+                                               spawn_shard_server)
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+
+    port = free_port()
+    van = spawn_shard_server(tmp_path, port, tag="soakvan")
+    ctrl = None
+    pool = None
+    try:
+        cfg = {"workdir": str(tmp_path), "port": port, "n_members": 3,
+               "model": TINY, "n_requests": 0, "hold_s": 600.0,
+               "lease_s": 0.5, "suspect_grace_s": 0.4,
+               "autoscale": {"park": [1, 2], "active": [0],
+                             "policy": _SOAK_POLICY, "ticks": 1}}
+        cfg_path = Path(tmp_path) / "soak_ctrl.json"
+        cfg_path.write_text(json.dumps(cfg))
+        ctrl = spawn_module(tmp_path, "soak_ctrl",
+                            "hetu_tpu.serve.crosshost",
+                            ["--controller", str(cfg_path)],
+                            extra_env={"JAX_PLATFORMS": "cpu"},
+                            timeout_s=180.0)
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            text = Path(ctrl.log_path).read_text(errors="replace")
+            if "SCALED up" in text:
+                break
+            assert ctrl.poll() is None, text[-2000:]
+            time.sleep(0.1)
+        else:
+            raise AssertionError("controller never scaled up")
+        ctrl.kill()  # after >= 1 journaled decision
+        ctrl.wait()
+        pool = CrossProcessServingPool.takeover(
+            workdir=tmp_path, port=port, lease_s=0.5,
+            suspect_grace_s=0.4)
+        st = pool.takeover_report["autoscaler_state"]
+        assert st is not None, pool.takeover_report
+        assert st["actions"] == 1 and st["active"] == [0, 1], st
+        # a successor loop adopts the journal with NO extra plumbing
+        sc = Autoscaler(pool, AutoscalePolicy(**_SOAK_POLICY))
+        assert sc.active == {0, 1}
+        assert sc.actions_total == 1
+        rec = sc.tick()  # same always-overloaded policy signals
+        assert rec["action"] == "hold", rec  # journaled cooldown: no
+        assert sc.actions_total == 1         # duplicate scale action
+    finally:
+        if pool is not None:
+            pool.close()
+        _reap([ctrl, van], tmp_path)
