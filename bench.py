@@ -3055,6 +3055,161 @@ def bench_soak():
     })
 
 
+def bench_health():
+    """Health-monitor overhead: what live alerting + the fleet doctor
+    cost on top of the observability plane.
+
+    A/B on the SAME cross-process serving pool shape (2 member
+    processes, CPU-pinned, seeded model), telemetry streams + scrape ON
+    in BOTH arms (that tax is bench_obs's number): arm A serves with no
+    monitor; arm B additionally runs ``pool.start_health_monitor()`` —
+    the streaming fleet tail, MetricWindows ingestion, burn-rate +
+    fleet rule evaluation, and the doctor, all live on the controller.
+    Both arms serve the same prompt set and measure per-request wall
+    latency at the client.
+
+    The contract printed against a budget: p50 request latency with the
+    monitor on must stay within ``overhead_budget_pct`` of monitor-off
+    — the bench RAISES past it, same rationale as bench_obs: a health
+    plane nobody can afford to leave on alerts on nothing.  The ON arm
+    also proves it measured a WORKING monitor: after the recorded
+    rounds it seeds a ``netem_degrade`` under continued traffic and the
+    ``link_degraded`` alert must fire in-flight with the doctor naming
+    the injected kind."""
+    import os
+    import tempfile
+    import threading
+
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+    from hetu_tpu.telemetry import trace
+
+    smoke = bool(os.environ.get("HETU_BENCH_SMOKE"))
+    if smoke:
+        H, L, MAXLEN, N_REQ, GEN, ROUNDS = 64, 2, 64, 6, 16, 1
+    else:
+        H, L, MAXLEN, N_REQ, GEN, ROUNDS = 128, 4, 128, 8, 32, 2
+    model_spec = {"vocab_size": 256, "hidden_size": H, "num_layers": L,
+                  "num_heads": 4, "ffn_size": 4 * H,
+                  "max_position": MAXLEN, "num_slots": N_REQ,
+                  "max_len": MAXLEN, "min_bucket": 8, "seed": 0}
+    prompts = [[(7 * i) % 251 + 1, (3 * i) % 251 + 1, 5]
+               for i in range(N_REQ)]
+
+    def run_arm(mon_on: bool, wd: str):
+        trace.enable(jsonl_path=os.path.join(
+            wd, "controller.trace.jsonl"))
+        pool = CrossProcessServingPool(
+            2, workdir=wd, model=model_spec, request_timeout_s=300.0,
+            telemetry_streams=True, scrape_s=0.25,
+            slo_classes={"gold": {"priority": 1, "weight": 4.0,
+                                  "ttft_slo_s": 0.25}},
+            member_env={"JAX_PLATFORMS": "cpu"})
+        mon = None
+        lats = []
+        extra = {}
+        try:
+            if mon_on:
+                mon = pool.start_health_monitor(
+                    interval_s=0.25, burn_windows=(2.0, 8.0),
+                    window_s=5.0)
+
+            def round_once(record):
+                out = {}
+
+                def worker(i):
+                    t0 = time.perf_counter()
+                    out[i] = pool.generate(
+                        prompts[i], max_tokens=GEN, timeout_s=300.0,
+                        tenant="gold")
+                    if record:
+                        lats.append(time.perf_counter() - t0)
+                ts = [threading.Thread(target=worker, args=(i,))
+                      for i in range(N_REQ)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(300)
+                assert len(out) == N_REQ and \
+                    all(r["status"] == "ok" for r in out.values()), out
+            round_once(record=False)  # warm both members' executables
+            for _ in range(ROUNDS):
+                round_once(record=True)
+            if mon_on:
+                # unrecorded epilogue: seed a fault under continued
+                # traffic — the arm only counts if the monitor it paid
+                # for actually catches a live fault
+                trace.instant("fault.netem_degrade",
+                              {"kind": "netem_degrade", "member": 1},
+                              cat="fault")
+                pool.apply_net_fault("netem_degrade", 1, 6.0)
+                deadline = time.time() + 45
+                fired = False
+                while time.time() < deadline and not fired:
+                    round_once(record=False)
+                    fired = any(a["rule"] == "link_degraded"
+                                for a in mon.active_alerts())
+                assert fired, "monitor missed the seeded netem_degrade"
+                deadline = time.time() + 10
+                while time.time() < deadline and \
+                        (mon.last_diagnosis or {}).get(
+                            "top", {}).get("kind") != "netem_degrade":
+                    time.sleep(0.2)
+                diag = (mon.last_diagnosis or {}).get("top", {})
+                assert diag.get("kind") == "netem_degrade", \
+                    mon.last_diagnosis
+                reg = pool.fleet_metrics(timeout_s=5.0)
+                extra["alert_proof"] = {
+                    "rule": "link_degraded",
+                    "diagnosis_kind": diag["kind"],
+                    "alerts_fired": reg.counter(
+                        "ctrl.health.alerts_fired").value,
+                    "diagnoses": reg.counter(
+                        "ctrl.health.diagnoses").value,
+                }
+        finally:
+            pool.close()
+            trace.disable()
+        return lats, extra
+
+    with tempfile.TemporaryDirectory(prefix="bench_health_off_") as wd:
+        off, _ = run_arm(False, wd)
+    with tempfile.TemporaryDirectory(prefix="bench_health_on_") as wd:
+        on, on_extra = run_arm(True, wd)
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(int(q * len(xs)), len(xs) - 1)]
+
+    off_p50, on_p50 = pct(off, 0.5), pct(on, 0.5)
+    overhead_pct = (on_p50 - off_p50) / off_p50 * 100
+    budget_pct = 25.0  # same shape as bench_obs: the monitor's tail
+    # poll + rule sweep runs on the controller off the decode path, so
+    # anything past this is a real regression (e.g. rule eval landed
+    # under the routing lock), not jitter
+    if overhead_pct > budget_pct:
+        raise AssertionError(
+            f"health-monitor overhead {overhead_pct:.1f}% p50 exceeds "
+            f"the {budget_pct:.0f}% budget")
+    _emit({
+        "metric": "health_monitor_overhead_pct",
+        "value": round(overhead_pct, 3),
+        "unit": "percent_p50_request_latency_monitor_on_vs_off",
+        "vs_baseline": round(off_p50 / on_p50, 4),
+        "extra": {
+            "overhead_budget_pct": budget_pct,
+            "within_budget": True,
+            "p50_s": {"off": round(off_p50, 4), "on": round(on_p50, 4)},
+            "p99_s": {"off": round(pct(off, 0.99), 4),
+                      "on": round(pct(on, 0.99), 4)},
+            "requests_per_round": N_REQ, "rounds": ROUNDS,
+            "gen_tokens": GEN,
+            **on_extra,
+            "ab": {"optimized": "tail_rules_doctor_on",
+                   "baseline": "streams_and_scrape_only"},
+        },
+    })
+
+
 _METRIC_BY_CMD = {
     "gpt": "gpt2s_bf16_train_mfu_1chip",
     "gpt_sweep": "gpt_config_sweep_best_mfu_1chip",
@@ -3077,6 +3232,7 @@ _METRIC_BY_CMD = {
     "obs": "obs_stream_scrape_overhead_pct",
     "autoscale": "autoscale_qps_gain_x",
     "soak": "soak_resilver_p50_s",
+    "health": "health_monitor_overhead_pct",
 }
 
 
@@ -3125,6 +3281,7 @@ def main():
      "obs": bench_obs,
      "autoscale": bench_autoscale,
      "soak": bench_soak,
+     "health": bench_health,
      "telemetry": bench_telemetry}.get(cmd, bench_gpt)()
 
 

@@ -132,21 +132,31 @@ _retired_lock = threading.Lock()
 _reaper_started = False
 
 
+def _reap_retired(now: Optional[float] = None) -> int:
+    """One reaper pass: close every handle whose grace lapsed, update
+    the ``van.replica.floating_handles`` gauge to what still floats.
+    Split from the loop so tests (and a health dashboard curious about
+    leak regressions) can drive a pass deterministically."""
+    now = time.monotonic() if now is None else float(now)
+    due = []
+    with _retired_lock:
+        keep = []
+        for item in _retired:
+            (due if item[0] <= now else keep).append(item)
+        _retired[:] = keep
+        _reg().gauge("van.replica.floating_handles").set(len(keep))
+    for _, h in due:
+        try:
+            h.close()
+        except Exception:
+            pass
+    return len(due)
+
+
 def _reaper_loop() -> None:
     while True:
         time.sleep(_RETIRE_GRACE_S / 4)
-        now = time.monotonic()
-        due = []
-        with _retired_lock:
-            keep = []
-            for item in _retired:
-                (due if item[0] <= now else keep).append(item)
-            _retired[:] = keep
-        for _, h in due:
-            try:
-                h.close()
-            except Exception:
-                pass
+        _reap_retired()
 
 
 def retire_handle(h, *, grace_s: float = _RETIRE_GRACE_S) -> None:
@@ -157,6 +167,7 @@ def retire_handle(h, *, grace_s: float = _RETIRE_GRACE_S) -> None:
         return
     with _retired_lock:
         _retired.append((time.monotonic() + float(grace_s), h))
+        _reg().gauge("van.replica.floating_handles").set(len(_retired))
         if not _reaper_started:
             _reaper_started = True
             threading.Thread(target=_reaper_loop, daemon=True,

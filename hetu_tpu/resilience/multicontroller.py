@@ -272,6 +272,10 @@ class WorkerProcess(ControlPlaneMember):
             if step >= spec.steps:
                 break
             bar_sync, bar_commit = self._epoch_barriers(width)
+            pushed = False  # did THIS attempt's gradient land on the
+            # tier?  read by the failover handler below: a step voided
+            # after its push re-pushes on re-run — that duplicate was
+            # invisible before PR 19 counted it
             try:
                 t0 = time.perf_counter()
                 self._await_barrier(bar_sync)
@@ -290,6 +294,7 @@ class WorkerProcess(ControlPlaneMember):
                     self._push_ordered(grad, rank, width)
                 else:
                     self.table.dense_push(grad)
+                pushed = True
                 t4 = time.perf_counter()
                 # the WORK phases only (pull/grad/push) feed the
                 # heartbeat's load field: barrier waits are time spent
@@ -331,6 +336,15 @@ class WorkerProcess(ControlPlaneMember):
                 # re-push is the plane's documented at-least-once
                 # (check_complete_cover tolerance); byte-identity under
                 # van chaos lives with the idempotent MPMD plane.
+                if pushed:
+                    # the gradient landed, then the step voided: the
+                    # re-run WILL push it again.  Count the duplicate
+                    # where it happens — ``ps.dp_repush_duplicates``
+                    # rides fleet_metrics() so an operator can bound
+                    # how non-idempotent a chaotic run actually was.
+                    from hetu_tpu.telemetry import default_registry
+                    default_registry.counter(
+                        "ps.dp_repush_duplicates").inc()
                 try:
                     self._wire_fault(e)
                 except _EpochChanged:

@@ -106,6 +106,20 @@ def _fenced_chan(base: int, ctrl_inc: int) -> int:
     return int(base) + int(ctrl_inc) * CTRL_CHAN_STRIDE
 
 
+def _fleet_event(name: str, rec: dict) -> None:
+    """Structured fleet forensics: one instant on the process span
+    stream (``membership.event`` / ``route.park`` / ``route.send_fail``
+    — the fleet doctor and ``fleet_report.py`` read these), with the
+    old ``HETU_DEBUG_FLEET`` stderr dump kept as a FORMATTER over the
+    same record — the env var now picks a sink, it no longer decides
+    whether the evidence exists."""
+    trace.instant(name, rec, cat="fleet")
+    if os.environ.get("HETU_DEBUG_FLEET"):
+        kv = " ".join(f"{k}={v}" for k, v in rec.items())
+        print(f"[fleet] {time.monotonic():.2f} {name} {kv}",
+              file=sys.stderr, flush=True)
+
+
 def seeded_prompts(n: int, seed: int = 0, *, vocab: int = 89,
                    max_len: int = 6) -> list:
     """Deterministic prompt set shared by the controller harness, the
@@ -685,7 +699,8 @@ class MemberHarness:
         return True
 
     _DURABLE_TIER_METRICS = ("membership.", "van.replica.",
-                             "van.resilver.", "ledger.", "standby.")
+                             "van.resilver.", "ledger.", "standby.",
+                             "ps.")
 
     def _emit_metrics(self) -> None:
         """Answer a fleet scrape: ship the FULL registry state (raw
@@ -1071,6 +1086,8 @@ class CrossProcessServingPool:
         # deadlines/active set journal here (and into the ledger) so a
         # takeover resumes the loop from measured history, not cold
         self._autoscaler_state: Optional[dict] = None
+        # live health plane (started on demand by start_health_monitor)
+        self.health_monitor = None
         self._stop = threading.Event()
         try:
             if _takeover:
@@ -2026,6 +2043,43 @@ class CrossProcessServingPool:
         reg.gauge("fleet.members_alive").set(len(self.svc.alive_slots()))
         return reg
 
+    def start_health_monitor(self, rules=None, *, interval_s: float = 0.5,
+                             history_s: float = 120.0, **rule_kw):
+        """Host the live health plane on this controller: a
+        :class:`~hetu_tpu.telemetry.health.HealthMonitor` loop over the
+        cadence-scraped ``fleet_metrics()`` view plus (when telemetry
+        streams are on) a streaming tail of the workdir for the fleet
+        doctor's evidence.  ``rules`` defaults to
+        :func:`~hetu_tpu.telemetry.health.default_fleet_rules` compiled
+        from this pool's ``slo_classes``; ``rule_kw`` (``burn_windows``,
+        ``burn_budget``, ``burn_factor``, ``window_s``, ...) tunes that
+        compilation — tests and benches shrink the burn windows to
+        match runs shorter than five minutes.
+
+        Alert state rides ``fleet_metrics()`` as ``ctrl.health.*``
+        (active gauge, fired/resolved counters, doctor verdict count),
+        and every transition is a ``health.alert`` instant on this
+        process's span stream — alerts are themselves telemetry.
+        """
+        from hetu_tpu.telemetry.health import (
+            HealthMonitor, default_fleet_rules,
+        )
+        if self.health_monitor is not None:
+            raise RuntimeError("health monitor already running")
+        if rules is None:
+            rules = default_fleet_rules(self._slo_classes, **rule_kw)
+        mon = HealthMonitor(
+            rules,
+            # scrape=False: the poll loop's cadence scrape feeds the
+            # dumps — the monitor must never block on a wedged member
+            source=lambda: self.fleet_metrics(scrape=False).dump(),
+            tail=(self.workdir if self._telemetry_streams else None),
+            interval_s=interval_s, history_s=history_s,
+            registry=self.metrics.registry)
+        self.health_monitor = mon
+        mon.start()
+        return mon
+
     def _on_done(self, slot: int, ev: dict) -> None:
         req = self._requests.get(int(ev.get("rid", -1)))
         if req is None or req.done.is_set():
@@ -2137,20 +2191,20 @@ class CrossProcessServingPool:
                     self._unrouted.pop(req.rid, None)
                 return
             except Exception as e:
-                if os.environ.get("HETU_DEBUG_FLEET"):
-                    print(f"[fleet] {time.monotonic():.2f} send fail "
-                          f"rid={req.rid} slot={slot} {type(e).__name__}: "
-                          f"{e}", file=sys.stderr, flush=True)
+                _fleet_event("route.send_fail",
+                             {"rid": req.rid, "member": int(slot),
+                              "error": f"{type(e).__name__}: {e}"})
                 with self._lock:
                     self._inflight[slot] = max(
                         self._inflight.get(slot, 1) - 1, 0)
                     req.member = None
                 exclude.add(slot)
-        if os.environ.get("HETU_DEBUG_FLEET"):
-            print(f"[fleet] {time.monotonic():.2f} park rid={req.rid} "
-                  f"exclude={exclude} states="
-                  f"{[(m.slot, m.state, m.suspect_reason) for m in self.svc.members]}",
-                  file=sys.stderr, flush=True)
+        _fleet_event("route.park",
+                     {"rid": req.rid,
+                      "exclude": sorted(int(s) for s in exclude),
+                      "states": [[int(m.slot), m.state,
+                                  m.suspect_reason]
+                                 for m in self.svc.members]})
         # no routable member RIGHT NOW (every member suspect during a
         # durable-tier failover's blind window, a mid-rebind wire, the
         # whole fleet draining): the request is JOURNALED, so it must
@@ -2331,10 +2385,12 @@ class CrossProcessServingPool:
             self.metrics.inc("controller_fenced")
             return 0
         n = 0
-        if events and os.environ.get("HETU_DEBUG_FLEET"):
-            print(f"[fleet] {time.monotonic():.2f} events={events} "
-                  f"states={[(m.slot, m.state) for m in self.svc.members]}",
-                  file=sys.stderr, flush=True)
+        if events:
+            states = [[int(m.slot), m.state] for m in self.svc.members]
+            for kind, slot in events:
+                _fleet_event("membership.event",
+                             {"kind": str(kind), "member": int(slot),
+                              "states": states})
         for kind, slot in events:
             if kind == "suspect":
                 self._suspect_t0[slot] = trace.now_us()
@@ -2665,6 +2721,12 @@ class CrossProcessServingPool:
     # ---- lifecycle ----
     def close(self, timeout_s: float = 10.0) -> None:
         self._stop.set()
+        if self.health_monitor is not None:
+            try:
+                self.health_monitor.stop()
+            except Exception:
+                pass
+            self.health_monitor = None
         if self._replica is not None:
             self._replica.unregister(self._on_van_failover)
         t = getattr(self, "_poll_thread", None)

@@ -26,8 +26,14 @@ instrumentation (van RPC latency/bytes, serve compiles) records into;
 ``prometheus_text()`` snapshots it for a file-based scrape.
 """
 
-from hetu_tpu.telemetry import costs, fleet, registry, timeline, trace
+from hetu_tpu.telemetry import (
+    costs, fleet, health, registry, timeline, trace,
+)
 from hetu_tpu.telemetry.costs import calibration_ratio, measured_op_costs
+from hetu_tpu.telemetry.health import (
+    AlertRule, BurnRateRule, HealthMonitor, MetricWindows, diagnose,
+    slo_burn_rules, tail_streams,
+)
 from hetu_tpu.telemetry.registry import (
     Counter, Gauge, Histogram, MetricsRegistry,
 )
@@ -46,7 +52,9 @@ def prometheus_text() -> str:
 
 
 __all__ = [
-    "trace", "registry", "timeline", "fleet", "costs",
+    "trace", "registry", "timeline", "fleet", "costs", "health",
+    "tail_streams", "MetricWindows", "AlertRule", "BurnRateRule",
+    "HealthMonitor", "slo_burn_rules", "diagnose",
     "Tracer", "enable", "disable", "enabled", "get_tracer",
     "span", "instant", "complete", "now_us", "load_jsonl",
     "open_process_stream", "measured_op_costs", "calibration_ratio",

@@ -25,7 +25,12 @@ writes its own crash-durable JSONL span stream on its own
   injected in the controller process pairs with a recovery span
   recorded in a member process.
 
-``python tools/fleet_report.py RUNDIR`` is the CLI over all of this.
+``python tools/fleet_report.py RUNDIR`` is the CLI over all of this —
+the post-hoc half.  The LIVE half is
+:func:`hetu_tpu.telemetry.health.tail_streams`, which follows the same
+streams incrementally with the same anchor alignment (exposed here as
+:func:`anchors` / :func:`offset_at` so the tail and the merge can never
+disagree about where an event sits on the wall clock).
 """
 
 from __future__ import annotations
@@ -98,6 +103,20 @@ def _offset_at(anchors, ts: float) -> float:
             break
         off = a_wall - a_ts
     return off
+
+
+# the streaming tail (telemetry/health.py) aligns events with exactly
+# this machinery — public names so external followers can too
+def anchors(events) -> list:
+    """Public alias of the anchor extractor: ``[(track_ts_us,
+    wall_us)]`` pairs from a stream's ``clock_sync`` records."""
+    return _anchors(events)
+
+
+def offset_at(anchor_list, ts: float) -> float:
+    """Public alias of the per-event alignment offset (see
+    :func:`_offset_at`)."""
+    return _offset_at(anchor_list, ts)
 
 
 def merge_streams(sources) -> tuple:

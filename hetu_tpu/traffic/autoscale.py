@@ -15,11 +15,15 @@ tick it scrapes the fleet registry and reads three signals —
   counter deltas between this tick and the last (cumulative fleet
   counters diff cleanly because dead incarnations stay folded into the
   merge — the PR 14 retired-accumulator property this loop leans on);
-* **SLO breach** — windowed per-tenant TTFT p99 from
-  ``tenant.<slug>.ttft_s`` histogram bucket DELTAS vs each tenant's
-  declared budget (``ttft_slos``), so one tenant blowing its p99 in
-  the last window triggers scale-up even while fleet averages look
-  calm —
+* **SLO breach** — when a :class:`~hetu_tpu.telemetry.health.
+  HealthMonitor` is wired (``monitor=`` or the pool's own
+  ``health_monitor``), the trigger is its multi-window BURN-RATE
+  alerts: a tenant-labelled alert firing (e.g. ``slo_burn.gold``)
+  votes scale-up, so the loop shares one alerting definition with
+  dashboards and pagers instead of a private threshold.  Without a
+  monitor, the legacy fallback compares the windowed per-tenant TTFT
+  p99 from ``tenant.<slug>.ttft_s`` histogram bucket deltas against
+  each tenant's declared budget (``ttft_slos``) —
 
 and votes scale-up / scale-down / hold.  Votes become actions only
 through hysteresis (``up_ticks``/``down_ticks`` consecutive agreeing
@@ -52,6 +56,7 @@ from typing import Callable, Optional
 
 from hetu_tpu.serve.metrics import ServeMetrics
 from hetu_tpu.telemetry import trace
+from hetu_tpu.telemetry.health import MetricWindows, _quantile_from_counts
 
 _tenant_slug = ServeMetrics._tenant_slug  # same sanitization both ways:
 # the slug this loop reads MUST be the slug the scheduler wrote
@@ -86,24 +91,17 @@ class _Signals:
     shed_rate: float = 0.0
     submitted_delta: int = 0
     shed_delta: int = 0
-    slo_breaches: dict = field(default_factory=dict)  # tenant -> p99
+    slo_breaches: dict = field(default_factory=dict)  # tenant -> p99/burn
+    burn_driven: bool = False  # breaches came from a HealthMonitor
+    # burn-rate alert, not the legacy hand-coded p99 threshold
 
 
 def _p99_from_counts(buckets, counts, q: float = 0.99) -> Optional[float]:
-    """Conservative quantile from raw bucket counts (upper bound of the
-    winning bucket): enough resolution for a threshold comparison, and
-    self-contained — no fabricated Histogram internals."""
-    total = sum(counts)
-    if total <= 0:
-        return None
-    target = q * total
-    cum = 0
-    for i, c in enumerate(counts):
-        cum += c
-        if cum >= target:
-            return float(buckets[i]) if i < len(buckets) \
-                else float(buckets[-1])
-    return float(buckets[-1])
+    """Conservative quantile from raw bucket counts — the shared
+    implementation lives with the windowing library now
+    (:func:`hetu_tpu.telemetry.health._quantile_from_counts`); this
+    name stays for callers of the PR 16 surface."""
+    return _quantile_from_counts(buckets, counts, q)
 
 
 class Autoscaler:
@@ -117,8 +115,11 @@ class Autoscaler:
     ``n_members`` — a fake with those four is a fine unit-test double.
 
     ``ttft_slos`` maps tenant name → TTFT p99 budget in seconds; a
-    tenant's windowed p99 over budget votes scale-up.  ``clock`` is
-    injectable for deterministic tests.
+    tenant's windowed p99 over budget votes scale-up.  ``monitor``
+    (or, lazily, the pool's ``health_monitor`` attribute) replaces
+    that hand-coded threshold with the monitor's tenant-labelled
+    burn-rate alerts.  ``clock`` is injectable for deterministic
+    tests.
     """
 
     def __init__(self, pool, policy: AutoscalePolicy, *,
@@ -126,7 +127,7 @@ class Autoscaler:
                  active: Optional[set] = None,
                  clock: Callable[[], float] = time.monotonic,
                  state: Optional[dict] = None,
-                 journal=None):
+                 journal=None, monitor=None):
         if policy.min_members < 1:
             raise ValueError("min_members must be >= 1")
         if policy.max_members < policy.min_members:
@@ -147,8 +148,12 @@ class Autoscaler:
         self.active = set(range(int(pool.n_members))) \
             if active is None else {int(s) for s in active}
         self.decisions: list = []     # every tick's verdict, in order
-        self._last_counters: dict = {}
-        self._last_tenant_hists: dict = {}
+        self.monitor = monitor
+        # one windowing implementation fleet-wide (PR 19): the same
+        # MetricWindows the HealthMonitor and dashboards read — with
+        # window_s=None its baseline is the previous ingested sample,
+        # which is exactly the old per-tick counter/hist delta
+        self._windows = MetricWindows()
         self._up_streak = 0
         self._down_streak = 0
         self._last_up = -float("inf")
@@ -213,15 +218,11 @@ class Autoscaler:
         return self._actions_prior + self.scale_ups + self.scale_downs
 
     # ---- sensing ----
-    def _counter_delta(self, dump: dict, name: str) -> int:
-        cur = int(dump.get(name, {}).get("value", 0))
-        prev = self._last_counters.get(name, 0)
-        self._last_counters[name] = cur
-        return max(cur - prev, 0)
-
     def read_signals(self, dump: dict) -> _Signals:
         """One tick's view of the fleet from a ``fleet_metrics`` dump —
         split out so tests can feed canned dumps."""
+        win = self._windows
+        win.ingest(dump, t=self.clock(), source="fleet")
         sig = _Signals()
         depths = []
         for slot in self.active:
@@ -229,26 +230,30 @@ class Autoscaler:
             if rec is not None:
                 depths.append(float(rec.get("value", 0.0)))
         sig.queue_depth = sum(depths) / max(len(self.active), 1)
-        sig.submitted_delta = self._counter_delta(
-            dump, "requests_submitted")
-        sig.shed_delta = self._counter_delta(dump, "requests_shed")
+        # window_s=None → delta against the PREVIOUS ingested sample:
+        # the since-last-tick semantics this loop has always used
+        sig.submitted_delta = int(win.delta("requests_submitted"))
+        sig.shed_delta = int(win.delta("requests_shed"))
         if sig.submitted_delta > 0:
             sig.shed_rate = sig.shed_delta / sig.submitted_delta
-        for tenant, budget in self.ttft_slos.items():
-            name = f"tenant.{_tenant_slug(tenant)}.ttft_s"
-            rec = dump.get(name)
-            if rec is None or rec.get("type") != "histogram":
-                continue
-            counts = list(rec["counts"])
-            prev = self._last_tenant_hists.get(name)
-            self._last_tenant_hists[name] = counts
-            if prev is not None and len(prev) == len(counts):
-                delta = [max(c - p, 0) for c, p in zip(counts, prev)]
-            else:
-                delta = counts
-            p99 = _p99_from_counts(rec["buckets"], delta)
-            if p99 is not None and p99 > float(budget):
-                sig.slo_breaches[tenant] = p99
+        mon = self.monitor if self.monitor is not None \
+            else getattr(self.pool, "health_monitor", None)
+        if mon is not None:
+            # the shared alerting definition IS the trigger: any firing
+            # tenant-labelled alert (slo_burn.<slug> from slo_classes)
+            # votes scale-up with its burn factor as the magnitude
+            sig.burn_driven = True
+            for alert in mon.active_alerts():
+                tenant = (alert.get("labels") or {}).get("tenant")
+                if tenant:
+                    sig.slo_breaches[tenant] = float(
+                        alert.get("value") or 0.0)
+        else:
+            for tenant, budget in self.ttft_slos.items():
+                name = f"tenant.{_tenant_slug(tenant)}.ttft_s"
+                p99 = win.quantile(name, 0.99, None, "fleet")
+                if p99 is not None and p99 > float(budget):
+                    sig.slo_breaches[tenant] = p99
         return sig
 
     # ---- deciding / actuating ----
@@ -338,7 +343,8 @@ class Autoscaler:
     @staticmethod
     def _reason(sig: _Signals, pol: AutoscalePolicy) -> str:
         if sig.slo_breaches:
-            return "slo_breach:" + ",".join(sorted(sig.slo_breaches))
+            prefix = "slo_burn:" if sig.burn_driven else "slo_breach:"
+            return prefix + ",".join(sorted(sig.slo_breaches))
         if sig.shed_rate >= pol.shed_high:
             return "shed_rate"
         return "queue_depth"

@@ -1,0 +1,698 @@
+"""Live fleet health (ISSUE 19): streaming telemetry tail, windowed
+aggregates, SLO burn-rate alerting, the automated fleet doctor, and the
+``fleet_top`` dashboard.
+
+Fast lane: tail semantics on synthetic streams (torn final line,
+hold-until-first-anchor retroactive alignment, re-anchor drift
+correction, 3-way pid-collision remap), MetricWindows delta/rate/
+quantile/frac_over semantics, alert rule ``for_ticks`` lifecycle with
+``health.alert`` instants, burn-rule compilation from ``slo_classes``
+and the both-windows-must-burn property, doctor ranking + alert-kind
+affinity, ``fleet_top --once --json`` on a synthetic workdir, the
+autoscaler's burn-alert scale-up trigger, and the retired-handle /
+dp-re-push metric surfacing regressions.
+
+Slow+chaos: the acceptance run — a 2-member cross-process pool over a
+replicated van pair with live traffic; a seeded ``netem_degrade`` and
+then a ``van_kill`` must each raise a matching alert IN-FLIGHT (read
+from ``active_alerts()`` while the fault is live, not post-hoc), the
+doctor's top verdict must name the injected fault kind both times, the
+``health.alert`` instants must survive into the merged trace, and
+``fleet_top --once --json`` over the workdir must reflect them.
+"""
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from hetu_tpu import telemetry
+from hetu_tpu.telemetry import fleet, trace
+from hetu_tpu.telemetry.health import (
+    AlertRule, HealthMonitor, MetricWindows, StreamTail, diagnose,
+    slo_burn_rules, tail_streams,
+)
+from hetu_tpu.telemetry.registry import MetricsRegistry
+from hetu_tpu.telemetry.trace import load_jsonl
+
+pytestmark = pytest.mark.health
+
+
+def _append(path, records):
+    with open(path, "a") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def _anchor(ts, wall_us):
+    return {"ph": "M", "name": "clock_sync", "ts": float(ts),
+            "args": {"wall_ns": int(wall_us * 1000)}}
+
+
+def _ctr(v):
+    return {"type": "counter", "value": v}
+
+
+def _hist(buckets, counts):
+    return {"type": "histogram", "buckets": list(buckets),
+            "counts": list(counts), "sum": 0.0, "count": sum(counts),
+            "min": 0.0, "max": 0.0}
+
+
+# ---------------------------------------------------------------------------
+# fast lane: the streaming tail
+# ---------------------------------------------------------------------------
+
+def test_stream_tail_buffers_torn_final_line(tmp_path):
+    """A writer mid-``write`` tears the last line; the tail must hold
+    the fragment and deliver the event intact once its newline lands —
+    the live analogue of load_jsonl's torn-tail tolerance."""
+    p = tmp_path / "m.trace.jsonl"
+    _append(p, [_anchor(0.0, 1_000_000.0)])
+    line = json.dumps({"ph": "i", "name": "a", "ts": 10.0, "pid": 1})
+    with open(p, "a") as f:
+        f.write(line[:10])
+    tail = StreamTail(p)
+    assert tail.poll() == []  # torn: buffered, never mangled
+    with open(p, "a") as f:
+        f.write(line[10:] + "\n")
+    out = tail.poll()
+    assert [e["name"] for e in out] == ["a"]
+    # wall-aligned through the anchor: off = 1e6 - 0
+    assert out[0]["ts"] == pytest.approx(1_000_010.0)
+    assert tail.poll() == []  # delivered once, not re-read
+
+
+def test_events_before_first_anchor_release_retroactively(tmp_path):
+    """An event read before the stream's first ``clock_sync`` has no
+    wall offset yet; it must be HELD and released aligned the moment
+    the anchor lands mid-tail — never handed out on the raw track."""
+    p = tmp_path / "m.trace.jsonl"
+    _append(p, [{"ph": "i", "name": "early", "ts": 5.0, "pid": 2}])
+    tail = StreamTail(p)
+    assert tail.poll() == []  # held, not dropped and not raw
+    _append(p, [_anchor(100.0, 7_000_000.0),
+                {"ph": "i", "name": "late", "ts": 110.0, "pid": 2}])
+    out = tail.poll()
+    assert [e["name"] for e in out] == ["early", "late"]
+    off = 7_000_000.0 - 100.0
+    assert out[0]["ts"] == pytest.approx(5.0 + off)
+    assert out[1]["ts"] == pytest.approx(110.0 + off)
+
+
+def test_reanchor_corrects_drift_beyond_cadence(tmp_path):
+    """Two anchors 40 s of track time apart whose wall offsets disagree
+    by 2 s (a drifting clock, re-anchored past the ~30 s cadence):
+    events after the second anchor must take ITS offset; events between
+    the anchors keep the first — matching merge_streams exactly."""
+    p = tmp_path / "m.trace.jsonl"
+    _append(p, [_anchor(0.0, 1_000_000.0),
+                {"ph": "i", "name": "mid", "ts": 10e6, "pid": 3},
+                _anchor(40e6, 45_000_000.0),   # offset grew 1e6 -> 5e6
+                {"ph": "i", "name": "post", "ts": 41e6, "pid": 3}])
+    tail = StreamTail(p)
+    out = {e["name"]: e["ts"] for e in tail.poll()}
+    assert out["mid"] == pytest.approx(10e6 + 1_000_000.0)
+    assert out["post"] == pytest.approx(41e6 + 5_000_000.0)
+    # the public anchor helpers agree (the tail IS the merge machinery)
+    anchors = fleet.anchors(load_jsonl(p))
+    assert fleet.offset_at(anchors, 10e6) == pytest.approx(1_000_000.0)
+    assert fleet.offset_at(anchors, 41e6) == pytest.approx(5_000_000.0)
+
+
+def test_fleet_tail_remaps_three_way_pid_collision(tmp_path):
+    """Three streams all claiming pid 7 (pid reuse across incarnations)
+    must come out attributed to three DISTINCT pids, +1e6 per collision
+    — same remap rule as merge_streams, so live and post-hoc views of
+    the same run name the same tracks."""
+    for name in ("a", "b", "c"):
+        _append(tmp_path / f"{name}.trace.jsonl", [
+            {"ph": "M", "name": "process_name", "pid": 7,
+             "args": {"name": name}},
+            _anchor(0.0, 1_000_000.0),
+            {"ph": "i", "name": f"ev_{name}", "ts": 1.0, "pid": 7}])
+    ft = tail_streams(tmp_path)
+    evs = [e for e in ft.poll() if e.get("ph") == "i"]
+    assert {e["pid"] for e in evs} == {7, 1_000_007, 2_000_007}
+    assert set(ft.processes) == {7, 1_000_007, 2_000_007}
+    assert sorted(ft.processes.values()) == ["a", "b", "c"]
+
+
+def test_fleet_tail_picks_up_streams_that_appear_later(tmp_path):
+    """A revived member's stream appears mid-run; the next poll must
+    start following it — the fleet is elastic, the tail must be too."""
+    _append(tmp_path / "a.trace.jsonl", [
+        _anchor(0.0, 1e6), {"ph": "i", "name": "x", "ts": 1.0, "pid": 1}])
+    ft = tail_streams(tmp_path)
+    assert len(ft.poll()) == 1
+    _append(tmp_path / "b.trace.jsonl", [
+        _anchor(0.0, 2e6), {"ph": "i", "name": "y", "ts": 1.0, "pid": 2}])
+    out = ft.poll()
+    assert [e["name"] for e in out] == ["y"]
+
+
+# ---------------------------------------------------------------------------
+# fast lane: windowed aggregates
+# ---------------------------------------------------------------------------
+
+def test_metric_windows_since_last_and_windowed_deltas():
+    w = MetricWindows()
+    w.ingest({"req": _ctr(100)}, t=0.0, source="f")
+    # one sample: everything ever counted is the first delta (the
+    # autoscaler's first-tick semantics)
+    assert w.delta("req") == 100.0
+    w.ingest({"req": _ctr(130)}, t=10.0, source="f")
+    assert w.delta("req") == 30.0            # since previous sample
+    assert w.delta("req", 100.0) == 130.0    # young series: everything
+    assert w.rate("req", 10.0) == pytest.approx(3.0)
+    assert w.value("req") == 130.0
+    # a restarted incarnation resets the counter: clamped, never
+    # negative load
+    w.ingest({"req": _ctr(5)}, t=20.0, source="f")
+    assert w.delta("req") == 0.0
+    assert w.value("missing") is None
+
+
+def test_metric_windows_hist_delta_frac_over_and_quantile():
+    w = MetricWindows()
+    b = (0.1, 0.5)
+    w.ingest({"lat": _hist(b, [10, 0, 0])}, t=0.0)
+    w.ingest({"lat": _hist(b, [10, 0, 6])}, t=5.0)
+    assert w.hist_delta("lat") == ([0.1, 0.5], [0, 0, 6])
+    assert w.frac_over("lat", 0.5) == 1.0
+    # widen past both samples: the old 10 fast observations dilute
+    assert w.frac_over("lat", 0.5, window_s=100.0) == pytest.approx(
+        6 / 16)
+    # threshold inside a bucket: the containing bucket counts as over
+    # (conservative — alerts err toward paging)
+    w2 = MetricWindows()
+    w2.ingest({"lat": _hist(b, [4, 4, 0])}, t=0.0)
+    assert w2.frac_over("lat", 0.25) == 0.5
+    assert w2.quantile("lat", 0.99) == pytest.approx(0.5)
+    assert w.frac_over("nope", 0.5) is None
+
+
+def test_metric_windows_ingest_events_per_pid_series():
+    w = MetricWindows()
+    w.ingest_events([
+        {"ph": "M", "name": "hetu_metrics", "ts": 1e6, "pid": 9,
+         "args": {"metrics": {"req": _ctr(5)}}},
+        {"ph": "M", "name": "hetu_metrics", "ts": 2e6, "pid": 11,
+         "args": {"metrics": {"req": _ctr(7)}}},
+        {"ph": "i", "name": "not_metrics", "ts": 3e6, "pid": 9},
+    ])
+    assert sorted(w.sources()) == [9, 11]
+    assert w.value("req") == 12.0            # summed across sources
+    assert w.value("req", source=9) == 5.0
+
+
+# ---------------------------------------------------------------------------
+# fast lane: rules + monitor lifecycle
+# ---------------------------------------------------------------------------
+
+def test_health_monitor_for_ticks_fire_resolve_and_instants(tmp_path):
+    vals = {"v": 0}
+    rule = AlertRule("link_degraded", "delta('ctrl.links_degraded')",
+                     0.0, window_s=5.0, for_ticks=2,
+                     fault_kinds=("netem_degrade",))
+    reg = MetricsRegistry()
+    mon = HealthMonitor(
+        [rule], source=lambda: {"ctrl.links_degraded": _ctr(vals["v"])},
+        registry=reg)
+    telemetry.enable(jsonl_path=str(tmp_path / "mon.trace.jsonl"))
+    try:
+        assert mon.tick(now=0.0)["fired"] == []      # baseline
+        vals["v"] = 1
+        r = mon.tick(now=1.0)                        # breach 1: pending
+        assert r["fired"] == [] and mon.active_alerts() == []
+        vals["v"] = 2
+        r = mon.tick(now=2.0)                        # breach 2: fires
+        assert r["fired"] == ["link_degraded"]
+        act = mon.active_alerts()
+        assert act[0]["rule"] == "link_degraded"
+        assert act[0]["severity"] == "warn"
+        assert reg.gauge("health.alerts_active").value == 1.0
+        # quiet long enough for the 5 s window to pass the last bump
+        r = mon.tick(now=20.0)
+        assert r["resolved"] == ["link_degraded"]
+        assert mon.active_alerts() == []
+        assert reg.counter("health.alerts_fired").value == 1
+        assert reg.counter("health.alerts_resolved").value == 1
+        assert reg.gauge("health.alerts_active").value == 0.0
+    finally:
+        telemetry.disable()
+    evs = load_jsonl(tmp_path / "mon.trace.jsonl")
+    alerts = [e for e in evs if e.get("name") == "health.alert"]
+    assert [e["args"]["state"] for e in alerts] == ["firing", "resolved"]
+    assert alerts[0]["args"]["rule"] == "link_degraded"
+
+
+def test_slo_burn_rules_fire_only_when_both_windows_burn():
+    rules = slo_burn_rules(
+        {"gold": {"priority": 1, "weight": 4.0, "ttft_slo_s": 0.25},
+         "free": {"priority": 0, "weight": 1.0, "ttft_slo_s": None}},
+        windows=(5.0, 20.0))
+    # one rule per class WITH a latency budget; None has none to burn
+    assert [r.name for r in rules] == ["slo_burn.gold"]
+    r = rules[0]
+    assert r.labels == {"tenant": "gold"} and r.severity == "page"
+    b = (0.25, 1.0)
+    name = "tenant.gold.ttft_s"
+    # fresh spike: breaches in BOTH windows -> burn >> factor
+    w = MetricWindows()
+    w.ingest({name: _hist(b, [100, 0, 0])}, t=0.0)
+    w.ingest({name: _hist(b, [100, 40, 0])}, t=18.0)
+    v = r.evaluate(w)
+    assert v is not None and v > r.threshold
+    # stale blip: outside the short window -> no short-burn evidence,
+    # the rule stays quiet (the fast-burn pair suppresses old noise)
+    w2 = MetricWindows()
+    w2.ingest({name: _hist(b, [0, 0, 0])}, t=0.0)
+    w2.ingest({name: _hist(b, [0, 40, 0])}, t=1.0)
+    w2.ingest({name: _hist(b, [0, 40, 0])}, t=18.0)
+    assert w2.frac_over(name, 0.25, 5.0) is None
+    assert r.evaluate(w2) is None
+
+
+# ---------------------------------------------------------------------------
+# fast lane: the doctor
+# ---------------------------------------------------------------------------
+
+def test_diagnose_ranks_injected_fault_with_recovery_pairing():
+    events = [
+        {"ph": "i", "name": "fault.netem_degrade", "ts": 90e6,
+         "args": {"kind": "netem_degrade", "member": 2}},
+        {"ph": "X", "name": "serve.link_degraded", "ts": 91e6,
+         "dur": 4.2e6, "args": {"member": 2}},
+        {"ph": "i", "name": "route.park", "ts": 92e6,
+         "args": {"rid": 1}},
+        {"ph": "i", "name": "route.park", "ts": 92.5e6,
+         "args": {"rid": 2}},
+        {"ph": "i", "name": "membership.event", "ts": 93e6,
+         "args": {"kind": "suspect", "member": 2}},
+    ]
+    alert = AlertRule("shed_spike", None,
+                      fault_kinds=("netem_degrade", "member_kill"))
+    d = diagnose(events, alert=alert, now_us=100e6)
+    assert d["top"]["kind"] == "netem_degrade"
+    assert d["top"]["member"] == 2
+    # the RECOVERY_FOR pairing made it into the verdict text
+    assert "serve.link_degraded closed 5.2s after injection" in \
+        d["top"]["text"]
+    assert d["top"]["text"].startswith("shed_spike ← netem_degrade "
+                                       "on member 2")
+    kinds = [v["kind"] for v in d["verdicts"]]
+    assert len(kinds) == len(set(kinds))  # one verdict per cause kind
+    assert "routing_stall" in kinds       # the noise ranked, not lost
+    assert diagnose([], alert=alert) is None
+
+
+def test_diagnose_alert_affinity_disambiguates_sequential_faults():
+    """Two faults on the recent timeline: which one an alert blames
+    must follow the alert's declared fault_kinds, not just recency —
+    that is what keeps a van_kill alert from blaming the fresher netem
+    fault during a sequential-fault chaos run."""
+    events = [
+        {"ph": "i", "name": "fault.van_kill", "ts": 80e6,
+         "args": {"kind": "van_kill", "van": 0}},
+        {"ph": "i", "name": "fault.netem_degrade", "ts": 90e6,
+         "args": {"kind": "netem_degrade", "member": 1}},
+    ]
+    link = AlertRule("link_degraded", None,
+                     fault_kinds=("netem_degrade", "netem_partition"))
+    van = AlertRule("van_failover", None, fault_kinds=("van_kill",))
+    d_link = diagnose(events, alert=link, now_us=95e6)
+    d_van = diagnose(events, alert=van, now_us=95e6)
+    assert d_link["top"]["kind"] == "netem_degrade"
+    assert d_van["top"]["kind"] == "van_kill"
+
+
+# ---------------------------------------------------------------------------
+# fast lane: fleet_top snapshot
+# ---------------------------------------------------------------------------
+
+def _synthetic_health_workdir(tmp_path):
+    _append(tmp_path / "member.trace.jsonl", [
+        {"ph": "M", "name": "process_name", "pid": 9,
+         "args": {"name": "member:0"}},
+        _anchor(0.0, 2_000_000_000.0),
+        {"ph": "M", "name": "hetu_metrics", "ts": 1e6, "pid": 9,
+         "args": {"metrics": {
+             "requests_submitted": _ctr(5),
+             "queue_depth": {"type": "gauge", "value": 2.0},
+             "ttft_s": _hist((0.1, 0.5), [4, 1, 0])}}},
+        {"ph": "M", "name": "hetu_metrics", "ts": 6e6, "pid": 9,
+         "args": {"metrics": {
+             "requests_submitted": _ctr(25),
+             "queue_depth": {"type": "gauge", "value": 3.0},
+             "ttft_s": _hist((0.1, 0.5), [20, 5, 0])}}},
+        {"ph": "i", "name": "health.alert", "ts": 7e6, "pid": 9,
+         "args": {"rule": "link_degraded", "state": "firing",
+                  "severity": "warn", "value": 1.0, "threshold": 0.0,
+                  "window_s": 10.0}},
+        {"ph": "i", "name": "health.diagnosis", "ts": 7.1e6, "pid": 9,
+         "args": {"alert": "link_degraded", "kind": "netem_degrade",
+                  "top": "link_degraded ← netem_degrade on member 1 ← "
+                         "serve.link_degraded open 4.2s"}},
+    ])
+
+
+def test_fleet_top_once_json_snapshot(tmp_path, capsys):
+    from tools import fleet_top
+    _synthetic_health_workdir(tmp_path)
+    rc = fleet_top.main([str(tmp_path), "--once", "--json",
+                         "--window", "30"])
+    assert rc == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["processes"] == {"9": "member:0"}
+    [m] = snap["members"]
+    assert m["name"] == "member:0" and m["requests"] == 25.0
+    assert m["queue_depth"] == 3.0
+    # the 30 s window predates the first dump -> full 25 requests,
+    # rated over the 5 s actually observed
+    assert m["qps"] == pytest.approx(5.0)
+    assert m["ttft_p50_ms"] is not None
+    [a] = snap["alerts"]
+    assert a["rule"] == "link_degraded" and a["state"] == "firing"
+    assert snap["diagnosis"]["kind"] == "netem_degrade"
+
+
+def test_fleet_top_once_text_render_and_bad_dir(tmp_path, capsys):
+    from tools import fleet_top
+    _synthetic_health_workdir(tmp_path)
+    assert fleet_top.main([str(tmp_path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "ACTIVE ALERTS (1):" in out
+    assert "link_degraded" in out and "netem_degrade" in out
+    assert fleet_top.main([str(tmp_path / "nope"), "--once"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# fast lane: the autoscaler's burn-alert trigger
+# ---------------------------------------------------------------------------
+
+class _StubMonitor:
+    def __init__(self):
+        self.alerts = []
+
+    def active_alerts(self):
+        return list(self.alerts)
+
+
+class _FakeReg:
+    def __init__(self, d):
+        self._d = d
+
+    def dump(self):
+        return dict(self._d)
+
+
+class _FakePool:
+    n_members = 2
+
+    def __init__(self):
+        self.dump = {}
+        self.revived = []
+
+    def fleet_metrics(self, scrape=True):
+        return _FakeReg(self.dump)
+
+    def revive_member(self, slot):
+        self.revived.append(slot)
+
+    def drain_member(self, slot, close=True):
+        pass
+
+
+def test_autoscaler_scales_up_on_burn_alert_with_named_reason():
+    """The tentpole rewire: with a HealthMonitor present, the SLO
+    scale-up trigger is "a tenant-labelled burn-rate alert is firing" —
+    the hand-coded p99-vs-budget comparison is gone from that path, and
+    the decision record names the shared alerting definition."""
+    from hetu_tpu.traffic.autoscale import AutoscalePolicy, Autoscaler
+    pool, mon, now = _FakePool(), _StubMonitor(), [0.0]
+    sc = Autoscaler(
+        pool, AutoscalePolicy(min_members=1, max_members=2, up_ticks=2,
+                              up_cooldown_s=0.0, queue_high=1e9,
+                              shed_high=1e9),
+        active={0}, clock=lambda: now[0], monitor=mon)
+    assert sc.tick()["action"] == "hold"
+    mon.alerts = [{"rule": "slo_burn.gold", "severity": "page",
+                   "value": 40.0, "threshold": 14.4, "since": 0.0,
+                   "labels": {"tenant": "gold"},
+                   "fault_kinds": ("netem_degrade",)},
+                  {"rule": "van_failover", "severity": "page",
+                   "value": 1.0, "threshold": 0.0, "since": 0.0,
+                   "labels": {}, "fault_kinds": ("van_kill",)}]
+    now[0] = 1.0
+    assert sc.tick()["action"] == "hold"   # hysteresis: streak 1 of 2
+    now[0] = 2.0
+    rec = sc.tick()
+    assert rec["action"] == "up"
+    assert rec["reason"] == "slo_burn:gold"  # tenant-labelled alerts
+    # only — the unlabelled van_failover alert is not a load signal
+    assert rec["slo_breaches"] == {"gold": 40.0}
+    assert pool.revived == [1]
+    # the alert resolves -> the vote disappears with it
+    mon.alerts = []
+    now[0] = 3.0
+    assert sc.tick()["action"] == "hold"
+
+
+def test_autoscaler_adopts_pool_health_monitor_lazily():
+    """Starting the pool's monitor upgrades a LIVE autoscaler's trigger
+    — the loop reads ``pool.health_monitor`` at signal time, so no
+    construction-order coupling."""
+    from hetu_tpu.traffic.autoscale import AutoscalePolicy, Autoscaler
+    pool = _FakePool()
+    sc = Autoscaler(pool, AutoscalePolicy(min_members=1, max_members=2),
+                    active={0}, clock=lambda: 0.0)
+    assert sc.read_signals({}).burn_driven is False  # legacy path
+    pool.health_monitor = _StubMonitor()
+    sig = sc.read_signals({})
+    assert sig.burn_driven is True and sig.slo_breaches == {}
+
+
+# ---------------------------------------------------------------------------
+# fast lane: metric-surfacing regressions (satellites)
+# ---------------------------------------------------------------------------
+
+def test_retired_handle_gauge_returns_to_zero_after_grace():
+    """``van.replica.floating_handles`` counts handles parked in the
+    retire-grace window; after the grace lapses and a reaper pass runs,
+    the gauge must read 0 again — a leak here is the fd-recycle bug's
+    early-warning light."""
+    from hetu_tpu.ps import replica as rep
+
+    class _H:
+        closed = False
+
+        def close(self):
+            self.closed = True
+
+    h = _H()
+    rep.retire_handle(h, grace_s=0.05)
+    g = telemetry.default_registry.gauge("van.replica.floating_handles")
+    assert g.value >= 1.0
+    assert not h.closed  # grace: a stale reference may still be inside
+    time.sleep(0.1)
+    rep._reap_retired()
+    assert h.closed
+    assert g.value == 0.0
+
+
+def test_dp_repush_counter_rides_the_durable_tier_fold():
+    """``ps.dp_repush_duplicates`` (the dp plane's at-least-once
+    re-push after a van failover) lives in the process-default registry
+    under a prefix both the member harness and the controller fold into
+    ``fleet_metrics()`` — an operator can bound how non-idempotent a
+    chaotic run was without grepping consumption logs."""
+    from hetu_tpu.serve.crosshost import MemberHarness
+    name = "ps.dp_repush_duplicates"
+    assert name.startswith(tuple(MemberHarness._DURABLE_TIER_METRICS))
+    before = telemetry.default_registry.counter(name).value
+    telemetry.default_registry.counter(name).inc(2)
+    reg = MetricsRegistry()
+    reg.merge({k: v for k, v in telemetry.default_registry.dump().items()
+               if k.startswith(MemberHarness._DURABLE_TIER_METRICS)},
+              prefix="ctrl.")
+    assert reg.counter(f"ctrl.{name}").value == before + 2
+
+
+# ---------------------------------------------------------------------------
+# slow+chaos: the ISSUE 19 acceptance run
+# ---------------------------------------------------------------------------
+
+from hetu_tpu.ps import available  # noqa: E402
+from hetu_tpu.ps import membership as mb  # noqa: E402
+
+needs_lib = pytest.mark.skipif(not available(),
+                               reason="native PS lib unavailable")
+
+TINY = {"vocab_size": 89, "hidden_size": 48, "num_layers": 2,
+        "num_heads": 4, "ffn_size": 96, "max_position": 64,
+        "num_slots": 6, "max_len": 48, "min_bucket": 8, "seed": 1}
+
+
+def _van_pair(tmp_path):
+    from hetu_tpu.resilience.shardproc import (
+        free_port, spawn_shard_server,
+    )
+    p1, p2 = free_port(), free_port()
+    v1 = spawn_shard_server(tmp_path, p1, tag="prim")
+    v2 = spawn_shard_server(tmp_path, p2, tag="back")
+    spec = {"endpoints": [["127.0.0.1", p1], ["127.0.0.1", p2]],
+            "epoch_table": mb.fresh_table_id(),
+            "promote_after_s": 0.3, "rcv_timeout_s": 1.5}
+    return v1, v2, p1, p2, spec
+
+
+def _reap(procs, workdir):
+    import signal
+    import subprocess
+    for p in procs:
+        if p is not None and p.poll() is None:
+            try:
+                p.send_signal(signal.SIGCONT)
+            except Exception:
+                pass
+            p.kill()
+            p.wait()
+    subprocess.run(["pkill", "-9", "-f", str(workdir)],
+                   capture_output=True, timeout=10)
+
+
+@needs_lib
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.crosshost
+def test_health_acceptance_inflight_alerts_for_two_faults(tmp_path,
+                                                          capsys):
+    """2-member pool over a replicated van pair, live gold traffic,
+    monitor hosted on the controller.  Seeded ``netem_degrade`` then
+    ``van_kill``: each must raise its matching alert while the fault is
+    LIVE, the doctor must name the injected kind both times, the
+    ``health.alert`` instants must land in the merged trace, and
+    ``fleet_top --once --json`` must reflect them."""
+    from hetu_tpu.serve.crosshost import CrossProcessServingPool
+    from tools import fleet_top
+
+    v1, v2, p1, p2, spec = _van_pair(tmp_path)
+    trace.open_process_stream(tmp_path, "controller")
+    pool = None
+    stop = threading.Event()
+    try:
+        pool = CrossProcessServingPool(
+            2, workdir=tmp_path, model=TINY, own_van=False, port=p1,
+            van_spec=spec, scrape_s=0.2, lease_s=0.6,
+            suspect_grace_s=0.5, request_timeout_s=120.0,
+            slo_classes={"gold": {"priority": 1, "weight": 4.0,
+                                  "ttft_slo_s": 0.25}},
+            member_env={"JAX_PLATFORMS": "cpu"})
+        mon = pool.start_health_monitor(
+            interval_s=0.2, history_s=60.0,
+            burn_windows=(2.0, 8.0), window_s=5.0)
+        assert pool.health_monitor is mon
+        with pytest.raises(RuntimeError):
+            pool.start_health_monitor()  # one monitor per controller
+
+        results = []
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                try:
+                    r = pool.generate([(i % 7) + 1, 3, 5], max_tokens=4,
+                                      timeout_s=120.0, tenant="gold")
+                    results.append(r["status"])
+                except Exception:
+                    if stop.is_set():
+                        return
+                i += 1
+
+        threads = [threading.Thread(target=traffic, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        # real serving (and RTT floors on both links) before fault 1
+        deadline = time.monotonic() + 120
+        while len(results) < 4 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert len(results) >= 4, "traffic never started"
+
+        def active(rule):
+            return any(a["rule"] == rule for a in mon.active_alerts())
+
+        def wait_for(pred, timeout_s, what):
+            deadline = time.monotonic() + timeout_s
+            while not pred() and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert pred(), what
+
+        # ---- fault 1: gray link on member 1, alert IN-FLIGHT ----
+        trace.instant("fault.netem_degrade",
+                      {"kind": "netem_degrade", "member": 1},
+                      cat="fault")
+        pool.apply_net_fault("netem_degrade", 1, 5.0)
+        wait_for(lambda: active("link_degraded"), 30,
+                 "link_degraded never fired during the live fault")
+        wait_for(lambda: (mon.last_diagnosis or {}).get("top", {})
+                 .get("kind") == "netem_degrade", 15,
+                 f"doctor missed the netem: {mon.last_diagnosis}")
+        assert "netem_degrade" in mon.last_diagnosis["top"]["text"]
+
+        # let the link heal so fault 2 starts from a recovered fleet
+        deadline = time.monotonic() + 40
+        while pool.metrics.count("links_recovered") < 1 and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+
+        # ---- fault 2: kill the primary van, alert IN-FLIGHT ----
+        trace.instant("fault.van_kill", {"kind": "van_kill", "van": 0},
+                      cat="fault")
+        v1.kill()
+        v1.wait()
+        wait_for(lambda: active("van_failover"), 45,
+                 "van_failover never fired during the live fault")
+        wait_for(lambda: (mon.last_diagnosis or {}).get("alert")
+                 in ("van_failover", "route_stall") and
+                 mon.last_diagnosis["top"]["kind"] == "van_kill", 15,
+                 f"doctor missed the van kill: {mon.last_diagnosis}")
+
+        # traffic survived both faults
+        stop.set()
+        for t in threads:
+            t.join(120)
+        assert "ok" in results
+
+        # alert state rides fleet_metrics() under ctrl.health.*, and
+        # the dp-re-push duplicate counter surfaces beside it
+        telemetry.default_registry.counter(
+            "ps.dp_repush_duplicates").inc()
+        fl = pool.fleet_metrics(timeout_s=8.0)
+        assert fl.counter("ctrl.health.alerts_fired").value >= 2
+        assert fl.counter("ctrl.health.diagnoses").value >= 2
+        assert fl.counter("ctrl.ps.dp_repush_duplicates").value >= 1
+    finally:
+        stop.set()
+        if pool is not None:
+            pool.close()
+        trace.disable()
+        _reap([v1, v2], tmp_path)
+
+    # ---- the alerts are themselves telemetry: merged trace has them
+    events, _ = fleet.merge_streams(tmp_path)
+    transitions = {(e["args"]["rule"], e["args"]["state"])
+                   for e in events if e.get("name") == "health.alert"}
+    assert ("link_degraded", "firing") in transitions
+    assert ("van_failover", "firing") in transitions
+    diag_kinds = {e["args"]["kind"] for e in events
+                  if e.get("name") == "health.diagnosis"}
+    assert {"netem_degrade", "van_kill"} <= diag_kinds
+    # ---- and fleet_top sees the same run post-hoc ----
+    assert fleet_top.main([str(tmp_path), "--once", "--json"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert {"link_degraded", "van_failover"} <= set(snap["alerts_seen"])
+    assert snap["diagnosis"] is not None

@@ -40,7 +40,7 @@ PIDFILE = REPO / ".bench_watch.pid"
 CMDS = ["gpt", "resnet", "ctr", "moe", "elastic", "telemetry", "migrate",
         "netchaos", "mpmd", "ctrlchaos", "vanchaos", "soak", "paged",
         "obs", "quant", "ctr_serve", "crosshost", "autoscale",
-        "gpt_sweep"]
+        "health", "gpt_sweep"]
 # gpt_sweep last: the headline matrix captures first; the sweep then maps
 # the MFU residual (attention head-dim, CE head, remat cost) in the same
 # tunnel window

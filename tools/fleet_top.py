@@ -1,0 +1,155 @@
+#!/usr/bin/env python3
+"""Live fleet dashboard over the streaming telemetry tail.
+
+``python tools/fleet_top.py RUNDIR`` follows every process span stream
+under RUNDIR (the pool workdir) and refreshes a terminal view of fleet
+health: per-member QPS / queue depth / TTFT percentiles read from the
+``hetu_metrics`` black-box records, the alerts the in-process
+``HealthMonitor`` emitted as ``health.alert`` instants (firing minus
+resolved = active), and the doctor's last ``health.diagnosis``.
+
+Nothing here talks to the controller: the dashboard is a pure stream
+reader, so it works on a live run, over ssh on a copied workdir, or on
+the corpse of a crashed one.  ``--once --json`` prints a single
+machine-readable snapshot and exits (scripting / CI assertions).
+"""
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from hetu_tpu.telemetry.health import (  # noqa: E402
+    MetricWindows, tail_streams,
+)
+
+
+def build_state(workdir):
+    """(tail, windows, alert-state dict) for one dashboard session."""
+    return tail_streams(workdir), MetricWindows(), {}
+
+
+def ingest(tail, win, alerts, events=None) -> dict:
+    """Advance the tail one poll; fold metric dumps into the windows
+    and ``health.*`` instants into the alert state (last record per
+    rule wins).  Returns the latest diagnosis seen (or {})."""
+    evs = tail.poll() if events is None else events
+    win.ingest_events(evs)
+    diagnosis = {}
+    for ev in evs:
+        name = ev.get("name")
+        if name == "health.alert":
+            a = dict(ev.get("args") or {})
+            a["ts"] = ev.get("ts")
+            alerts[a.get("rule", "?")] = a
+        elif name == "health.diagnosis":
+            diagnosis = dict(ev.get("args") or {})
+            diagnosis["ts"] = ev.get("ts")
+    return diagnosis
+
+
+def snapshot(tail, win, alerts, diagnosis, *, window_s: float) -> dict:
+    members = []
+    for pid in sorted(win.sources()):
+        name = tail.processes.get(pid, f"pid{pid}")
+        ttft_p50 = win.quantile("ttft_s", 0.50, window_s, source=pid)
+        ttft_p99 = win.quantile("ttft_s", 0.99, window_s, source=pid)
+        members.append({
+            "pid": pid, "name": name,
+            "qps": round(win.rate("requests_submitted", window_s,
+                                  source=pid), 3),
+            "queue_depth": win.value("queue_depth", source=pid),
+            "requests": win.value("requests_submitted", source=pid),
+            "ttft_p50_ms": None if ttft_p50 is None
+            else round(ttft_p50 * 1e3, 3),
+            "ttft_p99_ms": None if ttft_p99 is None
+            else round(ttft_p99 * 1e3, 3),
+        })
+    active = sorted((a for a in alerts.values()
+                     if a.get("state") == "firing"),
+                    key=lambda a: (a.get("severity") != "page",
+                                   a.get("rule", "")))
+    return {"workdir": str(tail.run_dir),
+            "processes": {str(k): v for k, v in tail.processes.items()},
+            "members": members,
+            "alerts": active,
+            "alerts_seen": sorted(alerts),
+            "diagnosis": diagnosis or None}
+
+
+def render(snap: dict) -> str:
+    lines = [f"fleet_top — {snap['workdir']}",
+             f"{len(snap['processes'])} process stream(s)", ""]
+    lines.append(f"{'process':<28} {'qps':>7} {'queue':>6} "
+                 f"{'p50 ttft':>10} {'p99 ttft':>10} {'reqs':>7}")
+    for m in snap["members"]:
+        def fmt(v, suffix=""):
+            return "-" if v is None else f"{v}{suffix}"
+        lines.append(
+            f"{m['name'][:27]:<28} {m['qps']:>7} "
+            f"{fmt(m['queue_depth']):>6} "
+            f"{fmt(m['ttft_p50_ms'], 'ms'):>10} "
+            f"{fmt(m['ttft_p99_ms'], 'ms'):>10} "
+            f"{fmt(m['requests']):>7}")
+    lines.append("")
+    if snap["alerts"]:
+        lines.append(f"ACTIVE ALERTS ({len(snap['alerts'])}):")
+        for a in snap["alerts"]:
+            lines.append(f"  [{a.get('severity', '?'):>4}] "
+                         f"{a.get('rule')}  value="
+                         f"{a.get('value')} > {a.get('threshold')}")
+    else:
+        lines.append("no active alerts")
+    diag = snap.get("diagnosis")
+    if diag:
+        lines.append("")
+        lines.append(f"last diagnosis: {diag.get('top')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live terminal dashboard over a fleet's span "
+                    "streams (see hetu_tpu/telemetry/health.py)")
+    ap.add_argument("workdir", help="pool workdir holding "
+                                    "*.trace.jsonl streams")
+    ap.add_argument("--once", action="store_true",
+                    help="one snapshot, no refresh loop")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the snapshot as JSON")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh cadence in seconds (default 1.0)")
+    ap.add_argument("--window", type=float, default=10.0,
+                    help="aggregation window in seconds (default 10)")
+    args = ap.parse_args(argv)
+    if not Path(args.workdir).is_dir():
+        print(f"not a directory: {args.workdir}", file=sys.stderr)
+        return 2
+    tail, win, alerts = build_state(args.workdir)
+    diagnosis = {}
+    while True:
+        d = ingest(tail, win, alerts)
+        diagnosis = d or diagnosis
+        snap = snapshot(tail, win, alerts, diagnosis,
+                        window_s=args.window)
+        if args.as_json:
+            out = json.dumps(snap, default=str)
+        else:
+            out = render(snap)
+        if args.once:
+            print(out)
+            return 0
+        # full-screen refresh: clear + home, then the frame
+        sys.stdout.write("\x1b[2J\x1b[H" + out + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(max(args.interval, 0.1))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
